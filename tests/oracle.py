@@ -1,0 +1,53 @@
+"""Scalar oracle: per-index Ballot semantics exactly as the reference
+implements them (core:entity/Ballot, core:core/BallotBox) — used to
+property-test the vectorized order-statistic kernels against.
+"""
+
+from __future__ import annotations
+
+
+class OracleBallot:
+    """One pending log index's quorum tracker (reference: Ballot#grant)."""
+
+    def __init__(self, voters: set[int], old_voters: set[int] | None = None):
+        self.voters = set(voters)
+        self.old_voters = set(old_voters) if old_voters else set()
+        self.granted: set[int] = set()
+
+    def grant(self, peer: int) -> None:
+        self.granted.add(peer)
+
+    def is_granted(self) -> bool:
+        new_ok = len(self.granted & self.voters) >= len(self.voters) // 2 + 1
+        if not self.old_voters:
+            return new_ok
+        old_ok = len(self.granted & self.old_voters) >= len(self.old_voters) // 2 + 1
+        return new_ok and old_ok
+
+
+def oracle_commit_index(
+    match: dict[int, int],
+    voters: set[int],
+    old_voters: set[int] | None,
+    pending_index: int,
+    last_log_index: int,
+    current_commit: int,
+) -> int:
+    """Reference BallotBox#commitAt semantics, brute force:
+
+    walk indexes [pending_index .. last_log_index]; index i commits iff a
+    quorum of voters (and of old voters, in joint mode) have match >= i.
+    Commit stops at the first non-granted index (ballots are consumed in
+    order) and never regresses below current_commit.
+    """
+    commit = current_commit
+    for i in range(pending_index, last_log_index + 1):
+        b = OracleBallot(voters, old_voters)
+        for p, m in match.items():
+            if m >= i:
+                b.grant(p)
+        if b.is_granted():
+            commit = max(commit, i)
+        else:
+            break
+    return commit

@@ -1,0 +1,140 @@
+"""Unit tests: PeerId/LogId/LogEntry codec, Configuration, Status.
+
+Mirrors the reference's pure-unit tier (SURVEY.md §5): test:entity/*,
+test:conf/ConfigurationTest.
+"""
+
+import pytest
+
+from tpuraft.conf import Configuration, ConfigurationEntry, ConfigurationManager
+from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
+from tpuraft.errors import RaftError, Status
+
+
+class TestPeerId:
+    def test_parse_roundtrip(self):
+        for s in ["127.0.0.1:8080", "10.0.0.1:9000:3", "10.0.0.1:9000:0:50"]:
+            p = PeerId.parse(s)
+            assert PeerId.parse(str(p)) == p
+
+    def test_fields(self):
+        p = PeerId.parse("10.1.2.3:8081:2:100")
+        assert (p.ip, p.port, p.idx, p.priority) == ("10.1.2.3", 8081, 2, 100)
+        assert p.endpoint == "10.1.2.3:8081"
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            PeerId.parse("no-port")
+        with pytest.raises(ValueError):
+            PeerId.parse("a:1:2:3:4")
+
+    def test_empty(self):
+        assert PeerId().is_empty()
+        assert not PeerId.parse("1.1.1.1:80").is_empty()
+
+
+class TestLogId:
+    def test_order_by_index(self):
+        assert LogId(5, 1) > LogId(4, 9)
+
+    def test_newer_than_term_first(self):
+        assert LogId(4, 9).newer_than(LogId(5, 1))
+        assert not LogId(5, 1).newer_than(LogId(4, 9))
+        assert LogId(6, 2).newer_than(LogId(5, 2))
+
+
+class TestLogEntryCodec:
+    def test_data_roundtrip(self):
+        e = LogEntry(type=EntryType.DATA, id=LogId(42, 7), data=b"hello raft")
+        d = LogEntry.decode(e.encode())
+        assert d.type == EntryType.DATA
+        assert d.id == LogId(42, 7)
+        assert d.data == b"hello raft"
+        assert d.peers is None
+
+    def test_conf_roundtrip(self):
+        peers = [PeerId.parse("1.1.1.1:80"), PeerId.parse("2.2.2.2:80:1")]
+        old = [PeerId.parse("3.3.3.3:80")]
+        e = LogEntry(
+            type=EntryType.CONFIGURATION,
+            id=LogId(10, 2),
+            peers=peers,
+            old_peers=old,
+            learners=[PeerId.parse("4.4.4.4:80")],
+        )
+        d = LogEntry.decode(e.encode())
+        assert d.peers == peers
+        assert d.old_peers == old
+        assert d.learners == [PeerId.parse("4.4.4.4:80")]
+        assert d.old_learners is None
+
+    def test_crc_detects_corruption(self):
+        raw = bytearray(LogEntry(type=EntryType.DATA, id=LogId(1, 1), data=b"x" * 100).encode())
+        raw[-3] ^= 0xFF
+        with pytest.raises(ValueError, match="crc"):
+            LogEntry.decode(bytes(raw))
+
+    def test_encoded_size(self):
+        e = LogEntry(type=EntryType.DATA, id=LogId(1, 1), data=b"abc")
+        assert e.encoded_size() == len(e.encode())
+
+
+class TestConfiguration:
+    def test_parse_and_str(self):
+        c = Configuration.parse("1.1.1.1:80,2.2.2.2:81,3.3.3.3:82/learner")
+        assert len(c.peers) == 2 and len(c.learners) == 1
+        assert Configuration.parse(str(c)) == c
+
+    def test_quorum(self):
+        assert Configuration.parse("a" * 0 + "1.1.1.1:1").quorum() == 1
+        assert Configuration.parse("1.1.1.1:1,1.1.1.1:2,1.1.1.1:3").quorum() == 2
+        assert Configuration.parse("1.1.1.1:1,1.1.1.1:2,1.1.1.1:3,1.1.1.1:4").quorum() == 3
+
+    def test_diff(self):
+        a = Configuration.parse("1.1.1.1:1,1.1.1.1:2")
+        b = Configuration.parse("1.1.1.1:2,1.1.1.1:3")
+        added, removed = a.diff(b)
+        assert added == {PeerId.parse("1.1.1.1:3")}
+        assert removed == {PeerId.parse("1.1.1.1:1")}
+
+    def test_valid(self):
+        c = Configuration.parse("1.1.1.1:1,1.1.1.1:1")
+        assert not c.is_valid()
+        c2 = Configuration.parse("1.1.1.1:1,1.1.1.1:2/learner")
+        assert c2.is_valid()
+        c2.learners.append(PeerId.parse("1.1.1.1:1"))
+        assert not c2.is_valid()
+
+
+class TestConfigurationManager:
+    def test_get_at_index(self):
+        m = ConfigurationManager()
+        c1 = ConfigurationEntry(LogId(5, 1), Configuration.parse("1.1.1.1:1"))
+        c2 = ConfigurationEntry(LogId(9, 2), Configuration.parse("1.1.1.1:1,1.1.1.1:2"))
+        assert m.add(c1) and m.add(c2)
+        assert not m.add(c1)  # non-monotonic rejected
+        assert m.get(7).id.index == 5
+        assert m.get(100).id.index == 9
+        assert m.get(1).id.index == 0  # falls to snapshot conf
+        assert m.last().id.index == 9
+
+    def test_truncate(self):
+        m = ConfigurationManager()
+        m.add(ConfigurationEntry(LogId(5, 1), Configuration.parse("1.1.1.1:1")))
+        m.add(ConfigurationEntry(LogId(9, 2), Configuration.parse("1.1.1.1:2")))
+        m.truncate_suffix(8)
+        assert m.last().id.index == 5
+        m.truncate_prefix(6)
+        assert m.last().id.index == 0
+
+
+class TestStatus:
+    def test_ok(self):
+        assert Status.OK().is_ok()
+        assert bool(Status.OK())
+
+    def test_error(self):
+        s = Status.error(RaftError.ERAFTTIMEDOUT)
+        assert not s.is_ok()
+        assert s.raft_error is RaftError.ERAFTTIMEDOUT
+        assert "ERAFTTIMEDOUT" in str(s)

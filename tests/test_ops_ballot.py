@@ -1,0 +1,160 @@
+"""Property tests: vectorized ballot kernels vs the scalar per-index
+Ballot oracle (reference semantics), per SURVEY.md §8 build order step 2.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpuraft.ops.ballot import (  # noqa: E402
+    NEG_INF_I32,
+    joint_quorum_match_index,
+    joint_vote_quorum,
+    quorum_match_index,
+    vote_quorum,
+)
+from tests.oracle import OracleBallot, oracle_commit_index  # noqa: E402
+
+
+def _oracle_quorum_match(match_row, voters):
+    """Largest i such that |{p in voters: match[p] >= i}| >= quorum; the
+    oracle form: q-th largest voter matchIndex."""
+    vals = sorted((match_row[p] for p in voters), reverse=True)
+    if not vals:
+        return None
+    q = len(voters) // 2 + 1
+    return vals[q - 1]
+
+
+class TestQuorumMatchIndex:
+    def test_simple_3_voters(self):
+        match = jnp.array([[5, 3, 7, 0]], jnp.int32)
+        mask = jnp.array([[True, True, True, False]])
+        assert int(quorum_match_index(match, mask)[0]) == 5
+
+    def test_even_voters(self):
+        # 4 voters -> quorum 3 -> 3rd largest
+        match = jnp.array([[10, 8, 6, 4]], jnp.int32)
+        mask = jnp.ones((1, 4), bool)
+        assert int(quorum_match_index(match, mask)[0]) == 6
+
+    def test_no_voters(self):
+        match = jnp.zeros((1, 4), jnp.int32)
+        mask = jnp.zeros((1, 4), bool)
+        assert int(quorum_match_index(match, mask)[0]) == NEG_INF_I32
+
+    def test_single_voter(self):
+        match = jnp.array([[9, 99, 99, 99]], jnp.int32)
+        mask = jnp.array([[True, False, False, False]])
+        assert int(quorum_match_index(match, mask)[0]) == 9
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_vs_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        G, P = 64, 8
+        match = rng.integers(0, 1000, (G, P)).astype(np.int32)
+        mask = rng.random((G, P)) < 0.7
+        got = np.asarray(quorum_match_index(jnp.asarray(match), jnp.asarray(mask)))
+        for g in range(G):
+            voters = {p for p in range(P) if mask[g, p]}
+            want = _oracle_quorum_match(match[g], voters)
+            if want is None:
+                assert got[g] == NEG_INF_I32
+            else:
+                assert got[g] == want, f"group {g}"
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_equivalent_to_per_index_ballots(self, seed):
+        """The core equivalence claim: order statistic == walking per-index
+        Ballots from pending_index (reference BallotBox#commitAt)."""
+        rng = np.random.default_rng(100 + seed)
+        P = 5
+        for _ in range(50):
+            voters = set(rng.choice(P, rng.integers(1, P + 1), replace=False).tolist())
+            match = {p: int(rng.integers(0, 30)) for p in range(P)}
+            pending = int(rng.integers(1, 15))
+            last_log = pending + int(rng.integers(0, 20))
+            cur = pending - 1
+            want = oracle_commit_index(match, voters, None, pending, last_log, cur)
+            row = np.array([[match[p] for p in range(P)]], np.int32)
+            m = np.array([[p in voters for p in range(P)]])
+            qi = int(quorum_match_index(jnp.asarray(row), jnp.asarray(m))[0])
+            # kernel-side gating: commit = qi if qi >= pending else unchanged,
+            # clamped to last_log (host guarantees match <= last_log; clamp anyway)
+            got = max(cur, min(qi, last_log)) if qi >= pending else cur
+            assert got == want
+
+
+class TestJointQuorum:
+    def test_joint_takes_min(self):
+        match = jnp.array([[10, 10, 10, 2, 2]], jnp.int32)
+        new = jnp.array([[True, True, True, False, False]])
+        old = jnp.array([[False, False, True, True, True]])
+        # new quorum idx = 10, old quorum idx = 2 -> joint = 2
+        assert int(joint_quorum_match_index(match, new, old)[0]) == 2
+
+    def test_stable_ignores_old(self):
+        match = jnp.array([[10, 9, 8]], jnp.int32)
+        new = jnp.ones((1, 3), bool)
+        old = jnp.zeros((1, 3), bool)
+        assert int(joint_quorum_match_index(match, new, old)[0]) == 9
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_joint_vs_oracle(self, seed):
+        rng = np.random.default_rng(200 + seed)
+        P = 6
+        for _ in range(30):
+            voters = set(rng.choice(P, rng.integers(1, P + 1), replace=False).tolist())
+            old_voters = set(rng.choice(P, rng.integers(1, P + 1), replace=False).tolist())
+            match = {p: int(rng.integers(0, 20)) for p in range(P)}
+            pending = int(rng.integers(1, 10))
+            last_log = pending + 15
+            cur = pending - 1
+            want = oracle_commit_index(match, voters, old_voters, pending, last_log, cur)
+            row = np.array([[match[p] for p in range(P)]], np.int32)
+            nm = np.array([[p in voters for p in range(P)]])
+            om = np.array([[p in old_voters for p in range(P)]])
+            qi = int(joint_quorum_match_index(jnp.asarray(row), jnp.asarray(nm), jnp.asarray(om))[0])
+            got = max(cur, min(qi, last_log)) if qi >= pending else cur
+            assert got == want
+
+
+class TestVoteQuorum:
+    def test_majority(self):
+        granted = jnp.array([[True, True, False]])
+        mask = jnp.ones((1, 3), bool)
+        assert bool(vote_quorum(granted, mask)[0])
+
+    def test_no_majority(self):
+        granted = jnp.array([[True, False, False]])
+        mask = jnp.ones((1, 3), bool)
+        assert not bool(vote_quorum(granted, mask)[0])
+
+    def test_non_voter_grants_ignored(self):
+        granted = jnp.array([[True, False, False, True, True]])
+        mask = jnp.array([[True, True, True, False, False]])
+        assert not bool(vote_quorum(granted, mask)[0])
+
+    def test_joint_needs_both(self):
+        granted = jnp.array([[True, True, False, False]])
+        new = jnp.array([[True, True, False, False]])
+        old = jnp.array([[False, False, True, True]])
+        assert not bool(joint_vote_quorum(granted, new, old)[0])
+        granted2 = jnp.array([[True, True, True, True]])
+        assert bool(joint_vote_quorum(granted2, new, old)[0])
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_vs_oracle_ballot(self, seed):
+        rng = np.random.default_rng(300 + seed)
+        P = 7
+        for _ in range(50):
+            voters = set(rng.choice(P, rng.integers(1, P + 1), replace=False).tolist())
+            grants = set(rng.choice(P, rng.integers(0, P + 1), replace=False).tolist())
+            b = OracleBallot(voters)
+            for p in grants:
+                b.grant(p)
+            g = np.array([[p in grants for p in range(P)]])
+            m = np.array([[p in voters for p in range(P)]])
+            assert bool(vote_quorum(jnp.asarray(g), jnp.asarray(m))[0]) == b.is_granted()

@@ -1,0 +1,133 @@
+"""Tests for the fused multi-group tick kernel (tpuraft.ops.tick)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpuraft.ops.tick import (  # noqa: E402
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_INACTIVE,
+    ROLE_LEADER,
+    GroupState,
+    TickParams,
+    raft_tick,
+)
+
+P = 4
+PARAMS = TickParams.make(election_timeout_ms=1000, heartbeat_ms=100, lease_ms=900)
+
+
+def mk_state(g=3):
+    s = GroupState.zeros(g, P)
+    return s
+
+
+def test_leader_commit_advances():
+    s = mk_state(2)
+    s.role = jnp.array([ROLE_LEADER, ROLE_FOLLOWER], jnp.int32)
+    s.voter_mask = jnp.array([[1, 1, 1, 0]] * 2, bool)
+    s.pending_rel = jnp.array([1, 1], jnp.int32)
+    # leader self slot 0 at 10, peers at 8 and 3 -> quorum idx 8
+    s.match_rel = jnp.array([[10, 8, 3, 0], [10, 8, 3, 0]], jnp.int32)
+    s.last_ack = jnp.zeros((2, P), jnp.int32)
+    ns, out = raft_tick(s, jnp.int32(0), PARAMS)
+    assert int(out.commit_rel[0]) == 8
+    assert bool(out.commit_advanced[0])
+    # follower's quorum math never advances commit on device
+    assert int(out.commit_rel[1]) == 0
+    assert not bool(out.commit_advanced[1])
+
+
+def test_commit_gated_by_pending_index():
+    """Entries from a previous leadership (below pending) never commit on
+    quorum math alone — Raft §5.4.2 via pending_rel gate."""
+    s = mk_state(1)
+    s.role = jnp.array([ROLE_LEADER], jnp.int32)
+    s.voter_mask = jnp.ones((1, P), bool)
+    s.pending_rel = jnp.array([20], jnp.int32)
+    s.match_rel = jnp.array([[15, 15, 15, 15]], jnp.int32)
+    _, out = raft_tick(s, jnp.int32(0), PARAMS)
+    assert int(out.commit_rel[0]) == 0
+    assert not bool(out.commit_advanced[0])
+
+
+def test_commit_monotone():
+    s = mk_state(1)
+    s.role = jnp.array([ROLE_LEADER], jnp.int32)
+    s.voter_mask = jnp.array([[1, 1, 1, 0]], bool)
+    s.pending_rel = jnp.array([1], jnp.int32)
+    s.commit_rel = jnp.array([9], jnp.int32)
+    s.match_rel = jnp.array([[5, 5, 5, 0]], jnp.int32)
+    _, out = raft_tick(s, jnp.int32(0), PARAMS)
+    assert int(out.commit_rel[0]) == 9  # never regresses
+
+
+def test_candidate_elected():
+    s = mk_state(2)
+    s.role = jnp.array([ROLE_CANDIDATE, ROLE_CANDIDATE], jnp.int32)
+    s.voter_mask = jnp.array([[1, 1, 1, 0]] * 2, bool)
+    s.granted = jnp.array([[1, 1, 0, 0], [1, 0, 0, 0]], bool)
+    _, out = raft_tick(s, jnp.int32(0), PARAMS)
+    assert bool(out.elected[0])
+    assert not bool(out.elected[1])
+
+
+def test_election_due_and_inactive_silent():
+    s = mk_state(3)
+    s.role = jnp.array([ROLE_FOLLOWER, ROLE_FOLLOWER, ROLE_INACTIVE], jnp.int32)
+    s.elect_deadline = jnp.array([100, 5000, 0], jnp.int32)
+    _, out = raft_tick(s, jnp.int32(200), PARAMS)
+    assert bool(out.election_due[0])
+    assert not bool(out.election_due[1])
+    assert not bool(out.election_due[2])
+
+
+def test_leader_step_down_on_dead_quorum():
+    s = mk_state(1)
+    s.role = jnp.array([ROLE_LEADER], jnp.int32)
+    s.voter_mask = jnp.ones((1, P), bool)
+    # self slot acked recently; all others stale -> quorum(3) ack is stale
+    s.last_ack = jnp.array([[5000, 100, 90, 80]], jnp.int32)
+    _, out = raft_tick(s, jnp.int32(5000), PARAMS)
+    assert bool(out.step_down[0])
+    assert not bool(out.lease_valid[0])
+
+
+def test_leader_lease_valid_with_live_quorum():
+    s = mk_state(1)
+    s.role = jnp.array([ROLE_LEADER], jnp.int32)
+    s.voter_mask = jnp.ones((1, P), bool)
+    s.last_ack = jnp.array([[5000, 4900, 4800, 100]], jnp.int32)
+    _, out = raft_tick(s, jnp.int32(5000), PARAMS)
+    assert not bool(out.step_down[0])
+    assert bool(out.lease_valid[0])
+
+
+def test_heartbeat_scheduling():
+    s = mk_state(1)
+    s.role = jnp.array([ROLE_LEADER], jnp.int32)
+    s.voter_mask = jnp.ones((1, P), bool)
+    s.last_ack = jnp.full((1, P), 5000, jnp.int32)
+    s.hb_deadline = jnp.array([4000], jnp.int32)
+    ns, out = raft_tick(s, jnp.int32(5000), PARAMS)
+    assert bool(out.hb_due[0])
+    assert int(ns.hb_deadline[0]) == 5100
+    # next tick before new deadline: not due
+    _, out2 = raft_tick(ns, jnp.int32(5050), PARAMS)
+    assert not bool(out2.hb_due[0])
+
+
+def test_jit_and_large_g():
+    G = 2048
+    s = GroupState.zeros(G, 8)
+    rng = np.random.default_rng(0)
+    s.role = jnp.asarray(rng.integers(0, 3, G).astype(np.int32))
+    s.voter_mask = jnp.asarray(rng.random((G, 8)) < 0.6)
+    s.match_rel = jnp.asarray(rng.integers(0, 1000, (G, 8)).astype(np.int32))
+    tick = jax.jit(raft_tick)
+    ns, out = tick(s, jnp.int32(123), PARAMS)
+    assert out.commit_rel.shape == (G,)
+    assert ns.match_rel.shape == (G, 8)

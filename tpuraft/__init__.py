@@ -1,0 +1,36 @@
+"""tpuraft — a TPU-native multi-raft consensus framework.
+
+Re-imagines the capabilities of SOFAJRaft (reference: finalcola/sofa-jraft)
+for TPU hardware: thousands of independent Raft groups' quorum math (ballot
+counting, commitIndex advancement, election/lease checks) run as one
+vectorized JAX/XLA kernel over ``[groups, peers]`` state tensors, sharded
+over a device mesh with ``jax.sharding`` — while an asyncio host runtime
+implements the protocol envelope (RPC, timers, log management, snapshots,
+membership change) and a native C++ layer provides durable log storage.
+
+Layer map (mirrors SURVEY.md §2):
+  L1 runtime utils      tpuraft.util
+  L2 RPC / transport    tpuraft.rpc
+  L3 storage            tpuraft.storage
+  L4 consensus core     tpuraft.core  (+ device plane in tpuraft.ops)
+  L5 client & routing   tpuraft.client
+  L6 RheaKV store       tpuraft.rhea
+  L7 examples           examples/
+"""
+
+__version__ = "0.1.0"
+
+from tpuraft.errors import RaftError, Status
+from tpuraft.entity import PeerId, LogId, LogEntry, EntryType, Task
+from tpuraft.conf import Configuration
+
+__all__ = [
+    "RaftError",
+    "Status",
+    "PeerId",
+    "LogId",
+    "LogEntry",
+    "EntryType",
+    "Task",
+    "Configuration",
+]

@@ -1,0 +1,153 @@
+"""Cluster configuration tracking.
+
+Reference parity (SURVEY.md §3.1): ``core:conf/Configuration`` (peer set +
+learners, parse/diff), ``core:conf/ConfigurationEntry`` (conf at a log id,
+with the *old* conf during joint consensus), ``core:conf/ConfigurationManager``
+(ordered history of committed/appended conf entries so the log manager can
+answer "what was the conf at index i").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from tpuraft.entity import LogId, PeerId
+
+
+@dataclass
+class Configuration:
+    """A voter set plus optional learner (read-only replica) set."""
+
+    peers: list[PeerId] = field(default_factory=list)
+    learners: list[PeerId] = field(default_factory=list)
+
+    @staticmethod
+    def parse(conf_str: str) -> "Configuration":
+        """Parse ``"ip:port,ip:port:idx,..."``; learners suffixed ``/learner``."""
+        conf = Configuration()
+        for tok in conf_str.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.endswith("/learner"):
+                conf.learners.append(PeerId.parse(tok[: -len("/learner")]))
+            else:
+                conf.peers.append(PeerId.parse(tok))
+        return conf
+
+    def copy(self) -> "Configuration":
+        return Configuration(list(self.peers), list(self.learners))
+
+    def is_empty(self) -> bool:
+        return not self.peers
+
+    def contains(self, peer: PeerId) -> bool:
+        return peer in self.peers
+
+    def is_valid(self) -> bool:
+        """Voter and learner sets must be disjoint; no duplicate peers."""
+        s = set(self.peers)
+        return len(s) == len(self.peers) and not (s & set(self.learners))
+
+    def quorum(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    def diff(self, other: "Configuration") -> tuple[set[PeerId], set[PeerId]]:
+        """Returns (added, removed) voter peers going self -> other."""
+        a, b = set(self.peers), set(other.peers)
+        return b - a, a - b
+
+    def list_all(self) -> list[PeerId]:
+        return list(self.peers) + list(self.learners)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Configuration):
+            return NotImplemented
+        return set(self.peers) == set(other.peers) and set(self.learners) == set(
+            other.learners
+        )
+
+    def __str__(self) -> str:
+        toks = [str(p) for p in sorted(self.peers)]
+        toks += [f"{p}/learner" for p in sorted(self.learners)]
+        return ",".join(toks)
+
+
+@dataclass
+class ConfigurationEntry:
+    """The configuration in force at a given log id.
+
+    During joint consensus (arbitrary ``changePeers``), ``old_conf`` is
+    non-empty and decisions need a quorum of *both* sets — the device
+    kernel's double-order-statistic path (tpuraft.ops.ballot).
+    """
+
+    id: LogId = field(default_factory=LogId)
+    conf: Configuration = field(default_factory=Configuration)
+    old_conf: Configuration = field(default_factory=Configuration)
+
+    def is_stable(self) -> bool:
+        return self.old_conf.is_empty()
+
+    def contains(self, peer: PeerId) -> bool:
+        return self.conf.contains(peer) or self.old_conf.contains(peer)
+
+    def list_peers(self) -> list[PeerId]:
+        return list({*self.conf.peers, *self.old_conf.peers})
+
+    def copy(self) -> "ConfigurationEntry":
+        return ConfigurationEntry(self.id, self.conf.copy(), self.old_conf.copy())
+
+
+class ConfigurationManager:
+    """Ordered history of configuration entries present in the log.
+
+    Reference: ``core:conf/ConfigurationManager`` — supports truncation from
+    either end (snapshot compaction / conflict truncation) and lookup of the
+    latest conf at-or-before an index.
+    """
+
+    def __init__(self) -> None:
+        self._configurations: list[ConfigurationEntry] = []
+        self._snapshot = ConfigurationEntry()
+
+    def add(self, entry: ConfigurationEntry) -> bool:
+        if self._configurations and self._configurations[-1].id.index >= entry.id.index:
+            return False
+        self._configurations.append(entry)
+        return True
+
+    def truncate_prefix(self, first_index_kept: int) -> None:
+        self._configurations = [
+            e for e in self._configurations if e.id.index >= first_index_kept
+        ]
+
+    def truncate_suffix(self, last_index_kept: int) -> None:
+        self._configurations = [
+            e for e in self._configurations if e.id.index <= last_index_kept
+        ]
+
+    def set_snapshot(self, entry: ConfigurationEntry) -> None:
+        if entry.id.index >= self._snapshot.id.index:
+            self._snapshot = entry
+
+    def get_snapshot(self) -> ConfigurationEntry:
+        return self._snapshot
+
+    def get(self, last_included_index: int) -> ConfigurationEntry:
+        """Latest configuration whose log index <= last_included_index."""
+        best: Optional[ConfigurationEntry] = None
+        for e in self._configurations:
+            if e.id.index <= last_included_index:
+                best = e
+            else:
+                break
+        if best is None:
+            return self._snapshot.copy()
+        return best.copy()
+
+    def last(self) -> ConfigurationEntry:
+        if self._configurations:
+            return self._configurations[-1].copy()
+        return self._snapshot.copy()

@@ -1,0 +1,232 @@
+"""Core entities: PeerId, LogId, LogEntry, Task.
+
+Reference parity (SURVEY.md §3.1 "Entities & conf"):
+``core:entity/PeerId`` (``ip:port[:idx[:priority]]`` parsing),
+``core:entity/LogId{index,term}``, ``core:entity/LogEntry`` with CRC
+checksum, ``core:entity/Task{data,done,expectedTerm}``.
+
+Design difference from the reference: entries carry an explicit binary
+codec (``encode``/``decode``) used by both the Python file log storage and
+the C++ storage engine — one on-disk/wire format, no protobuf dependency in
+the hot path.  Indexes/terms are unbounded Python ints on the host; the
+device plane (tpuraft.ops) works in *base-relative* int32 space.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+
+class ElectionPriority:
+    """Priority election values (reference: ``core:entity/ElectionPriority``)."""
+
+    DISABLED = -1   # priority election disabled for this node
+    NOT_ELECTED = 0 # node never takes part in election
+    MIN_VALUE = 1
+
+
+@dataclass(frozen=True, order=True)
+class PeerId:
+    """A participant endpoint: ``ip:port[:idx[:priority]]``.
+
+    Reference: ``core:entity/PeerId#parse``.  ``idx`` distinguishes
+    multiple nodes of one process sharing an endpoint; ``priority`` feeds
+    priority-based election (``[1.3+]``).
+    """
+
+    ip: str = "0.0.0.0"
+    port: int = 0
+    idx: int = 0
+    priority: int = ElectionPriority.DISABLED
+
+    @staticmethod
+    def parse(s: str) -> "PeerId":
+        parts = s.strip().split(":")
+        if len(parts) < 2 or len(parts) > 4:
+            raise ValueError(f"invalid peer id: {s!r}")
+        ip = parts[0]
+        port = int(parts[1])
+        idx = int(parts[2]) if len(parts) >= 3 else 0
+        priority = int(parts[3]) if len(parts) == 4 else ElectionPriority.DISABLED
+        return PeerId(ip, port, idx, priority)
+
+    def is_empty(self) -> bool:
+        return self.ip == "0.0.0.0" and self.port == 0 and self.idx == 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def __str__(self) -> str:
+        s = f"{self.ip}:{self.port}"
+        if self.priority != ElectionPriority.DISABLED:
+            return f"{s}:{self.idx}:{self.priority}"
+        if self.idx != 0:
+            return f"{s}:{self.idx}"
+        return s
+
+
+EMPTY_PEER = PeerId()
+
+
+@dataclass(frozen=True, order=True)
+class LogId:
+    """(index, term) pair; ordering is by index then term.
+
+    Reference: ``core:entity/LogId``. Raft log comparison for elections
+    compares term first, index second — use :meth:`newer_than` for that.
+    """
+
+    index: int = 0
+    term: int = 0
+
+    def newer_than(self, other: "LogId") -> bool:
+        """Election log-up-to-date comparison (term first, then index)."""
+        return (self.term, self.index) > (other.term, other.index)
+
+    def __str__(self) -> str:
+        return f"LogId[index={self.index}, term={self.term}]"
+
+
+class EntryType(enum.IntEnum):
+    """Reference: ``EnumOutter.EntryType``."""
+
+    NO_OP = 0
+    DATA = 1
+    CONFIGURATION = 2
+
+
+# On-disk / wire header for a log entry:
+#   magic(1) type(1) reserved(2) term(8) index(8) npeers(2) nold(2)
+#   data_len(4) crc32(4)  => 32 bytes, then peers blob, then data.
+_HDR = struct.Struct("<BBHqqHHII")
+_MAGIC = 0xB8
+
+
+@dataclass
+class LogEntry:
+    """A replicated log entry.
+
+    Reference: ``core:entity/LogEntry`` (+ v2 codec ``core:entity/codec/*``).
+    CONFIGURATION entries carry ``peers``/``old_peers`` (joint consensus)
+    and ``learners``/``old_learners``.
+    """
+
+    type: EntryType = EntryType.NO_OP
+    id: LogId = field(default_factory=LogId)
+    data: bytes = b""
+    peers: Optional[list[PeerId]] = None
+    old_peers: Optional[list[PeerId]] = None
+    learners: Optional[list[PeerId]] = None
+    old_learners: Optional[list[PeerId]] = None
+
+    # -- codec ---------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        peers_blob = _encode_peer_lists(
+            self.peers, self.old_peers, self.learners, self.old_learners
+        )
+        crc = zlib.crc32(self.data)
+        crc = zlib.crc32(peers_blob, crc)
+        hdr = _HDR.pack(
+            _MAGIC,
+            int(self.type),
+            0,
+            self.id.term,
+            self.id.index,
+            len(peers_blob),
+            0,
+            len(self.data),
+            crc,
+        )
+        return hdr + peers_blob + self.data
+
+    @staticmethod
+    def decode(buf: bytes | memoryview) -> "LogEntry":
+        buf = memoryview(buf)
+        (magic, etype, _rsv, term, index, peers_len, _n2, data_len, crc) = _HDR.unpack(
+            buf[: _HDR.size]
+        )
+        if magic != _MAGIC:
+            raise ValueError(f"bad log entry magic: {magic:#x}")
+        off = _HDR.size
+        peers_blob = bytes(buf[off : off + peers_len])
+        off += peers_len
+        data = bytes(buf[off : off + data_len])
+        actual = zlib.crc32(peers_blob, zlib.crc32(data))
+        if actual != crc:
+            raise ValueError(
+                f"log entry crc mismatch at index {index}: {actual:#x} != {crc:#x}"
+            )
+        peers, old_peers, learners, old_learners = _decode_peer_lists(peers_blob)
+        return LogEntry(
+            type=EntryType(etype),
+            id=LogId(index=index, term=term),
+            data=data,
+            peers=peers,
+            old_peers=old_peers,
+            learners=learners,
+            old_learners=old_learners,
+        )
+
+    def encoded_size(self) -> int:
+        return _HDR.size + len(
+            _encode_peer_lists(self.peers, self.old_peers, self.learners, self.old_learners)
+        ) + len(self.data)
+
+    def is_configuration(self) -> bool:
+        return self.type == EntryType.CONFIGURATION
+
+
+def _encode_peer_lists(*lists: Optional[list[PeerId]]) -> bytes:
+    if all(l is None for l in lists):
+        return b""
+    out = bytearray()
+    for l in lists:
+        if l is None:
+            out += struct.pack("<h", -1)
+        else:
+            out += struct.pack("<h", len(l))
+            for p in l:
+                s = str(p).encode()
+                out += struct.pack("<H", len(s)) + s
+    return bytes(out)
+
+
+def _decode_peer_lists(blob: bytes):
+    if not blob:
+        return None, None, None, None
+    lists: list[Optional[list[PeerId]]] = []
+    off = 0
+    for _ in range(4):
+        (n,) = struct.unpack_from("<h", blob, off)
+        off += 2
+        if n < 0:
+            lists.append(None)
+            continue
+        cur = []
+        for _ in range(n):
+            (slen,) = struct.unpack_from("<H", blob, off)
+            off += 2
+            cur.append(PeerId.parse(blob[off : off + slen].decode()))
+            off += slen
+        lists.append(cur)
+    return tuple(lists)  # type: ignore[return-value]
+
+
+@dataclass
+class Task:
+    """A user task to replicate: opaque ``data`` + completion callback.
+
+    Reference: ``core:entity/Task``.  ``done`` is called with a Status when
+    the entry commits (or fails); ``expected_term`` guards against applying
+    under a different leadership than intended.
+    """
+
+    data: bytes = b""
+    done: Optional[Callable[["Any"], None]] = None  # called with Status
+    expected_term: int = -1

@@ -1,0 +1,36 @@
+"""Device plane: vectorized consensus math as pure JAX functions.
+
+This package replaces the reference's per-group hot-path objects —
+``core:core/BallotBox`` (per-index Ballot quorum counting), the matchIndex
+side of ``core:core/Replicator``, and election vote counting in
+``core:core/NodeImpl`` — with one set of kernels over ``[G, P]`` tensors
+(G raft groups x P peer slots), designed for the MXU/VPU and for sharding
+over a TPU mesh (SURVEY.md §8).
+
+Key reformulation: per-index ballots within the pending window are
+equivalent to an order statistic — the index committed by a quorum of q
+voters is the q-th largest matchIndex (proof sketch: matchIndex_p >= i
+means peer p acked every index <= i, so |{p: match_p >= i}| >= q iff
+i <= qth_largest(match)).  Joint consensus (old+new conf) takes the min of
+the two order statistics.  All indexes on device are int32 *relative to a
+per-group host-managed base* so unbounded log indexes never hit the device.
+"""
+
+from tpuraft.ops.ballot import (
+    quorum_match_index,
+    joint_quorum_match_index,
+    vote_quorum,
+    NEG_INF_I32,
+)
+from tpuraft.ops.tick import GroupState, TickParams, TickOutputs, raft_tick
+
+__all__ = [
+    "quorum_match_index",
+    "joint_quorum_match_index",
+    "vote_quorum",
+    "NEG_INF_I32",
+    "GroupState",
+    "TickParams",
+    "TickOutputs",
+    "raft_tick",
+]

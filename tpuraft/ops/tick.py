@@ -1,0 +1,179 @@
+"""The fused multi-group tick kernel.
+
+One jitted function advances ALL G raft groups' quorum math at once
+(SURVEY.md §8 "Device plane"): commit-index advancement, election vote
+tallies, election-timeout firing, leader-lease/step-down checks, and
+heartbeat scheduling.  The host runtime (tpuraft.core.engine) merges
+protocol events (RPC responses, fsync acks) into the state arrays between
+ticks and applies the emitted event masks (elected / step_down /
+start_prevote) through the slow-path protocol code.
+
+Division of labor:
+  - device mutates only *derived, monotone* state (commit_rel, hb_deadline);
+  - role/term/vote transitions are host-applied from output masks, so the
+    host remains the single writer of protocol state (the functional
+    analog of NodeImpl's writeLock discipline).
+
+All times are int32 milliseconds relative to engine start; all log indexes
+are int32 relative to a per-group host-managed base (see tpuraft.ops).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuraft.ops.ballot import (
+    NEG_INF_I32,
+    joint_quorum_match_index,
+    joint_vote_quorum,
+    quorum_ack_time,
+)
+
+# Role encoding (device plane). Learners are not a role: they sit in peer
+# slots with voter_mask=False.
+ROLE_FOLLOWER = 0
+ROLE_CANDIDATE = 1
+ROLE_LEADER = 2
+ROLE_INACTIVE = 3  # unallocated group slot
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class GroupState:
+    """Structure-of-arrays consensus state for G groups x P peer slots.
+
+    This is this *node's* local view of each group it participates in —
+    the vectorized replacement for the reference's per-group object graph
+    (NodeImpl + BallotBox + ReplicatorGroup matchIndex bookkeeping).
+    """
+
+    role: jnp.ndarray          # int32 [G]
+    commit_rel: jnp.ndarray    # int32 [G]  committed index - base
+    pending_rel: jnp.ndarray   # int32 [G]  first index of current leadership
+    match_rel: jnp.ndarray     # int32 [G,P] acked matchIndex - base (self slot = lastLog)
+    granted: jnp.ndarray       # bool  [G,P] votes granted this election round
+    voter_mask: jnp.ndarray    # bool  [G,P] voters in current conf
+    old_voter_mask: jnp.ndarray  # bool [G,P] voters in old conf (joint) else False
+    elect_deadline: jnp.ndarray  # int32 [G] ms: follower election-timeout deadline
+    hb_deadline: jnp.ndarray   # int32 [G] ms: leader next-heartbeat time
+    last_ack: jnp.ndarray      # int32 [G,P] ms: last response time per peer
+
+    @staticmethod
+    def zeros(g: int, p: int) -> "GroupState":
+        return GroupState(
+            role=jnp.full((g,), ROLE_INACTIVE, jnp.int32),
+            commit_rel=jnp.zeros((g,), jnp.int32),
+            pending_rel=jnp.ones((g,), jnp.int32),
+            match_rel=jnp.zeros((g, p), jnp.int32),
+            granted=jnp.zeros((g, p), bool),
+            voter_mask=jnp.zeros((g, p), bool),
+            old_voter_mask=jnp.zeros((g, p), bool),
+            elect_deadline=jnp.zeros((g,), jnp.int32),
+            hb_deadline=jnp.zeros((g,), jnp.int32),
+            last_ack=jnp.zeros((g, p), jnp.int32),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TickParams:
+    """Scalar protocol parameters (prefetched once, not retraced)."""
+
+    election_timeout_ms: jnp.ndarray  # int32 scalar
+    heartbeat_ms: jnp.ndarray         # int32 scalar
+    lease_ms: jnp.ndarray             # int32 scalar
+
+    @staticmethod
+    def make(election_timeout_ms: int, heartbeat_ms: int, lease_ms: int) -> "TickParams":
+        return TickParams(
+            jnp.int32(election_timeout_ms),
+            jnp.int32(heartbeat_ms),
+            jnp.int32(lease_ms),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TickOutputs:
+    """Per-tick event masks + advanced indexes the host applies."""
+
+    commit_rel: jnp.ndarray     # int32 [G] new commit (== old where unchanged)
+    commit_advanced: jnp.ndarray  # bool [G]
+    elected: jnp.ndarray        # bool [G] candidate reached vote quorum
+    election_due: jnp.ndarray   # bool [G] follower/candidate election timer fired
+    step_down: jnp.ndarray      # bool [G] leader lost quorum within lease window
+    hb_due: jnp.ndarray         # bool [G] leader heartbeat due this tick
+    lease_valid: jnp.ndarray    # bool [G] leader lease currently valid (for reads)
+
+
+def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams
+              ) -> tuple[GroupState, TickOutputs]:
+    """Advance all groups one tick. Pure; jit/shard_map over the G axis."""
+    is_leader = state.role == ROLE_LEADER
+    is_follower = state.role == ROLE_FOLLOWER
+    is_candidate = state.role == ROLE_CANDIDATE
+
+    # --- commit advancement (BallotBox#commitAt, vectorized) ---------------
+    quorum_idx = joint_quorum_match_index(
+        state.match_rel, state.voter_mask, state.old_voter_mask
+    )
+    # Entries before pending_rel belong to prior leaderships: never counted
+    # (this IS the Raft §5.4.2 current-term commit gate — pending_rel is set
+    # to lastLogIndex+1 at becomeLeader, mirroring BallotBox#resetPendingIndex).
+    can_commit = is_leader & (quorum_idx >= state.pending_rel)
+    new_commit = jnp.where(
+        can_commit, jnp.maximum(state.commit_rel, quorum_idx), state.commit_rel
+    )
+    commit_advanced = new_commit > state.commit_rel
+
+    # --- election tally (NodeImpl#handleRequestVoteResponse, vectorized) ---
+    elected = is_candidate & joint_vote_quorum(
+        state.granted, state.voter_mask, state.old_voter_mask
+    )
+
+    # --- election timeout (RepeatedTimer electionTimer, vectorized) --------
+    election_due = (is_follower | is_candidate) & (now_ms >= state.elect_deadline)
+
+    # --- leader lease / step-down (NodeImpl#checkDeadNodes) ----------------
+    # Count the leader itself as acked "now" via its self slot: the host
+    # keeps last_ack[g, self] == now. Quorum ack time = q-th newest response.
+    q_ack = quorum_ack_time(state.last_ack, state.voter_mask)
+    have_quorum_ack = q_ack > NEG_INF_I32
+    lease_valid = is_leader & have_quorum_ack & (now_ms - q_ack < params.lease_ms)
+    step_down = is_leader & have_quorum_ack & (
+        now_ms - q_ack >= params.election_timeout_ms
+    )
+
+    # --- heartbeat scheduling ---------------------------------------------
+    hb_due = is_leader & (now_ms >= state.hb_deadline)
+    new_hb_deadline = jnp.where(hb_due, now_ms + params.heartbeat_ms, state.hb_deadline)
+
+    new_state = GroupState(
+        role=state.role,
+        commit_rel=new_commit,
+        pending_rel=state.pending_rel,
+        match_rel=state.match_rel,
+        granted=state.granted,
+        voter_mask=state.voter_mask,
+        old_voter_mask=state.old_voter_mask,
+        elect_deadline=state.elect_deadline,
+        hb_deadline=new_hb_deadline,
+        last_ack=state.last_ack,
+    )
+    outputs = TickOutputs(
+        commit_rel=new_commit,
+        commit_advanced=commit_advanced,
+        elected=elected,
+        election_due=election_due,
+        step_down=step_down,
+        hb_due=hb_due,
+        lease_valid=lease_valid,
+    )
+    return new_state, outputs
+
+
+raft_tick_jit = jax.jit(raft_tick, donate_argnums=(0,))

@@ -1,0 +1,102 @@
+"""Options tree — nested dataclasses, the reference's builder/POJO options.
+
+Reference parity (SURVEY.md §6 "Config / flag system"): ``NodeOptions``
+(timeouts, storage URIs, state machine, initial conf) containing
+``RaftOptions`` (engine tunables with the reference's defaults:
+max_entries_size=1024, max_body_size=512KB, apply_batch=32,
+max_inflight_msgs=256, pipelined replication, sync on write), plus
+``ReadOnlyOption``.  TPU-specific knobs live in :class:`TickOptions`.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, TYPE_CHECKING
+
+from tpuraft.conf import Configuration
+
+if TYPE_CHECKING:
+    from tpuraft.core.state_machine import StateMachine
+
+
+class ReadOnlyOption(enum.Enum):
+    """Linearizable read mode (reference: ``ReadOnlyOption``)."""
+
+    SAFE = "safe"               # quorum-confirmed ReadIndex round
+    LEASE_BASED = "lease_based" # trust the leader lease (clock-dependent)
+
+
+@dataclass
+class RaftOptions:
+    """Engine tunables; defaults mirror the reference's RaftOptions."""
+
+    max_entries_size: int = 1024          # max entries per AppendEntries
+    max_body_size: int = 512 * 1024       # max bytes per AppendEntries
+    max_append_buffer_size: int = 256 * 1024  # log-storage flush batch bytes
+    apply_batch: int = 32                 # tasks batched per apply event
+    sync: bool = True                     # fsync log writes
+    sync_meta: bool = True                # fsync term/votedFor changes
+    replicator_pipeline: bool = True
+    max_inflight_msgs: int = 256          # replication pipeline window
+    max_election_delay_ms: int = 1000     # random election timeout jitter
+    election_heartbeat_factor: int = 10   # heartbeat = election_timeout / factor
+    read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
+    max_replicator_retry_times: int = 3
+    step_down_when_vote_timedout: bool = True
+    # lease safety margin: leader lease = election_timeout * ratio
+    leader_lease_time_ratio: float = 0.9
+
+
+@dataclass
+class TickOptions:
+    """Device-plane knobs (no reference counterpart — TPU-native design).
+
+    The multi-raft engine advances all groups on a tick cadence; each tick
+    uploads one coalesced ``[G, P]`` delta and downloads one result batch
+    (SURVEY.md §8 "host<->device latency budget").
+    """
+
+    max_groups: int = 1024        # G capacity of the state tensors
+    max_peers: int = 8            # P: peer slots per group (voters+learners)
+    tick_interval_ms: int = 10    # host tick cadence
+    backend: str = "auto"         # "auto" | "jax" | "numpy" (numpy for tiny tests)
+    donate_state: bool = True     # donate state buffers to the tick kernel
+
+
+@dataclass
+class SnapshotOptions:
+    interval_secs: int = 3600           # periodic snapshot cadence (reference default)
+    log_index_margin: int = 0           # keep this many entries behind snapshot
+    max_chunk_size: int = 1 << 20       # InstallSnapshot file chunk bytes
+    throttle_bytes_per_sec: int = 0     # 0 = unthrottled (ThroughputSnapshotThrottle)
+
+
+@dataclass
+class NodeOptions:
+    """Per-node options (reference: ``core:option/NodeOptions``)."""
+
+    election_timeout_ms: int = 1000
+    snapshot: SnapshotOptions = field(default_factory=SnapshotOptions)
+    initial_conf: Configuration = field(default_factory=Configuration)
+    fsm: Optional["StateMachine"] = None
+    log_uri: str = ""            # "memory://" or "file://<dir>" or "native://<dir>"
+    raft_meta_uri: str = ""
+    snapshot_uri: str = ""       # empty = snapshots disabled
+    disable_cli: bool = False
+    enable_metrics: bool = True
+    catchup_margin: int = 1000   # membership-change catch-up threshold (entries)
+    raft_options: RaftOptions = field(default_factory=RaftOptions)
+    tick: TickOptions = field(default_factory=TickOptions)
+
+
+@dataclass
+class CliOptions:
+    timeout_ms: int = 3000
+    max_retry: int = 3
+
+
+@dataclass
+class ReadIndexOptions:
+    timeout_ms: int = 2000
+    batch: int = 32
