@@ -15,10 +15,23 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import asyncio  # noqa: E402
+import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
 
-@pytest.fixture
-def event_loop_policy():
-    return asyncio.DefaultEventLoopPolicy()
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio test support (pytest-asyncio is not in the image)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

@@ -1,0 +1,249 @@
+"""Storage tier tests (reference: BaseLogStorageTest, RocksDBLogStorageTest,
+LocalRaftMetaStorageTest, LogManagerTest — SURVEY.md §5)."""
+
+import asyncio
+
+import pytest
+
+from tpuraft.conf import Configuration, ConfigurationEntry
+from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
+from tpuraft.storage.log_manager import LogManager
+from tpuraft.storage.log_storage import FileLogStorage, MemoryLogStorage
+from tpuraft.storage.meta_storage import RaftMetaStorage
+
+
+def mk_entries(first, count, term=1, size=16):
+    return [
+        LogEntry(type=EntryType.DATA, id=LogId(first + i, term), data=bytes(size))
+        for i in range(count)
+    ]
+
+
+class _BaseLogStorageSuite:
+    def mk(self, tmp_path):
+        raise NotImplementedError
+
+    def test_empty(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        assert s.first_log_index() == 1
+        assert s.last_log_index() == 0
+        assert s.get_entry(1) is None
+        s.shutdown()
+
+    def test_append_get(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 10))
+        assert s.last_log_index() == 10
+        e = s.get_entry(7)
+        assert e and e.id == LogId(7, 1)
+        assert s.get_term(7) == 1
+        s.shutdown()
+
+    def test_truncate_suffix(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 10))
+        s.truncate_suffix(6)
+        assert s.last_log_index() == 6
+        assert s.get_entry(7) is None
+        s.append_entries(mk_entries(7, 2, term=2))
+        assert s.get_term(8) == 2
+        s.shutdown()
+
+    def test_truncate_prefix(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 10))
+        s.truncate_prefix(5)
+        assert s.first_log_index() == 5
+        assert s.last_log_index() == 10
+        s.shutdown()
+
+    def test_reset(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5))
+        s.reset(100)
+        assert s.first_log_index() == 100
+        assert s.last_log_index() == 99
+        s.append_entries(mk_entries(100, 3, term=9))
+        assert s.get_term(101) == 9
+        s.shutdown()
+
+
+class TestMemoryLogStorage(_BaseLogStorageSuite):
+    def mk(self, tmp_path):
+        return MemoryLogStorage()
+
+
+class TestFileLogStorage(_BaseLogStorageSuite):
+    def mk(self, tmp_path):
+        return FileLogStorage(str(tmp_path / "log"), segment_max_bytes=512)
+
+    def test_restart_recovery(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 20, size=40))  # spans segments
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()
+        assert s2.last_log_index() == 20
+        assert s2.get_entry(15).id == LogId(15, 1)
+        s2.shutdown()
+
+    def test_restart_after_prefix_truncate(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 20, size=40))
+        s.truncate_prefix(12)
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()
+        assert s2.first_log_index() == 12
+        assert s2.last_log_index() == 20
+        s2.shutdown()
+
+    def test_torn_write_recovery(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 3, size=40))
+        s.shutdown()
+        # corrupt: chop bytes off the tail of the (only) segment
+        seg = sorted((tmp_path / "log").glob("seg_*.log"))[0]
+        data = seg.read_bytes()
+        seg.write_bytes(data[:-10])
+        s2 = self.mk(tmp_path)
+        s2.init()
+        assert s2.last_log_index() == 2  # last entry dropped, first two intact
+        assert s2.get_entry(2) is not None
+        s2.shutdown()
+
+    def test_non_contiguous_append_rejected(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 3))
+        with pytest.raises(ValueError):
+            s.append_entries(mk_entries(7, 1))
+        s.shutdown()
+
+
+class TestRaftMetaStorage:
+    def test_roundtrip(self, tmp_path):
+        m = RaftMetaStorage(str(tmp_path))
+        m.init()
+        assert m.term == 0 and m.voted_for.is_empty()
+        m.set_term_and_voted_for(7, PeerId.parse("1.2.3.4:80"))
+        m2 = RaftMetaStorage(str(tmp_path))
+        m2.init()
+        assert m2.term == 7
+        assert m2.voted_for == PeerId.parse("1.2.3.4:80")
+
+    def test_corruption_detected(self, tmp_path):
+        m = RaftMetaStorage(str(tmp_path))
+        m.init()
+        m.set_term_and_voted_for(3, PeerId.parse("1.2.3.4:80"))
+        p = tmp_path / "raft_meta"
+        raw = bytearray(p.read_bytes())
+        raw[0] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        with pytest.raises(IOError):
+            m2 = RaftMetaStorage(str(tmp_path))
+            m2.init()
+
+
+@pytest.mark.asyncio
+class TestLogManager:
+    async def mk(self):
+        lm = LogManager(MemoryLogStorage())
+        await lm.init()
+        return lm
+
+    async def test_leader_append_assigns_ids(self):
+        lm = await self.mk()
+        entries = [LogEntry(type=EntryType.DATA, data=b"a"),
+                   LogEntry(type=EntryType.DATA, data=b"b")]
+        last = await lm.append_entries_leader(entries, term=3)
+        assert last == LogId(2, 3)
+        assert lm.last_log_index() == 2
+        assert lm.get_term(1) == 3
+        await lm.shutdown()
+
+    async def test_follower_append_and_conflict(self):
+        lm = await self.mk()
+        ok = await lm.append_entries_follower(0, 0, mk_entries(1, 5, term=1))
+        assert ok and lm.last_log_index() == 5
+        # conflicting suffix at index 4 with higher term
+        newer = mk_entries(4, 3, term=2)
+        ok = await lm.append_entries_follower(3, 1, newer)
+        assert ok
+        assert lm.last_log_index() == 6
+        assert lm.get_term(4) == 2
+        # gap rejected
+        assert not await lm.append_entries_follower(99, 1, mk_entries(100, 1))
+        # mismatched prev term rejected
+        assert not await lm.append_entries_follower(4, 1, mk_entries(5, 1, term=2))
+        await lm.shutdown()
+
+    async def test_duplicate_append_idempotent(self):
+        lm = await self.mk()
+        await lm.append_entries_follower(0, 0, mk_entries(1, 5, term=1))
+        ok = await lm.append_entries_follower(0, 0, mk_entries(1, 5, term=1))
+        assert ok and lm.last_log_index() == 5
+        await lm.shutdown()
+
+    async def test_waiters(self):
+        lm = await self.mk()
+        fut = lm.wait_for(3)
+        assert not fut.done()
+        await lm.append_entries_leader(
+            [LogEntry(type=EntryType.DATA, data=b"x") for _ in range(3)], term=1)
+        assert await fut is True
+        # already satisfied -> immediate
+        assert (await lm.wait_for(1)) is True
+        await lm.shutdown()
+
+    async def test_conf_tracking(self):
+        lm = await self.mk()
+        conf_entry = LogEntry(
+            type=EntryType.CONFIGURATION,
+            peers=[PeerId.parse("1.1.1.1:1"), PeerId.parse("1.1.1.1:2")],
+        )
+        await lm.append_entries_leader([conf_entry], term=1)
+        ce = lm.conf_manager.last()
+        assert ce.id.index == 1
+        assert len(ce.conf.peers) == 2
+        await lm.shutdown()
+
+    async def test_set_snapshot_compacts(self):
+        lm = await self.mk()
+        await lm.append_entries_leader(
+            [LogEntry(type=EntryType.DATA, data=b"x") for _ in range(10)], term=1)
+        conf = ConfigurationEntry(LogId(5, 1), Configuration.parse("1.1.1.1:1"))
+        await lm.set_snapshot(LogId(5, 1), conf)
+        assert lm.first_log_index() == 6
+        assert lm.last_log_index() == 10
+        assert lm.get_term(5) == 1  # via snapshot id
+        assert lm.check_consistency().is_ok()
+        await lm.shutdown()
+
+    async def test_set_snapshot_divergent_resets(self):
+        lm = await self.mk()
+        await lm.append_entries_follower(0, 0, mk_entries(1, 5, term=1))
+        # snapshot at index 8 term 3 — beyond our log: full reset
+        conf = ConfigurationEntry(LogId(8, 3), Configuration.parse("1.1.1.1:1"))
+        await lm.set_snapshot(LogId(8, 3), conf)
+        assert lm.first_log_index() == 9
+        assert lm.last_log_index() == 8
+        assert lm.get_term(8) == 3
+        await lm.shutdown()
+
+    async def test_concurrent_appends_batched(self):
+        lm = await self.mk()
+        async def one(i):
+            await lm.append_entries_leader(
+                [LogEntry(type=EntryType.DATA, data=f"{i}".encode())], term=1)
+        await asyncio.gather(*[one(i) for i in range(50)])
+        assert lm.last_log_index() == 50
+        await lm.shutdown()

@@ -148,9 +148,16 @@ class LogEntry:
     @staticmethod
     def decode(buf: bytes | memoryview) -> "LogEntry":
         buf = memoryview(buf)
+        if len(buf) < _HDR.size:
+            raise ValueError(f"log entry truncated: {len(buf)} < {_HDR.size} bytes")
         (magic, etype, _rsv, term, index, peers_len, _n2, data_len, crc) = _HDR.unpack(
             buf[: _HDR.size]
         )
+        if _HDR.size + peers_len + data_len != len(buf):
+            raise ValueError(
+                f"log entry size mismatch: header says "
+                f"{_HDR.size + peers_len + data_len}, have {len(buf)}"
+            )
         if magic != _MAGIC:
             raise ValueError(f"bad log entry magic: {magic:#x}")
         off = _HDR.size

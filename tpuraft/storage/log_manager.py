@@ -1,0 +1,359 @@
+"""LogManager: in-memory log window + async batched stable storage.
+
+Reference parity: ``core:storage/impl/LogManagerImpl`` (SURVEY.md §3.1,
+§4.2) — the Disruptor + AppendBatcher pipeline becomes an asyncio flusher
+task that coalesces concurrent appends into one storage write + fsync
+(storage I/O runs in a thread executor so the event loop never blocks);
+wait-listeners wake Replicators when the log grows; follower-side conflict
+resolution (``#checkAndResolveConflict``) truncates divergent suffixes;
+``#setSnapshot`` compacts the prefix after snapshots.
+
+Single-writer discipline: all public methods must be called from the
+node's event loop (the functional analog of LogManagerImpl's lock).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from tpuraft.conf import ConfigurationEntry, ConfigurationManager
+from tpuraft.entity import EntryType, LogEntry, LogId
+from tpuraft.errors import RaftError, RaftException, Status
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclass
+class _FlushReq:
+    entries: list[LogEntry]
+    future: asyncio.Future
+
+
+class LogManager:
+    def __init__(
+        self,
+        storage,
+        conf_manager: Optional[ConfigurationManager] = None,
+        sync: bool = True,
+        max_flush_batch: int = 256,
+    ):
+        self._storage = storage
+        self.conf_manager = conf_manager or ConfigurationManager()
+        self._sync = sync
+        self._max_flush_batch = max_flush_batch
+
+        self._mem: dict[int, LogEntry] = {}  # unstable + recent window
+        self._first_index = 1
+        self._last_index = 0          # includes unstable entries
+        self._stable_index = 0        # flushed to storage
+        self._applied_index = 0
+        self._last_snapshot_id = LogId(0, 0)
+
+        self._queue: asyncio.Queue[_FlushReq | None] = asyncio.Queue()
+        self._inflight_flushes = 0
+        self._flush_idle = asyncio.Event()
+        self._flush_idle.set()
+        self._flusher: Optional[asyncio.Task] = None
+        self._waiters: list[tuple[int, asyncio.Future]] = []
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def init(self) -> None:
+        self._storage.init()
+        self._first_index = self._storage.first_log_index()
+        self._last_index = self._storage.last_log_index()
+        self._stable_index = self._last_index
+        # rebuild configuration history from the stored log (sidecar index:
+        # O(#conf entries), not O(n) — see LogStorage#configuration_indexes)
+        loop = asyncio.get_running_loop()
+        conf_indexes = await loop.run_in_executor(
+            None, self._storage.configuration_indexes)
+        for i in conf_indexes:
+            e = self._storage.get_entry(i)
+            if e and e.type == EntryType.CONFIGURATION:
+                self._track_conf(e)
+        self._flusher = asyncio.ensure_future(self._flush_loop())
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        if self._flusher:
+            await self._queue.put(None)
+            await self._flusher
+            self._flusher = None
+        self._wake_waiters(error=True)
+        self._storage.shutdown()
+
+    # -- queries ------------------------------------------------------------
+
+    def first_log_index(self) -> int:
+        return self._first_index
+
+    def last_log_index(self) -> int:
+        return self._last_index
+
+    def last_log_id(self) -> LogId:
+        if self._last_index == self._last_snapshot_id.index:
+            return self._last_snapshot_id
+        return LogId(self._last_index, self.get_term(self._last_index))
+
+    def last_snapshot_id(self) -> LogId:
+        return self._last_snapshot_id
+
+    def get_entry(self, index: int) -> Optional[LogEntry]:
+        if index > self._last_index or index < self._first_index:
+            return None
+        e = self._mem.get(index)
+        if e is not None:
+            return e
+        return self._storage.get_entry(index)
+
+    def get_term(self, index: int) -> int:
+        if index == 0:
+            return 0
+        if index == self._last_snapshot_id.index:
+            return self._last_snapshot_id.term
+        e = self.get_entry(index)
+        return e.id.term if e else 0
+
+    def get_entries(self, from_index: int, max_count: int, max_bytes: int
+                    ) -> list[LogEntry]:
+        """Contiguous batch for replication, bounded by count and bytes."""
+        out: list[LogEntry] = []
+        size = 0
+        i = from_index
+        while i <= self._last_index and len(out) < max_count:
+            e = self.get_entry(i)
+            if e is None:
+                break
+            size += len(e.data)
+            if out and size > max_bytes:
+                break
+            out.append(e)
+            i += 1
+        return out
+
+    # -- appends ------------------------------------------------------------
+
+    async def append_entries_leader(self, entries: list[LogEntry], term: int
+                                    ) -> LogId:
+        """Assign indexes/terms and persist. Resolves after fsync."""
+        for e in entries:
+            self._last_index += 1
+            e.id = LogId(self._last_index, term)
+            self._mem[e.id.index] = e
+            if e.type == EntryType.CONFIGURATION:
+                self._track_conf(e)
+        last_id = LogId(self._last_index, term)
+        await self._enqueue_flush(entries)
+        self._wake_waiters()
+        return last_id
+
+    async def append_entries_follower(self, prev_log_index: int, prev_log_term: int,
+                                      entries: list[LogEntry]) -> bool:
+        """Conflict-checked follower append (#checkAndResolveConflict).
+
+        Returns False when prev_log does not match (leader must back off).
+        """
+        if prev_log_index > self._last_index:
+            return False  # gap: we don't have prev yet
+        if prev_log_index >= self._first_index - 1 or (
+            prev_log_index == self._last_snapshot_id.index
+        ):
+            if self.get_term(prev_log_index) != prev_log_term:
+                return False
+        # else: prev lies in the compacted region — those entries were
+        # committed, so Raft's Log Matching property guarantees agreement.
+        if not entries:
+            return True
+        # skip entries we already have with matching terms
+        keep_from = 0
+        for i, e in enumerate(entries):
+            if (e.id.index < self._first_index
+                    or e.id.index <= self._last_snapshot_id.index):
+                # already compacted => committed; a stale retransmission
+                keep_from = i + 1
+                continue
+            if e.id.index > self._last_index:
+                keep_from = i
+                break
+            if self.get_term(e.id.index) != e.id.term:
+                # conflict: truncate our suffix from this index
+                if e.id.index <= self._applied_index:
+                    raise RaftException(Status.error(
+                        RaftError.EINTERNAL,
+                        f"conflict at applied index {e.id.index}"))
+                await self._truncate_suffix(e.id.index - 1)
+                keep_from = i
+                break
+            keep_from = i + 1
+        new_entries = entries[keep_from:]
+        if not new_entries:
+            return True
+        for e in new_entries:
+            self._mem[e.id.index] = e
+            self._last_index = e.id.index
+            if e.type == EntryType.CONFIGURATION:
+                self._track_conf(e)
+        await self._enqueue_flush(new_entries)
+        self._wake_waiters()
+        return True
+
+    def _track_conf(self, e: LogEntry) -> None:
+        from tpuraft.conf import Configuration
+
+        ce = ConfigurationEntry(
+            id=e.id,
+            conf=Configuration(list(e.peers or []), list(e.learners or [])),
+            old_conf=Configuration(list(e.old_peers or []), list(e.old_learners or [])),
+        )
+        self.conf_manager.add(ce)
+
+    # -- flush pipeline ------------------------------------------------------
+
+    async def _enqueue_flush(self, entries: list[LogEntry]) -> None:
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight_flushes += 1
+        self._flush_idle.clear()
+        try:
+            await self._queue.put(_FlushReq(entries, fut))
+            await fut
+        finally:
+            self._inflight_flushes -= 1
+            if self._inflight_flushes == 0:
+                self._flush_idle.set()
+
+    async def _flush_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            req = await self._queue.get()
+            if req is None:
+                return
+            batch = [req]
+            # coalesce everything already queued (AppendBatcher)
+            while not self._queue.empty() and len(batch) < self._max_flush_batch:
+                nxt = self._queue.get_nowait()
+                if nxt is None:
+                    await self._queue.put(None)
+                    break
+                batch.append(nxt)
+            entries = [e for r in batch for e in r.entries]
+            try:
+                if entries:
+                    await loop.run_in_executor(
+                        None, self._storage.append_entries, entries, self._sync
+                    )
+                    self._stable_index = max(self._stable_index, entries[-1].id.index)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_result(True)
+            except Exception as exc:  # storage failure is fatal for the node
+                LOG.exception("log flush failed")
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            RaftException(Status.error(RaftError.EIO, str(exc))))
+
+    async def _drain_flushes(self) -> None:
+        """Wait until every in-flight flush completed (before truncation —
+        the reference funnels truncates through the same disruptor for the
+        same ordering guarantee)."""
+        await self._flush_idle.wait()
+
+    async def _truncate_suffix(self, last_index_kept: int) -> None:
+        await self._drain_flushes()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._storage.truncate_suffix, last_index_kept)
+        for i in range(last_index_kept + 1, self._last_index + 1):
+            self._mem.pop(i, None)
+        self._last_index = last_index_kept
+        self._stable_index = min(self._stable_index, last_index_kept)
+        self.conf_manager.truncate_suffix(last_index_kept)
+
+    # -- snapshot interaction ------------------------------------------------
+
+    async def set_snapshot(self, snapshot_id: LogId, conf: ConfigurationEntry,
+                           keep_margin: int = 0) -> None:
+        """Record a completed snapshot and compact the log prefix
+        (reference: LogManagerImpl#setSnapshot + truncatePrefix)."""
+        if snapshot_id.index <= self._last_snapshot_id.index:
+            return
+        term_here = self.get_term(snapshot_id.index)  # before updating snapshot id
+        self._last_snapshot_id = snapshot_id
+        self.conf_manager.set_snapshot(conf)
+        first_kept = snapshot_id.index + 1 - keep_margin
+        if term_here == snapshot_id.term:
+            # local log agrees with the snapshot: keep the tail after it
+            first_kept = min(first_kept, snapshot_id.index + 1)
+        else:
+            # log diverges from (or predates) the snapshot: drop everything
+            await self._drain_flushes()
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, self._storage.reset, snapshot_id.index + 1)
+            self._mem.clear()
+            self._first_index = snapshot_id.index + 1
+            self._last_index = snapshot_id.index
+            self._stable_index = snapshot_id.index
+            self.conf_manager.truncate_prefix(self._first_index)
+            return
+        first_kept = max(self._first_index, first_kept)
+        if first_kept > self._first_index:
+            await self._drain_flushes()
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._storage.truncate_prefix, first_kept)
+            for i in range(self._first_index, first_kept):
+                self._mem.pop(i, None)
+            self._first_index = first_kept
+            self.conf_manager.truncate_prefix(first_kept)
+
+    def set_applied_index(self, index: int) -> None:
+        self._applied_index = max(self._applied_index, index)
+        # trim the in-memory window: stable AND applied entries can be dropped
+        trim_to = min(self._applied_index, self._stable_index)
+        for i in [i for i in self._mem if i <= trim_to]:
+            del self._mem[i]
+
+    # -- waiters (replicator wakeup) -----------------------------------------
+
+    def wait_for(self, index: int) -> asyncio.Future:
+        """Future resolving True when last_log_index >= index (or False on
+        shutdown). Reference: LogManager#wait + wakeupAllWaiter."""
+        fut = asyncio.get_running_loop().create_future()
+        if self._last_index >= index or self._stopped:
+            fut.set_result(self._last_index >= index)
+            return fut
+        self._waiters.append((index, fut))
+        return fut
+
+    def _wake_waiters(self, error: bool = False) -> None:
+        rest: list[tuple[int, asyncio.Future]] = []
+        for idx, fut in self._waiters:
+            if fut.done():
+                continue
+            if error:
+                fut.set_result(False)
+            elif self._last_index >= idx:
+                fut.set_result(True)
+            else:
+                rest.append((idx, fut))
+        self._waiters = rest
+
+    # -- consistency ---------------------------------------------------------
+
+    def check_consistency(self) -> Status:
+        if self._first_index == 1 and self._last_snapshot_id.index == 0:
+            return Status.OK()
+        if (self._last_snapshot_id.index >= self._first_index - 1
+                and self._last_snapshot_id.index <= self._last_index):
+            return Status.OK()
+        if self._last_snapshot_id.index == self._last_index:
+            return Status.OK()
+        return Status.error(
+            RaftError.EINTERNAL,
+            f"inconsistent log: first={self._first_index} last={self._last_index} "
+            f"snapshot={self._last_snapshot_id.index}",
+        )

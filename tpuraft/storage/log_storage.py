@@ -1,0 +1,436 @@
+"""Durable raft log storage.
+
+Reference parity: ``core:storage/LogStorage`` interface and
+``core:storage/impl/RocksDBLogStorage`` (SURVEY.md §3.1 "Log storage").
+Where the reference keys RocksDB by 8-byte big-endian index, this build
+uses a *segmented append log* purpose-built for raft's access pattern
+(append-mostly, contiguous reads, prefix/suffix truncation) — the same
+format the native C++ engine (native/logstore.cc) implements, selected by
+``log_uri`` scheme:
+
+  memory://            in-memory (tests, benchmarks)
+  file://<dir>         Python segmented log (this module)
+  native://<dir>       C++ engine via ctypes (tpuraft.storage.native_log)
+
+On-disk format per segment ``seg_<first_index>.log``:
+  repeated [ u32 frame_len | LogEntry.encode() bytes ]  (CRC inside entry)
+A tiny ``meta`` file persists first_log_index for prefix truncation.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from tpuraft.entity import EntryType, LogEntry
+
+_FRAME = struct.Struct("<I")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creations inside it survive power loss."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class LogStorage(ABC):
+    """Synchronous storage SPI; LogManager wraps it with async batching."""
+
+    @abstractmethod
+    def init(self) -> None: ...
+
+    @abstractmethod
+    def shutdown(self) -> None: ...
+
+    @abstractmethod
+    def first_log_index(self) -> int: ...
+
+    @abstractmethod
+    def last_log_index(self) -> int: ...
+
+    @abstractmethod
+    def get_entry(self, index: int) -> Optional[LogEntry]: ...
+
+    def get_term(self, index: int) -> int:
+        e = self.get_entry(index)
+        return e.id.term if e else 0
+
+    @abstractmethod
+    def append_entries(self, entries: list[LogEntry], sync: bool = True) -> int:
+        """Append a batch; returns count appended."""
+
+    @abstractmethod
+    def truncate_prefix(self, first_index_kept: int) -> None:
+        """Drop entries < first_index_kept (snapshot compaction)."""
+
+    @abstractmethod
+    def truncate_suffix(self, last_index_kept: int) -> None:
+        """Drop entries > last_index_kept (conflict resolution)."""
+
+    @abstractmethod
+    def reset(self, next_log_index: int) -> None:
+        """Drop everything; next append starts at next_log_index
+        (InstallSnapshot beyond local log)."""
+
+    def configuration_indexes(self) -> list[int]:
+        """Indexes of CONFIGURATION entries currently stored — lets the
+        LogManager rebuild configuration history without an O(n) scan
+        (the reference keeps conf entries in their own column family)."""
+        return [
+            i
+            for i in range(self.first_log_index(), self.last_log_index() + 1)
+            if (e := self.get_entry(i)) and e.type == EntryType.CONFIGURATION
+        ]
+
+
+class MemoryLogStorage(LogStorage):
+    """Reference test double (``MemoryLogStorage`` exists upstream too)."""
+
+    def __init__(self) -> None:
+        self._entries: dict[int, LogEntry] = {}
+        self._first = 1
+        self._last = 0
+
+    def init(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        self._entries.clear()
+
+    def first_log_index(self) -> int:
+        return self._first
+
+    def last_log_index(self) -> int:
+        return self._last
+
+    def get_entry(self, index: int) -> Optional[LogEntry]:
+        return self._entries.get(index)
+
+    def append_entries(self, entries: list[LogEntry], sync: bool = True) -> int:
+        for e in entries:
+            self._entries[e.id.index] = e
+            self._last = max(self._last, e.id.index)
+        return len(entries)
+
+    def truncate_prefix(self, first_index_kept: int) -> None:
+        for i in range(self._first, first_index_kept):
+            self._entries.pop(i, None)
+        self._first = max(self._first, first_index_kept)
+        if self._last < self._first - 1:
+            self._last = self._first - 1
+
+    def truncate_suffix(self, last_index_kept: int) -> None:
+        for i in range(last_index_kept + 1, self._last + 1):
+            self._entries.pop(i, None)
+        self._last = min(self._last, last_index_kept)
+
+    def reset(self, next_log_index: int) -> None:
+        self._entries.clear()
+        self._first = next_log_index
+        self._last = next_log_index - 1
+
+
+class _Segment:
+    """One append-only segment file with an in-memory offset index."""
+
+    def __init__(self, path: str, first_index: int):
+        self.path = path
+        self.first_index = first_index
+        self.offsets: list[int] = []  # offsets[i] = file offset of entry first_index+i
+        self.size = 0
+        self._f = None  # type: ignore[assignment]
+
+    @property
+    def last_index(self) -> int:
+        return self.first_index + len(self.offsets) - 1
+
+    def open(self) -> None:
+        exists = os.path.exists(self.path)
+        self._f = open(self.path, "r+b" if exists else "w+b")
+        if exists:
+            self._scan()
+
+    def _scan(self) -> None:
+        """Rebuild the offset index; truncate a torn tail write if found."""
+        f = self._f
+        f.seek(0, os.SEEK_END)
+        end = f.tell()
+        f.seek(0)
+        off = 0
+        good_end = 0
+        while off + _FRAME.size <= end:
+            f.seek(off)
+            (flen,) = _FRAME.unpack(f.read(_FRAME.size))
+            if off + _FRAME.size + flen > end:
+                break  # torn write
+            blob = f.read(flen)
+            try:
+                LogEntry.decode(blob)  # CRC + framing check
+            except (ValueError, struct.error):
+                break
+            self.offsets.append(off)
+            off += _FRAME.size + flen
+            good_end = off
+        if good_end < end:
+            f.truncate(good_end)
+        self.size = good_end
+
+    def append(self, blob: bytes) -> None:
+        self._f.seek(self.size)
+        self._f.write(_FRAME.pack(len(blob)))
+        self._f.write(blob)
+        self.offsets.append(self.size)
+        self.size += _FRAME.size + len(blob)
+
+    def read(self, index: int) -> LogEntry:
+        off = self.offsets[index - self.first_index]
+        self._f.seek(off)
+        (flen,) = _FRAME.unpack(self._f.read(_FRAME.size))
+        return LogEntry.decode(self._f.read(flen))
+
+    def truncate_to(self, last_index_kept: int) -> None:
+        n_keep = last_index_kept - self.first_index + 1
+        if n_keep >= len(self.offsets):
+            return
+        new_size = self.offsets[n_keep] if n_keep > 0 else 0
+        self._f.truncate(new_size)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        del self.offsets[n_keep:]
+        self.size = new_size
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def delete(self) -> None:
+        self.close()
+        if os.path.exists(self.path):
+            os.remove(self.path)
+
+
+class FileLogStorage(LogStorage):
+    """Segmented append-log storage (Python implementation)."""
+
+    SEGMENT_MAX_BYTES = 64 * 1024 * 1024
+
+    def __init__(self, dir_path: str, segment_max_bytes: int | None = None):
+        self._dir = dir_path
+        self._segments: list[_Segment] = []
+        self._first = 1
+        self._seg_max = segment_max_bytes or self.SEGMENT_MAX_BYTES
+        self._conf_indexes: list[int] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        self._load_meta()
+        names = sorted(
+            (n for n in os.listdir(self._dir) if n.startswith("seg_") and n.endswith(".log")),
+            key=lambda n: int(n[4:-4]),
+        )
+        drop_rest = False
+        for n in names:
+            seg = _Segment(os.path.join(self._dir, n), int(n[4:-4]))
+            seg.open()
+            # stale: fully below first_log_index — crash mid truncate_prefix
+            # (meta saved, file not yet deleted)
+            stale = seg.first_index < self._first and (
+                not seg.offsets or seg.last_index < self._first
+            )
+            if drop_rest or stale:
+                seg.delete()
+                continue
+            if not seg.offsets or (
+                self._segments
+                and seg.first_index != self._segments[-1].last_index + 1
+            ):
+                # empty (torn) segment or a hole from a torn multi-segment
+                # batch append: everything from here on is unreachable
+                seg.delete()
+                drop_rest = True
+                continue
+            self._segments.append(seg)
+        self._load_conf_indexes()
+
+    def shutdown(self) -> None:
+        for s in self._segments:
+            s.close()
+        self._segments.clear()
+
+    def _meta_path(self) -> str:
+        return os.path.join(self._dir, "meta")
+
+    def _load_meta(self) -> None:
+        try:
+            with open(self._meta_path(), "rb") as f:
+                self._first = struct.unpack("<q", f.read(8))[0]
+        except FileNotFoundError:
+            self._first = 1
+
+    def _save_meta(self) -> None:
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<q", self._first))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path())
+        _fsync_dir(self._dir)
+
+    # conf sidecar: indexes of CONFIGURATION entries, so LogManager init
+    # avoids an O(n) scan (reference: RocksDB conf column family)
+
+    def _conf_path(self) -> str:
+        return os.path.join(self._dir, "conf.idx")
+
+    def _load_conf_indexes(self) -> None:
+        self._conf_indexes = []
+        try:
+            with open(self._conf_path(), "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return
+        n = len(blob) // 8
+        first, last = self._first, self.last_log_index()
+        self._conf_indexes = [
+            i
+            for (i,) in struct.iter_unpack("<q", blob[: n * 8])
+            if first <= i <= last
+        ]
+
+    def _rewrite_conf_indexes(self) -> None:
+        tmp = self._conf_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(b"".join(struct.pack("<q", i) for i in self._conf_indexes))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._conf_path())
+        _fsync_dir(self._dir)
+
+    def configuration_indexes(self) -> list[int]:
+        return list(self._conf_indexes)
+
+    # -- queries ------------------------------------------------------------
+
+    def first_log_index(self) -> int:
+        return self._first
+
+    def last_log_index(self) -> int:
+        if not self._segments:
+            return self._first - 1
+        return self._segments[-1].last_index
+
+    def _find_segment(self, index: int) -> Optional[_Segment]:
+        lo, hi = 0, len(self._segments) - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            s = self._segments[mid]
+            if index < s.first_index:
+                hi = mid - 1
+            elif index > s.last_index:
+                lo = mid + 1
+            else:
+                return s
+        return None
+
+    def get_entry(self, index: int) -> Optional[LogEntry]:
+        if index < self._first:
+            return None
+        s = self._find_segment(index)
+        return s.read(index) if s else None
+
+    # -- mutations ----------------------------------------------------------
+
+    def append_entries(self, entries: list[LogEntry], sync: bool = True) -> int:
+        if not entries:
+            return 0
+        expected = self.last_log_index() + 1
+        if entries[0].id.index != expected:
+            raise ValueError(
+                f"non-contiguous append: have last={expected - 1}, got {entries[0].id.index}"
+            )
+        touched: list[_Segment] = []
+        new_conf = False
+        for e in entries:
+            if not self._segments or self._segments[-1].size >= self._seg_max:
+                seg = _Segment(
+                    os.path.join(self._dir, f"seg_{e.id.index}.log"), e.id.index
+                )
+                seg.open()
+                _fsync_dir(self._dir)
+                self._segments.append(seg)
+            cur = self._segments[-1]
+            cur.append(e.encode())
+            if not touched or touched[-1] is not cur:
+                touched.append(cur)
+            if e.type == EntryType.CONFIGURATION:
+                self._conf_indexes.append(e.id.index)
+                new_conf = True
+        if sync:
+            # fsync oldest-first so a crash leaves a prefix, never a hole
+            for seg in touched:
+                seg.sync()
+        if new_conf:
+            self._rewrite_conf_indexes()
+        return len(entries)
+
+    def truncate_prefix(self, first_index_kept: int) -> None:
+        if first_index_kept <= self._first:
+            return
+        self._first = first_index_kept
+        self._save_meta()
+        # background-safe: delete whole segments strictly below the kept index
+        while self._segments and self._segments[0].last_index < first_index_kept:
+            self._segments.pop(0).delete()
+        if self._conf_indexes and self._conf_indexes[0] < first_index_kept:
+            self._conf_indexes = [i for i in self._conf_indexes if i >= first_index_kept]
+            self._rewrite_conf_indexes()
+
+    def truncate_suffix(self, last_index_kept: int) -> None:
+        while self._segments and self._segments[-1].first_index > last_index_kept:
+            self._segments.pop().delete()
+        if self._segments:
+            self._segments[-1].truncate_to(last_index_kept)
+        if self._conf_indexes and self._conf_indexes[-1] > last_index_kept:
+            self._conf_indexes = [i for i in self._conf_indexes if i <= last_index_kept]
+            self._rewrite_conf_indexes()
+
+    def reset(self, next_log_index: int) -> None:
+        for s in self._segments:
+            s.delete()
+        self._segments.clear()
+        self._first = next_log_index
+        self._conf_indexes = []
+        self._rewrite_conf_indexes()
+        self._save_meta()
+
+
+def create_log_storage(uri: str) -> LogStorage:
+    """SPI-style factory by URI scheme (reference: DefaultJRaftServiceFactory
+    #createLogStorage via JRaftServiceLoader)."""
+    if not uri or uri == "memory://" or uri.startswith("memory"):
+        return MemoryLogStorage()
+    if uri.startswith("file://"):
+        return FileLogStorage(uri[len("file://"):])
+    if uri.startswith("native://"):
+        try:
+            from tpuraft.storage.native_log import NativeLogStorage
+        except ImportError as exc:
+            raise ValueError(
+                "native:// log storage requires the C++ engine "
+                "(build with `make -C native`); falling back is deliberate "
+                f"not automatic: {exc}"
+            ) from exc
+        return NativeLogStorage(uri[len("native://"):])
+    raise ValueError(f"unknown log storage uri: {uri}")

@@ -1,0 +1,87 @@
+"""Durable {term, votedFor} — the tiny file raft must fsync before voting.
+
+Reference parity: ``core:storage/impl/LocalRaftMetaStorage`` over
+``core:storage/io/ProtoBufFile`` (SURVEY.md §3.1).  Format: fixed little-
+endian struct + crc32, written tmp-then-atomic-rename.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from tpuraft.entity import EMPTY_PEER, PeerId
+
+_FMT = struct.Struct("<qI")  # term, crc of (term||votedFor str)
+
+
+class RaftMetaStorage:
+    def __init__(self, dir_path: str, sync: bool = True):
+        self._dir = dir_path
+        self._sync = sync
+        self.term = 0
+        self.voted_for: PeerId = EMPTY_PEER
+
+    def _path(self) -> str:
+        return os.path.join(self._dir, "raft_meta")
+
+    def init(self) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        try:
+            with open(self._path(), "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return
+        if len(blob) < _FMT.size:
+            raise IOError(f"raft meta truncated in {self._dir}")
+        term, crc = _FMT.unpack_from(blob, 0)
+        voted = blob[_FMT.size:]
+        if zlib.crc32(struct.pack("<q", term) + voted) != crc:
+            raise IOError(f"raft meta corrupted in {self._dir}")
+        self.term = term
+        self.voted_for = PeerId.parse(voted.decode()) if voted else EMPTY_PEER
+
+    def set_term_and_voted_for(self, term: int, voted_for: PeerId) -> None:
+        self.term = term
+        self.voted_for = voted_for
+        self._save()
+
+    def set_term(self, term: int) -> None:
+        self.set_term_and_voted_for(term, self.voted_for)
+
+    def set_voted_for(self, voted_for: PeerId) -> None:
+        self.set_term_and_voted_for(self.term, voted_for)
+
+    def _save(self) -> None:
+        voted = b"" if self.voted_for.is_empty() else str(self.voted_for).encode()
+        crc = zlib.crc32(struct.pack("<q", self.term) + voted)
+        tmp = self._path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_FMT.pack(self.term, crc) + voted)
+            f.flush()
+            if self._sync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._path())
+        if self._sync:
+            fd = os.open(self._dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class MemoryRaftMetaStorage(RaftMetaStorage):
+    """Volatile variant for tests/benchmarks."""
+
+    def __init__(self) -> None:
+        super().__init__("", sync=False)
+
+    def init(self) -> None:
+        pass
+
+    def _save(self) -> None:
+        pass
