@@ -1,0 +1,172 @@
+"""TestCluster: N full Nodes in one process over the loopback transport.
+
+The reference's signature integration pattern (SURVEY.md §5): real
+protocol, real storage, fault injection by stopping/partitioning
+endpoints.  MockStateMachine records applied entries and exposes events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.core.node import Node, State
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.core.state_machine import Iterator, StateMachine
+from tpuraft.entity import PeerId, Task
+from tpuraft.errors import Status
+from tpuraft.options import NodeOptions, RaftOptions
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+
+class MockStateMachine(StateMachine):
+    def __init__(self):
+        self.logs: list[bytes] = []
+        self.applied_event = asyncio.Event()
+        self.leader_terms: list[int] = []
+        self.snapshots_saved = 0
+        self.snapshots_loaded = 0
+        self.errors: list[Status] = []
+
+    async def on_apply(self, it: Iterator) -> None:
+        while it.valid():
+            self.logs.append(it.data())
+            it.next()
+        self.applied_event.set()
+
+    async def on_leader_start(self, term: int) -> None:
+        self.leader_terms.append(term)
+
+    async def on_error(self, status: Status) -> None:
+        self.errors.append(status)
+
+    async def on_snapshot_save(self, writer, done) -> None:
+        import struct
+
+        blob = struct.pack("<I", len(self.logs)) + b"".join(
+            struct.pack("<I", len(x)) + x for x in self.logs)
+        writer.write_file("data", blob)
+        self.snapshots_saved += 1
+        done(Status.OK())
+
+    async def on_snapshot_load(self, reader) -> bool:
+        import struct
+
+        blob = reader.read_file("data")
+        if blob is None:
+            return False
+        (n,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        self.logs = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            self.logs.append(bytes(blob[off:off + ln]))
+            off += ln
+        self.snapshots_loaded += 1
+        return True
+
+
+class TestCluster:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, n: int, tmp_path=None, election_timeout_ms: int = 300,
+                 snapshot: bool = False, group_id: str = "test_group"):
+        self.net = InProcNetwork()
+        self.group_id = group_id
+        self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
+        self.conf = Configuration(list(self.peers))
+        self.tmp_path = tmp_path
+        self.election_timeout_ms = election_timeout_ms
+        self.snapshot = snapshot
+        self.nodes: dict[PeerId, Node] = {}
+        self.fsms: dict[PeerId, MockStateMachine] = {}
+        self.managers: dict[PeerId, NodeManager] = {}
+
+    def _options(self, peer: PeerId) -> NodeOptions:
+        opts = NodeOptions(
+            election_timeout_ms=self.election_timeout_ms,
+            initial_conf=self.conf.copy(),
+            fsm=self.fsms[peer],
+        )
+        if self.tmp_path is not None:
+            base = f"{self.tmp_path}/{peer.ip}_{peer.port}"
+            opts.log_uri = f"file://{base}/log"
+            opts.raft_meta_uri = f"file://{base}/meta"
+            if self.snapshot:
+                opts.snapshot_uri = f"file://{base}/snapshot"
+        else:
+            opts.log_uri = "memory://"
+            opts.raft_meta_uri = "memory://"
+        opts.snapshot.interval_secs = 0  # only on-demand snapshots in tests
+        return opts
+
+    async def start_all(self) -> None:
+        for p in self.peers:
+            await self.start(p)
+
+    async def start(self, peer: PeerId, fsm: Optional[MockStateMachine] = None
+                    ) -> Node:
+        if fsm is not None or peer not in self.fsms:
+            self.fsms[peer] = fsm or MockStateMachine()
+        server = RpcServer(peer.endpoint)
+        manager = NodeManager(server)
+        self.net.bind(server)
+        self.net.start_endpoint(peer.endpoint)
+        transport = InProcTransport(self.net, peer.endpoint)
+        node = Node(self.group_id, peer, self._options(peer), transport)
+        node.node_manager = manager
+        manager.add(node)
+        ok = await node.init()
+        assert ok, f"init failed for {peer}"
+        self.nodes[peer] = node
+        self.managers[peer] = manager
+        return node
+
+    async def stop(self, peer: PeerId) -> None:
+        """Crash-stop: unbind from the network, shut the node down."""
+        self.net.stop_endpoint(peer.endpoint)
+        node = self.nodes.pop(peer, None)
+        if node:
+            self.net.unbind(peer.endpoint)
+            await node.shutdown()
+
+    async def stop_all(self) -> None:
+        for p in list(self.nodes):
+            await self.stop(p)
+
+    async def wait_leader(self, timeout_s: float = 5.0) -> Node:
+        """Poll until exactly one live node is leader (reference:
+        TestCluster#waitLeader)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            leaders = [n for n in self.nodes.values() if n.state == State.LEADER]
+            if len(leaders) == 1:
+                # require a majority following it
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError(
+            f"no leader in {timeout_s}s; states="
+            f"{[(str(p), n.state.value) for p, n in self.nodes.items()]}")
+
+    async def apply_ok(self, node: Node, data: bytes, timeout_s: float = 5.0
+                       ) -> Status:
+        fut = asyncio.get_running_loop().create_future()
+        await node.apply(Task(data=data, done=lambda st: fut.set_result(st)))
+        return await asyncio.wait_for(fut, timeout_s)
+
+    async def wait_applied(self, count: int, timeout_s: float = 5.0,
+                           nodes=None) -> None:
+        """Wait until every (given) node's FSM has `count` log entries."""
+        deadline = time.monotonic() + timeout_s
+        targets = nodes if nodes is not None else list(self.nodes.values())
+        while time.monotonic() < deadline:
+            if all(len(self.fsms[n.server_id].logs) >= count for n in targets
+                   if n.server_id in self.fsms):
+                return
+            await asyncio.sleep(0.02)
+        states = {str(n.server_id): len(self.fsms[n.server_id].logs)
+                  for n in targets}
+        raise TimeoutError(f"applied counts after {timeout_s}s: {states}")

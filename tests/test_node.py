@@ -1,0 +1,290 @@
+"""Multi-node integration tests: the reference's NodeTest tier
+(SURVEY.md §5) — elections, replication, fail-over, restart recovery,
+partitions, leadership transfer, membership change, linearizable reads.
+"""
+
+import asyncio
+
+import pytest
+
+from tests.cluster import MockStateMachine, TestCluster
+from tpuraft.core.node import State
+from tpuraft.core.read_only import ReadIndexError
+from tpuraft.entity import PeerId, Task
+from tpuraft.errors import RaftError, Status
+
+
+async def test_single_node_becomes_leader_and_applies():
+    c = TestCluster(1)
+    await c.start_all()
+    leader = await c.wait_leader()
+    st = await c.apply_ok(leader, b"hello")
+    assert st.is_ok()
+    await c.wait_applied(1)
+    assert c.fsms[leader.server_id].logs == [b"hello"]
+    await c.stop_all()
+
+
+async def test_triple_node_elect_and_replicate():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(10):
+        st = await c.apply_ok(leader, b"op%d" % i)
+        assert st.is_ok(), str(st)
+    await c.wait_applied(10)
+    for p in c.peers:
+        assert c.fsms[p].logs == [b"op%d" % i for i in range(10)]
+    # exactly one leader, others followers
+    assert sum(1 for n in c.nodes.values() if n.state == State.LEADER) == 1
+    await c.stop_all()
+
+
+async def test_apply_on_follower_rejected():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    follower = next(n for n in c.nodes.values() if n is not leader)
+    st = await c.apply_ok(follower, b"nope")
+    assert not st.is_ok()
+    assert st.raft_error == RaftError.EPERM
+    await c.stop_all()
+
+
+async def test_leader_failover():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"before")
+    await c.wait_applied(1)
+    dead = leader.server_id
+    await c.stop(dead)
+    leader2 = await c.wait_leader()
+    assert leader2.server_id != dead
+    st = await c.apply_ok(leader2, b"after")
+    assert st.is_ok()
+    await c.wait_applied(2)
+    for p, n in c.nodes.items():
+        assert c.fsms[p].logs == [b"before", b"after"]
+    await c.stop_all()
+
+
+async def test_restart_recovery_from_log(tmp_path):
+    c = TestCluster(3, tmp_path=tmp_path)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(5):
+        await c.apply_ok(leader, b"v%d" % i)
+    await c.wait_applied(5)
+    await c.stop_all()
+    # full restart: state must replay from durable log
+    c2 = TestCluster(3, tmp_path=tmp_path)
+    c2.net = c.net
+    await c2.start_all()
+    leader2 = await c2.wait_leader()
+    await c2.apply_ok(leader2, b"v5")
+    await c2.wait_applied(6)
+    for p in c2.peers:
+        assert c2.fsms[p].logs == [b"v%d" % i for i in range(6)]
+    await c2.stop_all()
+
+
+async def test_partitioned_leader_steps_down_and_rejoins():
+    c = TestCluster(3, election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"a")
+    await c.wait_applied(1)
+    # isolate the leader: remaining majority elects a new one
+    c.net.isolate(leader.server_id.endpoint)
+    others = [n for n in c.nodes.values() if n is not leader]
+    deadline = asyncio.get_running_loop().time() + 5
+    new_leader = None
+    while asyncio.get_running_loop().time() < deadline:
+        cands = [n for n in others if n.state == State.LEADER]
+        if cands:
+            new_leader = cands[0]
+            break
+        await asyncio.sleep(0.02)
+    assert new_leader is not None, "majority side failed to elect"
+    st = await c.apply_ok(new_leader, b"b")
+    assert st.is_ok()
+    # old leader must have stepped down (lost quorum)
+    deadline = asyncio.get_running_loop().time() + 3
+    while asyncio.get_running_loop().time() < deadline:
+        if leader.state != State.LEADER:
+            break
+        await asyncio.sleep(0.02)
+    assert leader.state != State.LEADER, "isolated leader still thinks it leads"
+    # heal: old leader rejoins as follower and catches up
+    c.net.heal()
+    await c.wait_applied(2)
+    assert c.fsms[leader.server_id].logs == [b"a", b"b"]
+    # pre-vote means terms didn't explode while partitioned
+    assert new_leader.current_term <= leader.current_term + 2
+    await c.stop_all()
+
+
+async def test_symmetric_partition_no_term_explosion():
+    """Pre-vote: an isolated node must NOT bump its term while cut off."""
+    c = TestCluster(3, election_timeout_ms=150)
+    await c.start_all()
+    leader = await c.wait_leader()
+    victim = next(n for n in c.nodes.values() if n is not leader)
+    term_before = victim.current_term
+    c.net.isolate(victim.server_id.endpoint)
+    await asyncio.sleep(1.0)  # several election timeouts worth
+    assert victim.current_term == term_before, (
+        f"term exploded: {term_before} -> {victim.current_term}")
+    c.net.heal()
+    await c.stop_all()
+
+
+async def test_transfer_leadership():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"x")
+    target = next(p for p in c.peers if p != leader.server_id)
+    st = await leader.transfer_leadership_to(target)
+    assert st.is_ok(), str(st)
+    deadline = asyncio.get_running_loop().time() + 5
+    while asyncio.get_running_loop().time() < deadline:
+        t_node = c.nodes[target]
+        if t_node.state == State.LEADER:
+            break
+        await asyncio.sleep(0.02)
+    assert c.nodes[target].state == State.LEADER
+    st = await c.apply_ok(c.nodes[target], b"y")
+    assert st.is_ok()
+    await c.wait_applied(2)
+    await c.stop_all()
+
+
+async def test_read_index_leader_and_follower():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"r1")
+    await c.wait_applied(1)
+    idx = await leader.read_index()
+    assert idx >= 1
+    follower = next(n for n in c.nodes.values() if n is not leader)
+    idx_f = await follower.read_index()
+    assert idx_f >= 1
+    # follower FSM has applied through idx_f: linearizable local read
+    assert len(c.fsms[follower.server_id].logs) >= 1
+    await c.stop_all()
+
+
+async def test_read_index_fails_without_quorum():
+    c = TestCluster(3, election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader()
+    c.net.isolate(leader.server_id.endpoint)
+    with pytest.raises(ReadIndexError):
+        await asyncio.wait_for(leader.read_index(), 3)
+    c.net.heal()
+    await c.stop_all()
+
+
+async def test_add_peer():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(5):
+        await c.apply_ok(leader, b"d%d" % i)
+    await c.wait_applied(5)
+    # boot a 4th node with empty conf: it learns via replication
+    new_peer = PeerId.parse("127.0.0.1:5003")
+    c.peers.append(new_peer)
+    from tpuraft.conf import Configuration
+    save_conf = c.conf
+    c.conf = Configuration()  # joiner starts with empty conf
+    await c.start(new_peer)
+    c.conf = save_conf
+    st = await asyncio.wait_for(leader.add_peer(new_peer), 10)
+    assert st.is_ok(), str(st)
+    assert new_peer in leader.list_peers()
+    st = await c.apply_ok(leader, b"d5")
+    assert st.is_ok()
+    await c.wait_applied(6)
+    assert c.fsms[new_peer].logs == [b"d%d" % i for i in range(6)]
+    await c.stop_all()
+
+
+async def test_remove_peer():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"a")
+    victim = next(p for p in c.peers if p != leader.server_id)
+    st = await asyncio.wait_for(leader.remove_peer(victim), 10)
+    assert st.is_ok(), str(st)
+    assert victim not in leader.list_peers()
+    assert len(leader.list_peers()) == 2
+    # still works with 2 voters
+    st = await c.apply_ok(leader, b"b")
+    assert st.is_ok()
+    await c.wait_applied(2, nodes=[leader])
+    await c.stop_all()
+
+
+async def test_remove_leader_steps_down():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    st = await asyncio.wait_for(leader.remove_peer(leader.server_id), 10)
+    assert st.is_ok(), str(st)
+    # leader must step down; remaining two elect a new leader
+    deadline = asyncio.get_running_loop().time() + 5
+    while asyncio.get_running_loop().time() < deadline:
+        if leader.state != State.LEADER:
+            break
+        await asyncio.sleep(0.02)
+    assert leader.state != State.LEADER
+    others = {p: n for p, n in c.nodes.items() if n is not leader}
+    new_leader = None
+    deadline = asyncio.get_running_loop().time() + 5
+    while asyncio.get_running_loop().time() < deadline:
+        cands = [n for n in others.values() if n.state == State.LEADER]
+        if cands:
+            new_leader = cands[0]
+            break
+        await asyncio.sleep(0.02)
+    assert new_leader is not None
+    assert len(new_leader.list_peers()) == 2
+    await c.stop_all()
+
+
+async def test_learner_replicates_but_does_not_vote():
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    learner = PeerId.parse("127.0.0.1:5003")
+    c.peers.append(learner)
+    from tpuraft.conf import Configuration
+    save = c.conf
+    c.conf = Configuration()
+    await c.start(learner)
+    c.conf = save
+    st = await asyncio.wait_for(leader.add_learners([learner]), 10)
+    assert st.is_ok(), str(st)
+    assert learner in leader.list_learners()
+    assert learner not in leader.list_peers()
+    await c.apply_ok(leader, b"l1")
+    await c.wait_applied(1)
+    assert c.fsms[learner].logs == [b"l1"]
+    await c.stop_all()
+
+
+async def test_expected_term_guard():
+    c = TestCluster(1)
+    await c.start_all()
+    leader = await c.wait_leader()
+    fut = asyncio.get_running_loop().create_future()
+    await leader.apply(Task(data=b"x", done=fut.set_result,
+                            expected_term=leader.current_term + 5))
+    st = await fut
+    assert not st.is_ok()
+    await c.stop_all()

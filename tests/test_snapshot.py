@@ -1,0 +1,136 @@
+"""Snapshot subsystem tests (reference: SnapshotExecutorTest,
+LocalSnapshotStorageTest, NodeTest snapshot+install cases — SURVEY.md §5).
+"""
+
+import asyncio
+
+import pytest
+
+from tests.cluster import MockStateMachine, TestCluster
+from tpuraft.core.node import State
+from tpuraft.entity import PeerId
+from tpuraft.rpc.messages import SnapshotMeta
+from tpuraft.storage.snapshot import LocalSnapshotStorage
+
+
+class TestLocalSnapshotStorage:
+    def test_roundtrip(self, tmp_path):
+        s = LocalSnapshotStorage(str(tmp_path))
+        s.init()
+        assert s.open() is None
+        w = s.create()
+        w.write_file("a", b"alpha")
+        w.write_file("b", b"beta" * 100)
+        s.commit(w, SnapshotMeta(last_included_index=7, last_included_term=2,
+                                 peers=["1.1.1.1:1"]))
+        r = s.open()
+        assert r is not None
+        assert r.load_meta().last_included_index == 7
+        assert r.read_file("a") == b"alpha"
+        assert r.read_file("b") == b"beta" * 100
+        assert r.read_file("missing") is None
+
+    def test_only_newest_kept(self, tmp_path):
+        s = LocalSnapshotStorage(str(tmp_path))
+        s.init()
+        for idx in (5, 9):
+            w = s.create()
+            w.write_file("d", b"x%d" % idx)
+            s.commit(w, SnapshotMeta(last_included_index=idx))
+        assert len(s._snapshot_dirs()) == 1
+        assert s.open().load_meta().last_included_index == 9
+
+    def test_corrupt_file_detected(self, tmp_path):
+        s = LocalSnapshotStorage(str(tmp_path))
+        s.init()
+        w = s.create()
+        w.write_file("d", b"payload")
+        path = s.commit(w, SnapshotMeta(last_included_index=3))
+        (tmp_path / "snapshot_3" / "d").write_bytes(b"tampered")
+        r = s.open()
+        with pytest.raises(IOError):
+            r.read_file("d")
+
+    def test_chunked_read(self, tmp_path):
+        s = LocalSnapshotStorage(str(tmp_path))
+        s.init()
+        w = s.create()
+        w.write_file("big", bytes(range(256)) * 10)
+        s.commit(w, SnapshotMeta(last_included_index=1))
+        r = s.open()
+        out = bytearray()
+        off = 0
+        while True:
+            data, eof = r.read_chunk("big", off, 100)
+            out += data
+            off += len(data)
+            if eof:
+                break
+        assert bytes(out) == bytes(range(256)) * 10
+
+
+async def test_snapshot_save_and_restart_recovery(tmp_path):
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(20):
+        await c.apply_ok(leader, b"e%d" % i)
+    await c.wait_applied(20)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    assert c.fsms[leader.server_id].snapshots_saved == 1
+    # log compacted behind the snapshot
+    assert leader.log_manager.first_log_index() > 1
+    # more entries after the snapshot
+    for i in range(20, 25):
+        await c.apply_ok(leader, b"e%d" % i)
+    await c.wait_applied(25)
+    await c.stop_all()
+    # restart: leader-side node must restore from snapshot + log tail
+    c2 = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    c2.net = c.net
+    await c2.start_all()
+    leader2 = await c2.wait_leader()
+    await c2.apply_ok(leader2, b"e25")
+    await c2.wait_applied(26)
+    for p in c2.peers:
+        assert c2.fsms[p].logs == [b"e%d" % i for i in range(26)], str(p)
+    # at least one node loaded from snapshot rather than replaying all
+    assert any(c2.fsms[p].snapshots_loaded > 0 for p in c2.peers)
+    await c2.stop_all()
+
+
+async def test_install_snapshot_to_lagging_follower(tmp_path):
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    leader = await c.wait_leader()
+    victim = next(p for p in c.peers if p != leader.server_id)
+    await c.apply_ok(leader, b"s0")
+    await c.wait_applied(1)
+    # crash one follower, write + snapshot + compact so the log is gone
+    await c.stop(victim)
+    for i in range(1, 15):
+        await c.apply_ok(leader, b"s%d" % i)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    assert (leader.log_manager.first_log_index()
+            == leader.fsm_caller.last_applied_index + 1)
+    # follower comes back: too far behind the compacted log -> InstallSnapshot
+    await c.start(victim)
+    await c.wait_applied(15, timeout_s=10)
+    assert c.fsms[victim].logs == [b"s%d" % i for i in range(15)]
+    assert c.fsms[victim].snapshots_loaded >= 1
+    await c.stop_all()
+
+
+async def test_snapshot_nothing_new_rejected(tmp_path):
+    c = TestCluster(1, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"x")
+    await c.wait_applied(1)
+    st = await leader.snapshot()
+    assert st.is_ok()
+    st2 = await leader.snapshot()
+    assert not st2.is_ok()  # nothing new
+    await c.stop_all()

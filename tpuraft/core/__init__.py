@@ -1,0 +1,23 @@
+"""L4 consensus core (reference: core:core/ — SURVEY.md §2).
+
+Host-side protocol envelope around the device-plane math in tpuraft.ops:
+Node (election/replication/membership), BallotBox (quorum commit),
+FSMCaller (serialized user-state-machine callbacks), Replicator (per-peer
+log shipping), ReadOnlyService (linearizable reads), NodeManager (multi-
+group routing), RaftGroupService (bootstrap).
+"""
+
+from tpuraft.core.state_machine import StateMachine, StateMachineAdapter, Iterator
+from tpuraft.core.node import Node, State
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.core.raft_group_service import RaftGroupService
+
+__all__ = [
+    "StateMachine",
+    "StateMachineAdapter",
+    "Iterator",
+    "Node",
+    "State",
+    "NodeManager",
+    "RaftGroupService",
+]

@@ -1,0 +1,88 @@
+"""BallotBox: quorum commit tracking for one group (host runtime).
+
+Reference parity: ``core:core/BallotBox`` + ``core:entity/Ballot``
+(SURVEY.md §3.1 north-star hot path).  Reformulated: instead of one Ballot
+object per pending log index, the commit point is the quorum order
+statistic of the peers' matchIndex vector — the formulation proved
+equivalent to per-index ballots in tests/test_ops_ballot.py and executed
+batched on device by tpuraft.ops for the multi-raft engine.  During a
+membership change the double-quorum (joint consensus) applies to the whole
+pending window, which is conservative-safe (old conf is a subset of the
+joint requirement).
+
+This host class handles ONE group in scalar numpy/python — the
+MultiRaftEngine replaces G of these with one [G, P] kernel call per tick.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.entity import PeerId
+from tpuraft.errors import Status
+
+
+def commit_point(match: dict[PeerId, int], conf: Configuration,
+                 old_conf: Configuration) -> int:
+    """Scalar mirror of ops.ballot.joint_quorum_match_index."""
+
+    def order_stat(peers: list[PeerId]) -> int:
+        vals = sorted((match.get(p, 0) for p in peers), reverse=True)
+        if not vals:
+            return -1
+        return vals[len(peers) // 2]  # q-th largest, q = n//2+1
+
+    new_q = order_stat(conf.peers)
+    if old_conf.is_empty():
+        return new_q
+    return min(new_q, order_stat(old_conf.peers))
+
+
+class BallotBox:
+    def __init__(self, on_committed: Callable[[int], None]):
+        self._on_committed = on_committed  # FSMCaller#onCommitted
+        self.last_committed_index = 0
+        self.pending_index = 0  # first index of current leadership; 0 = not leader
+        self._match: dict[PeerId, int] = {}
+
+    # -- leader side ---------------------------------------------------------
+
+    def reset_pending_index(self, new_pending_index: int) -> None:
+        """At becomeLeader: only entries from here on may be quorum-committed
+        (Raft §5.4.2 — reference: BallotBox#resetPendingIndex)."""
+        self.pending_index = new_pending_index
+        self._match.clear()
+
+    def clear_pending(self) -> None:
+        self.pending_index = 0
+        self._match.clear()
+
+    def commit_at(self, peer: PeerId, match_index: int, conf: Configuration,
+                  old_conf: Configuration) -> bool:
+        """Record peer's acked matchIndex; advance commit if quorum reached.
+        Returns True if the commit index advanced."""
+        if self.pending_index == 0:
+            return False
+        prev = self._match.get(peer, 0)
+        if match_index <= prev:
+            return False
+        self._match[peer] = match_index
+        point = commit_point(self._match, conf, old_conf)
+        if point < self.pending_index or point <= self.last_committed_index:
+            return False
+        self.last_committed_index = point
+        self._on_committed(point)
+        return True
+
+    # -- follower side -------------------------------------------------------
+
+    def set_last_committed_index(self, index: int) -> bool:
+        """Follower: leader said commit has reached ``index``."""
+        if self.pending_index != 0:
+            return False  # leaders ignore remote commit notices
+        if index <= self.last_committed_index:
+            return False
+        self.last_committed_index = index
+        self._on_committed(index)
+        return True

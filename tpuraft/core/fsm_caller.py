@@ -1,0 +1,248 @@
+"""FSMCaller: serialized pipeline into the user StateMachine.
+
+Reference parity: ``core:core/FSMCallerImpl`` (SURVEY.md §3.1) — the
+Disruptor + ApplyTaskHandler becomes a single asyncio consumer task; all
+StateMachine callbacks (apply batches, snapshot save/load, role events)
+run on it in submission order, so user code never sees concurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.core.state_machine import Iterator, StateMachine
+
+LOG = logging.getLogger(__name__)
+
+
+class FSMCaller:
+    def __init__(self, fsm: StateMachine, log_manager, apply_batch: int = 32,
+                 on_error: Optional[Callable[[Status], Awaitable[None]]] = None):
+        self._fsm = fsm
+        self._lm = log_manager
+        self._apply_batch = apply_batch
+        self._node_on_error = on_error
+        self.last_applied_index = 0
+        self.last_applied_term = 0
+        self._committed_index = 0
+        self._closures: dict[int, Callable[[Status], None]] = {}
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._error: Optional[Status] = None
+        self._applied_waiters: list[tuple[int, asyncio.Future]] = []
+        # node hook: conf entry committed (drives membership-change stages)
+        self.on_configuration_applied: Optional[
+            Callable[[LogEntry], Awaitable[None]]] = None
+
+    async def init(self, bootstrap_id: LogId) -> None:
+        self.last_applied_index = bootstrap_id.index
+        self.last_applied_term = bootstrap_id.term
+        self._committed_index = bootstrap_id.index
+        self._task = asyncio.ensure_future(self._run())
+
+    async def shutdown(self) -> None:
+        if self._task:
+            await self._queue.put(("shutdown", None))
+            await self._task
+            self._task = None
+
+    # -- producers (called from node / ballot box) ---------------------------
+
+    def append_pending_closure(self, index: int, done: Callable[[Status], None]
+                               ) -> None:
+        self._closures[index] = done
+
+    def fail_pending_closures(self, status: Status) -> None:
+        """New leader emerged / stepping down: pending tasks won't commit here."""
+        for done in self._closures.values():
+            try:
+                done(status)
+            except Exception:
+                LOG.exception("closure failed")
+        self._closures.clear()
+
+    def on_committed(self, index: int) -> None:
+        if index <= self._committed_index:
+            return
+        self._committed_index = index
+        self._queue.put_nowait(("committed", index))
+
+    def on_leader_start(self, term: int) -> None:
+        self._queue.put_nowait(("leader_start", term))
+
+    def on_leader_stop(self, status: Status) -> None:
+        self._queue.put_nowait(("leader_stop", status))
+
+    def on_start_following(self, leader: PeerId, term: int) -> None:
+        self._queue.put_nowait(("start_following", (leader, term)))
+
+    def on_stop_following(self, leader: PeerId, term: int) -> None:
+        self._queue.put_nowait(("stop_following", (leader, term)))
+
+    def on_error(self, status: Status) -> None:
+        self._queue.put_nowait(("error", status))
+
+    async def on_snapshot_save(self, writer, done: Callable[[Status], None]) -> None:
+        self._queue.put_nowait(("snapshot_save", (writer, done)))
+
+    async def on_snapshot_load(self, reader) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait(("snapshot_load", (reader, fut)))
+        return fut
+
+    # -- applied-index waiters (ReadOnlyService) -----------------------------
+
+    def wait_applied(self, index: int) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        if self.last_applied_index >= index:
+            fut.set_result(self.last_applied_index)
+        else:
+            self._applied_waiters.append((index, fut))
+        return fut
+
+    def _wake_applied_waiters(self) -> None:
+        rest = []
+        for idx, fut in self._applied_waiters:
+            if fut.done():
+                continue
+            if self.last_applied_index >= idx:
+                fut.set_result(self.last_applied_index)
+            else:
+                rest.append((idx, fut))
+        self._applied_waiters = rest
+
+    # -- consumer ------------------------------------------------------------
+
+    async def _run(self) -> None:
+        while True:
+            kind, arg = await self._queue.get()
+            try:
+                if kind == "shutdown":
+                    await self._fsm.on_shutdown()
+                    return
+                if self._error is not None and kind not in ("error",):
+                    continue  # poisoned: only error propagation continues
+                if kind == "committed":
+                    await self._do_committed(arg)
+                elif kind == "leader_start":
+                    await self._fsm.on_leader_start(arg)
+                elif kind == "leader_stop":
+                    await self._fsm.on_leader_stop(arg)
+                elif kind == "start_following":
+                    await self._fsm.on_start_following(*arg)
+                elif kind == "stop_following":
+                    await self._fsm.on_stop_following(*arg)
+                elif kind == "snapshot_save":
+                    writer, done = arg
+                    await self._fsm.on_snapshot_save(writer, done)
+                elif kind == "snapshot_save_custom":
+                    # SnapshotExecutor wrapper: captures applied-id meta
+                    # at the moment the save runs in this serialized queue
+                    writer, done, wrapper = arg
+                    await wrapper(writer, done)
+                elif kind == "snapshot_load":
+                    reader, fut = arg
+                    try:
+                        ok = await self._fsm.on_snapshot_load(reader)
+                        if ok:
+                            meta = reader.load_meta()
+                            self.last_applied_index = meta.last_included_index
+                            self.last_applied_term = meta.last_included_term
+                            self._committed_index = max(
+                                self._committed_index, meta.last_included_index)
+                            self._wake_applied_waiters()
+                        if not fut.done():
+                            fut.set_result(ok)
+                    except Exception as exc:
+                        if not fut.done():
+                            fut.set_exception(exc)
+                elif kind == "error":
+                    await self._fsm.on_error(arg)
+            except Exception:
+                LOG.exception("FSMCaller %s handler crashed", kind)
+                await self._set_error(Status.error(
+                    RaftError.ESTATEMACHINE, f"{kind} handler crashed"))
+
+    async def _set_error(self, status: Status) -> None:
+        if self._error is None:
+            self._error = status
+            try:
+                await self._fsm.on_error(status)
+            except Exception:
+                LOG.exception("on_error crashed")
+            if self._node_on_error:
+                await self._node_on_error(status)
+
+    async def _do_committed(self, committed_index: int) -> None:
+        while self.last_applied_index < committed_index and self._error is None:
+            first = self.last_applied_index + 1
+            batch_entries: list[LogEntry] = []
+            data_entries: list[LogEntry] = []
+            closures: list[Optional[Callable[[Status], None]]] = []
+            idx = first
+            while idx <= committed_index and len(batch_entries) < self._apply_batch:
+                e = self._lm.get_entry(idx)
+                if e is None:
+                    await self._set_error(Status.error(
+                        RaftError.EINTERNAL, f"committed entry {idx} missing"))
+                    return
+                batch_entries.append(e)
+                idx += 1
+            # split: DATA entries go to user FSM; CONFIGURATION/NO_OP handled
+            # by the framework, batch boundaries preserved in order
+            pos = 0
+            while pos < len(batch_entries):
+                e = batch_entries[pos]
+                if e.type == EntryType.DATA:
+                    run_start = pos
+                    while (pos < len(batch_entries)
+                           and batch_entries[pos].type == EntryType.DATA):
+                        pos += 1
+                    run = batch_entries[run_start:pos]
+                    run_closures = [self._closures.pop(x.id.index, None) for x in run]
+                    it = Iterator(run, run_closures)
+                    try:
+                        await self._fsm.on_apply(it)
+                    except Exception:
+                        LOG.exception("StateMachine.on_apply crashed")
+                        await self._set_error(Status.error(
+                            RaftError.ESTATEMACHINE, "on_apply raised"))
+                        return
+                    if it.stopped_status is not None:
+                        await self._set_error(it.stopped_status)
+                        return
+                    # auto-complete closures the user didn't run
+                    for x, done in zip(run, run_closures):
+                        if done is not None:
+                            try:
+                                done(Status.OK())
+                            except Exception:
+                                LOG.exception("task closure failed")
+                    self.last_applied_index = run[-1].id.index
+                    self.last_applied_term = run[-1].id.term
+                else:
+                    if e.type == EntryType.CONFIGURATION:
+                        conf = Configuration(list(e.peers or []),
+                                             list(e.learners or []))
+                        try:
+                            await self._fsm.on_configuration_committed(conf)
+                        except Exception:
+                            LOG.exception("on_configuration_committed crashed")
+                        if self.on_configuration_applied is not None:
+                            await self.on_configuration_applied(e)
+                    done = self._closures.pop(e.id.index, None)
+                    if done is not None:
+                        try:
+                            done(Status.OK())
+                        except Exception:
+                            LOG.exception("conf closure failed")
+                    self.last_applied_index = e.id.index
+                    self.last_applied_term = e.id.term
+                    pos += 1
+            self._lm.set_applied_index(self.last_applied_index)
+            self._wake_applied_waiters()

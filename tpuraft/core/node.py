@@ -1,0 +1,944 @@
+"""Node: the per-group Raft state machine (host runtime).
+
+Reference parity: ``core:core/NodeImpl`` (SURVEY.md §3.1 "Node lifecycle &
+election", §4) — init/bootstrap, pre-vote + vote + become-leader/step-down,
+apply pipeline, AppendEntries/RequestVote/TimeoutNow handlers, leader
+lease + dead-quorum step-down, leadership transfer.  Membership change and
+snapshotting hook in via ConfigurationCtx / SnapshotExecutor.
+
+Concurrency model: everything runs on one asyncio loop; ``self._lock``
+(FIFO asyncio.Lock) is the analog of NodeImpl's writeLock.  The lock is
+held across follower-append fsync (durability ordering); the leader apply
+path stages entries under the lock and fsyncs outside it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+from tpuraft.conf import Configuration, ConfigurationEntry
+from tpuraft.core.ballot_box import BallotBox
+from tpuraft.core.fsm_caller import FSMCaller
+from tpuraft.core.replicator import Replicator, ReplicatorGroup
+from tpuraft.core.state_machine import StateMachine
+from tpuraft.entity import EMPTY_PEER, EntryType, LogEntry, LogId, PeerId, Task
+from tpuraft.errors import RaftError, Status
+from tpuraft.options import NodeOptions
+from tpuraft.rpc.messages import (
+    AppendEntriesRequest,
+    AppendEntriesResponse,
+    ReadIndexRequest,
+    ReadIndexResponse,
+    RequestVoteRequest,
+    RequestVoteResponse,
+    TimeoutNowRequest,
+    TimeoutNowResponse,
+)
+from tpuraft.rpc.transport import RpcError
+from tpuraft.storage.log_manager import LogManager
+from tpuraft.storage.log_storage import create_log_storage
+from tpuraft.storage.meta_storage import MemoryRaftMetaStorage, RaftMetaStorage
+from tpuraft.util.metrics import MetricRegistry
+from tpuraft.util.timer import RepeatedTimer
+
+LOG = logging.getLogger(__name__)
+
+
+class State(enum.Enum):
+    UNINITIALIZED = "uninitialized"
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+    TRANSFERRING = "transferring"
+    ERROR = "error"
+    SHUTTING = "shutting"
+    SHUTDOWN = "shutdown"
+
+
+class _VoteCtx:
+    """Vote tally for one (pre-)vote round — scalar mirror of
+    ops.ballot.joint_vote_quorum."""
+
+    def __init__(self, conf: Configuration, old_conf: Configuration):
+        self.peers = set(conf.peers)
+        self.old_peers = set(old_conf.peers)
+        self.granted: set[PeerId] = set()
+
+    def grant(self, peer: PeerId) -> None:
+        self.granted.add(peer)
+
+    def is_granted(self) -> bool:
+        new_ok = len(self.granted & self.peers) >= len(self.peers) // 2 + 1
+        if not self.old_peers:
+            return new_ok
+        old_ok = len(self.granted & self.old_peers) >= len(self.old_peers) // 2 + 1
+        return new_ok and old_ok
+
+
+class Node:
+    def __init__(self, group_id: str, server_id: PeerId, options: NodeOptions,
+                 transport):
+        self.group_id = group_id
+        self.server_id = server_id
+        self.options = options
+        self.transport = transport
+        self.metrics = MetricRegistry(options.enable_metrics)
+
+        self.state = State.UNINITIALIZED
+        self.current_term = 0
+        self.leader_id: PeerId = EMPTY_PEER
+        self.voted_for: PeerId = EMPTY_PEER
+        self.conf_entry = ConfigurationEntry()
+
+        self.log_manager: LogManager = None  # type: ignore[assignment]
+        self.fsm_caller: FSMCaller = None  # type: ignore[assignment]
+        self.ballot_box: BallotBox = None  # type: ignore[assignment]
+        self.replicators = ReplicatorGroup(self)
+        self.snapshot_executor = None  # set in init when snapshot_uri given
+        self.read_only_service = None
+        self.node_manager = None  # set by RaftGroupService (file service)
+
+        self._meta: RaftMetaStorage = None  # type: ignore[assignment]
+        self._lock = asyncio.Lock()
+        self._election_timer: Optional[RepeatedTimer] = None
+        self._vote_timer: Optional[RepeatedTimer] = None
+        self._stepdown_timer: Optional[RepeatedTimer] = None
+        self._snapshot_timer: Optional[RepeatedTimer] = None
+        self._last_leader_timestamp = time.monotonic()
+        self._peer_acks: dict[PeerId, float] = {}
+        self._conf_ctx: Optional["_ConfigurationCtx"] = None
+        self._transfer_deadline: float = 0.0
+        self._shutdown_event = asyncio.Event()
+        self._wakeup_candidate: Optional[PeerId] = None
+
+    # ======================================================================
+    # lifecycle
+    # ======================================================================
+
+    async def init(self) -> bool:
+        opts = self.options
+        # meta
+        if opts.raft_meta_uri.startswith("file://"):
+            self._meta = RaftMetaStorage(opts.raft_meta_uri[len("file://"):],
+                                         sync=opts.raft_options.sync_meta)
+        else:
+            self._meta = MemoryRaftMetaStorage()
+        self._meta.init()
+        self.current_term = self._meta.term
+        self.voted_for = self._meta.voted_for
+
+        # log
+        storage = create_log_storage(opts.log_uri)
+        self.log_manager = LogManager(
+            storage,
+            sync=opts.raft_options.sync,
+            max_flush_batch=opts.raft_options.max_entries_size,
+        )
+        await self.log_manager.init()
+
+        # fsm pipeline
+        self.ballot_box = BallotBox(self._on_committed)
+        self.fsm_caller = FSMCaller(
+            opts.fsm, self.log_manager,
+            apply_batch=opts.raft_options.apply_batch,
+            on_error=self._on_fsm_error)
+        self.fsm_caller.on_configuration_applied = self._on_configuration_applied
+
+        # snapshot subsystem
+        bootstrap = LogId(0, 0)
+        if opts.snapshot_uri:
+            from tpuraft.core.snapshot_executor import SnapshotExecutor
+
+            self.snapshot_executor = SnapshotExecutor(self, opts.snapshot_uri)
+            bootstrap = await self.snapshot_executor.init()
+        await self.fsm_caller.init(bootstrap)
+        if bootstrap.index > 0:
+            self.ballot_box.last_committed_index = bootstrap.index
+
+        # configuration: snapshot conf > log conf > initial conf
+        last_conf = self.log_manager.conf_manager.last()
+        if not last_conf.conf.is_empty():
+            self.conf_entry = last_conf
+        else:
+            self.conf_entry = ConfigurationEntry(
+                LogId(0, 0), opts.initial_conf.copy())
+
+        st = self.log_manager.check_consistency()
+        if not st.is_ok():
+            LOG.error("%s: log inconsistent: %s", self, st)
+            return False
+
+        from tpuraft.core.read_only import ReadOnlyService
+
+        self.read_only_service = ReadOnlyService(self)
+
+        # timers
+        self._election_timer = RepeatedTimer(
+            f"election-{self.server_id}", opts.election_timeout_ms,
+            self._handle_election_timeout, adjust=RepeatedTimer.random_adjust)
+        self._vote_timer = RepeatedTimer(
+            f"vote-{self.server_id}", opts.election_timeout_ms,
+            self._handle_vote_timeout, adjust=RepeatedTimer.random_adjust)
+        self._stepdown_timer = RepeatedTimer(
+            f"stepdown-{self.server_id}", opts.election_timeout_ms // 2 or 1,
+            self._check_dead_nodes)
+        if self.snapshot_executor and opts.snapshot.interval_secs > 0:
+            self._snapshot_timer = RepeatedTimer(
+                f"snapshot-{self.server_id}", opts.snapshot.interval_secs * 1000,
+                self._handle_snapshot_timeout)
+            self._snapshot_timer.start()
+
+        self.state = State.FOLLOWER
+        self._last_leader_timestamp = time.monotonic()
+        self._election_timer.start()
+        LOG.info("%s initialized: term=%d conf=%s", self, self.current_term,
+                 self.conf_entry.conf)
+
+        # single-voter group elects itself immediately
+        if (self.conf_entry.conf.peers == [self.server_id]
+                and self.conf_entry.old_conf.is_empty()):
+            async with self._lock:
+                await self._elect_self()
+        return True
+
+    async def shutdown(self) -> None:
+        async with self._lock:
+            if self.state in (State.SHUTTING, State.SHUTDOWN):
+                return
+            prev_state = self.state
+            self.state = State.SHUTTING
+            for t in (self._election_timer, self._vote_timer,
+                      self._stepdown_timer, self._snapshot_timer):
+                if t:
+                    t.stop()
+            self.replicators.stop_all()
+            if prev_state in (State.LEADER, State.TRANSFERRING):
+                self.fsm_caller.fail_pending_closures(
+                    Status.error(RaftError.ENODESHUTTING, "node is shutting down"))
+        if self.read_only_service:
+            await self.read_only_service.shutdown()
+        if self.snapshot_executor:
+            await self.snapshot_executor.shutdown()
+        await self.fsm_caller.shutdown()
+        await self.log_manager.shutdown()
+        self._meta.shutdown()
+        self.state = State.SHUTDOWN
+        self._shutdown_event.set()
+
+    # ======================================================================
+    # public API (reference: Node interface — SURVEY.md §9)
+    # ======================================================================
+
+    def is_leader(self) -> bool:
+        return self.state in (State.LEADER, State.TRANSFERRING)
+
+    def get_leader_id(self) -> PeerId:
+        return self.leader_id
+
+    def list_peers(self) -> list[PeerId]:
+        return list(self.conf_entry.conf.peers)
+
+    def list_learners(self) -> list[PeerId]:
+        return list(self.conf_entry.conf.learners)
+
+    async def apply(self, task: Task) -> None:
+        """Replicate task.data; task.done(status) fires on commit/failure."""
+        async with self._lock:
+            if self.state != State.LEADER:
+                st = (Status.error(RaftError.EBUSY, "leadership transferring")
+                      if self.state == State.TRANSFERRING
+                      else Status.error(RaftError.EPERM,
+                                        f"not leader (state={self.state.value})"))
+                if task.done:
+                    task.done(st)
+                return
+            if task.expected_term not in (-1, self.current_term):
+                if task.done:
+                    task.done(Status.error(
+                        RaftError.EPERM,
+                        f"expected term {task.expected_term} != {self.current_term}"))
+                return
+            entry = LogEntry(type=EntryType.DATA, data=task.data)
+            term = self.current_term
+            last_id = self.log_manager.stage_leader_entries([entry], term)
+            if task.done:
+                self.fsm_caller.append_pending_closure(last_id.index, task.done)
+            self.replicators.wake_all()
+        # fsync outside the lock; batched with concurrent appliers
+        await self.log_manager.flush_staged(last_id.index)
+        async with self._lock:
+            if self.state in (State.LEADER, State.TRANSFERRING) \
+                    and self.current_term == term:
+                self._commit_at_self(last_id.index)
+
+    def _commit_at_self(self, index: int) -> None:
+        self.ballot_box.commit_at(
+            self.server_id, index, self.conf_entry.conf, self.conf_entry.old_conf)
+
+    async def snapshot(self) -> Status:
+        if not self.snapshot_executor:
+            return Status.error(RaftError.EINVAL, "snapshot storage not configured")
+        return await self.snapshot_executor.do_snapshot()
+
+    async def read_index(self) -> int:
+        """Linearizable read barrier: resolves to a safe read index once
+        the local FSM has applied up to it (reference: Node#readIndex)."""
+        return await self.read_only_service.read_index()
+
+    async def transfer_leadership_to(self, peer: PeerId) -> Status:
+        async with self._lock:
+            if self.state != State.LEADER:
+                return Status.error(RaftError.EPERM, "not leader")
+            if peer == self.server_id:
+                return Status.OK()  # already the leader
+            if not self.conf_entry.conf.contains(peer):
+                return Status.error(RaftError.EINVAL, f"{peer} not in conf")
+            r = self.replicators.get(peer)
+            if r is None:
+                return Status.error(RaftError.EINVAL, f"no replicator for {peer}")
+            self.state = State.TRANSFERRING
+            self._transfer_deadline = (
+                time.monotonic() + self.options.election_timeout_ms / 1000.0)
+            r.transfer_leadership(self.log_manager.last_log_index())
+            r.wake()
+            LOG.info("%s transferring leadership to %s", self, peer)
+            asyncio.ensure_future(self._transfer_watchdog())
+            return Status.OK()
+
+    async def _transfer_watchdog(self) -> None:
+        await asyncio.sleep(self.options.election_timeout_ms / 1000.0)
+        async with self._lock:
+            if self.state == State.TRANSFERRING:
+                LOG.info("%s leadership transfer timed out; resuming", self)
+                self.state = State.LEADER
+
+    # ======================================================================
+    # apply-side commit plumbing
+    # ======================================================================
+
+    def _on_committed(self, index: int) -> None:
+        self.fsm_caller.on_committed(index)
+        self.metrics.counter("commits", 1)
+
+    def on_match_advanced(self, peer: PeerId, match_index: int) -> None:
+        if not self.is_leader():
+            return
+        self.ballot_box.commit_at(
+            peer, match_index, self.conf_entry.conf, self.conf_entry.old_conf)
+
+    def on_peer_ack(self, peer: PeerId, when: float) -> None:
+        self._peer_acks[peer] = when
+
+    # ======================================================================
+    # election machinery
+    # ======================================================================
+
+    def _leader_lease_valid(self) -> bool:
+        return (time.monotonic() - self._last_leader_timestamp
+                < self.options.election_timeout_ms
+                * self.options.raft_options.leader_lease_time_ratio / 1000.0)
+
+    async def _handle_election_timeout(self) -> None:
+        async with self._lock:
+            if self.state != State.FOLLOWER:
+                return
+            if not self.conf_entry.contains(self.server_id):
+                return  # not a participant (e.g. learner or removed)
+            if self._leader_lease_valid():
+                return
+            prev_leader = self.leader_id
+            self.leader_id = EMPTY_PEER
+            if not prev_leader.is_empty():
+                self.fsm_caller.on_stop_following(prev_leader, self.current_term)
+            await self._pre_vote()
+
+    async def _pre_vote(self) -> None:
+        """Pre-vote: probe electability WITHOUT bumping term (symmetric-
+        partition tolerance — reference: NodeImpl#preVote)."""
+        if self.log_manager.last_snapshot_id().index > 0 and \
+                self.snapshot_executor and self.snapshot_executor.installing:
+            return
+        conf, old_conf = self.conf_entry.conf, self.conf_entry.old_conf
+        ctx = _VoteCtx(conf, old_conf)
+        ctx.grant(self.server_id)
+        last_id = self.log_manager.last_log_id()
+        term = self.current_term
+        if ctx.is_granted():
+            await self._elect_self()
+            return
+        req_term = term + 1  # NOT persisted
+
+        async def ask(peer: PeerId):
+            req = RequestVoteRequest(
+                group_id=self.group_id, server_id=str(self.server_id),
+                peer_id=str(peer), term=req_term,
+                last_log_index=last_id.index, last_log_term=last_id.term,
+                pre_vote=True)
+            try:
+                resp: RequestVoteResponse = await self.transport.request_vote(
+                    peer.endpoint, req,
+                    timeout_ms=self.options.election_timeout_ms)
+            except RpcError:
+                return
+            async with self._lock:
+                if (self.state != State.FOLLOWER or self.current_term != term):
+                    return  # world moved on
+                if resp.term > self.current_term:
+                    await self._step_down(resp.term, Status.error(
+                        RaftError.EHIGHERTERMRESPONSE, "pre-vote response"))
+                    return
+                if resp.granted:
+                    ctx.grant(peer)
+                    if ctx.is_granted():
+                        await self._elect_self()
+
+        for p in set(conf.peers) | set(old_conf.peers):
+            if p != self.server_id:
+                asyncio.ensure_future(ask(p))
+
+    async def _elect_self(self) -> None:
+        """Real election: term+1, vote for self, solicit votes.
+        Caller must hold the lock."""
+        conf, old_conf = self.conf_entry.conf, self.conf_entry.old_conf
+        if not self.conf_entry.contains(self.server_id):
+            return
+        LOG.info("%s starting election at term %d", self, self.current_term + 1)
+        self._election_timer.stop()
+        self.state = State.CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.server_id
+        self.leader_id = EMPTY_PEER
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._meta.set_term_and_voted_for, self.current_term,
+            self.server_id)
+        ctx = _VoteCtx(conf, old_conf)
+        ctx.grant(self.server_id)
+        self._vote_ctx = ctx
+        term = self.current_term
+        last_id = self.log_manager.last_log_id()
+        self._vote_timer.start()
+        if ctx.is_granted():
+            await self._become_leader()
+            return
+
+        async def ask(peer: PeerId):
+            req = RequestVoteRequest(
+                group_id=self.group_id, server_id=str(self.server_id),
+                peer_id=str(peer), term=term,
+                last_log_index=last_id.index, last_log_term=last_id.term,
+                pre_vote=False)
+            try:
+                resp: RequestVoteResponse = await self.transport.request_vote(
+                    peer.endpoint, req,
+                    timeout_ms=self.options.election_timeout_ms)
+            except RpcError:
+                return
+            async with self._lock:
+                if self.state != State.CANDIDATE or self.current_term != term:
+                    return
+                if resp.term > self.current_term:
+                    await self._step_down(resp.term, Status.error(
+                        RaftError.EHIGHERTERMRESPONSE, "vote response"))
+                    return
+                if resp.granted:
+                    ctx.grant(peer)
+                    if ctx.is_granted():
+                        await self._become_leader()
+
+        for p in set(conf.peers) | set(old_conf.peers):
+            if p != self.server_id:
+                asyncio.ensure_future(ask(p))
+
+    async def _handle_vote_timeout(self) -> None:
+        async with self._lock:
+            if self.state != State.CANDIDATE:
+                return
+            if self.options.raft_options.step_down_when_vote_timedout:
+                self._vote_timer.stop()
+                await self._step_down(self.current_term, Status.error(
+                    RaftError.ERAFTTIMEDOUT, "vote timed out"))
+            else:
+                await self._elect_self()  # retry
+
+    async def _become_leader(self) -> None:
+        """Caller holds the lock; we are CANDIDATE with a vote quorum."""
+        self._vote_timer.stop()
+        self.state = State.LEADER
+        self.leader_id = self.server_id
+        self._peer_acks = {self.server_id: time.monotonic()}
+        LOG.info("%s became LEADER at term %d", self, self.current_term)
+        for peer in self.conf_entry.list_peers():
+            if peer != self.server_id:
+                self.replicators.add(peer)
+        for learner in set(self.conf_entry.conf.learners) | set(
+                self.conf_entry.old_conf.learners):
+            self.replicators.add(learner)
+        self.ballot_box.reset_pending_index(
+            self.log_manager.last_log_index() + 1)
+        # commit a CONFIGURATION entry for the current conf: safely commits
+        # all prior-term entries (Raft §5.4.2; reference: becomeLeader)
+        conf_entry = LogEntry(
+            type=EntryType.CONFIGURATION,
+            peers=list(self.conf_entry.conf.peers),
+            learners=list(self.conf_entry.conf.learners) or None,
+            old_peers=list(self.conf_entry.old_conf.peers) or None,
+            old_learners=list(self.conf_entry.old_conf.learners) or None,
+        )
+        term = self.current_term
+        last_id = self.log_manager.stage_leader_entries([conf_entry], term)
+        self.replicators.wake_all()
+        self.fsm_caller.on_leader_start(term)
+        self._stepdown_timer.start()
+        asyncio.ensure_future(self._flush_and_self_commit(term, last_id.index))
+
+    async def _flush_and_self_commit(self, term: int, index: int) -> None:
+        await self.log_manager.flush_staged(index)
+        async with self._lock:
+            if self.is_leader() and self.current_term == term:
+                self._commit_at_self(index)
+
+    async def _step_down(self, term: int, status: Status,
+                         new_leader: PeerId = EMPTY_PEER) -> None:
+        """Caller holds the lock (reference: NodeImpl#stepDown)."""
+        LOG.info("%s step down at term %d -> %d: %s", self, self.current_term,
+                 term, status)
+        was_leader = self.state in (State.LEADER, State.TRANSFERRING)
+        if self.state == State.CANDIDATE:
+            self._vote_timer.stop()
+        if was_leader:
+            self._stepdown_timer.stop()
+            self.replicators.stop_all()
+            self.ballot_box.clear_pending()
+            self.fsm_caller.fail_pending_closures(
+                Status.error(RaftError.ENEWLEADER,
+                             "leader stepped down: " + status.error_msg))
+            self.fsm_caller.on_leader_stop(status)
+        self.state = State.FOLLOWER
+        self.leader_id = new_leader
+        self._last_leader_timestamp = time.monotonic()
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = EMPTY_PEER
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._meta.set_term_and_voted_for, term, EMPTY_PEER)
+        if self._conf_ctx is not None:
+            self._conf_ctx.fail(Status.error(
+                RaftError.ENEWLEADER, "leader stepped down"))
+            self._conf_ctx = None
+        self._election_timer.restart()
+
+    async def step_down_on_higher_term(self, term: int, reason: str) -> None:
+        async with self._lock:
+            if term > self.current_term:
+                await self._step_down(term, Status.error(
+                    RaftError.EHIGHERTERMRESPONSE, reason))
+
+    async def _check_dead_nodes(self) -> None:
+        """Leader: step down if a quorum hasn't acked within the election
+        timeout (asymmetric-partition tolerance — NodeImpl#checkDeadNodes)."""
+        async with self._lock:
+            if not self.is_leader():
+                return
+            now = time.monotonic()
+            self._peer_acks[self.server_id] = now
+            conf, old_conf = self.conf_entry.conf, self.conf_entry.old_conf
+
+            def quorum_ack(peers: list[PeerId]) -> float:
+                acks = sorted((self._peer_acks.get(p, 0.0) for p in peers),
+                              reverse=True)
+                return acks[len(peers) // 2] if peers else 0.0
+
+            q_ack = quorum_ack(conf.peers)
+            if not old_conf.is_empty():
+                q_ack = min(q_ack, quorum_ack(old_conf.peers))
+            if now - q_ack >= self.options.election_timeout_ms / 1000.0:
+                await self._step_down(
+                    self.current_term,
+                    Status.error(RaftError.ERAFTTIMEDOUT,
+                                 "quorum unreachable within election timeout"))
+
+    def leader_lease_is_valid(self) -> bool:
+        """For LEASE_BASED reads: a quorum acked within lease window."""
+        if not self.is_leader():
+            return False
+        now = time.monotonic()
+        self._peer_acks[self.server_id] = now
+        conf = self.conf_entry.conf
+        acks = sorted((self._peer_acks.get(p, 0.0) for p in conf.peers),
+                      reverse=True)
+        if not acks:
+            return False
+        q_ack = acks[len(conf.peers) // 2]
+        lease_s = (self.options.election_timeout_ms
+                   * self.options.raft_options.leader_lease_time_ratio / 1000.0)
+        return now - q_ack < lease_s
+
+    # ======================================================================
+    # RPC handlers (server side)
+    # ======================================================================
+
+    async def handle_request_vote(self, req: RequestVoteRequest
+                                  ) -> RequestVoteResponse:
+        candidate = PeerId.parse(req.server_id)
+        async with self._lock:
+            if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
+                              State.UNINITIALIZED):
+                return RequestVoteResponse(term=self.current_term, granted=False)
+            if req.pre_vote:
+                return self._handle_pre_vote(req, candidate)
+            # real vote
+            if req.term < self.current_term:
+                return RequestVoteResponse(term=self.current_term, granted=False)
+            if req.term > self.current_term:
+                await self._step_down(req.term, Status.error(
+                    RaftError.EHIGHERTERMREQUEST,
+                    f"vote request from {candidate}"))
+            log_ok = self._candidate_log_up_to_date(req)
+            if (log_ok and self.voted_for.is_empty()
+                    and self.state == State.FOLLOWER):
+                self.voted_for = candidate
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._meta.set_term_and_voted_for, self.current_term,
+                    candidate)
+                self._last_leader_timestamp = time.monotonic()  # grant => reset
+                return RequestVoteResponse(term=self.current_term, granted=True)
+            granted = log_ok and self.voted_for == candidate
+            return RequestVoteResponse(term=self.current_term, granted=granted)
+
+    def _handle_pre_vote(self, req: RequestVoteRequest, candidate: PeerId
+                         ) -> RequestVoteResponse:
+        """Pre-vote grant: candidate's log >= ours, req.term >= ours, and we
+        haven't heard from a live leader within the lease."""
+        if req.term < self.current_term:
+            return RequestVoteResponse(term=self.current_term, granted=False)
+        if not self.leader_id.is_empty() and self._leader_lease_valid():
+            return RequestVoteResponse(term=self.current_term, granted=False)
+        granted = self._candidate_log_up_to_date(req)
+        return RequestVoteResponse(term=self.current_term, granted=granted)
+
+    def _candidate_log_up_to_date(self, req: RequestVoteRequest) -> bool:
+        last = self.log_manager.last_log_id()
+        return (req.last_log_term, req.last_log_index) >= (last.term, last.index)
+
+    async def handle_append_entries(self, req: AppendEntriesRequest
+                                    ) -> AppendEntriesResponse:
+        server = PeerId.parse(req.server_id)
+        async with self._lock:
+            if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
+                              State.UNINITIALIZED):
+                return AppendEntriesResponse(
+                    term=self.current_term, success=False,
+                    last_log_index=0)
+            if req.term < self.current_term:
+                return AppendEntriesResponse(
+                    term=self.current_term, success=False,
+                    last_log_index=self.log_manager.last_log_index())
+            if req.term > self.current_term or self.state != State.FOLLOWER:
+                await self._step_down(req.term, Status.error(
+                    RaftError.EHIGHERTERMREQUEST,
+                    f"append_entries from {server}"), new_leader=server)
+            if self.leader_id.is_empty():
+                self.leader_id = server
+                self.fsm_caller.on_start_following(server, req.term)
+            elif self.leader_id != server:
+                # two leaders in one term: protocol violation
+                LOG.error("%s: leader conflict %s vs %s at term %d", self,
+                          self.leader_id, server, req.term)
+                await self._step_down(req.term + 1, Status.error(
+                    RaftError.ELEADERCONFLICT, "two leaders in one term"))
+                return AppendEntriesResponse(
+                    term=self.current_term, success=False,
+                    last_log_index=self.log_manager.last_log_index())
+            self._last_leader_timestamp = time.monotonic()
+
+            lm = self.log_manager
+            if not req.entries:
+                # heartbeat / probe
+                local_prev_term = lm.get_term(req.prev_log_index)
+                if req.prev_log_index > lm.last_log_index() or (
+                        req.prev_log_index >= lm.first_log_index() - 1
+                        and local_prev_term != req.prev_log_term
+                        and req.prev_log_index != lm.last_snapshot_id().index):
+                    return AppendEntriesResponse(
+                        term=self.current_term, success=False,
+                        last_log_index=lm.last_log_index())
+                self.ballot_box.set_last_committed_index(
+                    min(req.committed_index, req.prev_log_index))
+                return AppendEntriesResponse(
+                    term=self.current_term, success=True,
+                    last_log_index=lm.last_log_index())
+
+            ok = await lm.append_entries_follower(
+                req.prev_log_index, req.prev_log_term, list(req.entries))
+            if not ok:
+                return AppendEntriesResponse(
+                    term=self.current_term, success=False,
+                    last_log_index=lm.last_log_index())
+            self._refresh_conf_from_log()
+            self.ballot_box.set_last_committed_index(
+                min(req.committed_index,
+                    req.prev_log_index + len(req.entries)))
+            return AppendEntriesResponse(
+                term=self.current_term, success=True,
+                last_log_index=lm.last_log_index())
+
+    def _refresh_conf_from_log(self) -> None:
+        last = self.log_manager.conf_manager.last()
+        if not last.conf.is_empty() and last.id.index > self.conf_entry.id.index:
+            self.conf_entry = last
+
+    async def handle_timeout_now(self, req: TimeoutNowRequest
+                                 ) -> TimeoutNowResponse:
+        """Leadership transfer target: elect immediately, skipping pre-vote
+        (reference: NodeImpl#handleTimeoutNowRequest)."""
+        async with self._lock:
+            if req.term != self.current_term or self.state != State.FOLLOWER:
+                return TimeoutNowResponse(term=self.current_term, success=False)
+            await self._elect_self()
+            return TimeoutNowResponse(term=self.current_term, success=True)
+
+    async def handle_install_snapshot(self, req):
+        from tpuraft.rpc.messages import InstallSnapshotResponse
+
+        if not self.snapshot_executor:
+            return InstallSnapshotResponse(term=self.current_term, success=False)
+        return await self.snapshot_executor.handle_install_snapshot(req)
+
+    async def handle_read_index(self, req: ReadIndexRequest) -> ReadIndexResponse:
+        """Follower-forwarded readIndex: only the leader serves it."""
+        if not self.is_leader():
+            return ReadIndexResponse(index=0, success=False)
+        try:
+            idx = await self.read_only_service.leader_confirm_read_index()
+            return ReadIndexResponse(index=idx, success=True)
+        except Exception:
+            return ReadIndexResponse(index=0, success=False)
+
+    # ======================================================================
+    # membership change (reference: ConfigurationCtx — SURVEY.md §3.1)
+    # ======================================================================
+
+    async def add_peer(self, peer: PeerId) -> Status:
+        new_conf = self.conf_entry.conf.copy()
+        if new_conf.contains(peer):
+            return Status.error(RaftError.EEXISTS, f"{peer} already in conf")
+        new_conf.peers.append(peer)
+        return await self.change_peers(new_conf)
+
+    async def remove_peer(self, peer: PeerId) -> Status:
+        new_conf = self.conf_entry.conf.copy()
+        if not new_conf.contains(peer):
+            return Status.error(RaftError.ENOENT, f"{peer} not in conf")
+        new_conf.peers.remove(peer)
+        return await self.change_peers(new_conf)
+
+    async def add_learners(self, learners: list[PeerId]) -> Status:
+        new_conf = self.conf_entry.conf.copy()
+        for l in learners:
+            if l not in new_conf.learners:
+                new_conf.learners.append(l)
+        return await self.change_peers(new_conf)
+
+    async def remove_learners(self, learners: list[PeerId]) -> Status:
+        new_conf = self.conf_entry.conf.copy()
+        new_conf.learners = [l for l in new_conf.learners if l not in learners]
+        return await self.change_peers(new_conf)
+
+    async def change_peers(self, new_conf: Configuration) -> Status:
+        """Arbitrary configuration change via joint consensus."""
+        async with self._lock:
+            if self.state != State.LEADER:
+                return Status.error(RaftError.EPERM, "not leader")
+            if self._conf_ctx is not None:
+                return Status.error(RaftError.EBUSY, "another change in progress")
+            if not new_conf.is_valid():
+                return Status.error(RaftError.EINVAL, f"invalid conf {new_conf}")
+            if new_conf == self.conf_entry.conf:
+                return Status.OK()
+            ctx = _ConfigurationCtx(self, self.conf_entry.conf.copy(), new_conf)
+            self._conf_ctx = ctx
+            await ctx.start()
+        try:
+            return await ctx.wait()
+        finally:
+            async with self._lock:
+                if self._conf_ctx is ctx:
+                    self._conf_ctx = None
+
+    async def reset_peers(self, new_conf: Configuration) -> Status:
+        """Unsafe manual override when quorum is permanently lost
+        (reference: Node#resetPeers)."""
+        async with self._lock:
+            if not new_conf.is_valid():
+                return Status.error(RaftError.EINVAL, str(new_conf))
+            self.conf_entry = ConfigurationEntry(
+                LogId(0, self.current_term), new_conf.copy())
+            await self._step_down(self.current_term + 1, Status.error(
+                RaftError.ESETPEER, "reset_peers"))
+            return Status.OK()
+
+    async def _on_configuration_applied(self, entry: LogEntry) -> None:
+        """A CONFIGURATION entry committed+applied: advance the change ctx."""
+        async with self._lock:
+            self._refresh_conf_from_log()
+            if self._conf_ctx is not None:
+                await self._conf_ctx.on_committed(entry)
+
+    # ======================================================================
+    # snapshot plumbing (filled by SnapshotExecutor)
+    # ======================================================================
+
+    async def install_snapshot_on(self, peer: PeerId, replicator: Replicator
+                                  ) -> bool:
+        if not self.snapshot_executor:
+            LOG.error("%s: peer %s needs snapshot but none configured",
+                      self, peer)
+            return False
+        return await self.snapshot_executor.send_install_snapshot(
+            peer, replicator)
+
+    async def _handle_snapshot_timeout(self) -> None:
+        if self.snapshot_executor:
+            await self.snapshot_executor.do_snapshot()
+
+    async def _on_fsm_error(self, status: Status) -> None:
+        async with self._lock:
+            if self.state in (State.SHUTTING, State.SHUTDOWN):
+                return
+            LOG.error("%s entering ERROR state: %s", self, status)
+            if self.is_leader():
+                self.replicators.stop_all()
+                self.fsm_caller.fail_pending_closures(status)
+            self.state = State.ERROR
+            for t in (self._election_timer, self._vote_timer,
+                      self._stepdown_timer):
+                if t:
+                    t.stop()
+
+    def __str__(self) -> str:
+        return f"Node<{self.group_id}/{self.server_id}>"
+
+
+class _ConfigurationCtx:
+    """Membership-change state machine: CATCHING_UP -> JOINT -> STABLE.
+
+    Reference: NodeImpl's inner ConfigurationCtx (SURVEY.md §3.1/§4.3).
+    """
+
+    def __init__(self, node: Node, old_conf: Configuration,
+                 new_conf: Configuration):
+        self._node = node
+        self.old_conf = old_conf
+        self.new_conf = new_conf
+        self.stage = "none"
+        self._done: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._joint_index = 0
+        self._stable_index = 0
+
+    async def start(self) -> None:
+        """Called under node lock."""
+        node = self._node
+        added = [p for p in self.new_conf.peers
+                 if not self.old_conf.contains(p)]
+        added += [l for l in self.new_conf.learners
+                  if l not in self.old_conf.learners
+                  and not self.old_conf.contains(l)]
+        if not added:
+            await self._enter_joint()
+            return
+        self.stage = "catching_up"
+        waiters = []
+        for peer in added:
+            r = node.replicators.add(peer)  # replicate as learner during catch-up
+            waiters.append(r.wait_caught_up(
+                node.options.catchup_margin,
+                node.options.election_timeout_ms * 10 / 1000.0))
+        asyncio.ensure_future(self._wait_catchup(waiters))
+
+    async def _wait_catchup(self, waiters) -> None:
+        results = await asyncio.gather(*waiters, return_exceptions=True)
+        node = self._node
+        async with node._lock:
+            if self.stage != "catching_up":
+                return
+            if not all(r is True for r in results):
+                self.fail(Status.error(RaftError.ECATCHUP,
+                                       "new peers failed to catch up"))
+                if node._conf_ctx is self:
+                    node._conf_ctx = None
+                return
+            await self._enter_joint()
+
+    async def _enter_joint(self) -> None:
+        """Append the joint-consensus CONFIGURATION entry (under lock)."""
+        node = self._node
+        self.stage = "joint"
+        in_joint = self.old_conf.peers != self.new_conf.peers
+        entry = LogEntry(
+            type=EntryType.CONFIGURATION,
+            peers=list(self.new_conf.peers),
+            old_peers=list(self.old_conf.peers) if in_joint else None,
+            learners=list(self.new_conf.learners) or None,
+            old_learners=(list(self.old_conf.learners) or None)
+            if in_joint else None,
+        )
+        term = node.current_term
+        last_id = node.log_manager.stage_leader_entries([entry], term)
+        self._joint_index = last_id.index
+        node.conf_entry = ConfigurationEntry(
+            last_id, self.new_conf.copy(),
+            self.old_conf.copy() if in_joint else Configuration())
+        # new peers may now vote/commit; replicators for removed peers keep
+        # running until the change commits
+        node.replicators.wake_all()
+        asyncio.ensure_future(node._flush_and_self_commit(term, last_id.index))
+
+    async def on_committed(self, entry: LogEntry) -> None:
+        """A conf entry applied (under node lock)."""
+        node = self._node
+        if self.stage == "joint" and entry.id.index == self._joint_index:
+            if entry.old_peers:
+                # leave joint: append the stable (new-conf-only) entry
+                self.stage = "stable"
+                stable = LogEntry(
+                    type=EntryType.CONFIGURATION,
+                    peers=list(self.new_conf.peers),
+                    learners=list(self.new_conf.learners) or None,
+                )
+                term = node.current_term
+                last_id = node.log_manager.stage_leader_entries([stable], term)
+                self._stable_index = last_id.index
+                node.conf_entry = ConfigurationEntry(
+                    last_id, self.new_conf.copy())
+                node.replicators.wake_all()
+                asyncio.ensure_future(
+                    node._flush_and_self_commit(term, last_id.index))
+            else:
+                await self._finish()
+        elif self.stage == "stable" and entry.id.index == self._stable_index:
+            await self._finish()
+
+    async def _finish(self) -> None:
+        node = self._node
+        # drop replicators for peers no longer in conf
+        for peer in list(node.replicators.peers()):
+            if not node.conf_entry.contains(peer) and \
+                    peer not in node.conf_entry.conf.learners:
+                node.replicators.remove(peer)
+        if not self._done.done():
+            self._done.set_result(Status.OK())
+        # leader removed itself: step down
+        if not node.conf_entry.conf.contains(node.server_id):
+            await node._step_down(node.current_term, Status.error(
+                RaftError.ELEADERREMOVED, "leader removed from configuration"))
+
+    def fail(self, status: Status) -> None:
+        if not self._done.done():
+            self._done.set_result(status)
+
+    async def wait(self) -> Status:
+        return await self._done

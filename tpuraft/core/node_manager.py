@@ -1,0 +1,78 @@
+"""NodeManager: groupId -> Node routing on one shared RPC endpoint.
+
+Reference parity: ``core:NodeManager`` + the per-request processors bound
+to one RpcServer (SURVEY.md §2 "Key structural fact"): N raft groups
+multiplex one server; requests route by (group_id, peer_id).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpuraft.core.node import Node
+from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.transport import RpcError, RpcServer
+
+LOG = logging.getLogger(__name__)
+
+
+class NodeManager:
+    """One per process endpoint."""
+
+    def __init__(self, server: RpcServer):
+        self.server = server
+        self._nodes: dict[tuple[str, str], Node] = {}
+        for method in ("append_entries", "request_vote", "timeout_now",
+                       "install_snapshot", "read_index"):
+            server.register(method, self._make_handler(method))
+        # get_file serves snapshot chunks; routed by reader_id not group
+        self._file_readers: dict[int, object] = {}
+        self._next_reader_id = 1
+        server.register("get_file", self._handle_get_file)
+
+    def _make_handler(self, method: str):
+        async def handler(request):
+            node = self._nodes.get((request.group_id, request.peer_id))
+            if node is None:
+                raise RpcError(Status.error(
+                    RaftError.ENOENT,
+                    f"no node for group={request.group_id} peer={request.peer_id}"))
+            return await getattr(node, f"handle_{method}")(request)
+
+        return handler
+
+    def add(self, node: Node) -> None:
+        self._nodes[(node.group_id, str(node.server_id))] = node
+
+    def remove(self, node: Node) -> None:
+        self._nodes.pop((node.group_id, str(node.server_id)), None)
+
+    def get(self, group_id: str, peer_id: str) -> Optional[Node]:
+        return self._nodes.get((group_id, peer_id))
+
+    def list_nodes(self) -> list[Node]:
+        return list(self._nodes.values())
+
+    # -- snapshot file service (reference: core:storage/FileService) --------
+
+    def register_file_reader(self, reader) -> int:
+        rid = self._next_reader_id
+        self._next_reader_id += 1
+        self._file_readers[rid] = reader
+        return rid
+
+    def unregister_file_reader(self, reader_id: int) -> None:
+        self._file_readers.pop(reader_id, None)
+
+    async def _handle_get_file(self, request):
+        from tpuraft.rpc.messages import GetFileResponse
+
+        reader = self._file_readers.get(request.reader_id)
+        if reader is None:
+            raise RpcError(Status.error(
+                RaftError.ENOENT, f"no file reader {request.reader_id}"))
+        data, eof = reader.read_file(request.filename, request.offset,
+                                     request.count)
+        return GetFileResponse(eof=eof, data=data)

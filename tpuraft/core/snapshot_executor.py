@@ -1,0 +1,264 @@
+"""SnapshotExecutor: periodic/on-demand snapshot save, remote install.
+
+Reference parity: ``core:storage/snapshot/SnapshotExecutorImpl``
+(SURVEY.md §3.1): doSnapshot (FSM save -> atomic commit -> log prefix
+truncation), installSnapshot (leader streams files to a lagging follower
+via the file service; follower loads and resets its log).  This subsystem
+doubles as checkpoint/resume AND log compaction (§6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+from tpuraft.conf import Configuration, ConfigurationEntry
+from tpuraft.entity import LogId, PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.messages import (
+    InstallSnapshotRequest,
+    InstallSnapshotResponse,
+    SnapshotMeta,
+)
+from tpuraft.rpc.transport import RpcError
+from tpuraft.storage.snapshot import (
+    LocalSnapshotStorage,
+    RemoteFileCopier,
+    SnapshotReader,
+    _MANIFEST,
+    _decode_manifest,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class SnapshotExecutor:
+    def __init__(self, node, snapshot_uri: str):
+        assert snapshot_uri.startswith("file://"), snapshot_uri
+        self._node = node
+        self._storage = LocalSnapshotStorage(snapshot_uri[len("file://"):])
+        self.last_snapshot_id = LogId(0, 0)
+        self.installing = False
+        self._saving = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def init(self) -> LogId:
+        """Load the newest local snapshot into the FSM (direct call — the
+        FSMCaller loop isn't running yet at bootstrap). Returns the
+        bootstrap id the FSM state corresponds to."""
+        self._storage.init()
+        reader = self._storage.open()
+        if reader is None:
+            return LogId(0, 0)
+        meta = reader.load_meta()
+        node = self._node
+        ok = await node.options.fsm.on_snapshot_load(reader)
+        if not ok:
+            LOG.error("%s: on_snapshot_load failed at init", node)
+            return LogId(0, 0)
+        self.last_snapshot_id = LogId(meta.last_included_index,
+                                      meta.last_included_term)
+        conf = _conf_from_meta(meta)
+        await node.log_manager.set_snapshot(
+            self.last_snapshot_id, conf,
+            keep_margin=node.options.snapshot.log_index_margin)
+        node.conf_entry = conf
+        return self.last_snapshot_id
+
+    async def shutdown(self) -> None:
+        pass
+
+    # -- save ----------------------------------------------------------------
+
+    async def do_snapshot(self) -> Status:
+        node = self._node
+        if self.installing:
+            return Status.error(RaftError.EBUSY, "installing a snapshot")
+        if self._saving:
+            return Status.error(RaftError.EBUSY, "snapshot already running")
+        if node.fsm_caller.last_applied_index <= self.last_snapshot_id.index:
+            return Status.error(RaftError.ECANCELED, "nothing new to snapshot")
+        self._saving = True
+        try:
+            writer = self._storage.create()
+            done_fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            meta_box: dict = {}
+
+            def done(st: Status) -> None:
+                if not done_fut.done():
+                    done_fut.set_result(st)
+
+            # capture applied state consistently: build meta inside the
+            # FSMCaller queue right before on_snapshot_save runs
+            async def save_wrapper(w, d):
+                meta_box["id"] = LogId(node.fsm_caller.last_applied_index,
+                                       node.fsm_caller.last_applied_term)
+                await node.options.fsm.on_snapshot_save(w, d)
+
+            node.fsm_caller._queue.put_nowait(
+                ("snapshot_save_custom", (writer, done, save_wrapper)))
+            st = await done_fut
+            if not st.is_ok():
+                return st
+            snap_id: LogId = meta_box["id"]
+            conf_entry = node.log_manager.conf_manager.get(snap_id.index)
+            if conf_entry.conf.is_empty():
+                conf_entry = ConfigurationEntry(
+                    LogId(0, 0), node.conf_entry.conf.copy(),
+                    node.conf_entry.old_conf.copy())
+            meta = SnapshotMeta(
+                last_included_index=snap_id.index,
+                last_included_term=snap_id.term,
+                peers=[str(p) for p in conf_entry.conf.peers],
+                old_peers=[str(p) for p in conf_entry.old_conf.peers],
+                learners=[str(p) for p in conf_entry.conf.learners],
+                old_learners=[str(p) for p in conf_entry.old_conf.learners],
+            )
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._storage.commit, writer, meta)
+            self.last_snapshot_id = snap_id
+            await node.log_manager.set_snapshot(
+                snap_id, conf_entry,
+                keep_margin=node.options.snapshot.log_index_margin)
+            node.metrics.counter("snapshots-saved")
+            LOG.info("%s snapshot saved at %s", node, snap_id)
+            return Status.OK()
+        finally:
+            self._saving = False
+
+    # -- leader: install on a lagging follower -------------------------------
+
+    async def send_install_snapshot(self, peer: PeerId, replicator) -> bool:
+        node = self._node
+        reader = self._storage.open()
+        if reader is None:
+            LOG.error("%s: follower %s needs snapshot but none exists",
+                      node, peer)
+            return False
+        meta = reader.load_meta()
+        if meta.last_included_index < replicator.next_index:
+            return False  # snapshot too old to help
+        reader_id = node.node_manager.register_file_reader(_ChunkAdapter(reader))
+        try:
+            req = InstallSnapshotRequest(
+                group_id=node.group_id,
+                server_id=str(node.server_id),
+                peer_id=str(peer),
+                term=node.current_term,
+                meta=meta,
+                uri=f"remote://{node.server_id.endpoint}/{reader_id}",
+            )
+            try:
+                resp: InstallSnapshotResponse = await node.transport.install_snapshot(
+                    peer.endpoint, req,
+                    timeout_ms=node.options.election_timeout_ms * 10)
+            except RpcError as e:
+                LOG.warning("%s install_snapshot to %s failed: %s", node, peer, e)
+                return False
+            if resp.term > node.current_term:
+                await node.step_down_on_higher_term(
+                    resp.term, f"install_snapshot response from {peer}")
+                return False
+            if not resp.success:
+                return False
+            replicator.next_index = meta.last_included_index + 1
+            replicator._matched = False  # re-probe from the snapshot point
+            node.metrics.counter("install-snapshot-sent")
+            LOG.info("%s installed snapshot %d on %s", node,
+                     meta.last_included_index, peer)
+            return True
+        finally:
+            node.node_manager.unregister_file_reader(reader_id)
+
+    # -- follower: receive an install ---------------------------------------
+
+    async def handle_install_snapshot(self, req: InstallSnapshotRequest
+                                      ) -> InstallSnapshotResponse:
+        node = self._node
+        async with node._lock:
+            if req.term < node.current_term:
+                return InstallSnapshotResponse(term=node.current_term,
+                                               success=False)
+            from tpuraft.core.node import State
+
+            if req.term > node.current_term or node.state != State.FOLLOWER:
+                await node._step_down(req.term, Status.error(
+                    RaftError.EHIGHERTERMREQUEST, "install_snapshot"),
+                    new_leader=PeerId.parse(req.server_id))
+            node._last_leader_timestamp = time.monotonic()
+            if self.installing:
+                return InstallSnapshotResponse(term=node.current_term,
+                                               success=False)
+            if req.meta.last_included_index <= self.last_snapshot_id.index:
+                return InstallSnapshotResponse(term=node.current_term,
+                                               success=True)
+            self.installing = True
+        try:
+            ok = await self._do_install(req)
+            return InstallSnapshotResponse(term=node.current_term, success=ok)
+        finally:
+            self.installing = False
+
+    async def _do_install(self, req: InstallSnapshotRequest) -> bool:
+        node = self._node
+        # parse uri: remote://<endpoint>/<reader_id>
+        rest = req.uri[len("remote://"):]
+        endpoint, _, rid = rest.partition("/")
+        copier = RemoteFileCopier(node.transport, endpoint, int(rid),
+                                  chunk_size=node.options.snapshot.max_chunk_size)
+        writer = self._storage.create()
+        try:
+            manifest_blob = await copier.read_bytes(_MANIFEST)
+            meta, files = _decode_manifest(manifest_blob)
+            for f in files:
+                await copier.copy_to(f.name, os.path.join(writer.path, f.name))
+                writer.add_file(f.name)
+        except (RpcError, ValueError, IOError) as e:
+            LOG.warning("%s snapshot copy failed: %s", node, e)
+            return False
+        loop = asyncio.get_running_loop()
+        path = await loop.run_in_executor(
+            None, self._storage.commit, writer, meta)
+        reader = SnapshotReader(path)
+        fut = await node.fsm_caller.on_snapshot_load(reader)
+        ok = await fut
+        if not ok:
+            LOG.error("%s on_snapshot_load failed during install", node)
+            return False
+        snap_id = LogId(meta.last_included_index, meta.last_included_term)
+        self.last_snapshot_id = snap_id
+        conf = _conf_from_meta(meta)
+        async with node._lock:
+            await node.log_manager.set_snapshot(snap_id, conf)
+            node.conf_entry = conf
+            node.ballot_box.set_last_committed_index(snap_id.index)
+        node.metrics.counter("install-snapshot-received")
+        LOG.info("%s loaded installed snapshot at %s", node, snap_id)
+        return True
+
+
+class _ChunkAdapter:
+    """Adapts SnapshotReader to the file-service read_file(name, off, count)
+    protocol (reference: FileService + SnapshotFileReader)."""
+
+    def __init__(self, reader: SnapshotReader):
+        self._reader = reader
+
+    def read_file(self, name: str, offset: int, count: int):
+        return self._reader.read_chunk(name, offset, count)
+
+
+def _conf_from_meta(meta: SnapshotMeta) -> ConfigurationEntry:
+    return ConfigurationEntry(
+        id=LogId(meta.last_included_index, meta.last_included_term),
+        conf=Configuration(
+            [PeerId.parse(p) for p in meta.peers],
+            [PeerId.parse(p) for p in meta.learners]),
+        old_conf=Configuration(
+            [PeerId.parse(p) for p in meta.old_peers],
+            [PeerId.parse(p) for p in meta.old_learners]),
+    )

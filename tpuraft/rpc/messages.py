@@ -1,0 +1,263 @@
+"""Raft RPC messages + compact binary codec.
+
+Reference parity: protobuf ``RpcRequests.*`` (AppendEntries, RequestVote,
+InstallSnapshot, TimeoutNow, ReadIndex, GetFile) — SURVEY.md §3.1 "RPC
+layer".  Dataclasses here are the in-proc representation; ``encode``/
+``decode`` give a deterministic wire format shared with the native
+transport (length-prefixed little-endian fields, LogEntry's own codec for
+entries).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpuraft.entity import LogEntry
+
+_U16 = struct.Struct("<H")
+_I64 = struct.Struct("<q")
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return _U16.pack(len(b)) + b
+
+
+def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
+    (n,) = _U16.unpack_from(buf, off)
+    off += 2
+    return bytes(buf[off : off + n]).decode(), off + n
+
+
+def _pack_bytes(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+def _unpack_bytes(buf: memoryview, off: int) -> tuple[bytes, int]:
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return bytes(buf[off : off + n]), off + n
+
+
+@dataclass
+class SnapshotMeta:
+    """Snapshot manifest meta (reference: RaftOutter.SnapshotMeta)."""
+
+    last_included_index: int = 0
+    last_included_term: int = 0
+    peers: list[str] = field(default_factory=list)
+    old_peers: list[str] = field(default_factory=list)
+    learners: list[str] = field(default_factory=list)
+    old_learners: list[str] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        out = bytearray(_I64.pack(self.last_included_index))
+        out += _I64.pack(self.last_included_term)
+        for lst in (self.peers, self.old_peers, self.learners, self.old_learners):
+            out += _U16.pack(len(lst))
+            for s in lst:
+                out += _pack_str(s)
+        return bytes(out)
+
+    @staticmethod
+    def decode(buf: bytes | memoryview) -> "SnapshotMeta":
+        buf = memoryview(buf)
+        idx, term = _I64.unpack_from(buf, 0)[0], _I64.unpack_from(buf, 8)[0]
+        off = 16
+        lists = []
+        for _ in range(4):
+            (n,) = _U16.unpack_from(buf, off)
+            off += 2
+            cur = []
+            for _ in range(n):
+                s, off = _unpack_str(buf, off)
+                cur.append(s)
+            lists.append(cur)
+        return SnapshotMeta(idx, term, *lists)
+
+
+# ---- message dataclasses ---------------------------------------------------
+# All carry group_id (multi-raft routing key), server_id (sender), peer_id
+# (target) as strings — the reference's protobuf does the same.
+
+
+@dataclass
+class AppendEntriesRequest:
+    group_id: str
+    server_id: str
+    peer_id: str
+    term: int
+    prev_log_index: int
+    prev_log_term: int
+    committed_index: int
+    entries: list[LogEntry] = field(default_factory=list)
+    # heartbeats are empty-entry requests (reference: sendEmptyEntries)
+
+
+@dataclass
+class AppendEntriesResponse:
+    term: int
+    success: bool
+    last_log_index: int  # hint for nextIndex backoff on rejection
+
+
+@dataclass
+class RequestVoteRequest:
+    group_id: str
+    server_id: str
+    peer_id: str
+    term: int
+    last_log_index: int
+    last_log_term: int
+    pre_vote: bool
+
+
+@dataclass
+class RequestVoteResponse:
+    term: int
+    granted: bool
+
+
+@dataclass
+class InstallSnapshotRequest:
+    group_id: str
+    server_id: str
+    peer_id: str
+    term: int
+    meta: SnapshotMeta
+    uri: str  # remote://<endpoint>/<reader_id>
+
+
+@dataclass
+class InstallSnapshotResponse:
+    term: int
+    success: bool
+
+
+@dataclass
+class TimeoutNowRequest:
+    group_id: str
+    server_id: str
+    peer_id: str
+    term: int
+
+
+@dataclass
+class TimeoutNowResponse:
+    term: int
+    success: bool
+
+
+@dataclass
+class ReadIndexRequest:
+    group_id: str
+    server_id: str
+    peer_id: str
+
+
+@dataclass
+class ReadIndexResponse:
+    index: int
+    success: bool
+
+
+@dataclass
+class GetFileRequest:
+    reader_id: int
+    filename: str
+    offset: int
+    count: int
+
+
+@dataclass
+class GetFileResponse:
+    eof: bool
+    data: bytes
+
+
+@dataclass
+class ErrorResponse:
+    code: int
+    msg: str
+
+
+# ---- codec -----------------------------------------------------------------
+
+_MSG_TYPES: list[type] = [
+    AppendEntriesRequest,
+    AppendEntriesResponse,
+    RequestVoteRequest,
+    RequestVoteResponse,
+    InstallSnapshotRequest,
+    InstallSnapshotResponse,
+    TimeoutNowRequest,
+    TimeoutNowResponse,
+    ReadIndexRequest,
+    ReadIndexResponse,
+    GetFileRequest,
+    GetFileResponse,
+    ErrorResponse,
+]
+_TYPE_ID = {t: i for i, t in enumerate(_MSG_TYPES)}
+
+
+def encode_message(msg) -> bytes:
+    """Wire-encode any message: u8 type id + field stream."""
+    tid = _TYPE_ID[type(msg)]
+    out = bytearray(struct.pack("<B", tid))
+    for name, ftype in type(msg).__dataclass_fields__.items():
+        v = getattr(msg, name)
+        if isinstance(v, bool):
+            out += struct.pack("<B", v)
+        elif isinstance(v, int):
+            out += _I64.pack(v)
+        elif isinstance(v, str):
+            out += _pack_str(v)
+        elif isinstance(v, bytes):
+            out += _pack_bytes(v)
+        elif isinstance(v, SnapshotMeta):
+            out += _pack_bytes(v.encode())
+        elif isinstance(v, list):  # list[LogEntry]
+            out += struct.pack("<I", len(v))
+            for e in v:
+                out += _pack_bytes(e.encode())
+        else:
+            raise TypeError(f"cannot encode field {name}={v!r}")
+    return bytes(out)
+
+
+def decode_message(buf: bytes | memoryview):
+    buf = memoryview(buf)
+    (tid,) = struct.unpack_from("<B", buf, 0)
+    cls = _MSG_TYPES[tid]
+    off = 1
+    kwargs = {}
+    for name, f in cls.__dataclass_fields__.items():
+        ann = f.type
+        if ann == "bool":
+            (v,) = struct.unpack_from("<B", buf, off)
+            kwargs[name] = bool(v)
+            off += 1
+        elif ann == "int":
+            (kwargs[name],) = _I64.unpack_from(buf, off)
+            off += 8
+        elif ann == "str":
+            kwargs[name], off = _unpack_str(buf, off)
+        elif ann == "bytes":
+            kwargs[name], off = _unpack_bytes(buf, off)
+        elif ann == "SnapshotMeta":
+            blob, off = _unpack_bytes(buf, off)
+            kwargs[name] = SnapshotMeta.decode(blob)
+        elif ann.startswith("list[LogEntry]"):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            entries = []
+            for _ in range(n):
+                blob, off = _unpack_bytes(buf, off)
+                entries.append(LogEntry.decode(blob))
+            kwargs[name] = entries
+        else:
+            raise TypeError(f"cannot decode field {name}: {ann}")
+    return cls(**kwargs)

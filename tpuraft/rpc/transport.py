@@ -1,0 +1,171 @@
+"""Transport: async RPC interface + in-process loopback with fault injection.
+
+Reference parity: ``core:rpc/RaftClientService`` / processors bound to one
+shared RpcServer multiplexing many groups (SURVEY.md §2 L2, §3.1).  The
+in-proc implementation is the analog of the reference's signature test
+pattern — ``TestCluster``: N real nodes in one process, real protocol,
+loopback "network" with kill/partition/delay/drop injection (§5).
+
+Routing: requests carry (group_id, peer_id); an :class:`RpcServer`
+registered per endpoint dispatches to per-group handlers (NodeManager).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Awaitable, Callable, Optional
+
+from tpuraft.errors import RaftError, Status
+
+
+class RpcError(Exception):
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+
+class RpcServer:
+    """One per process endpoint; multiplexes all raft groups on it.
+
+    Handlers: method name -> async fn(request) -> response.  The node
+    manager registers one handler set and routes by request.group_id
+    (reference: NodeManager + per-request processors on a shared server).
+    """
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._handlers: dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        self.running = False
+
+    def register(self, method: str, handler: Callable[[Any], Awaitable[Any]]) -> None:
+        self._handlers[method] = handler
+
+    async def dispatch(self, method: str, request: Any) -> Any:
+        h = self._handlers.get(method)
+        if h is None:
+            raise RpcError(Status.error(RaftError.EINTERNAL, f"no handler {method}"))
+        return await h(request)
+
+
+class InProcNetwork:
+    """Shared fabric for in-process transports; owns fault injection.
+
+    Test API (TestCluster-style):
+      net.partition({"a:1"}, {"b:1","c:1"})  — split-brain
+      net.isolate("a:1") / net.heal()
+      net.set_delay_ms(5), net.set_drop_rate(0.1)
+      net.stop_endpoint(ep) / start_endpoint(ep)  — crash/restart
+    """
+
+    def __init__(self) -> None:
+        self._servers: dict[str, RpcServer] = {}
+        self._blocked_pairs: set[tuple[str, str]] = set()
+        self._down: set[str] = set()
+        self.delay_ms: float = 0.0
+        self.drop_rate: float = 0.0
+        self._rng = random.Random(0)
+
+    # -- server registry -----------------------------------------------------
+
+    def bind(self, server: RpcServer) -> None:
+        self._servers[server.endpoint] = server
+        server.running = True
+
+    def unbind(self, endpoint: str) -> None:
+        s = self._servers.pop(endpoint, None)
+        if s:
+            s.running = False
+
+    # -- fault injection -----------------------------------------------------
+
+    def partition(self, side_a: set[str], side_b: set[str]) -> None:
+        for a in side_a:
+            for b in side_b:
+                self._blocked_pairs.add((a, b))
+                self._blocked_pairs.add((b, a))
+
+    def partition_one_way(self, src: set[str], dst: set[str]) -> None:
+        """Asymmetric partition: src -> dst dropped, dst -> src flows."""
+        for a in src:
+            for b in dst:
+                self._blocked_pairs.add((a, b))
+
+    def isolate(self, endpoint: str) -> None:
+        others = set(self._servers) - {endpoint}
+        self.partition({endpoint}, others)
+
+    def heal(self) -> None:
+        self._blocked_pairs.clear()
+
+    def stop_endpoint(self, endpoint: str) -> None:
+        self._down.add(endpoint)
+
+    def start_endpoint(self, endpoint: str) -> None:
+        self._down.discard(endpoint)
+
+    def set_delay_ms(self, ms: float) -> None:
+        self.delay_ms = ms
+
+    def set_drop_rate(self, rate: float) -> None:
+        self.drop_rate = rate
+
+    # -- the "wire" ----------------------------------------------------------
+
+    async def call(self, src: str, dst: str, method: str, request: Any,
+                   timeout_ms: float) -> Any:
+        if self.delay_ms:
+            await asyncio.sleep(self.delay_ms / 1000.0)
+        if (
+            dst not in self._servers
+            or dst in self._down
+            or src in self._down
+            or (src, dst) in self._blocked_pairs
+            or (self.drop_rate and self._rng.random() < self.drop_rate)
+        ):
+            # unreachable: behave like a connect/request timeout
+            await asyncio.sleep(min(timeout_ms, 50) / 1000.0)
+            raise RpcError(
+                Status.error(RaftError.EHOSTDOWN, f"{dst} unreachable from {src}"))
+        server = self._servers[dst]
+        try:
+            return await asyncio.wait_for(
+                server.dispatch(method, request), timeout_ms / 1000.0)
+        except asyncio.TimeoutError:
+            raise RpcError(Status.error(RaftError.ETIMEDOUT, f"{method} to {dst}"))
+
+
+class InProcTransport:
+    """The RaftClientService bound to one local endpoint."""
+
+    def __init__(self, network: InProcNetwork, endpoint: str,
+                 default_timeout_ms: float = 1000.0):
+        self._net = network
+        self.endpoint = endpoint
+        self._timeout_ms = default_timeout_ms
+
+    async def call(self, dst: str, method: str, request: Any,
+                   timeout_ms: Optional[float] = None) -> Any:
+        return await self._net.call(
+            self.endpoint, dst, method, request,
+            timeout_ms if timeout_ms is not None else self._timeout_ms)
+
+    # typed helpers (reference: RaftClientService methods)
+
+    async def append_entries(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "append_entries", req, timeout_ms)
+
+    async def request_vote(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "request_vote", req, timeout_ms)
+
+    async def install_snapshot(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "install_snapshot", req, timeout_ms)
+
+    async def timeout_now(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "timeout_now", req, timeout_ms)
+
+    async def read_index(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "read_index", req, timeout_ms)
+
+    async def get_file(self, dst: str, req, timeout_ms=None):
+        return await self.call(dst, "get_file", req, timeout_ms)

@@ -52,6 +52,8 @@ class LogManager:
         self._applied_index = 0
         self._last_snapshot_id = LogId(0, 0)
 
+        self._staged: list[LogEntry] = []
+        self._stable_waiters: list[tuple[int, asyncio.Future]] = []
         self._queue: asyncio.Queue[_FlushReq | None] = asyncio.Queue()
         self._inflight_flushes = 0
         self._flush_idle = asyncio.Event()
@@ -138,18 +140,43 @@ class LogManager:
 
     # -- appends ------------------------------------------------------------
 
-    async def append_entries_leader(self, entries: list[LogEntry], term: int
-                                    ) -> LogId:
-        """Assign indexes/terms and persist. Resolves after fsync."""
+    def stage_leader_entries(self, entries: list[LogEntry], term: int) -> LogId:
+        """Leader: assign indexes/terms, make entries visible to replicators
+        (in-memory) — synchronous, call under the node lock.  Durability
+        comes from a following :meth:`flush_staged`."""
         for e in entries:
             self._last_index += 1
             e.id = LogId(self._last_index, term)
             self._mem[e.id.index] = e
             if e.type == EntryType.CONFIGURATION:
                 self._track_conf(e)
-        last_id = LogId(self._last_index, term)
-        await self._enqueue_flush(entries)
+        self._staged.extend(entries)
         self._wake_waiters()
+        return LogId(self._last_index, term)
+
+    async def flush_staged(self, upto: Optional[int] = None) -> None:
+        """Flush all staged entries; resolves once the log is stable up to
+        ``upto`` (default: everything staged so far).  Safe to call from
+        multiple appliers concurrently — whoever runs first carries the
+        whole staged batch; the rest wait on the stable watermark."""
+        batch, self._staged = self._staged, []
+        # default target: the full staged watermark (_last_index), NOT the
+        # stable index — if another applier stole our staged batch we must
+        # still wait for our entries' fsync before self-granting a vote
+        target = upto if upto is not None else self._last_index
+        if batch:
+            await self._enqueue_flush(batch)
+        if self._stable_index >= target:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._stable_waiters.append((target, fut))
+        await fut
+
+    async def append_entries_leader(self, entries: list[LogEntry], term: int
+                                    ) -> LogId:
+        """stage + flush in one call (single-applier convenience)."""
+        last_id = self.stage_leader_entries(entries, term)
+        await self.flush_staged(last_id.index)
         return last_id
 
     async def append_entries_follower(self, prev_log_index: int, prev_log_term: int,
@@ -160,13 +187,14 @@ class LogManager:
         """
         if prev_log_index > self._last_index:
             return False  # gap: we don't have prev yet
-        if prev_log_index >= self._first_index - 1 or (
+        if prev_log_index >= self._first_index or (
             prev_log_index == self._last_snapshot_id.index
         ):
             if self.get_term(prev_log_index) != prev_log_term:
                 return False
-        # else: prev lies in the compacted region — those entries were
-        # committed, so Raft's Log Matching property guarantees agreement.
+        # else: prev lies in the compacted region (its term is unknowable
+        # unless it is the snapshot index) — those entries were committed,
+        # so Raft's Log Matching property guarantees agreement.
         if not entries:
             return True
         # skip entries we already have with matching terms
@@ -250,12 +278,28 @@ class LogManager:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_result(True)
+                self._wake_stable_waiters()
             except Exception as exc:  # storage failure is fatal for the node
                 LOG.exception("log flush failed")
+                err = RaftException(Status.error(RaftError.EIO, str(exc)))
                 for r in batch:
                     if not r.future.done():
-                        r.future.set_exception(
-                            RaftException(Status.error(RaftError.EIO, str(exc))))
+                        r.future.set_exception(err)
+                for _, fut in self._stable_waiters:
+                    if not fut.done():
+                        fut.set_exception(err)
+                self._stable_waiters.clear()
+
+    def _wake_stable_waiters(self) -> None:
+        rest = []
+        for target, fut in self._stable_waiters:
+            if fut.done():
+                continue
+            if self._stable_index >= target:
+                fut.set_result(None)
+            else:
+                rest.append((target, fut))
+        self._stable_waiters = rest
 
     async def _drain_flushes(self) -> None:
         """Wait until every in-flight flush completed (before truncation —

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -230,6 +231,9 @@ class FileLogStorage(LogStorage):
         self._first = 1
         self._seg_max = segment_max_bytes or self.SEGMENT_MAX_BYTES
         self._conf_indexes: list[int] = []
+        # guards _segments and file handles: the event loop reads (get_entry)
+        # while the LogManager flusher appends/truncates in executor threads
+        self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -327,9 +331,10 @@ class FileLogStorage(LogStorage):
         return self._first
 
     def last_log_index(self) -> int:
-        if not self._segments:
-            return self._first - 1
-        return self._segments[-1].last_index
+        with self._lock:
+            if not self._segments:
+                return self._first - 1
+            return self._segments[-1].last_index
 
     def _find_segment(self, index: int) -> Optional[_Segment]:
         lo, hi = 0, len(self._segments) - 1
@@ -345,10 +350,11 @@ class FileLogStorage(LogStorage):
         return None
 
     def get_entry(self, index: int) -> Optional[LogEntry]:
-        if index < self._first:
-            return None
-        s = self._find_segment(index)
-        return s.read(index) if s else None
+        with self._lock:
+            if index < self._first:
+                return None
+            s = self._find_segment(index)
+            return s.read(index) if s else None
 
     # -- mutations ----------------------------------------------------------
 
@@ -377,12 +383,16 @@ class FileLogStorage(LogStorage):
             if e.type == EntryType.CONFIGURATION:
                 self._conf_indexes.append(e.id.index)
                 new_conf = True
+        if new_conf:
+            # sidecar BEFORE the entry fsync: a crash in between leaves a
+            # sidecar index beyond last_log_index, which init's
+            # first<=i<=last filter drops; the reverse order would
+            # permanently hide a durable CONFIGURATION entry
+            self._rewrite_conf_indexes()
         if sync:
             # fsync oldest-first so a crash leaves a prefix, never a hole
             for seg in touched:
                 seg.sync()
-        if new_conf:
-            self._rewrite_conf_indexes()
         return len(entries)
 
     def truncate_prefix(self, first_index_kept: int) -> None:
@@ -419,7 +429,7 @@ class FileLogStorage(LogStorage):
 def create_log_storage(uri: str) -> LogStorage:
     """SPI-style factory by URI scheme (reference: DefaultJRaftServiceFactory
     #createLogStorage via JRaftServiceLoader)."""
-    if not uri or uri == "memory://" or uri.startswith("memory"):
+    if uri == "memory://":
         return MemoryLogStorage()
     if uri.startswith("file://"):
         return FileLogStorage(uri[len("file://"):])

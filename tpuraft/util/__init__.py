@@ -1,0 +1,6 @@
+"""L1 runtime utilities (reference: core:util/ — SURVEY.md §3.1)."""
+
+from tpuraft.util.timer import RepeatedTimer
+from tpuraft.util.metrics import MetricRegistry
+
+__all__ = ["RepeatedTimer", "MetricRegistry"]

@@ -1,0 +1,110 @@
+"""Metrics: counters, gauges, histograms/timers per node.
+
+Reference parity: Dropwizard ``MetricRegistry`` via ``core:core/NodeMetrics``,
+``ThreadPoolMetricSet``, ``DisruptorMetricSet`` (SURVEY.md §6).  Names keep
+the reference's dotted style (``replicate-entries``, ``append-logs``...).
+Lightweight by design: a disabled registry costs one branch.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+
+class Histogram:
+    """Reservoir-free histogram: keeps a bounded ring of samples."""
+
+    __slots__ = ("_samples", "_max", "count", "total")
+
+    def __init__(self, max_samples: int = 4096):
+        self._samples: list[float] = []
+        self._max = max_samples
+        self.count = 0
+        self.total = 0.0
+
+    def update(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if len(self._samples) >= self._max:
+            self._samples[self.count % self._max] = value
+        else:
+            self._samples.append(value)
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, int(p / 100.0 * len(s)))
+        return s[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
+            "max": max(self._samples) if self._samples else 0.0,
+        }
+
+
+class MetricRegistry:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.counters: dict[str, int] = defaultdict(int)
+        self.histograms: dict[str, Histogram] = {}
+        self.gauges: dict[str, Callable[[], float]] = {}
+
+    def counter(self, name: str, delta: int = 1) -> None:
+        if self.enabled:
+            self.counters[name] += delta
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        if not self.enabled:
+            return None
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        return h
+
+    def update(self, name: str, value: float) -> None:
+        if self.enabled:
+            self.histogram(name).update(value)
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        if self.enabled:
+            self.gauges[name] = fn
+
+    def timer(self, name: str) -> "_Timer":
+        return _Timer(self, name)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "histograms": {k: h.snapshot() for k, h in self.histograms.items()},
+            "gauges": {k: g() for k, g in self.gauges.items()},
+        }
+
+
+class _Timer:
+    """``with metrics.timer("replicate-entries"): ...`` records millis."""
+
+    __slots__ = ("_reg", "_name", "_t0")
+
+    def __init__(self, reg: MetricRegistry, name: str):
+        self._reg = reg
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._reg.update(self._name, (time.perf_counter() - self._t0) * 1000.0)
+        return False

@@ -1,0 +1,86 @@
+"""RepeatedTimer: stoppable, restartable recurring timer with jitter.
+
+Reference parity: ``core:util/RepeatedTimer`` (election/vote/stepdown/
+snapshot timers — SURVEY.md §3.1 "Timers & queues").  asyncio-native: one
+task per timer instead of a hashed wheel; the multi-raft engine replaces
+per-group timers with tick-tensor deadlines (tpuraft.ops.tick), so this
+class only backs the single-group host runtime and the snapshot cadence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Optional
+
+
+class RepeatedTimer:
+    def __init__(
+        self,
+        name: str,
+        timeout_ms: int,
+        on_trigger: Callable[[], Awaitable[None]],
+        adjust: Optional[Callable[[int], int]] = None,
+    ):
+        """``adjust`` maps the base timeout to the actual per-round delay —
+        e.g. randomized election timeouts (reference: NodeImpl's
+        ``randomTimeout``)."""
+        self._name = name
+        self._timeout_ms = timeout_ms
+        self._on_trigger = on_trigger
+        self._adjust = adjust or (lambda t: t)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = True
+        self._destroyed = False
+
+    @staticmethod
+    def random_adjust(timeout_ms: int) -> int:
+        """Election-style jitter: [timeout, 2*timeout)."""
+        return timeout_ms + random.randrange(timeout_ms)
+
+    def start(self) -> None:
+        if self._destroyed or not self._stopped:
+            return
+        self._stopped = False
+        self._schedule()
+
+    def _schedule(self) -> None:
+        delay = self._adjust(self._timeout_ms) / 1000.0
+        self._task = asyncio.ensure_future(self._run(delay))
+
+    async def _run(self, delay: float) -> None:
+        try:
+            await asyncio.sleep(delay)
+            if self._stopped or self._destroyed:
+                return
+            await self._on_trigger()
+        except asyncio.CancelledError:
+            return
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception("timer %s handler failed", self._name)
+        if not self._stopped and not self._destroyed:
+            self._schedule()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    def restart(self) -> None:
+        self.stop()
+        self._stopped = False
+        self._schedule()
+
+    def reset_timeout(self, timeout_ms: int) -> None:
+        self._timeout_ms = timeout_ms
+
+    async def destroy(self) -> None:
+        self._destroyed = True
+        self.stop()
+
+    @property
+    def running(self) -> bool:
+        return not self._stopped
