@@ -1,0 +1,100 @@
+"""Benchmark: batched multi-raft commit throughput on the device plane.
+
+Measures the north-star hot path (BASELINE.json config row 3/4): G raft
+groups' quorum commit advancement as one [G, P] kernel per tick, with the
+realistic per-tick host<->device traffic — upload the updated matchIndex
+matrix, run the fused tick, download commit results.  commits/sec = total
+log entries whose commit index advanced, summed over groups.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "commits/s", "vs_baseline": N/1e6}
+vs_baseline is against the BASELINE.md north-star target of 1M commits/s
+(the reference repo publishes no benchmark numbers — mount was empty; see
+BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tpuraft.ops.tick import (
+        ROLE_FOLLOWER,
+        ROLE_LEADER,
+        GroupState,
+        TickParams,
+        raft_tick,
+    )
+
+    G = 16384       # groups (north-star scale)
+    P = 8           # peer slots
+    VOTERS = 3      # 3-replica groups
+    BATCH = 32      # entries acked per follower per tick (apply_batch)
+    TICKS = 200
+    WARMUP = 20
+
+    rng = np.random.default_rng(0)
+    state = GroupState.zeros(G, P)
+    state.role = jnp.full((G,), ROLE_LEADER, jnp.int32)
+    voter = np.zeros((G, P), bool)
+    voter[:, :VOTERS] = True
+    state.voter_mask = jnp.asarray(voter)
+    state.pending_rel = jnp.ones((G,), jnp.int32)
+    params = TickParams.make(1000, 100, 900)
+
+    tick = jax.jit(raft_tick, donate_argnums=(0,))
+
+    # host-side match bookkeeping: per tick, followers ack BATCH more
+    # entries with realistic jitter (stragglers ack less)
+    host_match = np.zeros((G, P), np.int32)
+
+    def run_tick(i):
+        nonlocal state, host_match
+        adv = rng.integers(BATCH // 2, BATCH + 1, (G, P)).astype(np.int32)
+        adv[:, VOTERS:] = 0
+        host_match[:, :] += adv
+        # the per-tick upload: one coalesced [G, P] transfer
+        state.match_rel = jax.device_put(host_match)
+        state, out = tick(state, jnp.int32(i), params)
+        # the per-tick download: commit results back to the host runtime
+        return np.asarray(out.commit_rel)
+
+    for i in range(WARMUP):
+        commit = run_tick(i)
+    commits_start = int(commit.sum())
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(WARMUP, WARMUP + TICKS):
+        t1 = time.perf_counter()
+        commit = run_tick(i)
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.perf_counter() - t0
+    total_commits = int(commit.sum()) - commits_start
+
+    commits_per_sec = total_commits / elapsed
+    lat_ms = sorted(x * 1000 for x in lat)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[int(len(lat_ms) * 0.99)]
+
+    print(json.dumps({
+        "metric": "multiraft_batched_commits_per_sec_16k_groups",
+        "value": round(commits_per_sec, 1),
+        "unit": "commits/s",
+        "vs_baseline": round(commits_per_sec / 1e6, 3),
+        "extra": {
+            "groups": G, "peer_slots": P, "voters": VOTERS,
+            "ticks_per_sec": round(TICKS / elapsed, 1),
+            "tick_p50_ms": round(p50, 3), "tick_p99_ms": round(p99, 3),
+            "device": str(jax.devices()[0]),
+            "baseline": "north-star 1e6 commits/s (BASELINE.md; reference publishes none)",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

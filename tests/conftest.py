@@ -1,18 +1,26 @@
-"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports,
-so sharding tests exercise a realistic mesh without TPU hardware
-(SURVEY.md §5 lesson: N real nodes, one process)."""
+"""Test env: force JAX onto CPU with 8 virtual devices so sharding tests
+exercise a realistic mesh without TPU hardware (SURVEY.md §5 lesson:
+N real nodes, one process).
+
+Note: this machine's sitecustomize imports jax before pytest loads this
+file, so env vars alone are too late — but the backend is not initialized
+until the first jax.devices() call, so config.update still takes effect."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

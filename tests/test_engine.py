@@ -1,0 +1,159 @@
+"""MultiRaftEngine integration: many groups per process, one batched
+commit plane (the north-star configuration at test scale)."""
+
+import asyncio
+
+import pytest
+
+from tests.cluster import MockStateMachine
+from tpuraft.conf import Configuration
+from tpuraft.core.engine import MultiRaftEngine, TpuBallotBox
+from tpuraft.core.node import Node, State
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.entity import PeerId, Task
+from tpuraft.options import NodeOptions, TickOptions
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+
+class MultiRaftCluster:
+    """N endpoints x G groups; each endpoint hosts one replica of every
+    group and ONE MultiRaftEngine batching all its groups' commits."""
+
+    def __init__(self, n_endpoints: int, n_groups: int,
+                 election_timeout_ms: int = 300, tick_ms: int = 5):
+        self.net = InProcNetwork()
+        self.endpoints = [PeerId.parse(f"127.0.0.1:{6000 + i}")
+                          for i in range(n_endpoints)]
+        self.conf = Configuration(list(self.endpoints))
+        self.groups = [f"g{k}" for k in range(n_groups)]
+        self.engines: dict[str, MultiRaftEngine] = {}
+        self.nodes: dict[tuple[str, PeerId], Node] = {}
+        self.fsms: dict[tuple[str, PeerId], MockStateMachine] = {}
+        self.election_timeout_ms = election_timeout_ms
+        self.tick_ms = tick_ms
+
+    async def start_all(self):
+        for ep in self.endpoints:
+            server = RpcServer(ep.endpoint)
+            manager = NodeManager(server)
+            self.net.bind(server)
+            transport = InProcTransport(self.net, ep.endpoint)
+            engine = MultiRaftEngine(TickOptions(
+                max_groups=len(self.groups) + 4, max_peers=8,
+                tick_interval_ms=self.tick_ms))
+            await engine.start()
+            self.engines[ep.endpoint] = engine
+            factory = engine.ballot_box_factory()
+            for gid in self.groups:
+                fsm = MockStateMachine()
+                self.fsms[(gid, ep)] = fsm
+                opts = NodeOptions(
+                    election_timeout_ms=self.election_timeout_ms,
+                    initial_conf=self.conf.copy(),
+                    fsm=fsm, log_uri="memory://", raft_meta_uri="memory://")
+                node = Node(gid, ep, opts, transport,
+                            ballot_box_factory=factory)
+                node.node_manager = manager
+                manager.add(node)
+                assert await node.init()
+                self.nodes[(gid, ep)] = node
+
+    async def stop_all(self):
+        for node in self.nodes.values():
+            await node.shutdown()
+        for engine in self.engines.values():
+            await engine.shutdown()
+
+    async def wait_leader(self, gid: str, timeout_s: float = 8.0) -> Node:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while loop.time() < deadline:
+            leaders = [n for (g, ep), n in self.nodes.items()
+                       if g == gid and n.state == State.LEADER]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"no leader for {gid}")
+
+
+async def test_engine_backed_cluster_replicates():
+    c = MultiRaftCluster(3, 8)
+    await c.start_all()
+    try:
+        leaders = {}
+        for gid in c.groups:
+            leaders[gid] = await c.wait_leader(gid)
+        # apply one batch to every group's leader concurrently
+        async def apply(gid, i):
+            fut = asyncio.get_running_loop().create_future()
+            await leaders[gid].apply(Task(
+                data=b"%s-%d" % (gid.encode(), i),
+                done=lambda st: fut.set_result(st)))
+            st = await asyncio.wait_for(fut, 10)
+            assert st.is_ok(), f"{gid}: {st}"
+
+        await asyncio.gather(*[apply(g, i) for g in c.groups for i in range(5)])
+        # every replica of every group applied all 5 entries
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 10
+        def done():
+            return all(len(f.logs) >= 5 for f in c.fsms.values())
+        while loop.time() < deadline and not done():
+            await asyncio.sleep(0.05)
+        assert done(), {k: len(f.logs) for k, f in c.fsms.items()}
+        for gid in c.groups:
+            sets = [sorted(c.fsms[(gid, ep)].logs) for ep in c.endpoints]
+            assert sets[0] == sets[1] == sets[2]
+            assert len(sets[0]) == 5
+        # the engine actually ticked and advanced commits in batch
+        assert any(e.ticks > 0 and e.commit_advances > 0
+                   for e in c.engines.values())
+    finally:
+        await c.stop_all()
+
+
+async def test_engine_failover():
+    c = MultiRaftCluster(3, 4)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        fut = asyncio.get_running_loop().create_future()
+        await leader.apply(Task(data=b"x", done=fut.set_result))
+        assert (await asyncio.wait_for(fut, 10)).is_ok()
+        # kill the whole endpoint hosting this group's leader (all its groups!)
+        dead_ep = leader.server_id
+        c.net.stop_endpoint(dead_ep.endpoint)
+        for g in c.groups:
+            n = c.nodes.pop((g, dead_ep))
+            await n.shutdown()
+        await c.engines.pop(dead_ep.endpoint).shutdown()
+        self_net = c.net
+        self_net.unbind(dead_ep.endpoint)
+        leader2 = await c.wait_leader(gid, timeout_s=10)
+        assert leader2.server_id != dead_ep
+        fut2 = asyncio.get_running_loop().create_future()
+        await leader2.apply(Task(data=b"y", done=fut2.set_result))
+        assert (await asyncio.wait_for(fut2, 10)).is_ok()
+    finally:
+        await c.stop_all()
+
+
+async def test_tpu_ballot_box_membership_conf_sync():
+    """TpuBallotBox voter masks must track conf changes (remove_peer)."""
+    c = MultiRaftCluster(3, 1)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        victim = next(ep for ep in c.endpoints if ep != leader.server_id)
+        st = await asyncio.wait_for(leader.remove_peer(victim), 15)
+        assert st.is_ok(), str(st)
+        fut = asyncio.get_running_loop().create_future()
+        await leader.apply(Task(data=b"post-change", done=fut.set_result))
+        assert (await asyncio.wait_for(fut, 10)).is_ok()
+        eng = c.engines[leader.server_id.endpoint]
+        slot = leader.ballot_box.slot
+        assert eng.voter_mask[slot].sum() == 2
+    finally:
+        await c.stop_all()

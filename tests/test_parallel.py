@@ -1,0 +1,88 @@
+"""Sharded tick + collective quorum tests over the 8-device virtual mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpuraft.ops.ballot import quorum_match_index  # noqa: E402
+from tpuraft.ops.tick import (  # noqa: E402
+    ROLE_LEADER,
+    GroupState,
+    TickParams,
+)
+from tpuraft.parallel.collective import replicated_tick  # noqa: E402
+from tpuraft.parallel.mesh import make_mesh, shard_group_state, sharded_tick  # noqa: E402
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+
+
+def test_sharded_tick_matches_local():
+    mesh = make_mesh()
+    G, P = 64, 8
+    rng = np.random.default_rng(0)
+    s = GroupState.zeros(G, P)
+    s.role = jnp.full((G,), ROLE_LEADER, jnp.int32)
+    s.voter_mask = jnp.asarray(rng.random((G, P)) < 0.7)
+    s.match_rel = jnp.asarray(rng.integers(0, 100, (G, P)).astype(np.int32))
+    s.pending_rel = jnp.ones((G,), jnp.int32)
+    params = TickParams.make(1000, 100, 900)
+
+    from tpuraft.ops.tick import raft_tick
+
+    _, expect = raft_tick(s, jnp.int32(5), params)
+
+    tick = sharded_tick(mesh, donate=False)
+    sh = shard_group_state(GroupState.zeros(G, P), mesh)
+    sh.role, sh.voter_mask, sh.match_rel, sh.pending_rel = (
+        s.role, s.voter_mask, s.match_rel, s.pending_rel)
+    sh = shard_group_state(s, mesh)
+    ns, out = tick(sh, jnp.int32(5), params)
+    np.testing.assert_array_equal(np.asarray(out.commit_rel),
+                                  np.asarray(expect.commit_rel))
+    np.testing.assert_array_equal(np.asarray(out.elected),
+                                  np.asarray(expect.elected))
+    # result stays sharded over the mesh
+    assert len(out.commit_rel.sharding.device_set) == 8
+
+
+def test_replicated_tick_psum_quorum():
+    """Cross-replica quorum over a (replica=2, groups=4) mesh — collectives
+    execute for real across the 8 virtual devices."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("replica", "groups"))
+    R, G = 2, 16
+    rng = np.random.default_rng(1)
+    match = rng.integers(0, 50, (R, G)).astype(np.int32)
+    granted = rng.random((R, G)) < 0.5
+    run = replicated_tick(mesh, n_replicas=R)
+    commit, votes = run(jnp.asarray(match), jnp.asarray(granted))
+    # oracle: q-th largest of each column; vote counts per column
+    q = R // 2 + 1
+    want_commit = np.sort(match, axis=0)[::-1][q - 1]
+    want_votes = granted.sum(axis=0)
+    np.testing.assert_array_equal(np.asarray(commit), want_commit)
+    np.testing.assert_array_equal(np.asarray(votes), want_votes)
+
+
+def test_replicated_tick_3_replicas():
+    from jax.sharding import Mesh
+
+    # replica axis not a divisor trick: use (1,8) mesh, R folds locally
+    devs = np.array(jax.devices()).reshape(1, 8)
+    mesh = Mesh(devs, ("replica", "groups"))
+    R, G = 3, 32
+    rng = np.random.default_rng(2)
+    match = rng.integers(0, 1000, (R, G)).astype(np.int32)
+    granted = rng.random((R, G)) < 0.6
+    run = replicated_tick(mesh, n_replicas=R)
+    commit, votes = run(jnp.asarray(match), jnp.asarray(granted))
+    q = 2
+    want_commit = np.sort(match, axis=0)[::-1][q - 1]
+    np.testing.assert_array_equal(np.asarray(commit), want_commit)
+    np.testing.assert_array_equal(np.asarray(votes), granted.sum(axis=0))

@@ -75,6 +75,13 @@ class BallotBox:
         self._on_committed(point)
         return True
 
+    def update_conf(self, conf: Configuration, old_conf: Configuration) -> None:
+        """SPI hook: the scalar box reads conf per commit_at call; the
+        engine-backed TpuBallotBox maintains device voter masks here."""
+
+    def close(self) -> None:
+        """SPI hook: release engine resources (no-op for the scalar box)."""
+
     # -- follower side -------------------------------------------------------
 
     def set_last_committed_index(self, index: int) -> bool:

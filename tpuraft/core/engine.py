@@ -1,0 +1,299 @@
+"""MultiRaftEngine: one device tick advances ALL raft groups in a process.
+
+The north-star component (BASELINE.json): the per-group ``BallotBox``
+quorum counting becomes rows of a ``[G, P]`` tensor; one jitted
+``raft_tick`` per engine tick computes every group's commit advancement
+on device.  Host Nodes keep the protocol envelope; their ballot boxes are
+swapped for :class:`TpuBallotBox` via the ``ballot_box_factory`` seam
+(the analog of plugging TpuBallotBox through the reference's
+``JRaftServiceLoader`` SPI, leaving NodeImpl/FSMCaller/LogStorage
+untouched).
+
+Index-domain note: the device works in int32 *relative* indexes
+(``abs - base[g]``); the engine re-bases a group whenever its relative
+window approaches 2^28, so unbounded absolute indexes never overflow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from tpuraft.conf import Configuration
+from tpuraft.entity import PeerId
+from tpuraft.options import TickOptions
+from tpuraft.ops.tick import GroupState, TickParams
+
+LOG = logging.getLogger(__name__)
+
+_REBASE_LIMIT = 1 << 28
+
+
+class TpuBallotBox:
+    """Drop-in for core.ballot_box.BallotBox backed by the engine tensors.
+
+    Mutations write numpy mirrors and mark the engine dirty; quorum math
+    happens on device at the next engine tick.
+    """
+
+    def __init__(self, engine: "MultiRaftEngine", slot: int,
+                 on_committed: Callable[[int], None]):
+        self._engine = engine
+        self.slot = slot
+        self._on_committed = on_committed
+        self.last_committed_index = 0
+        self.pending_index = 0
+
+    # -- leader side ---------------------------------------------------------
+
+    def reset_pending_index(self, new_pending_index: int) -> None:
+        e = self._engine
+        self.pending_index = new_pending_index
+        e.base[self.slot] = new_pending_index - 1
+        e.pending_rel[self.slot] = 1
+        e.match_abs[self.slot, :] = 0
+        # commit baseline for the gate `q > commit_now`: nothing of THIS
+        # leadership is committed yet (slot may be reused from a prior node)
+        e.commit_abs[self.slot] = new_pending_index - 1
+        e.leader_mask[self.slot] = True
+        e.mark_dirty()
+
+    def clear_pending(self) -> None:
+        self.pending_index = 0
+        e = self._engine
+        e.leader_mask[self.slot] = False
+        e.match_abs[self.slot, :] = 0
+
+    def commit_at(self, peer: PeerId, match_index: int, conf: Configuration,
+                  old_conf: Configuration) -> bool:
+        """Record the ack; actual quorum reduce happens on device."""
+        if self.pending_index == 0:
+            return False
+        e = self._engine
+        col = e.peer_col(self.slot, peer)
+        if col is None:
+            return False
+        if match_index > e.match_abs[self.slot, col]:
+            e.match_abs[self.slot, col] = match_index
+            e.mark_dirty()
+        return False  # advancement is reported asynchronously by the tick
+
+    def update_conf(self, conf: Configuration, old_conf: Configuration) -> None:
+        self._engine.set_conf(self.slot, conf, old_conf)
+
+    def close(self) -> None:
+        self._engine.release(self)
+
+    # -- follower side -------------------------------------------------------
+
+    def set_last_committed_index(self, index: int) -> bool:
+        if self.pending_index != 0:
+            return False
+        if index <= self.last_committed_index:
+            return False
+        self.last_committed_index = index
+        self._on_committed(index)
+        return True
+
+    # engine callback
+    def _advance(self, new_commit: int) -> None:
+        if self.pending_index == 0:
+            return
+        if new_commit > self.last_committed_index:
+            self.last_committed_index = new_commit
+            self._on_committed(new_commit)
+
+
+class MultiRaftEngine:
+    """Per-process batched commit plane.  Start once, register each node's
+    ballot box through :meth:`ballot_box_factory`."""
+
+    def __init__(self, opts: Optional[TickOptions] = None):
+        self.opts = opts or TickOptions()
+        g, p = self.opts.max_groups, self.opts.max_peers
+        self.G, self.P = g, p
+        # numpy mirrors (host-owned truth between ticks)
+        self.match_abs = np.zeros((g, p), np.int64)
+        self.base = np.zeros(g, np.int64)
+        self.pending_rel = np.ones(g, np.int32)
+        self.voter_mask = np.zeros((g, p), bool)
+        self.old_voter_mask = np.zeros((g, p), bool)
+        self.leader_mask = np.zeros(g, bool)
+        self.commit_abs = np.zeros(g, np.int64)
+        self._peer_cols: list[dict[PeerId, int]] = [dict() for _ in range(g)]
+        self._boxes: list[Optional[TpuBallotBox]] = [None] * g
+        self._free = list(range(g - 1, -1, -1))
+        self._dirty = False
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self._tick_fn = None  # jitted quorum reduce (None => numpy path)
+        self.ticks = 0
+        self.commit_advances = 0
+
+    # -- registry ------------------------------------------------------------
+
+    def ballot_box_factory(self):
+        """Returns a factory usable as Node(ballot_box_factory=...)."""
+
+        def make(on_committed: Callable[[int], None]) -> TpuBallotBox:
+            slot = self.alloc_slot()
+            box = TpuBallotBox(self, slot, on_committed)
+            self._boxes[slot] = box
+            return box
+
+        return make
+
+    def alloc_slot(self) -> int:
+        if not self._free:
+            raise RuntimeError(f"engine full: {self.G} groups")
+        return self._free.pop()
+
+    def release(self, box: TpuBallotBox) -> None:
+        s = box.slot
+        self._boxes[s] = None
+        self.voter_mask[s] = False
+        self.old_voter_mask[s] = False
+        self.leader_mask[s] = False
+        self.match_abs[s] = 0
+        self.commit_abs[s] = 0
+        self.base[s] = 0
+        self.pending_rel[s] = 1
+        self._peer_cols[s].clear()
+        self._free.append(s)
+
+    def set_conf(self, slot: int, conf: Configuration,
+                 old_conf: Configuration) -> None:
+        """Map peers to columns and set voter masks for a group."""
+        cols = self._peer_cols[slot]
+        all_peers = list(dict.fromkeys(
+            conf.peers + old_conf.peers + conf.learners + old_conf.learners))
+        # retain existing column assignments; add new peers to free columns
+        used = set(cols.values())
+        for peer in all_peers:
+            if peer not in cols:
+                col = next((i for i in range(self.P) if i not in used), None)
+                if col is None:
+                    raise RuntimeError(
+                        f"group slot {slot}: {len(all_peers)} distinct peers "
+                        f"exceed max_peers={self.P} engine columns")
+                cols[peer] = col
+                used.add(col)
+        # drop stale peers
+        for peer in [p for p in cols if p not in all_peers]:
+            self.match_abs[slot, cols[peer]] = 0
+            del cols[peer]
+        vm = np.zeros(self.P, bool)
+        ovm = np.zeros(self.P, bool)
+        for peer in conf.peers:
+            vm[cols[peer]] = True
+        for peer in old_conf.peers:
+            ovm[cols[peer]] = True
+        self.voter_mask[slot] = vm
+        self.old_voter_mask[slot] = ovm
+        self.mark_dirty()
+
+    def peer_col(self, slot: int, peer: PeerId) -> Optional[int]:
+        return self._peer_cols[slot].get(peer)
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    # -- tick loop -----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.opts.backend != "numpy":
+            import jax
+
+            from tpuraft.ops.ballot import joint_quorum_match_index
+
+            # jitted once: eager per-tick dispatch would cost ~100ms over
+            # a tunneled device and starve the asyncio loop
+            self._tick_fn = jax.jit(joint_quorum_match_index)
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        interval = self.opts.tick_interval_ms / 1000.0
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self.tick_once()
+                except Exception:
+                    LOG.exception("engine tick failed")
+                    self._dirty = True  # re-process pending acks next tick
+
+    # -- the tick ------------------------------------------------------------
+
+    def _rebase(self) -> None:
+        hot = (self.match_abs.max(axis=1) - self.base) > _REBASE_LIMIT
+        if hot.any():
+            for s in np.nonzero(hot)[0]:
+                new_base = self.commit_abs[s]
+                self.pending_rel[s] = max(
+                    1, self.pending_rel[s] - (new_base - self.base[s]))
+                self.base[s] = new_base
+
+    def tick_once(self) -> int:
+        """One batched commit computation for all leader groups.  Returns
+        number of groups whose commit advanced."""
+        import jax.numpy as jnp
+
+        self._rebase()
+        rel = np.clip(self.match_abs - self.base[:, None], 0, None
+                      ).astype(np.int32)
+        commit_rel_now = np.clip(self.commit_abs - self.base, 0, None
+                                 ).astype(np.int32)
+
+        if self._tick_fn is not None:
+            q = np.asarray(self._tick_fn(
+                jnp.asarray(rel), jnp.asarray(self.voter_mask),
+                jnp.asarray(self.old_voter_mask)))
+        else:  # numpy fallback (tiny deployments / no jax)
+            q = _np_joint_quorum(rel, self.voter_mask, self.old_voter_mask)
+
+        can = (self.leader_mask & (q >= self.pending_rel)
+               & (q > commit_rel_now))
+        advanced = 0
+        self.ticks += 1
+        for s in np.nonzero(can)[0]:
+            box = self._boxes[s]
+            if box is None:
+                continue
+            new_commit = int(self.base[s] + q[s])
+            self.commit_abs[s] = new_commit
+            advanced += 1
+            box._advance(new_commit)
+        self.commit_advances += advanced
+        return advanced
+
+
+def _np_joint_quorum(rel: np.ndarray, vm: np.ndarray, ovm: np.ndarray
+                     ) -> np.ndarray:
+    NEG = np.int32(-(2 ** 30))
+
+    def order_stat(mask):
+        v = np.where(mask, rel, NEG)
+        sd = -np.sort(-v, axis=1)
+        n = mask.sum(axis=1)
+        qi = np.clip(n // 2, 0, rel.shape[1] - 1)
+        picked = np.take_along_axis(sd, qi[:, None], axis=1)[:, 0]
+        return np.where(n > 0, picked, NEG)
+
+    new_q = order_stat(vm)
+    old_q = order_stat(ovm)
+    return np.where(ovm.any(axis=1), np.minimum(new_q, old_q), new_q)

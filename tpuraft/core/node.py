@@ -81,11 +81,15 @@ class _VoteCtx:
 
 class Node:
     def __init__(self, group_id: str, server_id: PeerId, options: NodeOptions,
-                 transport):
+                 transport, ballot_box_factory=None):
         self.group_id = group_id
         self.server_id = server_id
         self.options = options
         self.transport = transport
+        # SPI seam (reference: DefaultJRaftServiceFactory / JRaftServiceLoader):
+        # the MultiRaftEngine plugs TpuBallotBox in here; everything else in
+        # the node is untouched by the device plane
+        self._ballot_box_factory = ballot_box_factory or BallotBox
         self.metrics = MetricRegistry(options.enable_metrics)
 
         self.state = State.UNINITIALIZED
@@ -141,7 +145,7 @@ class Node:
         await self.log_manager.init()
 
         # fsm pipeline
-        self.ballot_box = BallotBox(self._on_committed)
+        self.ballot_box = self._ballot_box_factory(self._on_committed)
         self.fsm_caller = FSMCaller(
             opts.fsm, self.log_manager,
             apply_batch=opts.raft_options.apply_batch,
@@ -166,6 +170,9 @@ class Node:
         else:
             self.conf_entry = ConfigurationEntry(
                 LogId(0, 0), opts.initial_conf.copy())
+
+        self.ballot_box.update_conf(self.conf_entry.conf,
+                                    self.conf_entry.old_conf)
 
         st = self.log_manager.check_consistency()
         if not st.is_ok():
@@ -225,6 +232,7 @@ class Node:
             await self.snapshot_executor.shutdown()
         await self.fsm_caller.shutdown()
         await self.log_manager.shutdown()
+        self.ballot_box.close()
         self._meta.shutdown()
         self.state = State.SHUTDOWN
         self._shutdown_event.set()
@@ -690,6 +698,7 @@ class Node:
         last = self.log_manager.conf_manager.last()
         if not last.conf.is_empty() and last.id.index > self.conf_entry.id.index:
             self.conf_entry = last
+            self.ballot_box.update_conf(last.conf, last.old_conf)
 
     async def handle_timeout_now(self, req: TimeoutNowRequest
                                  ) -> TimeoutNowResponse:
@@ -777,6 +786,7 @@ class Node:
                 return Status.error(RaftError.EINVAL, str(new_conf))
             self.conf_entry = ConfigurationEntry(
                 LogId(0, self.current_term), new_conf.copy())
+            self.ballot_box.update_conf(new_conf, Configuration())
             await self._step_down(self.current_term + 1, Status.error(
                 RaftError.ESETPEER, "reset_peers"))
             return Status.OK()
@@ -892,6 +902,8 @@ class _ConfigurationCtx:
         node.conf_entry = ConfigurationEntry(
             last_id, self.new_conf.copy(),
             self.old_conf.copy() if in_joint else Configuration())
+        node.ballot_box.update_conf(node.conf_entry.conf,
+                                    node.conf_entry.old_conf)
         # new peers may now vote/commit; replicators for removed peers keep
         # running until the change commits
         node.replicators.wake_all()
@@ -914,6 +926,8 @@ class _ConfigurationCtx:
                 self._stable_index = last_id.index
                 node.conf_entry = ConfigurationEntry(
                     last_id, self.new_conf.copy())
+                node.ballot_box.update_conf(node.conf_entry.conf,
+                                            node.conf_entry.old_conf)
                 node.replicators.wake_all()
                 asyncio.ensure_future(
                     node._flush_and_self_commit(term, last_id.index))

@@ -235,6 +235,7 @@ class SnapshotExecutor:
         async with node._lock:
             await node.log_manager.set_snapshot(snap_id, conf)
             node.conf_entry = conf
+            node.ballot_box.update_conf(conf.conf, conf.old_conf)
             node.ballot_box.set_last_committed_index(snap_id.index)
         node.metrics.counter("install-snapshot-received")
         LOG.info("%s loaded installed snapshot at %s", node, snap_id)

@@ -1,0 +1,29 @@
+"""Device-mesh parallelism for the multi-raft tick.
+
+Two complementary planes (SURVEY.md §6 "Distributed communication
+backend", BASELINE.json north star):
+
+- :mod:`tpuraft.parallel.mesh` — shard the ``[G, P]`` group-state tensors
+  over the mesh's ``groups`` axis (multi-group data parallelism, the
+  reference's NodeManager/RegionEngine axis vectorized);
+- :mod:`tpuraft.parallel.collective` — quorum math where each mesh slice
+  along the ``replica`` axis holds one replica's local view: vote counting
+  via ``psum`` and commit points via ``all_gather`` + order statistic over
+  ICI (the "vote-matrix psum" configuration).
+"""
+
+from tpuraft.parallel.mesh import make_mesh, shard_group_state, sharded_tick
+from tpuraft.parallel.collective import (
+    replica_commit_point,
+    replica_vote_count,
+    replicated_tick,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_group_state",
+    "sharded_tick",
+    "replica_commit_point",
+    "replica_vote_count",
+    "replicated_tick",
+]

@@ -1,0 +1,66 @@
+"""Group-axis sharding of the multi-raft tick over a device mesh.
+
+The tick kernel (tpuraft.ops.tick) is element-wise over the G axis, so
+sharding G over the mesh makes every chip advance its shard of raft
+groups with zero cross-chip traffic; cross-chip collectives only appear
+in (a) global metrics reductions and (b) the replica-axis quorum plane
+(tpuraft.parallel.collective).  This mirrors how the reference scales:
+thousands of independent groups per process, processes scaled out
+(SURVEY.md §3.5 row 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuraft.ops.tick import GroupState, TickOutputs, TickParams, raft_tick
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "groups"
+              ) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def shard_group_state(state: GroupState, mesh: Mesh, axis_name: str = "groups"
+                      ) -> GroupState:
+    """Place the SoA state with G sharded over the mesh.  G must divide the
+    mesh size evenly (pad the group capacity, not the mesh)."""
+
+    def put(x):
+        spec = P(axis_name, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, state)
+
+
+def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
+    """Compile raft_tick with G sharded over the mesh.  Returns the jitted
+    function; call with (state, now_ms, params)."""
+    row = NamedSharding(mesh, P(axis_name))
+    mat = NamedSharding(mesh, P(axis_name, None))
+    scalar = NamedSharding(mesh, P())
+
+    def state_shardings(state_cls=GroupState):
+        # all [G] fields -> row, all [G,P] fields -> mat
+        return GroupState(
+            role=row, commit_rel=row, pending_rel=row, match_rel=mat,
+            granted=mat, voter_mask=mat, old_voter_mask=mat,
+            elect_deadline=row, hb_deadline=row, last_ack=mat)
+
+    out_outputs = TickOutputs(
+        commit_rel=row, commit_advanced=row, elected=row, election_due=row,
+        step_down=row, hb_due=row, lease_valid=row)
+    params_sharding = TickParams(scalar, scalar, scalar)
+    return jax.jit(
+        raft_tick,
+        in_shardings=(state_shardings(), scalar, params_sharding),
+        out_shardings=(state_shardings(), out_outputs),
+        donate_argnums=(0,) if donate else (),
+    )
