@@ -12,6 +12,7 @@ import time
 from typing import Optional
 
 from tpuraft.conf import Configuration
+from tpuraft.core.cli_service import CliProcessors
 from tpuraft.core.node import Node, State
 from tpuraft.core.node_manager import NodeManager
 from tpuraft.core.state_machine import Iterator, StateMachine
@@ -113,6 +114,7 @@ class TestCluster:
             self.fsms[peer] = fsm or MockStateMachine()
         server = RpcServer(peer.endpoint)
         manager = NodeManager(server)
+        CliProcessors(manager)
         self.net.bind(server)
         self.net.start_endpoint(peer.endpoint)
         transport = InProcTransport(self.net, peer.endpoint)
@@ -136,6 +138,10 @@ class TestCluster:
     async def stop_all(self) -> None:
         for p in list(self.nodes):
             await self.stop(p)
+
+    def client_transport(self, endpoint: str = "client:0") -> InProcTransport:
+        """A transport for out-of-cluster clients (CliService, RouteTable)."""
+        return InProcTransport(self.net, endpoint)
 
     async def wait_leader(self, timeout_s: float = 5.0) -> Node:
         """Poll until exactly one live node is leader (reference:

@@ -1,0 +1,134 @@
+"""CLI service + RouteTable integration tests.
+
+Reference parity: ``test:core/CliServiceTest`` and ``test:core/RouteTableTest``
+run against a TestCluster (SURVEY.md §5 "CLI/route" row).
+"""
+
+import asyncio
+import contextlib
+
+from tests.cluster import TestCluster
+from tpuraft.conf import Configuration
+from tpuraft.core.cli_service import CliService
+from tpuraft.route_table import RouteTable
+
+
+@contextlib.asynccontextmanager
+async def cluster3(tmp_path=None, **kw):
+    c = TestCluster(3, tmp_path=tmp_path, **kw)
+    await c.start_all()
+    try:
+        yield c
+    finally:
+        await c.stop_all()
+
+
+async def test_get_leader_and_peers(tmp_path):
+    async with cluster3(tmp_path) as c:
+        leader = await c.wait_leader()
+        cli = CliService(c.client_transport())
+        got = await cli.get_leader(c.group_id, c.conf)
+        assert got == leader.server_id
+        peers = await cli.get_peers(c.group_id, c.conf)
+        assert sorted(map(str, peers)) == sorted(map(str, c.peers))
+
+
+async def test_transfer_leader_via_cli(tmp_path):
+    async with cluster3(tmp_path) as c:
+        leader = await c.wait_leader()
+        cli = CliService(c.client_transport())
+        target = next(p for p in c.peers if p != leader.server_id)
+        st = await cli.transfer_leader(c.group_id, c.conf, target)
+        assert st.is_ok(), st
+        deadline = asyncio.get_running_loop().time() + 5
+        while asyncio.get_running_loop().time() < deadline:
+            if (await cli.get_leader(c.group_id, c.conf)) == target:
+                break
+            await asyncio.sleep(0.05)
+        assert (await cli.get_leader(c.group_id, c.conf)) == target
+
+
+async def test_remove_and_add_peer_via_cli(tmp_path):
+    async with cluster3(tmp_path) as c:
+        leader = await c.wait_leader()
+        cli = CliService(c.client_transport())
+        victim = next(p for p in c.peers if p != leader.server_id)
+        st = await cli.remove_peer(c.group_id, c.conf, victim)
+        assert st.is_ok(), st
+        peers = await cli.get_peers(c.group_id, c.conf)
+        assert victim not in peers and len(peers) == 2
+        st = await cli.add_peer(c.group_id, Configuration(peers), victim)
+        assert st.is_ok(), st
+        peers = await cli.get_peers(c.group_id, c.conf)
+        assert victim in peers and len(peers) == 3
+
+
+async def test_snapshot_via_cli(tmp_path):
+    async with cluster3(tmp_path, snapshot=True) as c:
+        leader = await c.wait_leader()
+        await c.apply_ok(leader, b"x")
+        cli = CliService(c.client_transport())
+        st = await cli.snapshot(c.group_id, leader.server_id)
+        assert st.is_ok(), st
+
+
+async def test_cli_follows_leader_redirect(tmp_path):
+    """Ops issued while the cached leader is stale must refresh + retry."""
+    async with cluster3(tmp_path) as c:
+        leader = await c.wait_leader()
+        cli = CliService(c.client_transport())
+        await cli.get_leader(c.group_id, c.conf)  # warm the cache
+        target = next(p for p in c.peers if p != leader.server_id)
+        assert (await leader.transfer_leadership_to(target)).is_ok()
+        deadline = asyncio.get_running_loop().time() + 5
+        while asyncio.get_running_loop().time() < deadline:
+            if c.nodes[target].is_leader():
+                break
+            await asyncio.sleep(0.05)
+        third = next(p for p in c.peers
+                     if p not in (leader.server_id, target))
+        st = await cli.transfer_leader(c.group_id, c.conf, third)
+        assert st.is_ok(), st
+
+
+async def test_route_table_refresh(tmp_path):
+    async with cluster3(tmp_path) as c:
+        await c.wait_leader()
+        rt = RouteTable()
+        assert rt.update_configuration(
+            c.group_id, ",".join(str(p) for p in c.peers))
+        cli = CliService(c.client_transport())
+        st = await rt.refresh_leader(cli, c.group_id)
+        assert st.is_ok(), st
+        leader = rt.select_leader(c.group_id)
+        assert leader is not None and c.nodes[leader].is_leader()
+        st = await rt.refresh_configuration(cli, c.group_id)
+        assert st.is_ok(), st
+        conf = rt.get_configuration(c.group_id)
+        assert sorted(map(str, conf.list_all())) == sorted(map(str, c.peers))
+
+
+async def test_route_table_unknown_group():
+    rt = RouteTable()
+    assert rt.select_leader("nope") is None
+    st = await rt.refresh_leader(CliService(None), "nope")
+    assert not st.is_ok()
+
+
+async def test_cli_message_codec_roundtrip():
+    from tpuraft.rpc.cli_messages import ChangePeersRequest, CliResponse
+    from tpuraft.rpc.messages import decode_message, encode_message
+
+    req = ChangePeersRequest(group_id="g", peer_id="1.2.3.4:80",
+                             new_peers=["a:1", "b:2"])
+    assert decode_message(encode_message(req)) == req
+    resp = CliResponse(code=0, msg="", old_peers=["a:1"], new_peers=["b:2"])
+    assert decode_message(encode_message(resp)) == resp
+
+
+async def test_rebalance(tmp_path):
+    async with cluster3(tmp_path) as c:
+        await c.wait_leader()
+        cli = CliService(c.client_transport())
+        st = await cli.rebalance([c.group_id], c.conf)
+        assert st.is_ok(), st

@@ -341,6 +341,13 @@ class Node:
     def on_peer_ack(self, peer: PeerId, when: float) -> None:
         self._peer_acks[peer] = when
 
+    def list_alive_peers(self) -> list[PeerId]:
+        """Peers heard from within one election timeout (leader only;
+        reference: CliServiceImpl#getAlivePeers via Replicator lastRpcSendTimestamp)."""
+        horizon = time.monotonic() - self.options.election_timeout_ms / 1000.0
+        return [p for p in self.list_peers()
+                if p == self.server_id or self._peer_acks.get(p, 0.0) > horizon]
+
     # ======================================================================
     # election machinery
     # ======================================================================

@@ -94,6 +94,7 @@ class NodeOptions:
 class CliOptions:
     timeout_ms: int = 3000
     max_retry: int = 3
+    retry_interval_ms: int = 100
 
 
 @dataclass

@@ -1,0 +1,120 @@
+"""CLI (admin) RPC messages.
+
+Reference parity: generated ``core:rpc/CliRequests`` protobuf — one
+request/response pair per admin op (AddPeer, RemovePeer, ChangePeers,
+ResetPeer, Snapshot, TransferLeader, GetLeader, GetPeers, AddLearners,
+RemoveLearners) — handled server-side by the per-op processors under
+``core:rpc/impl/cli/`` (SURVEY.md §3.1 "CLI service & processors").
+
+All requests carry ``group_id`` (multi-raft routing key) and ``peer_id``
+(the serving peer; empty string = "whichever node of this group lives on
+the addressed endpoint").  Peers travel as ``str`` in PeerId's canonical
+``ip:port[:idx[:priority]]`` form.  Type ids 64+ in the shared codec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpuraft.rpc.messages import register_message
+
+
+def _cli(tid: int):
+    def deco(cls):
+        return register_message(tid, dataclass(cls))
+    return deco
+
+
+@_cli(64)
+class GetLeaderRequest:
+    group_id: str
+    peer_id: str = ""
+
+
+@_cli(65)
+class GetLeaderResponse:
+    leader_id: str = ""
+    success: bool = True
+
+
+@_cli(66)
+class GetPeersRequest:
+    group_id: str
+    peer_id: str = ""
+    only_alive: bool = False
+
+
+@_cli(67)
+class GetPeersResponse:
+    peers: list[str] = field(default_factory=list)
+    learners: list[str] = field(default_factory=list)
+    success: bool = True
+
+
+@_cli(68)
+class AddPeerRequest:
+    group_id: str
+    peer_id: str
+    adding: str = ""
+
+
+@_cli(69)
+class RemovePeerRequest:
+    group_id: str
+    peer_id: str
+    removing: str = ""
+
+
+@_cli(70)
+class ChangePeersRequest:
+    group_id: str
+    peer_id: str
+    new_peers: list[str] = field(default_factory=list)
+
+
+@_cli(71)
+class ResetPeersRequest:
+    group_id: str
+    peer_id: str
+    new_peers: list[str] = field(default_factory=list)
+
+
+@_cli(72)
+class SnapshotRequest:
+    group_id: str
+    peer_id: str = ""
+
+
+@_cli(73)
+class TransferLeaderRequest:
+    group_id: str
+    peer_id: str
+    transferee: str = ""
+
+
+@_cli(74)
+class AddLearnersRequest:
+    group_id: str
+    peer_id: str
+    learners: list[str] = field(default_factory=list)
+
+
+@_cli(75)
+class RemoveLearnersRequest:
+    group_id: str
+    peer_id: str
+    learners: list[str] = field(default_factory=list)
+
+
+@_cli(76)
+class CliResponse:
+    """Uniform admin-op outcome: ok/error code/msg + new conf if changed."""
+
+    code: int = 0
+    msg: str = ""
+    old_peers: list[str] = field(default_factory=list)
+    new_peers: list[str] = field(default_factory=list)
+
+    @property
+    def success(self) -> bool:
+        return self.code == 0

@@ -184,8 +184,22 @@ class ErrorResponse:
 
 
 # ---- codec -----------------------------------------------------------------
+# Extensible registry so other message families (CLI ops, KV ops) claim
+# stable type-id ranges: 0-31 raft core, 64-95 CLI, 128-159 KV.
 
-_MSG_TYPES: list[type] = [
+_MSG_TYPES: dict[int, type] = {}
+_TYPE_ID: dict[type, int] = {}
+
+
+def register_message(tid: int, cls: type) -> type:
+    if tid in _MSG_TYPES and _MSG_TYPES[tid] is not cls:
+        raise ValueError(f"type id {tid} already taken by {_MSG_TYPES[tid]}")
+    _MSG_TYPES[tid] = cls
+    _TYPE_ID[cls] = tid
+    return cls
+
+
+for _i, _t in enumerate([
     AppendEntriesRequest,
     AppendEntriesResponse,
     RequestVoteRequest,
@@ -199,32 +213,52 @@ _MSG_TYPES: list[type] = [
     GetFileRequest,
     GetFileResponse,
     ErrorResponse,
-]
-_TYPE_ID = {t: i for i, t in enumerate(_MSG_TYPES)}
+]):
+    register_message(_i, _t)
+
+
+def _ann(f) -> str:
+    """Field annotation as a string, whether or not the defining module
+    uses ``from __future__ import annotations``."""
+    t = f.type
+    if isinstance(t, str):
+        return t
+    if isinstance(t, type):
+        return t.__name__
+    return str(t)  # e.g. types.GenericAlias: list[str] -> "list[str]"
 
 
 def encode_message(msg) -> bytes:
     """Wire-encode any message: u8 type id + field stream."""
     tid = _TYPE_ID[type(msg)]
     out = bytearray(struct.pack("<B", tid))
-    for name, ftype in type(msg).__dataclass_fields__.items():
+    for name, f in type(msg).__dataclass_fields__.items():
         v = getattr(msg, name)
-        if isinstance(v, bool):
+        ann = _ann(f)
+        if ann == "bool":
             out += struct.pack("<B", v)
-        elif isinstance(v, int):
+        elif ann == "int":
             out += _I64.pack(v)
-        elif isinstance(v, str):
+        elif ann == "str":
             out += _pack_str(v)
-        elif isinstance(v, bytes):
+        elif ann == "bytes":
             out += _pack_bytes(v)
-        elif isinstance(v, SnapshotMeta):
+        elif ann == "SnapshotMeta":
             out += _pack_bytes(v.encode())
-        elif isinstance(v, list):  # list[LogEntry]
+        elif ann.startswith("list[str]"):
+            out += struct.pack("<I", len(v))
+            for s in v:
+                out += _pack_str(s)
+        elif ann.startswith("list[bytes]"):
+            out += struct.pack("<I", len(v))
+            for b in v:
+                out += _pack_bytes(b)
+        elif ann.startswith("list[LogEntry]"):
             out += struct.pack("<I", len(v))
             for e in v:
                 out += _pack_bytes(e.encode())
         else:
-            raise TypeError(f"cannot encode field {name}={v!r}")
+            raise TypeError(f"cannot encode field {name}={v!r} ({ann})")
     return bytes(out)
 
 
@@ -235,7 +269,7 @@ def decode_message(buf: bytes | memoryview):
     off = 1
     kwargs = {}
     for name, f in cls.__dataclass_fields__.items():
-        ann = f.type
+        ann = _ann(f)
         if ann == "bool":
             (v,) = struct.unpack_from("<B", buf, off)
             kwargs[name] = bool(v)
@@ -250,6 +284,22 @@ def decode_message(buf: bytes | memoryview):
         elif ann == "SnapshotMeta":
             blob, off = _unpack_bytes(buf, off)
             kwargs[name] = SnapshotMeta.decode(blob)
+        elif ann.startswith("list[str]"):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            items = []
+            for _ in range(n):
+                s, off = _unpack_str(buf, off)
+                items.append(s)
+            kwargs[name] = items
+        elif ann.startswith("list[bytes]"):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            blobs = []
+            for _ in range(n):
+                b, off = _unpack_bytes(buf, off)
+                blobs.append(b)
+            kwargs[name] = blobs
         elif ann.startswith("list[LogEntry]"):
             (n,) = struct.unpack_from("<I", buf, off)
             off += 4
