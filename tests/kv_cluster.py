@@ -1,0 +1,99 @@
+"""KVTestCluster: N StoreEngines in one process over loopback transport.
+
+Mirrors the reference's RheaKV in-JVM multi-store test pattern
+(SURVEY.md §5 "RheaKV integration"): real region raft groups, real KV
+command processors, fault injection via the shared InProcNetwork.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from tests.cluster import TestCluster  # noqa: F401  (re-export convenience)
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.region_engine import RegionEngine
+from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+
+class KVTestCluster:
+    __test__ = False
+
+    def __init__(self, n_stores: int = 3, tmp_path=None,
+                 regions: Optional[list[Region]] = None,
+                 election_timeout_ms: int = 300,
+                 multi_raft_engine_factory=None):
+        self.net = InProcNetwork()
+        self.endpoints = [f"127.0.0.1:{6000 + i}" for i in range(n_stores)]
+        peers = list(self.endpoints)
+        if regions is None:
+            regions = [Region(id=1, peers=peers)]
+        else:
+            for r in regions:
+                if not r.peers:
+                    r.peers = list(peers)
+        self.region_template = [r.copy() for r in regions]
+        self.tmp_path = tmp_path
+        self.election_timeout_ms = election_timeout_ms
+        self.engine_factory = multi_raft_engine_factory
+        self.stores: dict[str, StoreEngine] = {}
+
+    async def start_all(self) -> None:
+        for ep in self.endpoints:
+            await self.start_store(ep)
+
+    async def start_store(self, endpoint: str) -> StoreEngine:
+        server = RpcServer(endpoint)
+        self.net.bind(server)
+        self.net.start_endpoint(endpoint)
+        transport = InProcTransport(self.net, endpoint)
+        opts = StoreEngineOptions(
+            server_id=endpoint,
+            initial_regions=[r.copy() for r in self.region_template],
+            data_path=str(self.tmp_path) if self.tmp_path else "",
+            election_timeout_ms=self.election_timeout_ms,
+        )
+        engine = self.engine_factory() if self.engine_factory else None
+        store = StoreEngine(opts, server, transport, multi_raft_engine=engine)
+        await store.start()
+        self.stores[endpoint] = store
+        return store
+
+    async def stop_store(self, endpoint: str) -> None:
+        self.net.stop_endpoint(endpoint)
+        store = self.stores.pop(endpoint, None)
+        if store:
+            self.net.unbind(endpoint)
+            await store.shutdown()
+
+    async def stop_all(self) -> None:
+        for ep in list(self.stores):
+            await self.stop_store(ep)
+
+    def client_transport(self, endpoint: str = "kvclient:0") -> InProcTransport:
+        return InProcTransport(self.net, endpoint)
+
+    async def wait_region_leader(self, region_id: int, timeout_s: float = 5.0
+                                 ) -> RegionEngine:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            leaders = [s.get_region_engine(region_id)
+                       for s in self.stores.values()
+                       if s.get_region_engine(region_id)
+                       and s.get_region_engine(region_id).is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"no leader for region {region_id} in {timeout_s}s")
+
+    async def wait_region_on_all(self, region_id: int, timeout_s: float = 5.0
+                                 ) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(s.get_region_engine(region_id) is not None
+                   for s in self.stores.values()):
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"region {region_id} not on all stores")

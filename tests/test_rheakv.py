@@ -1,0 +1,272 @@
+"""RheaKV tests: raw store units + multi-store raft-backed integration.
+
+Reference tiers mirrored (SURVEY.md §5): MemoryKVStoreTest-style unit
+tests; StoreEngine/DefaultRheaKVStore-style in-process cluster tests with
+leader kill and region split.
+"""
+
+import asyncio
+import contextlib
+import struct
+
+import pytest
+
+from tests.kv_cluster import KVTestCluster
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.kv_service import (
+    ERR_INVALID_EPOCH,
+    KVCommandRequest,
+    decode_result,
+    encode_result,
+    scan_op,
+)
+from tpuraft.rheakv.metadata import Region, RegionEpoch
+from tpuraft.rheakv.raw_store import MemoryRawKVStore
+
+
+# ---- unit: MemoryRawKVStore ------------------------------------------------
+
+
+def test_memory_store_basic_ops():
+    s = MemoryRawKVStore()
+    assert s.get(b"a") is None
+    s.put(b"a", b"1")
+    s.put(b"c", b"3")
+    s.put(b"b", b"2")
+    assert s.get(b"b") == b"2"
+    assert s.contains_key(b"c") and not s.contains_key(b"x")
+    assert s.scan(b"", b"") == [(b"a", b"1"), (b"b", b"2"), (b"c", b"3")]
+    assert s.scan(b"b", b"") == [(b"b", b"2"), (b"c", b"3")]
+    assert s.scan(b"", b"b") == [(b"a", b"1")]
+    assert s.scan(b"", b"", limit=2) == [(b"a", b"1"), (b"b", b"2")]
+    assert s.reverse_scan(b"", b"")[0] == (b"c", b"3")
+    s.delete(b"b")
+    assert s.get(b"b") is None
+    s.delete_range(b"a", b"c")
+    assert s.scan(b"", b"") == [(b"c", b"3")]
+
+
+def test_memory_store_cas_merge_putlist():
+    s = MemoryRawKVStore()
+    assert s.put_if_absent(b"k", b"v") is None
+    assert s.put_if_absent(b"k", b"w") == b"v"
+    assert not s.compare_and_put(b"k", b"wrong", b"x")
+    assert s.compare_and_put(b"k", b"v", b"x")
+    assert s.get(b"k") == b"x"
+    assert s.get_and_put(b"k", b"y") == b"x"
+    s.merge(b"m", b"a")
+    s.merge(b"m", b"b")
+    assert s.get(b"m") == b"a,b"
+    s.put_list([(b"p1", b"1"), (b"p2", b"2")])
+    assert s.get(b"p1") == b"1" and s.get(b"p2") == b"2"
+
+
+def test_memory_store_sequence_and_locks():
+    s = MemoryRawKVStore()
+    seq = s.get_sequence(b"s", 10)
+    assert (seq.start, seq.end) == (0, 10)
+    seq = s.get_sequence(b"s", 5)
+    assert (seq.start, seq.end) == (10, 15)
+    assert s.get_sequence(b"s", 0).start == 15  # pure read
+    s.reset_sequence(b"s")
+    assert s.get_sequence(b"s", 1).start == 0
+
+    ok, token, owner = s.try_lock_with(b"L", b"me", 60_000, False)
+    assert ok and owner == b"me"
+    ok2, token2, owner2 = s.try_lock_with(b"L", b"you", 60_000, False)
+    assert not ok2 and owner2 == b"me" and token2 == token
+    # reentrant
+    ok3, token3, _ = s.try_lock_with(b"L", b"me", 60_000, True)
+    assert ok3 and token3 == token
+    assert not s.release_lock(b"L", b"you")
+    assert s.release_lock(b"L", b"me")      # acquires 2 -> 1
+    assert s.release_lock(b"L", b"me")      # released
+    ok4, token4, _ = s.try_lock_with(b"L", b"you", 1000, False)
+    assert ok4 and token4 > token  # fencing token monotonic
+
+
+def test_memory_store_snapshot_roundtrip():
+    s = MemoryRawKVStore()
+    for i in range(20):
+        s.put(b"k%02d" % i, b"v%d" % i)
+    s.get_sequence(b"k05seq", 7)
+    s.try_lock_with(b"k07", b"me", 60_000, False)
+    blob = s.serialize_range(b"k00", b"k10")
+    t = MemoryRawKVStore()
+    t.load_serialized(blob)
+    assert t.get(b"k05") == b"v5" and t.get(b"k15") is None
+    assert t.get_sequence(b"k05seq", 0).start == 7
+    ok, _, owner = t.try_lock_with(b"k07", b"you", 1000, False)
+    assert not ok and owner == b"me"
+
+
+def test_kv_operation_codec():
+    for op in [
+        KVOperation(KVOp.PUT, b"k", b"v"),
+        KVOperation.cas(b"k", b"e", b"u"),
+        KVOperation.get_sequence(b"s", 42),
+        KVOperation.key_lock(b"L", b"id", 5000, True),
+        KVOperation.range_split(9, b"m"),
+        KVOperation.put_list([(b"a", b"1"), (b"b", b"2")]),
+    ]:
+        got = KVOperation.decode(op.encode())
+        assert got == op
+    kvs = KVOperation.unpack_kv_list(
+        KVOperation.put_list([(b"a", b"1"), (b"b", b"2")]).value)
+    assert kvs == [(b"a", b"1"), (b"b", b"2")]
+
+
+def test_result_codec():
+    for r in [None, True, False, b"bytes", (3, 9),
+              (True, 7, b"owner"),
+              [(b"k1", b"v1"), (b"k2", None)]]:
+        assert decode_result(encode_result(r)) == r
+
+
+# ---- integration: multi-store cluster --------------------------------------
+
+
+@contextlib.asynccontextmanager
+async def kv_cluster(tmp_path=None, **kw):
+    c = KVTestCluster(3, tmp_path=tmp_path, **kw)
+    await c.start_all()
+    try:
+        yield c
+    finally:
+        await c.stop_all()
+
+
+async def test_region_replicated_put_get_scan():
+    async with kv_cluster() as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        assert await rs.put(b"hello", b"world")
+        assert await rs.get(b"hello") == b"world"
+        await rs.put_list([(b"a", b"1"), (b"b", b"2"), (b"c", b"3")])
+        assert [k for k, _ in await rs.scan(b"a", b"c")] == [b"a", b"b"]
+        assert await rs.compare_and_put(b"a", b"1", b"1'")
+        assert not await rs.compare_and_put(b"a", b"1", b"nope")
+        got = await rs.multi_get([b"a", b"zz"])
+        assert got[b"a"] == b"1'" and got[b"zz"] is None
+        # replicas converge: every store's raw store sees the data
+        await asyncio.sleep(0.2)
+        for s in c.stores.values():
+            assert s.raw_store.get(b"hello") == b"world"
+
+
+async def test_sequence_and_lock_through_raft():
+    async with kv_cluster() as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        s1 = await rs.get_sequence(b"ids", 100)
+        s2 = await rs.get_sequence(b"ids", 100)
+        assert (s1.start, s1.end, s2.start, s2.end) == (0, 100, 100, 200)
+        ok, token, owner = await rs.try_lock_with(b"lock", b"client-A", 30_000)
+        assert ok
+        ok2, _, owner2 = await rs.try_lock_with(b"lock", b"client-B", 30_000)
+        assert not ok2 and owner2 == b"client-A"
+        assert await rs.release_lock(b"lock", b"client-A")
+        ok3, token3, _ = await rs.try_lock_with(b"lock", b"client-B", 30_000)
+        assert ok3 and token3 > token
+
+
+async def test_kv_survives_leader_kill(tmp_path):
+    async with kv_cluster(tmp_path) as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        for i in range(5):
+            await rs.put(b"k%d" % i, b"v%d" % i)
+        dead_ep = leader.store_engine.server_id.endpoint
+        await c.stop_store(dead_ep)
+        new_leader = await c.wait_region_leader(1)
+        assert new_leader.store_engine.server_id.endpoint != dead_ep
+        rs2 = new_leader.raft_store
+        assert await rs2.get(b"k3") == b"v3"  # durable across failover
+        assert await rs2.put(b"after", b"crash")
+        assert await rs2.get(b"after") == b"crash"
+
+
+async def test_kv_command_processor_epoch_check():
+    async with kv_cluster() as c:
+        leader = await c.wait_region_leader(1)
+        t = c.client_transport()
+        ep = leader.store_engine.server_id.endpoint
+        put = KVOperation(KVOp.PUT, b"wire", b"ok").encode()
+        # stale epoch rejected with current region meta attached
+        resp = await t.call(ep, "kv_command", KVCommandRequest(
+            region_id=1, conf_ver=99, version=99, op_blob=put), 2000)
+        assert resp.code == ERR_INVALID_EPOCH
+        cur = Region.decode(resp.region_meta)
+        assert cur.id == 1
+        # correct epoch accepted
+        resp = await t.call(ep, "kv_command", KVCommandRequest(
+            region_id=1, conf_ver=cur.epoch.conf_ver,
+            version=cur.epoch.version, op_blob=put), 2000)
+        assert resp.code == 0 and decode_result(resp.result) is True
+        get = KVOperation(KVOp.GET, b"wire").encode()
+        resp = await t.call(ep, "kv_command", KVCommandRequest(
+            region_id=1, conf_ver=cur.epoch.conf_ver,
+            version=cur.epoch.version, op_blob=get), 2000)
+        assert decode_result(resp.result) == b"ok"
+        # scan over the wire
+        resp = await t.call(ep, "kv_command", KVCommandRequest(
+            region_id=1, conf_ver=cur.epoch.conf_ver,
+            version=cur.epoch.version,
+            op_blob=scan_op(b"", b"").encode()), 2000)
+        assert (b"wire", b"ok") in decode_result(resp.result)
+
+
+async def test_region_split():
+    async with kv_cluster() as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        for i in range(32):
+            await rs.put(b"key%02d" % i, b"v%d" % i)
+        se = leader.store_engine
+        st = await se.apply_split(1, 2)
+        assert st.is_ok(), str(st)
+        # new region appears on every store (applied via raft on each)
+        await c.wait_region_on_all(2)
+        for s in c.stores.values():
+            r1 = s.get_region_engine(1).region
+            r2 = s.get_region_engine(2).region
+            assert r1.end_key == r2.start_key != b""
+            assert r1.epoch.version == 2 and r2.epoch.version == 2
+        # both regions elect leaders and serve their halves
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        split_key = l1.region.end_key
+        assert await l1.raft_store.get(b"key00") == b"v0"
+        assert await l2.raft_store.get(b"key31") == b"v31"
+        # writes routed to the proper region engines still work
+        assert await l1.raft_store.put(split_key[:-1] + b"!", b"left")
+        assert await l2.raft_store.put(split_key + b"z", b"right")
+
+
+async def test_kv_over_device_commit_plane():
+    """Regions' quorum bookkeeping on the MultiRaftEngine's [G,P] tick
+    (numpy backend for test speed; same code path as the jax backend)."""
+    from tpuraft.core.engine import MultiRaftEngine
+    from tpuraft.options import TickOptions
+
+    def factory():
+        return MultiRaftEngine(TickOptions(
+            max_groups=8, max_peers=4, tick_interval_ms=2, backend="numpy"))
+
+    async with kv_cluster(multi_raft_engine_factory=factory) as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        for i in range(10):
+            assert await rs.put(b"e%d" % i, b"v%d" % i)
+        assert await rs.get(b"e7") == b"v7"
+        await asyncio.sleep(0.2)
+        for s in c.stores.values():
+            assert s.raw_store.get(b"e9") == b"v9"
+
+
+async def test_split_too_small_rejected():
+    async with kv_cluster() as c:
+        leader = await c.wait_region_leader(1)
+        await leader.raft_store.put(b"only", b"one")
+        st = await leader.store_engine.apply_split(1, 2)
+        assert not st.is_ok()

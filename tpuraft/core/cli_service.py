@@ -102,8 +102,11 @@ class CliProcessors:
             success=bool(leader) and not leader.is_empty())
 
     async def _get_peers(self, req: GetPeersRequest) -> GetPeersResponse:
+        # membership queries must come from the leader — a deposed node
+        # would answer with a stale (or, for only_alive, empty) view
+        # (reference: GetPeersRequestProcessor requires leadership)
         node = self._find(req.group_id, req.peer_id)
-        if node is None:
+        if node is None or not node.is_leader():
             return GetPeersResponse(success=False)
         peers = (node.list_alive_peers() if req.only_alive
                  else node.list_peers())
@@ -136,7 +139,8 @@ class CliProcessors:
         if err:
             return err
         old = [str(p) for p in node.list_peers()]
-        conf = Configuration([PeerId.parse(p) for p in req.new_peers])
+        conf = Configuration([PeerId.parse(p) for p in req.new_peers],
+                             [PeerId.parse(p) for p in req.new_learners])
         st = await node.change_peers(conf)
         resp = self._from_status(st, node)
         resp.old_peers = old
@@ -149,7 +153,8 @@ class CliProcessors:
         if node is None:
             return CliResponse(code=int(RaftError.ENOENT),
                                msg=f"no node for group {req.group_id} here")
-        conf = Configuration([PeerId.parse(p) for p in req.new_peers])
+        conf = Configuration([PeerId.parse(p) for p in req.new_peers],
+                             [PeerId.parse(p) for p in req.new_learners])
         st = await node.reset_peers(conf)
         return self._from_status(st, node)
 
@@ -229,6 +234,13 @@ class CliService:
         resp = await self._peers_rpc(group_id, conf, False)
         return [PeerId.parse(p) for p in resp.learners]
 
+    async def get_configuration(self, group_id: str, conf: Configuration
+                                ) -> Configuration:
+        """Voters AND learners in one round trip."""
+        resp = await self._peers_rpc(group_id, conf, False)
+        return Configuration([PeerId.parse(p) for p in resp.peers],
+                             [PeerId.parse(p) for p in resp.learners])
+
     async def _peers_rpc(self, group_id: str, conf: Configuration,
                          only_alive: bool) -> GetPeersResponse:
         leader = await self._require_leader(group_id, conf)
@@ -278,7 +290,8 @@ class CliService:
             group_id, conf, "cli_change_peers",
             lambda leader: ChangePeersRequest(
                 group_id=group_id, peer_id=str(leader),
-                new_peers=[str(p) for p in new_conf.list_all()]))
+                new_peers=[str(p) for p in new_conf.peers],
+                new_learners=[str(p) for p in new_conf.learners]))
 
     async def reset_peers(self, group_id: str, peer: PeerId,
                           new_conf: Configuration) -> Status:
@@ -286,7 +299,8 @@ class CliService:
         resp = await self._transport.call(
             peer.endpoint, "cli_reset_peers",
             ResetPeersRequest(group_id=group_id, peer_id=str(peer),
-                              new_peers=[str(p) for p in new_conf.list_all()]),
+                              new_peers=[str(p) for p in new_conf.peers],
+                              new_learners=[str(p) for p in new_conf.learners]),
             self._opts.timeout_ms)
         return Status(resp.code, resp.msg)
 
@@ -334,7 +348,7 @@ class CliService:
         """
         if not balance_group_ids:
             return Status.OK()
-        peers = conf.list_all()
+        peers = list(conf.peers)  # voters only — learners can't lead
         if not peers:
             return Status.error(RaftError.EINVAL, "empty conf")
         expected = (len(balance_group_ids) + len(peers) - 1) // len(peers)

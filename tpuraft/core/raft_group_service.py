@@ -13,16 +13,19 @@ from tpuraft.options import NodeOptions
 
 class RaftGroupService:
     def __init__(self, group_id: str, server_id: PeerId, options: NodeOptions,
-                 node_manager: NodeManager, transport):
+                 node_manager: NodeManager, transport,
+                 ballot_box_factory=None):
         self.group_id = group_id
         self.server_id = server_id
         self.options = options
         self.node_manager = node_manager
         self.transport = transport
+        self.ballot_box_factory = ballot_box_factory
         self.node: Node | None = None
 
     async def start(self) -> Node:
-        node = Node(self.group_id, self.server_id, self.options, self.transport)
+        node = Node(self.group_id, self.server_id, self.options, self.transport,
+                    ballot_box_factory=self.ballot_box_factory)
         node.node_manager = self.node_manager  # for snapshot file service
         self.node_manager.add(node)
         ok = await node.init()

@@ -1,0 +1,30 @@
+"""RheaKV: an embedded distributed KV store on multi-raft.
+
+Reference parity: ``jraft-rheakv`` (SURVEY.md §3.2) — regions (key
+ranges) each backed by one raft group, a store engine per process
+multiplexing many regions over one transport, a placement driver for
+region scheduling/splitting.
+
+TPU-first design note: regions map to rows of the MultiRaftEngine's
+``[G, P]`` device plane — all regions on a store advance their consensus
+math in one fused tick (SURVEY.md §3.5 "multi-group data parallelism").
+The KV data path stays host-side (storage + RPC), as in the reference.
+"""
+
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.metadata import Region, RegionEpoch, StoreMeta
+from tpuraft.rheakv.raw_store import MemoryRawKVStore, RawKVStore
+from tpuraft.rheakv.region_engine import RegionEngine
+from tpuraft.rheakv.store_engine import StoreEngine
+
+__all__ = [
+    "KVOp",
+    "KVOperation",
+    "MemoryRawKVStore",
+    "RawKVStore",
+    "Region",
+    "RegionEngine",
+    "RegionEpoch",
+    "StoreEngine",
+    "StoreMeta",
+]

@@ -1,0 +1,139 @@
+"""KVOperation: the serialized command replicated through raft.
+
+Reference parity: ``rhea:storage/KVOperation`` — an op-code plus
+key/value/extras, created by ``RaftRawKVStore`` and consumed by
+``KVStoreStateMachine#onApply`` (SURVEY.md §3.2 "RawKVStore stack").
+
+Wire layout: ``u8 op | u32 klen | key | u32 vlen | value | u32 alen |
+aux`` — ``aux`` packs op-specific extras (CAS expect value, scan bounds,
+sequence step, lock lease...).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+
+
+class KVOp(enum.IntEnum):
+    PUT = 1
+    PUT_IF_ABSENT = 2
+    DELETE = 3
+    COMPARE_PUT = 4            # CAS
+    DELETE_RANGE = 5
+    GET_SEQUENCE = 6
+    MERGE = 7
+    PUT_LIST = 8
+    DELETE_LIST = 9
+    GET_AND_PUT = 10
+    RESET_SEQUENCE = 11
+    KEY_LOCK = 12
+    KEY_LOCK_RELEASE = 13
+    RANGE_SPLIT = 14
+    # read ops (only replicated when linearizable-via-log is requested;
+    # normally served via readIndex + local read)
+    GET = 20
+    MULTI_GET = 21
+    SCAN = 22
+    CONTAINS_KEY = 23
+
+
+@dataclass
+class KVOperation:
+    op: int
+    key: bytes = b""
+    value: bytes = b""
+    aux: bytes = b""
+
+    def encode(self) -> bytes:
+        return (struct.pack("<B", self.op)
+                + struct.pack("<I", len(self.key)) + self.key
+                + struct.pack("<I", len(self.value)) + self.value
+                + struct.pack("<I", len(self.aux)) + self.aux)
+
+    @staticmethod
+    def decode(buf: bytes | memoryview) -> "KVOperation":
+        buf = memoryview(buf)
+        (op,) = struct.unpack_from("<B", buf, 0)
+        off = 1
+        parts = []
+        for _ in range(3):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            parts.append(bytes(buf[off:off + n]))
+            off += n
+        return KVOperation(op, *parts)
+
+    # -- aux packers ---------------------------------------------------------
+
+    @staticmethod
+    def cas(key: bytes, expect: bytes, update: bytes) -> "KVOperation":
+        return KVOperation(KVOp.COMPARE_PUT, key, update, expect)
+
+    @staticmethod
+    def delete_range(start: bytes, end: bytes) -> "KVOperation":
+        return KVOperation(KVOp.DELETE_RANGE, start, end)
+
+    @staticmethod
+    def get_sequence(key: bytes, step: int) -> "KVOperation":
+        return KVOperation(KVOp.GET_SEQUENCE, key, aux=struct.pack("<q", step))
+
+    @staticmethod
+    def key_lock(key: bytes, locker_id: bytes, lease_ms: int,
+                 keep_lease: bool) -> "KVOperation":
+        return KVOperation(
+            KVOp.KEY_LOCK, key, locker_id,
+            struct.pack("<qB", lease_ms, int(keep_lease)))
+
+    @staticmethod
+    def key_unlock(key: bytes, locker_id: bytes) -> "KVOperation":
+        return KVOperation(KVOp.KEY_LOCK_RELEASE, key, locker_id)
+
+    @staticmethod
+    def range_split(new_region_id: int, split_key: bytes) -> "KVOperation":
+        return KVOperation(KVOp.RANGE_SPLIT, split_key,
+                           aux=struct.pack("<q", new_region_id))
+
+    @staticmethod
+    def put_list(kvs: list[tuple[bytes, bytes]]) -> "KVOperation":
+        blob = bytearray(struct.pack("<I", len(kvs)))
+        for k, v in kvs:
+            blob += struct.pack("<I", len(k)) + k
+            blob += struct.pack("<I", len(v)) + v
+        return KVOperation(KVOp.PUT_LIST, value=bytes(blob))
+
+    @staticmethod
+    def unpack_kv_list(blob: bytes) -> list[tuple[bytes, bytes]]:
+        (n,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            (kl,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            k = blob[off:off + kl]
+            off += kl
+            (vl,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            out.append((k, blob[off:off + vl]))
+            off += vl
+        return out
+
+    @staticmethod
+    def delete_list(keys: list[bytes]) -> "KVOperation":
+        blob = bytearray(struct.pack("<I", len(keys)))
+        for k in keys:
+            blob += struct.pack("<I", len(k)) + k
+        return KVOperation(KVOp.DELETE_LIST, value=bytes(blob))
+
+    @staticmethod
+    def unpack_key_list(blob: bytes) -> list[bytes]:
+        (n,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            (kl,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            out.append(blob[off:off + kl])
+            off += kl
+        return out

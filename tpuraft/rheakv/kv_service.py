@@ -1,0 +1,187 @@
+"""Region KV RPC service: wire messages + the store-side processor.
+
+Reference parity: ``rhea:cmd/store/*`` requests +
+``rhea:DefaultRegionKVService`` / ``KVCommandProcessor`` (SURVEY.md
+§4.5): a request names a region and the client's view of its epoch; the
+store checks the epoch (INVALID_REGION_EPOCH → client refreshes route),
+then drives the region's RaftRawKVStore.
+
+One generic ``KVCommandRequest`` carries any encoded KVOperation rather
+than one message class per op — the op byte inside the blob dispatches.
+Results travel as a tagged blob (see ``encode_result``).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpuraft.errors import RaftError, Status
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.raft_store import KVStoreError
+from tpuraft.rpc.messages import register_message
+from tpuraft.rpc.transport import RpcError
+
+# RheaKV-layer error codes (reference: rhea:errors/Errors enum)
+ERR_INVALID_EPOCH = 2001
+ERR_NO_REGION = 2002
+ERR_STORE_BUSY = 2003
+
+
+@dataclass
+class KVCommandRequest:
+    region_id: int
+    conf_ver: int
+    version: int
+    op_blob: bytes  # encoded KVOperation
+
+
+@dataclass
+class KVCommandResponse:
+    code: int = 0
+    msg: str = ""
+    result: bytes = b""       # tagged result blob
+    region_meta: bytes = b""  # current Region encoding on epoch mismatch
+
+
+register_message(128, KVCommandRequest)
+register_message(129, KVCommandResponse)
+
+
+# ---- tagged result codec ---------------------------------------------------
+
+_T_NONE, _T_BOOL, _T_BYTES, _T_SEQ, _T_PAIRS, _T_LOCK = range(6)
+
+
+def encode_result(result) -> bytes:
+    if result is None:
+        return struct.pack("<B", _T_NONE)
+    if isinstance(result, bool):
+        return struct.pack("<BB", _T_BOOL, int(result))
+    if isinstance(result, bytes):
+        return struct.pack("<B", _T_BYTES) + result
+    if isinstance(result, tuple) and len(result) == 2 \
+            and all(isinstance(x, int) for x in result):
+        return struct.pack("<Bqq", _T_SEQ, result[0], result[1])
+    if isinstance(result, tuple) and len(result) == 3:  # lock triple
+        ok, token, owner = result
+        return (struct.pack("<BBq", _T_LOCK, int(ok), token)
+                + struct.pack("<I", len(owner)) + owner)
+    if isinstance(result, list):  # list[(key, Optional[value])]
+        out = bytearray(struct.pack("<BI", _T_PAIRS, len(result)))
+        for k, v in result:
+            out += struct.pack("<I", len(k)) + k
+            if v is None:
+                out += struct.pack("<i", -1)
+            else:
+                out += struct.pack("<i", len(v)) + v
+        return bytes(out)
+    raise TypeError(f"cannot encode KV result {result!r}")
+
+
+def decode_result(blob: bytes):
+    buf = memoryview(blob)
+    (tag,) = struct.unpack_from("<B", buf, 0)
+    if tag == _T_NONE:
+        return None
+    if tag == _T_BOOL:
+        return bool(buf[1])
+    if tag == _T_BYTES:
+        return bytes(buf[1:])
+    if tag == _T_SEQ:
+        a, b = struct.unpack_from("<qq", buf, 1)
+        return (a, b)
+    if tag == _T_LOCK:
+        ok, token = struct.unpack_from("<Bq", buf, 1)
+        (n,) = struct.unpack_from("<I", buf, 10)
+        owner = bytes(buf[14:14 + n])
+        return (bool(ok), token, owner)
+    if tag == _T_PAIRS:
+        (n,) = struct.unpack_from("<I", buf, 1)
+        off = 5
+        out = []
+        for _ in range(n):
+            (kl,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            k = bytes(buf[off:off + kl])
+            off += kl
+            (vl,) = struct.unpack_from("<i", buf, off)
+            off += 4
+            if vl < 0:
+                out.append((k, None))
+            else:
+                out.append((k, bytes(buf[off:off + vl])))
+                off += vl
+        return out
+    raise ValueError(f"bad result tag {tag}")
+
+
+# ---- store-side processor ---------------------------------------------------
+
+# ops a follower may NOT serve; everything routes through the region leader
+_WRITE_OPS = {
+    KVOp.PUT, KVOp.PUT_IF_ABSENT, KVOp.DELETE, KVOp.COMPARE_PUT,
+    KVOp.DELETE_RANGE, KVOp.GET_SEQUENCE, KVOp.MERGE, KVOp.PUT_LIST,
+    KVOp.DELETE_LIST, KVOp.GET_AND_PUT, KVOp.RESET_SEQUENCE, KVOp.KEY_LOCK,
+    KVOp.KEY_LOCK_RELEASE, KVOp.RANGE_SPLIT,
+}
+
+
+class KVCommandProcessor:
+    """Registered as method ``kv_command`` on the store's RpcServer."""
+
+    def __init__(self, store_engine) -> None:
+        self._se = store_engine
+        store_engine.rpc_server.register("kv_command", self.handle)
+
+    async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
+        engine = self._se.get_region_engine(req.region_id)
+        if engine is None:
+            return KVCommandResponse(
+                code=ERR_NO_REGION,
+                msg=f"region {req.region_id} not on store {self._se.server_id}")
+        region = engine.region
+        if (region.epoch.conf_ver != req.conf_ver
+                or region.epoch.version != req.version):
+            return KVCommandResponse(
+                code=ERR_INVALID_EPOCH,
+                msg=(f"region {req.region_id} epoch is "
+                     f"{region.epoch.conf_ver}.{region.epoch.version}, "
+                     f"client sent {req.conf_ver}.{req.version}"),
+                region_meta=region.encode())
+        op = KVOperation.decode(req.op_blob)
+        rs = engine.raft_store
+        try:
+            if op.op in _WRITE_OPS:
+                result = await rs._apply(op)
+            elif op.op == KVOp.GET:
+                result = await rs.get(op.key)
+            elif op.op == KVOp.MULTI_GET:
+                keys = KVOperation.unpack_key_list(op.value)
+                got = await rs.multi_get(keys)
+                result = [(k, got[k]) for k in keys]
+            elif op.op == KVOp.CONTAINS_KEY:
+                result = await rs.contains_key(op.key)
+            elif op.op == KVOp.SCAN:
+                (limit, rv, reverse) = struct.unpack("<iBB", op.aux)
+                scan = rs.reverse_scan if reverse else rs.scan
+                result = await scan(op.key, op.value, limit, bool(rv))
+            else:
+                return KVCommandResponse(code=int(RaftError.EINVAL),
+                                         msg=f"bad op {op.op}")
+        except KVStoreError as e:
+            return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
+        except RpcError as e:
+            return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
+        except Exception as e:  # noqa: BLE001 — e.g. ReadIndexError
+            return KVCommandResponse(code=int(RaftError.EINTERNAL), msg=str(e))
+        return KVCommandResponse(result=encode_result(result))
+
+
+def scan_op(start: bytes, end: bytes, limit: int = -1,
+            return_value: bool = True, reverse: bool = False) -> KVOperation:
+    return KVOperation(KVOp.SCAN, start, end,
+                       struct.pack("<iBB", limit, int(return_value),
+                                   int(reverse)))

@@ -1,0 +1,123 @@
+"""RaftRawKVStore: the async KV API that routes writes through raft.
+
+Reference parity: ``rhea:storage/RaftRawKVStore`` (SURVEY.md §4.5) —
+every mutation becomes a serialized KVOperation applied via
+``Node#apply``; reads take the readIndex barrier then read the local
+store (linearizable without a log write — reference routes reads through
+``Node#readIndex`` the same way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional
+
+from tpuraft.core.node import Node
+from tpuraft.entity import Task
+from tpuraft.errors import RaftError, Status
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.raw_store import RawKVStore, Sequence
+from tpuraft.rheakv.state_machine import KVClosure
+
+
+class KVStoreError(Exception):
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+
+class RaftRawKVStore:
+    def __init__(self, node: Node, store: RawKVStore):
+        self.node = node
+        self.store = store
+
+    # -- write path (through the log) ---------------------------------------
+
+    async def _apply(self, op: KVOperation):
+        fut = asyncio.get_running_loop().create_future()
+        await self.node.apply(Task(data=op.encode(), done=KVClosure(fut)))
+        status, result = await fut
+        if not status.is_ok():
+            raise KVStoreError(status)
+        return result
+
+    async def put(self, key: bytes, value: bytes) -> bool:
+        return await self._apply(KVOperation(KVOp.PUT, key, value))
+
+    async def put_if_absent(self, key: bytes, value: bytes) -> Optional[bytes]:
+        return await self._apply(KVOperation(KVOp.PUT_IF_ABSENT, key, value))
+
+    async def get_and_put(self, key: bytes, value: bytes) -> Optional[bytes]:
+        return await self._apply(KVOperation(KVOp.GET_AND_PUT, key, value))
+
+    async def compare_and_put(self, key: bytes, expect: bytes,
+                              update: bytes) -> bool:
+        return await self._apply(KVOperation.cas(key, expect, update))
+
+    async def merge(self, key: bytes, value: bytes) -> bool:
+        return await self._apply(KVOperation(KVOp.MERGE, key, value))
+
+    async def put_list(self, kvs: list[tuple[bytes, bytes]]) -> bool:
+        return await self._apply(KVOperation.put_list(kvs))
+
+    async def delete(self, key: bytes) -> bool:
+        return await self._apply(KVOperation(KVOp.DELETE, key))
+
+    async def delete_list(self, keys: list[bytes]) -> bool:
+        return await self._apply(KVOperation.delete_list(keys))
+
+    async def delete_range(self, start: bytes, end: bytes) -> bool:
+        return await self._apply(KVOperation.delete_range(start, end))
+
+    async def get_sequence(self, key: bytes, step: int) -> Sequence:
+        if step < 0:
+            raise KVStoreError(Status.error(RaftError.EINVAL, "step < 0"))
+        if step == 0:  # pure read of the current value
+            start, end = await self._apply(KVOperation.get_sequence(key, 0))
+            return Sequence(start, end)
+        start, end = await self._apply(KVOperation.get_sequence(key, step))
+        return Sequence(start, end)
+
+    async def reset_sequence(self, key: bytes) -> bool:
+        return await self._apply(KVOperation(KVOp.RESET_SEQUENCE, key))
+
+    async def try_lock_with(self, key: bytes, locker_id: bytes, lease_ms: int,
+                            keep_lease: bool = False
+                            ) -> tuple[bool, int, bytes]:
+        return await self._apply(
+            KVOperation.key_lock(key, locker_id, lease_ms, keep_lease))
+
+    async def release_lock(self, key: bytes, locker_id: bytes) -> bool:
+        return await self._apply(KVOperation.key_unlock(key, locker_id))
+
+    async def range_split(self, new_region_id: int, split_key: bytes) -> bool:
+        return await self._apply(
+            KVOperation.range_split(new_region_id, split_key))
+
+    # -- read path (readIndex barrier + local read) --------------------------
+
+    async def get(self, key: bytes) -> Optional[bytes]:
+        await self.node.read_index()
+        return self.store.get(key)
+
+    async def multi_get(self, keys: list[bytes]
+                        ) -> dict[bytes, Optional[bytes]]:
+        await self.node.read_index()
+        return self.store.multi_get(keys)
+
+    async def contains_key(self, key: bytes) -> bool:
+        await self.node.read_index()
+        return self.store.contains_key(key)
+
+    async def scan(self, start: bytes, end: bytes, limit: int = -1,
+                   return_value: bool = True
+                   ) -> list[tuple[bytes, Optional[bytes]]]:
+        await self.node.read_index()
+        return self.store.scan(start, end, limit, return_value)
+
+    async def reverse_scan(self, start: bytes, end: bytes, limit: int = -1,
+                           return_value: bool = True
+                           ) -> list[tuple[bytes, Optional[bytes]]]:
+        await self.node.read_index()
+        return self.store.reverse_scan(start, end, limit, return_value)

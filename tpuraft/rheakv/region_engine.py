@@ -1,0 +1,64 @@
+"""RegionEngine: one raft group member serving one region on a store.
+
+Reference parity: ``rhea:RegionEngine`` (SURVEY.md §3.2 "StoreEngine"
+row) — owns the region's raft Node (via RaftGroupService), its
+KVStoreStateMachine over the store-shared RawKVStore, and the
+RaftRawKVStore async API.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.core.raft_group_service import RaftGroupService
+from tpuraft.entity import PeerId
+from tpuraft.options import NodeOptions
+from tpuraft.rheakv.metadata import Region, region_group_id
+from tpuraft.rheakv.raft_store import RaftRawKVStore
+from tpuraft.rheakv.raw_store import RawKVStore
+from tpuraft.rheakv.state_machine import KVStoreStateMachine
+
+LOG = logging.getLogger(__name__)
+
+
+class RegionEngine:
+    def __init__(self, region: Region, store_engine) -> None:
+        self.region = region
+        self.store_engine = store_engine
+        self.fsm: Optional[KVStoreStateMachine] = None
+        self.raft_store: Optional[RaftRawKVStore] = None
+        self._group_service: Optional[RaftGroupService] = None
+
+    @property
+    def group_id(self) -> str:
+        return region_group_id(self.store_engine.cluster_name, self.region.id)
+
+    @property
+    def node(self):
+        return self._group_service.node if self._group_service else None
+
+    def is_leader(self) -> bool:
+        n = self.node
+        return bool(n and n.is_leader())
+
+    async def start(self) -> None:
+        se = self.store_engine
+        self.fsm = KVStoreStateMachine(self.region, se.raw_store, se)
+        opts = se.make_node_options(self.region, self.fsm)
+        self._group_service = RaftGroupService(
+            self.group_id, se.server_id, opts, se.node_manager, se.transport,
+            ballot_box_factory=se.ballot_box_factory())
+        node = await self._group_service.start()
+        self.raft_store = RaftRawKVStore(node, se.raw_store)
+        LOG.info("region engine started: %s on %s", self.region,
+                 se.server_id)
+
+    async def shutdown(self) -> None:
+        if self._group_service:
+            await self._group_service.shutdown()
+            self._group_service = None
+
+    async def transfer_leadership_to(self, peer: PeerId):
+        return await self.node.transfer_leadership_to(peer)

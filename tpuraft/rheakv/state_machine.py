@@ -1,0 +1,162 @@
+"""KVStoreStateMachine: applies committed KVOperations to the raw store.
+
+Reference parity: ``rhea:storage/KVStoreStateMachine`` (SURVEY.md §3.2,
+§4.5) — batches committed entries, dispatches by op-code to the shared
+RawKVStore, sets per-op results on the proposing closure, handles
+region snapshots (range-serialized) and RANGE_SPLIT.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Optional
+
+from tpuraft.core.state_machine import Iterator, StateMachine
+from tpuraft.errors import RaftError, Status
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.raw_store import RawKVStore
+
+LOG = logging.getLogger(__name__)
+
+
+class KVClosure:
+    """Proposal completion carrying an op result back to the proposer
+    (reference: ``rhea:storage/KVStoreClosure#setData``)."""
+
+    def __init__(self, fut):
+        self._fut = fut
+        self.result = None
+
+    def __call__(self, status: Status) -> None:
+        if not self._fut.done():
+            self._fut.set_result((status, self.result))
+
+
+class KVStoreStateMachine(StateMachine):
+    def __init__(self, region: Region, store: RawKVStore,
+                 store_engine=None) -> None:
+        self.region = region
+        self.store = store
+        self.store_engine = store_engine  # for RANGE_SPLIT
+        self.leader_term = -1
+
+    # -- apply ---------------------------------------------------------------
+
+    async def on_apply(self, it: Iterator) -> None:
+        while it.valid():
+            op = KVOperation.decode(it.data())
+            done = it.done()
+            closure = done if isinstance(done, KVClosure) else None
+            try:
+                result = self._dispatch(op)
+                if closure is not None:
+                    closure.result = result
+                if done is not None:
+                    done(Status.OK())
+            except Exception as e:  # noqa: BLE001 — op-level failure, not fatal
+                LOG.exception("region %d apply op %s failed",
+                              self.region.id, op.op)
+                if done is not None:
+                    done(Status.error(RaftError.ESTATEMACHINE, str(e)))
+            it.next()
+
+    def _dispatch(self, op: KVOperation):
+        s = self.store
+        code = op.op
+        if code == KVOp.PUT:
+            s.put(op.key, op.value)
+            return True
+        if code == KVOp.PUT_IF_ABSENT:
+            return s.put_if_absent(op.key, op.value)
+        if code == KVOp.DELETE:
+            s.delete(op.key)
+            return True
+        if code == KVOp.COMPARE_PUT:
+            return s.compare_and_put(op.key, op.aux, op.value)
+        if code == KVOp.DELETE_RANGE:
+            s.delete_range(op.key, op.value)
+            return True
+        if code == KVOp.GET_SEQUENCE:
+            (step,) = struct.unpack("<q", op.aux)
+            seq = s.get_sequence(op.key, step)
+            return (seq.start, seq.end)
+        if code == KVOp.RESET_SEQUENCE:
+            s.reset_sequence(op.key)
+            return True
+        if code == KVOp.MERGE:
+            s.merge(op.key, op.value)
+            return True
+        if code == KVOp.PUT_LIST:
+            s.put_list(KVOperation.unpack_kv_list(op.value))
+            return True
+        if code == KVOp.DELETE_LIST:
+            s.delete_list(KVOperation.unpack_key_list(op.value))
+            return True
+        if code == KVOp.GET_AND_PUT:
+            return s.get_and_put(op.key, op.value)
+        if code == KVOp.KEY_LOCK:
+            lease_ms, keep = struct.unpack("<qB", op.aux)
+            return s.try_lock_with(op.key, op.value, lease_ms, bool(keep))
+        if code == KVOp.KEY_LOCK_RELEASE:
+            return s.release_lock(op.key, op.value)
+        if code == KVOp.RANGE_SPLIT:
+            (new_region_id,) = struct.unpack("<q", op.aux)
+            if self.store_engine is None:
+                raise RuntimeError("split requires a store engine")
+            self.store_engine.do_split(self.region.id, new_region_id, op.key)
+            return True
+        if code == KVOp.GET:  # linearizable-via-log read
+            return s.get(op.key)
+        if code == KVOp.MULTI_GET:
+            keys = KVOperation.unpack_key_list(op.value)
+            got = s.multi_get(keys)
+            return [(k, got[k]) for k in keys]
+        if code == KVOp.CONTAINS_KEY:
+            return s.contains_key(op.key)
+        raise ValueError(f"unknown KV op {code}")
+
+    # -- leadership ----------------------------------------------------------
+
+    async def on_leader_start(self, term: int) -> None:
+        self.leader_term = term
+        if self.store_engine is not None:
+            self.store_engine.on_region_leader_start(self.region.id, term)
+
+    async def on_leader_stop(self, status: Status) -> None:
+        self.leader_term = -1
+        if self.store_engine is not None:
+            self.store_engine.on_region_leader_stop(self.region.id)
+
+    # -- snapshot ------------------------------------------------------------
+
+    async def on_snapshot_save(self, writer, done) -> None:
+        try:
+            blob = self.store.serialize_range(self.region.start_key,
+                                              self.region.end_key)
+            writer.write_file("kv_data", blob)
+            writer.write_file("region_meta", self.region.encode())
+            done(Status.OK())
+        except Exception as e:  # noqa: BLE001
+            done(Status.error(RaftError.EIO, f"kv snapshot save: {e}"))
+
+    async def on_snapshot_load(self, reader) -> bool:
+        blob = reader.read_file("kv_data")
+        if blob is None:
+            return False
+        meta = reader.read_file("region_meta")
+        if meta is not None:
+            saved = Region.decode(meta)
+            # adopt the snapshot's view of the range/epoch (it may post-date
+            # a split that this lagging replica never applied)
+            self.region.start_key = saved.start_key
+            self.region.end_key = saved.end_key
+            self.region.epoch = saved.epoch
+        # clear our slice of the keyspace, then load
+        self.store.delete_range(self.region.start_key, self.region.end_key)
+        self.store.load_serialized(blob)
+        return True
+
+    async def on_error(self, status: Status) -> None:
+        LOG.error("region %d FSM error: %s", self.region.id, status)

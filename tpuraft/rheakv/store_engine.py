@@ -1,0 +1,207 @@
+"""StoreEngine: one KV storage process hosting many region raft groups.
+
+Reference parity: ``rhea:StoreEngine`` (SURVEY.md §3.2) — boots the
+shared RPC server + NodeManager, the shared RawKVStore, one RegionEngine
+per region, the KV command processor, split handling, and (optionally)
+heartbeats to the placement driver.
+
+TPU-native design: when given a :class:`MultiRaftEngine`, every region's
+quorum/commit bookkeeping runs on the engine's fused ``[G, P]`` device
+tick — thousands of regions advance their commit indexes in one XLA
+dispatch per tick instead of per-group Python work (SURVEY.md §3.5
+"multi-group data parallelism", the BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.core.cli_service import CliProcessors
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.options import NodeOptions, SnapshotOptions
+from tpuraft.rheakv.kv_service import KVCommandProcessor
+from tpuraft.rheakv.metadata import Region, StoreMeta
+from tpuraft.rheakv.raw_store import MemoryRawKVStore, RawKVStore
+from tpuraft.rheakv.region_engine import RegionEngine
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclass
+class StoreEngineOptions:
+    cluster_name: str = "rheakv"
+    server_id: str = ""                  # this store's PeerId string
+    initial_regions: list[Region] = field(default_factory=list)
+    data_path: str = ""                  # "" = memory storage
+    election_timeout_ms: int = 1000
+    snapshot_interval_secs: int = 0      # 0 = on-demand only
+    raw_store_factory: Callable[[], RawKVStore] = MemoryRawKVStore
+    # least keys a region must hold before a split is sensible
+    least_keys_on_split: int = 16
+
+
+class StoreEngine:
+    def __init__(self, opts: StoreEngineOptions, rpc_server, transport,
+                 multi_raft_engine=None, pd_client=None) -> None:
+        self.opts = opts
+        self.cluster_name = opts.cluster_name
+        self.server_id = PeerId.parse(opts.server_id)
+        self.rpc_server = rpc_server
+        self.transport = transport
+        self.node_manager = NodeManager(rpc_server)
+        CliProcessors(self.node_manager)
+        KVCommandProcessor(self)
+        self.raw_store: RawKVStore = opts.raw_store_factory()
+        self.multi_raft_engine = multi_raft_engine
+        self.pd_client = pd_client
+        self._regions: dict[int, RegionEngine] = {}
+        self._leader_regions: set[int] = set()
+        self._started = False
+        self._pending_splits: set[int] = set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.multi_raft_engine is not None:
+            await self.multi_raft_engine.start()
+        for region in self.opts.initial_regions:
+            await self._start_region(region)
+        self._started = True
+        LOG.info("store engine %s up with %d regions", self.server_id,
+                 len(self._regions))
+
+    async def shutdown(self) -> None:
+        self._started = False
+        for engine in list(self._regions.values()):
+            await engine.shutdown()
+        self._regions.clear()
+        if self.multi_raft_engine is not None:
+            await self.multi_raft_engine.shutdown()
+
+    async def _start_region(self, region: Region) -> RegionEngine:
+        engine = RegionEngine(region, self)
+        await engine.start()
+        self._regions[region.id] = engine
+        return engine
+
+    # -- region access -------------------------------------------------------
+
+    def get_region_engine(self, region_id: int) -> Optional[RegionEngine]:
+        return self._regions.get(region_id)
+
+    def list_regions(self) -> list[Region]:
+        return [e.region for e in self._regions.values()]
+
+    def store_meta(self) -> StoreMeta:
+        return StoreMeta(id=hash(str(self.server_id)) & 0x7FFFFFFF,
+                         endpoint=self.server_id.endpoint,
+                         regions=[r.copy() for r in self.list_regions()])
+
+    # -- node options for a region's raft group ------------------------------
+
+    def make_node_options(self, region: Region, fsm) -> NodeOptions:
+        opts = NodeOptions(
+            election_timeout_ms=self.opts.election_timeout_ms,
+            initial_conf=Configuration.parse(",".join(region.peers)),
+            fsm=fsm,
+        )
+        if self.opts.data_path:
+            base = (f"{self.opts.data_path}/"
+                    f"{self.server_id.ip}_{self.server_id.port}/r{region.id}")
+            opts.log_uri = f"file://{base}/log"
+            opts.raft_meta_uri = f"file://{base}/meta"
+            opts.snapshot_uri = f"file://{base}/snapshot"
+        else:
+            opts.log_uri = "memory://"
+            opts.raft_meta_uri = "memory://"
+        opts.snapshot = SnapshotOptions(
+            interval_secs=self.opts.snapshot_interval_secs)
+        return opts
+
+    def ballot_box_factory(self):
+        if self.multi_raft_engine is None:
+            return None
+        return self.multi_raft_engine.ballot_box_factory()
+
+    # -- leadership bookkeeping (PD heartbeat fodder) ------------------------
+
+    def on_region_leader_start(self, region_id: int, term: int) -> None:
+        self._leader_regions.add(region_id)
+
+    def on_region_leader_stop(self, region_id: int) -> None:
+        self._leader_regions.discard(region_id)
+
+    def leader_region_ids(self) -> list[int]:
+        return sorted(self._leader_regions)
+
+    # -- split ---------------------------------------------------------------
+
+    async def apply_split(self, region_id: int, new_region_id: int,
+                          split_key: Optional[bytes] = None) -> Status:
+        """Leader-side entry: replicate a RANGE_SPLIT through the region's
+        raft group (reference: ``rhea:StoreEngine#applySplit``)."""
+        engine = self._regions.get(region_id)
+        if engine is None:
+            return Status.error(RaftError.ENOENT, f"region {region_id} absent")
+        if new_region_id in self._regions:
+            return Status.error(RaftError.EEXISTS,
+                                f"region {new_region_id} exists")
+        region = engine.region
+        if split_key is None:
+            n = self.raw_store.approximate_keys_in_range(
+                region.start_key, region.end_key)
+            if n < self.opts.least_keys_on_split:
+                return Status.error(
+                    RaftError.EBUSY,
+                    f"region {region_id} too small to split ({n} keys)")
+            split_key = self.raw_store.jump_over(
+                region.start_key, region.end_key, n // 2)
+        if split_key is None or not region.contains_key(split_key):
+            return Status.error(RaftError.EINVAL,
+                                f"bad split key {split_key!r}")
+        try:
+            await engine.raft_store.range_split(new_region_id, split_key)
+        except Exception as e:  # noqa: BLE001
+            return Status.error(RaftError.EINTERNAL, f"split failed: {e}")
+        return Status.OK()
+
+    def do_split(self, region_id: int, new_region_id: int,
+                 split_key: bytes) -> None:
+        """FSM-side application, invoked deterministically on EVERY replica
+        when the RANGE_SPLIT entry commits.  Metadata mutates synchronously;
+        the new region's raft node boots asynchronously."""
+        engine = self._regions.get(region_id)
+        if engine is None or new_region_id in self._regions \
+                or new_region_id in self._pending_splits:
+            return
+        parent = engine.region
+        if not parent.contains_key(split_key):
+            return
+        new_region = Region(
+            id=new_region_id,
+            start_key=split_key,
+            end_key=parent.end_key,
+            peers=list(parent.peers),
+        )
+        new_region.epoch.version = parent.epoch.version + 1
+        parent.end_key = split_key
+        parent.epoch.version += 1
+        self._pending_splits.add(new_region_id)
+
+        async def boot():
+            try:
+                await self._start_region(new_region)
+                if self.pd_client is not None:
+                    await self.pd_client.report_split(parent, new_region)
+            except Exception:  # noqa: BLE001
+                LOG.exception("booting split region %d failed", new_region_id)
+            finally:
+                self._pending_splits.discard(new_region_id)
+
+        asyncio.ensure_future(boot())

@@ -92,13 +92,13 @@ class RouteTable:
         if not st.is_ok():
             return st
         try:
-            peers = await asyncio.wait_for(
-                cli.get_peers(group_id, conf), timeout_ms / 1000.0)
+            fresh = await asyncio.wait_for(
+                cli.get_configuration(group_id, conf), timeout_ms / 1000.0)
         except asyncio.TimeoutError:
             return Status.error(RaftError.ETIMEDOUT,
                                 "refresh_configuration timeout")
         except RpcError as e:
             return e.status
-        if peers:
-            self._conf[group_id] = Configuration(peers)
+        if fresh.is_valid():
+            self._conf[group_id] = fresh
         return Status.OK()

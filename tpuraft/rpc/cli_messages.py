@@ -69,14 +69,16 @@ class RemovePeerRequest:
 class ChangePeersRequest:
     group_id: str
     peer_id: str
-    new_peers: list[str] = field(default_factory=list)
+    new_peers: list[str] = field(default_factory=list)      # voters
+    new_learners: list[str] = field(default_factory=list)
 
 
 @_cli(71)
 class ResetPeersRequest:
     group_id: str
     peer_id: str
-    new_peers: list[str] = field(default_factory=list)
+    new_peers: list[str] = field(default_factory=list)      # voters
+    new_learners: list[str] = field(default_factory=list)
 
 
 @_cli(72)
