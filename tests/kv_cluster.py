@@ -13,6 +13,11 @@ from typing import Optional
 
 from tests.cluster import TestCluster  # noqa: F401  (re-export convenience)
 from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+from tpuraft.rheakv.pd_server import (
+    PlacementDriverOptions,
+    PlacementDriverServer,
+)
 from tpuraft.rheakv.region_engine import RegionEngine
 from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
 from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
@@ -97,3 +102,93 @@ class KVTestCluster:
                 return
             await asyncio.sleep(0.02)
         raise TimeoutError(f"region {region_id} not on all stores")
+
+
+class PDTestCluster(KVTestCluster):
+    """Stores + a PD raft cluster on the same loopback network.
+
+    Mirrors the reference's pd-backed RheaKV tests: stores heartbeat to
+    the PD; the PD answers routing and emits split instructions.
+    """
+
+    __test__ = False
+
+    def __init__(self, n_stores: int = 3, n_pd: int = 3, tmp_path=None,
+                 regions: Optional[list[Region]] = None,
+                 election_timeout_ms: int = 300,
+                 split_threshold_keys: int = 0,
+                 heartbeat_interval_ms: int = 100):
+        super().__init__(n_stores, tmp_path=tmp_path, regions=regions,
+                         election_timeout_ms=election_timeout_ms)
+        self.pd_endpoints = [f"127.0.0.1:{7000 + i}" for i in range(n_pd)]
+        self.split_threshold_keys = split_threshold_keys
+        self.heartbeat_interval_ms = heartbeat_interval_ms
+        self.pd_servers: dict[str, PlacementDriverServer] = {}
+
+    async def start_all(self) -> None:
+        for ep in self.pd_endpoints:
+            await self.start_pd(ep)
+        await super().start_all()
+
+    async def start_pd(self, endpoint: str) -> PlacementDriverServer:
+        server = RpcServer(endpoint)
+        self.net.bind(server)
+        self.net.start_endpoint(endpoint)
+        transport = InProcTransport(self.net, endpoint)
+        opts = PlacementDriverOptions(
+            endpoints=list(self.pd_endpoints),
+            election_timeout_ms=self.election_timeout_ms,
+            data_path=str(self.tmp_path) if self.tmp_path else "",
+            split_threshold_keys=self.split_threshold_keys,
+            initial_regions=[r.copy() for r in self.region_template],
+        )
+        pd = PlacementDriverServer(opts, endpoint, server, transport)
+        await pd.start()
+        self.pd_servers[endpoint] = pd
+        return pd
+
+    async def stop_pd(self, endpoint: str) -> None:
+        self.net.stop_endpoint(endpoint)
+        pd = self.pd_servers.pop(endpoint, None)
+        if pd:
+            self.net.unbind(endpoint)
+            await pd.shutdown()
+
+    async def start_store(self, endpoint: str) -> StoreEngine:
+        server = RpcServer(endpoint)
+        self.net.bind(server)
+        self.net.start_endpoint(endpoint)
+        transport = InProcTransport(self.net, endpoint)
+        opts = StoreEngineOptions(
+            server_id=endpoint,
+            initial_regions=[r.copy() for r in self.region_template],
+            data_path=str(self.tmp_path) if self.tmp_path else "",
+            election_timeout_ms=self.election_timeout_ms,
+            heartbeat_interval_ms=self.heartbeat_interval_ms,
+        )
+        pd_client = RemotePlacementDriverClient(transport, self.pd_endpoints)
+        store = StoreEngine(opts, server, transport, pd_client=pd_client)
+        await store.start()
+        self.stores[endpoint] = store
+        return store
+
+    async def stop_all(self) -> None:
+        await super().stop_all()
+        for ep in list(self.pd_servers):
+            await self.stop_pd(ep)
+
+    async def wait_pd_leader(self, timeout_s: float = 5.0
+                             ) -> PlacementDriverServer:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            leaders = [p for p in self.pd_servers.values()
+                       if p.node and p.node.is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError("no PD leader")
+
+    def pd_client(self, endpoint: str = "pdclient:0"
+                  ) -> RemotePlacementDriverClient:
+        return RemotePlacementDriverClient(
+            InProcTransport(self.net, endpoint), self.pd_endpoints)

@@ -1,0 +1,140 @@
+"""RheaKVStore client tests: routing, retry, failover, multi-region ops.
+
+Reference parity tier: ``rhea .../client/DefaultRheaKVStoreTest``
+(SURVEY.md §5 "RheaKV integration").
+"""
+
+import asyncio
+import contextlib
+
+from tests.kv_cluster import KVTestCluster
+from tpuraft.rheakv.client import RheaKVStore
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+
+@contextlib.asynccontextmanager
+async def kv_client_cluster(regions=None, tmp_path=None, **kw):
+    c = KVTestCluster(3, tmp_path=tmp_path, regions=regions, **kw)
+    await c.start_all()
+    pd = FakePlacementDriverClient(c.region_template)
+    # FakePD's static view lacks peers filled in by the cluster helper
+    pd._regions = {r.id: r.copy() for s in [next(iter(c.stores.values()))]
+                   for r in s.list_regions()}
+    client = RheaKVStore(pd, c.client_transport())
+    await client.start()
+    try:
+        yield c, client
+    finally:
+        await client.shutdown()
+        await c.stop_all()
+
+
+async def test_client_basic_ops():
+    async with kv_client_cluster() as (c, kv):
+        assert await kv.put(b"k", b"v")
+        assert await kv.get(b"k") == b"v"
+        assert await kv.contains_key(b"k")
+        assert not await kv.contains_key(b"nope")
+        assert await kv.put_if_absent(b"k", b"w") == b"v"
+        assert await kv.compare_and_put(b"k", b"v", b"v2")
+        assert await kv.get_and_put(b"k", b"v3") == b"v2"
+        assert await kv.merge(b"m", b"a") and await kv.merge(b"m", b"b")
+        assert await kv.get(b"m") == b"a,b"
+        assert await kv.delete(b"k")
+        assert await kv.get(b"k") is None
+
+
+async def test_client_two_region_routing():
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    async with kv_client_cluster(regions=regions) as (c, kv):
+        # keys on both sides of the split
+        assert await kv.put(b"apple", b"1")
+        assert await kv.put(b"zebra", b"2")
+        got = await kv.multi_get([b"apple", b"zebra", b"miss"])
+        assert got == {b"apple": b"1", b"zebra": b"2", b"miss": None}
+        assert await kv.put_list([(b"aa", b"x"), (b"zz", b"y")])
+        # scan spans regions in order
+        out = await kv.scan(b"", b"")
+        assert [k for k, _ in out] == [b"aa", b"apple", b"zebra", b"zz"]
+        # limit respected across regions
+        out = await kv.scan(b"", b"", limit=3)
+        assert len(out) == 3
+        rev = await kv.reverse_scan(b"", b"")
+        assert [k for k, _ in rev] == [b"zz", b"zebra", b"apple", b"aa"]
+        assert await kv.delete_range(b"a", b"z")
+        assert [k for k, _ in await kv.scan(b"", b"")] == [b"zebra", b"zz"]
+        assert await kv.delete_list([b"zebra", b"zz"])
+        assert await kv.scan(b"", b"") == []
+
+
+async def test_client_survives_split():
+    async with kv_client_cluster() as (c, kv):
+        for i in range(32):
+            assert await kv.put(b"key%02d" % i, b"v%d" % i)
+        # server-side split happens under the client's feet
+        leader = await c.wait_region_leader(1)
+        st = await leader.store_engine.apply_split(1, 2)
+        assert st.is_ok(), str(st)
+        await c.wait_region_on_all(2)
+        await c.wait_region_leader(2)
+        # stale-epoch requests must transparently refresh + re-route
+        assert await kv.get(b"key00") == b"v0"
+        assert await kv.get(b"key31") == b"v31"
+        assert await kv.put(b"key31", b"updated")
+        assert await kv.get(b"key31") == b"updated"
+        # client discovered both regions
+        assert len(kv.route_table.list_regions()) == 2
+        # full scan still sees everything, in order
+        out = await kv.scan(b"", b"")
+        assert len(out) == 32
+
+
+async def test_client_fails_over_on_leader_kill(tmp_path):
+    async with kv_client_cluster(tmp_path=tmp_path) as (c, kv):
+        for i in range(5):
+            assert await kv.put(b"d%d" % i, b"v%d" % i)
+        leader = await c.wait_region_leader(1)
+        await c.stop_store(leader.store_engine.server_id.endpoint)
+        await c.wait_region_leader(1)
+        assert await kv.get(b"d3") == b"v3"
+        assert await kv.put(b"after", b"failover")
+        assert await kv.get(b"after") == b"failover"
+
+
+async def test_client_sequences():
+    async with kv_client_cluster() as (c, kv):
+        s1 = await kv.get_sequence(b"ids", 10)
+        s2 = await kv.get_sequence(b"ids", 10)
+        assert (s1.start, s1.end, s2.start, s2.end) == (0, 10, 10, 20)
+        assert await kv.get_latest_sequence(b"ids") == 20
+        assert await kv.reset_sequence(b"ids")
+        assert (await kv.get_sequence(b"ids", 1)).start == 0
+
+
+async def test_client_distributed_lock():
+    async with kv_client_cluster() as (c, kv):
+        lock_a = kv.get_distributed_lock(b"resource", lease_ms=60_000)
+        lock_b = kv.get_distributed_lock(b"resource", lease_ms=60_000)
+        assert await lock_a.try_lock()
+        assert lock_a.fencing_token > 0
+        assert not await lock_b.try_lock()
+        # blocking lock with timeout fails while held
+        assert not await lock_b.lock(timeout_ms=300, retry_interval_ms=50)
+        assert await lock_a.unlock()
+        assert await lock_b.lock(timeout_ms=2000)
+        assert lock_b.fencing_token > lock_a.fencing_token
+        await lock_b.unlock()
+
+
+async def test_client_lock_watchdog_renews_short_lease():
+    async with kv_client_cluster() as (c, kv):
+        lock = kv.get_distributed_lock(b"wd", lease_ms=600)
+        other = kv.get_distributed_lock(b"wd", lease_ms=600)
+        assert await lock.try_lock(watchdog=True)
+        await asyncio.sleep(1.2)  # beyond the original lease
+        assert not await other.try_lock()  # renewal kept it held
+        await lock.unlock()
+        assert await other.try_lock()
+        await other.unlock()

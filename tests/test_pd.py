@@ -1,0 +1,106 @@
+"""Placement driver tests: metadata, heartbeats, failover, auto-split.
+
+Reference parity tier: PD server tests + chaos-style region scheduling
+(SURVEY.md §3.2 "PD server", §5 "RheaKV integration").
+"""
+
+import asyncio
+import contextlib
+import time
+
+from tests.kv_cluster import PDTestCluster
+from tpuraft.rheakv.client import RheaKVStore
+
+
+@contextlib.asynccontextmanager
+async def pd_cluster(**kw):
+    c = PDTestCluster(**kw)
+    await c.start_all()
+    try:
+        yield c
+    finally:
+        await c.stop_all()
+
+
+async def test_pd_tracks_stores_and_regions():
+    async with pd_cluster() as c:
+        await c.wait_pd_leader()
+        pd = c.pd_client()
+        # heartbeats flow on a 100ms cadence; PD learns the layout
+        deadline = time.monotonic() + 5
+        stores, regions = [], []
+        while time.monotonic() < deadline:
+            stores = await pd.get_store_metas()
+            regions = await pd.list_regions()
+            if len(stores) == 3 and len(regions) >= 1:
+                break
+            await asyncio.sleep(0.1)
+        assert len(stores) == 3
+        assert {s.endpoint for s in stores} == set(c.endpoints)
+        assert any(r.id == 1 for r in regions)
+
+
+async def test_pd_region_id_allocation():
+    async with pd_cluster() as c:
+        await c.wait_pd_leader()
+        from tpuraft.rheakv.pd_messages import CreateRegionIdRequest
+
+        pd = c.pd_client()
+        r1 = await pd._call("pd_create_region_id", CreateRegionIdRequest())
+        r2 = await pd._call("pd_create_region_id", CreateRegionIdRequest())
+        assert r2.region_id == r1.region_id + 1 >= 1024
+
+
+async def test_pd_leader_failover():
+    async with pd_cluster() as c:
+        leader = await c.wait_pd_leader()
+        pd = c.pd_client()
+        assert await pd.list_regions() is not None
+        await c.stop_pd(leader.server_id.endpoint)
+        await c.wait_pd_leader()
+        # client redirects to the new PD leader
+        regions = await pd.list_regions()
+        assert any(r.id == 1 for r in regions)
+        # store heartbeats also recover; metadata keeps flowing
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if len(await pd.get_store_metas()) == 3:
+                break
+            await asyncio.sleep(0.1)
+        assert len(await pd.get_store_metas()) == 3
+
+
+async def test_pd_ordered_auto_split():
+    """Write past the threshold; the PD orders a split on heartbeat."""
+    async with pd_cluster(split_threshold_keys=24) as c:
+        await c.wait_pd_leader()
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        for i in range(40):
+            await rs.put(b"auto%03d" % i, b"v")
+        # heartbeat reports ~40 keys -> PD issues RANGE_SPLIT
+        await c.wait_region_on_all(1024, timeout_s=10)
+        l2 = await c.wait_region_leader(1024)
+        assert l2.region.start_key != b""
+        # PD metadata reflects the split (split report or heartbeats)
+        pd = c.pd_client()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            regions = await pd.list_regions()
+            if len(regions) >= 2:
+                break
+            await asyncio.sleep(0.1)
+        assert len(regions) >= 2
+
+
+async def test_client_with_remote_pd():
+    async with pd_cluster() as c:
+        await c.wait_pd_leader()
+        await c.wait_region_leader(1)
+        kv = RheaKVStore(c.pd_client(), c.client_transport())
+        await kv.start()
+        assert await kv.put(b"via-pd", b"yes")
+        assert await kv.get(b"via-pd") == b"yes"
+        s = await kv.get_sequence(b"pd-seq", 5)
+        assert (s.start, s.end) == (0, 5)
+        await kv.shutdown()

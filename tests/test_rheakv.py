@@ -75,9 +75,12 @@ def test_memory_store_sequence_and_locks():
     assert ok and owner == b"me"
     ok2, token2, owner2 = s.try_lock_with(b"L", b"you", 60_000, False)
     assert not ok2 and owner2 == b"me" and token2 == token
-    # reentrant
-    ok3, token3, _ = s.try_lock_with(b"L", b"me", 60_000, True)
+    # reentrant acquire (keep_lease=False) bumps the hold count
+    ok3, token3, _ = s.try_lock_with(b"L", b"me", 60_000, False)
     assert ok3 and token3 == token
+    # watchdog renewal (keep_lease=True) does NOT add a hold
+    okr, tokenr, _ = s.try_lock_with(b"L", b"me", 60_000, True)
+    assert okr and tokenr == token
     assert not s.release_lock(b"L", b"you")
     assert s.release_lock(b"L", b"me")      # acquires 2 -> 1
     assert s.release_lock(b"L", b"me")      # released
@@ -214,6 +217,31 @@ async def test_kv_command_processor_epoch_check():
             version=cur.epoch.version,
             op_blob=scan_op(b"", b"").encode()), 2000)
         assert (b"wire", b"ok") in decode_result(resp.result)
+
+
+async def test_kv_command_rejects_out_of_range_keys():
+    """Epoch can match while a batched key escapes the range (split raced
+    the client's grouping) — the server must bounce, never mis-commit."""
+    from tpuraft.rheakv.kv_service import ERR_KEY_OUT_OF_RANGE
+
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    c = KVTestCluster(3, regions=regions)
+    await c.start_all()
+    try:
+        leader = await c.wait_region_leader(1)
+        t = c.client_transport()
+        ep = leader.store_engine.server_id.endpoint
+        r1 = leader.region
+        bad = KVOperation.put_list([(b"a", b"1"), (b"zzz", b"2")]).encode()
+        resp = await t.call(ep, "kv_command", KVCommandRequest(
+            region_id=1, conf_ver=r1.epoch.conf_ver,
+            version=r1.epoch.version, op_blob=bad), 2000)
+        assert resp.code == ERR_KEY_OUT_OF_RANGE
+        # nothing leaked into the store
+        assert leader.store_engine.raw_store.get(b"zzz") is None
+    finally:
+        await c.stop_all()
 
 
 async def test_region_split():

@@ -1,0 +1,446 @@
+"""RheaKVStore: the user-facing distributed KV client.
+
+Reference parity: ``rhea:client/DefaultRheaKVStore`` (SURVEY.md §3.2
+"Client", §4.5): key → region lookup via RegionRouteTable, request to
+the region leader's store, bounded retry with epoch-stale route patching
+and not-leader failover; multi-region scan/delete_range fan-out; the
+distributed lock and sequence APIs.
+
+All methods are async (the reference's closure style); the reference's
+blocking ``b*`` variants are just ``asyncio.run``-style waits in Python.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import uuid
+from typing import Optional
+
+from tpuraft.errors import RaftError, Status
+from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+from tpuraft.rheakv.kv_service import (
+    ERR_INVALID_EPOCH,
+    ERR_KEY_OUT_OF_RANGE,
+    ERR_NO_REGION,
+    KVCommandRequest,
+    ListRegionsOnStoreRequest,
+    decode_result,
+    scan_op,
+)
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_client import PlacementDriverClient
+from tpuraft.rheakv.raw_store import Sequence
+from tpuraft.rheakv.region_route_table import RegionRouteTable
+from tpuraft.rpc.transport import RpcError
+
+LOG = logging.getLogger(__name__)
+
+
+class RheaKVError(Exception):
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+
+class RheaKVStore:
+    def __init__(self, pd_client: PlacementDriverClient, transport,
+                 timeout_ms: float = 5000, max_retries: int = 8,
+                 retry_interval_ms: float = 50):
+        self.pd = pd_client
+        self.transport = transport
+        self.route_table = RegionRouteTable()
+        self.timeout_ms = timeout_ms
+        self.max_retries = max_retries
+        self.retry_interval_ms = retry_interval_ms
+        # region id -> endpoint of the last known leader's store
+        self._leaders: dict[int, str] = {}
+        self._started = False
+
+    async def start(self) -> None:
+        self.route_table.reset(await self.pd.list_regions())
+        self._started = True
+
+    async def shutdown(self) -> None:
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # routing & retry engine
+    # ------------------------------------------------------------------
+
+    async def _refresh_routes(self) -> None:
+        """Re-pull the region layout: PD first, then store-reported truth
+        (PD-less mode — and PD outages — discover split regions this way).
+        Best-effort: a down PD must not fail ops the cached routes or the
+        stores themselves can still serve."""
+        regions: list[Region] = []
+        try:
+            regions = await self.pd.list_regions()
+        except Exception:  # noqa: BLE001 — PD unreachable / electing
+            LOG.debug("pd route refresh failed; falling back to stores",
+                      exc_info=True)
+        endpoints = {p for r in regions for p in r.peers}
+        # also ask every store we already know about (covers PD-down case)
+        endpoints.update(p for r in self.route_table.list_regions()
+                         for p in r.peers)
+        async def ask(peer: str):
+            return await self.transport.call(
+                _endpoint(peer), "kv_list_regions",
+                ListRegionsOnStoreRequest(), self.timeout_ms)
+
+        answers = await asyncio.gather(
+            *(ask(ep) for ep in endpoints), return_exceptions=True)
+        for resp in answers:
+            if isinstance(resp, BaseException):
+                continue
+            for blob in resp.regions:
+                regions.append(Region.decode(blob))
+        # fold: keep the freshest epoch per region id
+        best: dict[int, Region] = {}
+        for r in regions:
+            cur = best.get(r.id)
+            if cur is None or (r.epoch.version, r.epoch.conf_ver) > \
+                    (cur.epoch.version, cur.epoch.conf_ver):
+                best[r.id] = r
+        if best:  # never wipe a usable cache with an empty refresh
+            self.route_table.reset(list(best.values()))
+
+    def _endpoints_for(self, region: Region) -> list[str]:
+        """Leader-first candidate ordering of the region's store endpoints."""
+        eps = []
+        leader = self._leaders.get(region.id)
+        if leader and leader in region.peers:
+            eps.append(leader)
+        eps.extend(p for p in region.peers if p not in eps)
+        return eps
+
+    async def _call_region(self, region: Region, op: KVOperation):
+        """One attempt cycle over a region's stores; raises on hard error."""
+        last_status = Status.error(RaftError.EAGAIN, "no store reachable")
+        for ep_str in self._endpoints_for(region):
+            # peers are PeerId strings; the store serves on ip:port
+            endpoint = _endpoint(ep_str)
+            req = KVCommandRequest(
+                region_id=region.id,
+                conf_ver=region.epoch.conf_ver,
+                version=region.epoch.version,
+                op_blob=op.encode())
+            try:
+                resp = await self.transport.call(endpoint, "kv_command", req,
+                                                 self.timeout_ms)
+            except RpcError as e:
+                last_status = e.status
+                self._leaders.pop(region.id, None)
+                continue
+            if resp.code == 0:
+                self._leaders[region.id] = ep_str
+                return decode_result(resp.result)
+            if resp.code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
+                fresh = Region.decode(resp.region_meta)
+                self.route_table.add_or_update(fresh)
+                raise _Retry(refresh=True)
+            if resp.code == ERR_NO_REGION:
+                self._leaders.pop(region.id, None)
+                raise _Retry(refresh=True)
+            if resp.code in (int(RaftError.EPERM), int(RaftError.EBUSY),
+                             int(RaftError.EAGAIN),
+                             int(RaftError.ERAFTTIMEDOUT)):
+                # not leader / electing: try the next store
+                last_status = Status(resp.code, resp.msg)
+                self._leaders.pop(region.id, None)
+                continue
+            raise RheaKVError(Status(resp.code, resp.msg))
+        raise _Retry(status=last_status)
+
+    async def _execute(self, key: bytes, op: KVOperation):
+        """Route by key, run with bounded retries."""
+        last = Status.error(RaftError.EAGAIN, "exhausted retries")
+        for attempt in range(self.max_retries):
+            region = self.route_table.find_region_by_key(key)
+            if region is None:
+                await self._refresh_routes()
+                region = self.route_table.find_region_by_key(key)
+                if region is None:
+                    raise RheaKVError(Status.error(
+                        RaftError.ENOENT, f"no region covers key {key!r}"))
+            try:
+                return await self._call_region(region, op)
+            except _Retry as r:
+                if r.refresh:
+                    await self._refresh_routes()
+                if r.status is not None:
+                    last = r.status
+                # linear backoff: elections take a few election timeouts
+                await asyncio.sleep(
+                    self.retry_interval_ms * (attempt + 1) / 1000.0)
+        raise RheaKVError(last)
+
+    # ------------------------------------------------------------------
+    # single-key ops
+    # ------------------------------------------------------------------
+
+    async def get(self, key: bytes) -> Optional[bytes]:
+        return await self._execute(key, KVOperation(KVOp.GET, key))
+
+    async def contains_key(self, key: bytes) -> bool:
+        return await self._execute(key, KVOperation(KVOp.CONTAINS_KEY, key))
+
+    async def put(self, key: bytes, value: bytes) -> bool:
+        return await self._execute(key, KVOperation(KVOp.PUT, key, value))
+
+    async def put_if_absent(self, key: bytes, value: bytes) -> Optional[bytes]:
+        return await self._execute(
+            key, KVOperation(KVOp.PUT_IF_ABSENT, key, value))
+
+    async def get_and_put(self, key: bytes, value: bytes) -> Optional[bytes]:
+        return await self._execute(
+            key, KVOperation(KVOp.GET_AND_PUT, key, value))
+
+    async def compare_and_put(self, key: bytes, expect: bytes,
+                              update: bytes) -> bool:
+        return await self._execute(key, KVOperation.cas(key, expect, update))
+
+    async def merge(self, key: bytes, value: bytes) -> bool:
+        return await self._execute(key, KVOperation(KVOp.MERGE, key, value))
+
+    async def delete(self, key: bytes) -> bool:
+        return await self._execute(key, KVOperation(KVOp.DELETE, key))
+
+    # ------------------------------------------------------------------
+    # multi-key ops (fan out by owning region)
+    # ------------------------------------------------------------------
+
+    async def _run_sharded(self, items: list, key_fn, op_fn):
+        """Group items by owning region, run each group, and — crucially —
+        RE-SHARD whatever failed after every route refresh: a split that
+        races the batch must never commit keys through the wrong group
+        (the server also range-checks, returning ERR_KEY_OUT_OF_RANGE).
+        Returns the list of per-group results."""
+        remaining = list(items)
+        results = []
+        last = Status.error(RaftError.EAGAIN, "exhausted retries")
+        for attempt in range(self.max_retries):
+            groups: dict[int, list] = {}
+            unroutable = []
+            for it in remaining:
+                r = self.route_table.find_region_by_key(key_fn(it))
+                if r is None:
+                    unroutable.append(it)
+                else:
+                    groups.setdefault(r.id, []).append(it)
+            failed: list = list(unroutable)
+            need_refresh = bool(unroutable)
+            for rid, part in groups.items():
+                region = self.route_table.find_region_by_id(rid)
+                try:
+                    results.append(await self._call_region(region, op_fn(part)))
+                except _Retry as r:
+                    need_refresh = need_refresh or r.refresh
+                    if r.status is not None:
+                        last = r.status
+                    failed.extend(part)
+            if not failed:
+                return results
+            remaining = failed
+            if need_refresh:
+                await self._refresh_routes()
+            await asyncio.sleep(
+                self.retry_interval_ms * (attempt + 1) / 1000.0)
+        raise RheaKVError(last)
+
+    async def multi_get(self, keys: list[bytes]
+                        ) -> dict[bytes, Optional[bytes]]:
+        parts = await self._run_sharded(
+            keys, lambda k: k,
+            lambda ks: KVOperation(KVOp.MULTI_GET, value=_pack_keys(ks)))
+        out: dict[bytes, Optional[bytes]] = {}
+        for pairs in parts:
+            out.update(dict(pairs))
+        return out
+
+    async def put_list(self, kvs: list[tuple[bytes, bytes]]) -> bool:
+        parts = await self._run_sharded(
+            kvs, lambda kv: kv[0], KVOperation.put_list)
+        return all(parts)
+
+    async def delete_list(self, keys: list[bytes]) -> bool:
+        parts = await self._run_sharded(
+            keys, lambda k: k, KVOperation.delete_list)
+        return all(parts)
+
+    # ------------------------------------------------------------------
+    # range ops (span regions)
+    # ------------------------------------------------------------------
+
+    def _clip(self, region: Region, start: bytes, end: bytes
+              ) -> tuple[bytes, bytes]:
+        s = max(start, region.start_key) if region.start_key else start
+        if region.end_key:
+            e = region.end_key if not end else min(end, region.end_key)
+        else:
+            e = end
+        return s, e
+
+    async def scan(self, start: bytes, end: bytes, limit: int = -1,
+                   return_value: bool = True
+                   ) -> list[tuple[bytes, Optional[bytes]]]:
+        out: list[tuple[bytes, Optional[bytes]]] = []
+        regions = self.route_table.find_regions_by_range(start, end)
+        if not regions:
+            await self._refresh_routes()
+            regions = self.route_table.find_regions_by_range(start, end)
+        for region in regions:
+            s, e = self._clip(region, start, end)
+            part_limit = -1 if limit < 0 else limit - len(out)
+            if part_limit == 0:
+                break
+            part = await self._execute(
+                s if s else region.start_key,
+                scan_op(s, e, part_limit, return_value))
+            out.extend(part)
+        return out
+
+    async def reverse_scan(self, start: bytes, end: bytes, limit: int = -1,
+                           return_value: bool = True
+                           ) -> list[tuple[bytes, Optional[bytes]]]:
+        out: list[tuple[bytes, Optional[bytes]]] = []
+        regions = self.route_table.find_regions_by_range(start, end)
+        for region in reversed(regions):
+            s, e = self._clip(region, start, end)
+            part_limit = -1 if limit < 0 else limit - len(out)
+            if part_limit == 0:
+                break
+            part = await self._execute(
+                s if s else region.start_key,
+                scan_op(s, e, part_limit, return_value, reverse=True))
+            out.extend(part)
+        return out
+
+    async def delete_range(self, start: bytes, end: bytes) -> bool:
+        ok = True
+        for region in self.route_table.find_regions_by_range(start, end):
+            s, e = self._clip(region, start, end)
+            ok = await self._execute(
+                s if s else region.start_key,
+                KVOperation.delete_range(s, e)) and ok
+        return ok
+
+    # ------------------------------------------------------------------
+    # sequences & locks
+    # ------------------------------------------------------------------
+
+    async def get_sequence(self, key: bytes, step: int) -> Sequence:
+        start, end = await self._execute(key,
+                                         KVOperation.get_sequence(key, step))
+        return Sequence(start, end)
+
+    async def get_latest_sequence(self, key: bytes) -> int:
+        return (await self.get_sequence(key, 0)).start
+
+    async def reset_sequence(self, key: bytes) -> bool:
+        return await self._execute(key, KVOperation(KVOp.RESET_SEQUENCE, key))
+
+    def get_distributed_lock(self, key: bytes, lease_ms: int = 30_000
+                             ) -> "DistributedLock":
+        return DistributedLock(self, key, lease_ms)
+
+
+class _Retry(Exception):
+    def __init__(self, refresh: bool = False,
+                 status: Optional[Status] = None):
+        self.refresh = refresh
+        self.status = status
+
+
+def _endpoint(peer_str: str) -> str:
+    """PeerId string ('ip:port[:idx[:priority]]') -> store endpoint."""
+    return ":".join(peer_str.split(":")[:2])
+
+
+def _pack_keys(keys: list[bytes]) -> bytes:
+    blob = bytearray(struct.pack("<I", len(keys)))
+    for k in keys:
+        blob += struct.pack("<I", len(k)) + k
+    return bytes(blob)
+
+
+class DistributedLock:
+    """Lease-based distributed lock with fencing tokens.
+
+    Reference parity: ``rhea:client/DefaultRheaKVStore#getDistributedLock``
+    + ``KVOperation.KEY_LOCK`` (SURVEY.md §3.2 "Distributed lock &
+    sequence").  ``watchdog`` renews the lease at lease/3 cadence while
+    held (the reference leaves renewal to the caller's scheduler).
+    """
+
+    def __init__(self, store: RheaKVStore, key: bytes, lease_ms: int):
+        self._store = store
+        self.key = key
+        self.lease_ms = lease_ms
+        self.locker_id = uuid.uuid4().bytes
+        self.fencing_token: int = -1
+        self._held = False
+        self._watchdog: Optional[asyncio.Task] = None
+
+    @property
+    def held(self) -> bool:
+        return self._held
+
+    async def try_lock(self, watchdog: bool = False) -> bool:
+        ok, token, _owner = await self._store._execute(
+            self.key,
+            KVOperation.key_lock(self.key, self.locker_id, self.lease_ms,
+                                 keep_lease=False))
+        if ok:
+            self.fencing_token = token
+            self._held = True
+            if watchdog and (self._watchdog is None or self._watchdog.done()):
+                self._watchdog = asyncio.ensure_future(self._renew_loop())
+        return ok
+
+    async def lock(self, watchdog: bool = False,
+                   retry_interval_ms: float = 200,
+                   timeout_ms: Optional[float] = None) -> bool:
+        """Block until acquired (or timeout)."""
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout_ms is None \
+            else loop.time() + timeout_ms / 1000.0
+        while True:
+            if await self.try_lock(watchdog=watchdog):
+                return True
+            if deadline is not None and loop.time() >= deadline:
+                return False
+            await asyncio.sleep(retry_interval_ms / 1000.0)
+
+    async def _renew_loop(self) -> None:
+        try:
+            while self._held:
+                await asyncio.sleep(self.lease_ms / 3000.0)
+                if not self._held:
+                    break
+                try:
+                    ok, _, _ = await self._store._execute(
+                        self.key,
+                        KVOperation.key_lock(self.key, self.locker_id,
+                                             self.lease_ms, keep_lease=True))
+                except Exception:  # noqa: BLE001 — transient (election etc.)
+                    # retry quickly; the lease may still be alive
+                    await asyncio.sleep(self.lease_ms / 6000.0)
+                    continue
+                if not ok:
+                    # someone else owns it now — we lost the lease for real
+                    self._held = False
+                    break
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._watchdog = None
+
+    async def unlock(self) -> bool:
+        self._held = False
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
+        return await self._store._execute(
+            self.key, KVOperation.key_unlock(self.key, self.locker_id))

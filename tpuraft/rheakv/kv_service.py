@@ -28,6 +28,7 @@ from tpuraft.rpc.transport import RpcError
 ERR_INVALID_EPOCH = 2001
 ERR_NO_REGION = 2002
 ERR_STORE_BUSY = 2003
+ERR_KEY_OUT_OF_RANGE = 2004
 
 
 @dataclass
@@ -46,8 +47,20 @@ class KVCommandResponse:
     region_meta: bytes = b""  # current Region encoding on epoch mismatch
 
 
+@dataclass
+class ListRegionsOnStoreRequest:
+    pass
+
+
+@dataclass
+class ListRegionsOnStoreResponse:
+    regions: list[bytes] = field(default_factory=list)  # Region encodings
+
+
 register_message(128, KVCommandRequest)
 register_message(129, KVCommandResponse)
+register_message(130, ListRegionsOnStoreRequest)
+register_message(131, ListRegionsOnStoreResponse)
 
 
 # ---- tagged result codec ---------------------------------------------------
@@ -135,6 +148,15 @@ class KVCommandProcessor:
     def __init__(self, store_engine) -> None:
         self._se = store_engine
         store_engine.rpc_server.register("kv_command", self.handle)
+        store_engine.rpc_server.register("kv_list_regions",
+                                         self.handle_list_regions)
+
+    async def handle_list_regions(self, req: ListRegionsOnStoreRequest
+                                  ) -> ListRegionsOnStoreResponse:
+        """Region discovery for PD-less clients (split makes new regions
+        the static route table has never heard of)."""
+        return ListRegionsOnStoreResponse(
+            regions=[r.encode() for r in self._se.list_regions()])
 
     async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
         engine = self._se.get_region_engine(req.region_id)
@@ -152,6 +174,14 @@ class KVCommandProcessor:
                      f"client sent {req.conf_ver}.{req.version}"),
                 region_meta=region.encode())
         op = KVOperation.decode(req.op_blob)
+        if not _keys_in_region(op, region):
+            # epoch matched but a key escapes the range: the client grouped
+            # a batch against a route view that split under it — make it
+            # re-shard rather than silently committing through this group
+            return KVCommandResponse(
+                code=ERR_KEY_OUT_OF_RANGE,
+                msg=f"key(s) outside region {req.region_id} range",
+                region_meta=region.encode())
         rs = engine.raft_store
         try:
             if op.op in _WRITE_OPS:
@@ -178,6 +208,29 @@ class KVCommandProcessor:
         except Exception as e:  # noqa: BLE001 — e.g. ReadIndexError
             return KVCommandResponse(code=int(RaftError.EINTERNAL), msg=str(e))
         return KVCommandResponse(result=encode_result(result))
+
+
+_SINGLE_KEY_OPS = {
+    KVOp.PUT, KVOp.PUT_IF_ABSENT, KVOp.DELETE, KVOp.COMPARE_PUT,
+    KVOp.GET_SEQUENCE, KVOp.MERGE, KVOp.GET_AND_PUT, KVOp.RESET_SEQUENCE,
+    KVOp.KEY_LOCK, KVOp.KEY_LOCK_RELEASE, KVOp.RANGE_SPLIT, KVOp.GET,
+    KVOp.CONTAINS_KEY,
+}
+
+
+def _keys_in_region(op: KVOperation, region: Region) -> bool:
+    code = op.op
+    if code in _SINGLE_KEY_OPS:
+        return region.contains_key(op.key)
+    if code in (KVOp.DELETE_RANGE, KVOp.SCAN):
+        return region.contains_range(op.key, op.value)
+    if code == KVOp.PUT_LIST:
+        return all(region.contains_key(k)
+                   for k, _ in KVOperation.unpack_kv_list(op.value))
+    if code in (KVOp.DELETE_LIST, KVOp.MULTI_GET):
+        return all(region.contains_key(k)
+                   for k in KVOperation.unpack_key_list(op.value))
+    return True
 
 
 def scan_op(start: bytes, end: bytes, limit: int = -1,

@@ -1,0 +1,151 @@
+"""Placement driver clients: fake (static, pd-less) and remote.
+
+Reference parity: ``rhea:client/pd/AbstractPlacementDriverClient`` with
+``FakePlacementDriverClient`` (static conf, no PD cluster) and
+``RemotePlacementDriverClient`` (region metadata served by the PD's own
+raft group) — SURVEY.md §3.2 "PD client".
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpuraft.rheakv.metadata import Region, StoreMeta
+
+LOG = logging.getLogger(__name__)
+
+
+class PlacementDriverClient:
+    """Region metadata source + store-side reporting sink."""
+
+    async def list_regions(self) -> list[Region]:
+        raise NotImplementedError
+
+    async def get_store_metas(self) -> list[StoreMeta]:
+        return []
+
+    # -- store-side hooks ----------------------------------------------------
+
+    async def report_split(self, parent: Region, child: Region) -> None:
+        pass
+
+    async def store_heartbeat(self, meta: StoreMeta) -> None:
+        pass
+
+    async def region_heartbeat(self, region: Region, leader: str,
+                               metrics: Optional[dict] = None) -> list:
+        """Returns PD instructions (e.g. split orders); empty by default."""
+        return []
+
+    async def shutdown(self) -> None:
+        pass
+
+
+class FakePlacementDriverClient(PlacementDriverClient):
+    """PD-less mode: the initial region layout is the whole truth; splits
+    reported by stores are folded into the static view."""
+
+    def __init__(self, regions: list[Region]):
+        self._regions: dict[int, Region] = {r.id: r.copy() for r in regions}
+
+    async def list_regions(self) -> list[Region]:
+        return [r.copy() for r in self._regions.values()]
+
+    async def report_split(self, parent: Region, child: Region) -> None:
+        self._regions[parent.id] = parent.copy()
+        self._regions[child.id] = child.copy()
+
+
+class RemotePlacementDriverClient(PlacementDriverClient):
+    """Talks to the PD server cluster over the shared transport.
+
+    The PD is itself a 1-group raft app (reference:
+    ``pd:PlacementDriverServer``); requests go to its leader via the
+    pd_* RPC methods (see tpuraft.rheakv.pd_server).
+    """
+
+    def __init__(self, transport, pd_endpoints: list[str],
+                 timeout_ms: float = 3000):
+        self._transport = transport
+        self._endpoints = list(pd_endpoints)
+        self._timeout_ms = timeout_ms
+        self._leader: Optional[str] = None
+
+    async def _call(self, method: str, request):
+        from tpuraft.rpc.transport import RpcError
+
+        rotation = ([self._leader] if self._leader else []) + [
+            e for e in self._endpoints if e != self._leader]
+        last: Optional[Exception] = None
+        next_ep: Optional[str] = None
+        # enough attempts to probe every endpoint AND follow a redirect
+        # back to one already probed (it may have won the election since)
+        for _ in range(2 * len(rotation) + 2):
+            ep = next_ep if next_ep is not None else (
+                rotation.pop(0) if rotation else None)
+            next_ep = None
+            if ep is None:
+                break
+            try:
+                resp = await self._transport.call(ep, method, request,
+                                                  self._timeout_ms)
+            except RpcError as e:
+                last = e
+                self._leader = None
+                continue
+            if getattr(resp, "redirect", ""):
+                next_ep = resp.redirect
+                self._leader = resp.redirect
+                continue
+            if getattr(resp, "success", True):
+                self._leader = ep
+                return resp
+            last = RuntimeError(getattr(resp, "msg", "pd error"))
+            self._leader = None
+        raise last if last else RuntimeError("no PD endpoints")
+
+    async def list_regions(self) -> list[Region]:
+        from tpuraft.rheakv.pd_messages import ListRegionsRequest
+
+        resp = await self._call("pd_list_regions", ListRegionsRequest())
+        return [Region.decode(b) for b in resp.regions]
+
+    async def get_store_metas(self) -> list[StoreMeta]:
+        from tpuraft.rheakv.pd_messages import ListStoresRequest
+
+        resp = await self._call("pd_list_stores", ListStoresRequest())
+        out = []
+        for blob in resp.stores:
+            import struct
+
+            (sid,) = struct.unpack_from("<q", blob, 0)
+            (n,) = struct.unpack_from("<H", blob, 8)
+            ep = bytes(blob[10:10 + n]).decode()
+            out.append(StoreMeta(id=sid, endpoint=ep))
+        return out
+
+    async def report_split(self, parent: Region, child: Region) -> None:
+        from tpuraft.rheakv.pd_messages import ReportSplitRequest
+
+        await self._call("pd_report_split", ReportSplitRequest(
+            parent=parent.encode(), child=child.encode()))
+
+    async def store_heartbeat(self, meta: StoreMeta) -> None:
+        from tpuraft.rheakv.pd_messages import StoreHeartbeatRequest
+
+        await self._call("pd_store_heartbeat", StoreHeartbeatRequest(
+            store_id=meta.id, endpoint=meta.endpoint,
+            regions=[r.encode() for r in meta.regions]))
+
+    async def region_heartbeat(self, region: Region, leader: str,
+                               metrics: Optional[dict] = None) -> list:
+        from tpuraft.rheakv.pd_messages import (
+            Instruction,
+            RegionHeartbeatRequest,
+        )
+
+        keys = (metrics or {}).get("approximate_keys", 0)
+        resp = await self._call("pd_region_heartbeat", RegionHeartbeatRequest(
+            region=region.encode(), leader=leader, approximate_keys=keys))
+        return [Instruction.decode(b) for b in resp.instructions]

@@ -1,0 +1,134 @@
+"""Placement driver RPC messages.
+
+Reference parity: the PD request/response protocol under
+``rhea:cmd/pd/*`` (GetClusterInfo, StoreHeartbeat, RegionHeartbeat,
+CreateRegionId...) — SURVEY.md §3.2 "PD server".  Type ids 140+.
+
+All PD responses carry ``success`` + optional ``redirect`` (the PD
+leader's endpoint) because the PD metadata store is itself a raft group.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from tpuraft.rpc.messages import register_message
+
+
+def _pd(tid: int):
+    def deco(cls):
+        return register_message(tid, dataclass(cls))
+    return deco
+
+
+@_pd(140)
+class ListRegionsRequest:
+    pass
+
+
+@_pd(141)
+class ListRegionsResponse:
+    regions: list[bytes] = field(default_factory=list)  # Region encodings
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(142)
+class ListStoresRequest:
+    pass
+
+
+@_pd(143)
+class ListStoresResponse:
+    stores: list[bytes] = field(default_factory=list)  # StoreMeta encodings
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(144)
+class StoreHeartbeatRequest:
+    store_id: int
+    endpoint: str
+    regions: list[bytes] = field(default_factory=list)  # Region encodings
+
+
+@_pd(145)
+class StoreHeartbeatResponse:
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(146)
+class RegionHeartbeatRequest:
+    region: bytes  # Region encoding
+    leader: str    # PeerId string of the region leader
+    approximate_keys: int = 0
+
+
+@_pd(147)
+class RegionHeartbeatResponse:
+    instructions: list[bytes] = field(default_factory=list)
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(148)
+class ReportSplitRequest:
+    parent: bytes  # Region encoding
+    child: bytes
+
+
+@_pd(149)
+class ReportSplitResponse:
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(150)
+class CreateRegionIdRequest:
+    pass
+
+
+@_pd(151)
+class CreateRegionIdResponse:
+    region_id: int = 0
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@dataclass
+class Instruction:
+    """A PD order to a store (reference: ``rhea:metadata/Instruction`` —
+    e.g. RANGE_SPLIT with the new region id)."""
+
+    KIND_SPLIT = 1
+    KIND_TRANSFER_LEADER = 2
+
+    kind: int = 0
+    region_id: int = 0
+    new_region_id: int = 0
+    target_peer: str = ""
+
+    def encode(self) -> bytes:
+        tp = self.target_peer.encode()
+        return struct.pack("<Bqq", self.kind, self.region_id,
+                           self.new_region_id) \
+            + struct.pack("<H", len(tp)) + tp
+
+    @staticmethod
+    def decode(blob: bytes) -> "Instruction":
+        kind, rid, nrid = struct.unpack_from("<Bqq", blob, 0)
+        (n,) = struct.unpack_from("<H", blob, 17)
+        return Instruction(kind, rid, nrid, blob[19:19 + n].decode())
+
+
+def encode_store_meta(store_id: int, endpoint: str) -> bytes:
+    ep = endpoint.encode()
+    return struct.pack("<q", store_id) + struct.pack("<H", len(ep)) + ep

@@ -1,0 +1,410 @@
+"""Placement driver server: cluster metadata + region scheduling.
+
+Reference parity: ``pd:DefaultPlacementDriverService`` /
+``pd:PlacementDriverServer`` / ``pd:MetadataStore`` /
+``pd:ClusterStatsManager`` (SURVEY.md §3.2 "PD server") — the PD is
+itself a one-group raft application: store/region heartbeats mutate
+replicated metadata; the PD leader answers routing queries and emits
+Instructions (RANGE_SPLIT, TRANSFER_LEADER) back to stores.
+
+Determinism note: replicated FSM state holds only logical metadata
+(stores, regions, id allocator).  Liveness clocks and split decisions
+live on the PD *leader* outside the FSM — they are re-derived after
+failover from fresh heartbeats, exactly like the reference's in-memory
+ClusterStatsManager.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.core.raft_group_service import RaftGroupService
+from tpuraft.core.state_machine import Iterator, StateMachine
+from tpuraft.entity import PeerId, Task
+from tpuraft.errors import RaftError, Status
+from tpuraft.options import NodeOptions
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_messages import (
+    CreateRegionIdRequest,
+    CreateRegionIdResponse,
+    Instruction,
+    ListRegionsRequest,
+    ListRegionsResponse,
+    ListStoresRequest,
+    ListStoresResponse,
+    RegionHeartbeatRequest,
+    RegionHeartbeatResponse,
+    ReportSplitRequest,
+    ReportSplitResponse,
+    StoreHeartbeatRequest,
+    StoreHeartbeatResponse,
+    encode_store_meta,
+)
+
+LOG = logging.getLogger(__name__)
+
+PD_GROUP_ID = "__pd__"
+
+# PD command kinds (the PD group's replicated ops)
+_CMD_STORE_UPSERT = 1
+_CMD_REGION_UPSERT = 2
+_CMD_SPLIT = 3
+_CMD_ALLOC_ID = 4
+
+
+def _cmd(kind: int, payload: bytes = b"") -> bytes:
+    return struct.pack("<B", kind) + payload
+
+
+@dataclass
+class _StoreRecord:
+    store_id: int
+    endpoint: str
+
+
+class PDMetadataFSM(StateMachine):
+    """Replicated PD state: stores, regions, region-id allocator."""
+
+    def __init__(self) -> None:
+        self.stores: dict[str, _StoreRecord] = {}   # endpoint -> record
+        self.regions: dict[int, Region] = {}
+        self.region_leaders: dict[int, str] = {}
+        self.next_region_id: int = 1024  # user regions allocate upward
+
+    async def on_apply(self, it: Iterator) -> None:
+        while it.valid():
+            data = it.data()
+            done = it.done()
+            result = None
+            try:
+                result = self._dispatch(data)
+                status = Status.OK()
+            except Exception as e:  # noqa: BLE001
+                LOG.exception("pd apply failed")
+                status = Status.error(RaftError.ESTATEMACHINE, str(e))
+            if done is not None:
+                if hasattr(done, "result"):
+                    done.result = result
+                done(status)
+            it.next()
+
+    def _dispatch(self, data: bytes):
+        (kind,) = struct.unpack_from("<B", data, 0)
+        payload = data[1:]
+        if kind == _CMD_STORE_UPSERT:
+            (sid,) = struct.unpack_from("<q", payload, 0)
+            (n,) = struct.unpack_from("<H", payload, 8)
+            ep = payload[10:10 + n].decode()
+            self.stores[ep] = _StoreRecord(sid, ep)
+            return True
+        if kind == _CMD_REGION_UPSERT:
+            (ln,) = struct.unpack_from("<H", payload, 0)
+            leader = payload[2:2 + ln].decode()
+            region = Region.decode(payload[2 + ln:])
+            cur = self.regions.get(region.id)
+            if cur is None or (region.epoch.version, region.epoch.conf_ver) \
+                    >= (cur.epoch.version, cur.epoch.conf_ver):
+                self.regions[region.id] = region
+                if leader:
+                    self.region_leaders[region.id] = leader
+            return True
+        if kind == _CMD_SPLIT:
+            (pn,) = struct.unpack_from("<I", payload, 0)
+            parent = Region.decode(payload[4:4 + pn])
+            child = Region.decode(payload[4 + pn:])
+            self.regions[parent.id] = parent
+            self.regions[child.id] = child
+            self.next_region_id = max(self.next_region_id, child.id + 1)
+            return True
+        if kind == _CMD_ALLOC_ID:
+            rid = self.next_region_id
+            self.next_region_id += 1
+            return rid
+        raise ValueError(f"unknown pd cmd {kind}")
+
+    # -- snapshot ------------------------------------------------------------
+
+    async def on_snapshot_save(self, writer, done) -> None:
+        out = bytearray(struct.pack("<q", self.next_region_id))
+        out += struct.pack("<I", len(self.stores))
+        for rec in self.stores.values():
+            out += encode_store_meta(rec.store_id, rec.endpoint)
+        out += struct.pack("<I", len(self.regions))
+        for rid, region in self.regions.items():
+            blob = region.encode()
+            leader = self.region_leaders.get(rid, "").encode()
+            out += struct.pack("<I", len(blob)) + blob
+            out += struct.pack("<H", len(leader)) + leader
+        writer.write_file("pd_meta", bytes(out))
+        done(Status.OK())
+
+    async def on_snapshot_load(self, reader) -> bool:
+        blob = reader.read_file("pd_meta")
+        if blob is None:
+            return False
+        buf = memoryview(blob)
+        (self.next_region_id,) = struct.unpack_from("<q", buf, 0)
+        off = 8
+        (ns,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        self.stores = {}
+        for _ in range(ns):
+            (sid,) = struct.unpack_from("<q", buf, off)
+            off += 8
+            (n,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            ep = bytes(buf[off:off + n]).decode()
+            off += n
+            self.stores[ep] = _StoreRecord(sid, ep)
+        (nr,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        self.regions = {}
+        self.region_leaders = {}
+        for _ in range(nr):
+            (bn,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            region = Region.decode(buf[off:off + bn])
+            off += bn
+            (ln,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            leader = bytes(buf[off:off + ln]).decode()
+            off += ln
+            self.regions[region.id] = region
+            if leader:
+                self.region_leaders[region.id] = leader
+        return True
+
+
+class ClusterStatsManager:
+    """Leader-side (non-replicated) stats: key counts + split decisions.
+
+    Reference: ``pd:ClusterStatsManager`` — finds the region with the
+    most keys above the split threshold.
+    """
+
+    def __init__(self, split_threshold_keys: int) -> None:
+        self.split_threshold_keys = split_threshold_keys
+        self._keys: dict[int, int] = {}
+        self._inflight_splits: dict[int, float] = {}  # region -> deadline
+
+    def record(self, region_id: int, approximate_keys: int) -> None:
+        self._keys[region_id] = approximate_keys
+
+    def should_split(self, region_id: int) -> bool:
+        if self.split_threshold_keys <= 0:
+            return False
+        now = time.monotonic()
+        self._inflight_splits = {r: d for r, d in
+                                 self._inflight_splits.items() if d > now}
+        if region_id in self._inflight_splits:
+            return False
+        return self._keys.get(region_id, 0) >= self.split_threshold_keys
+
+    def mark_split_issued(self, region_id: int, cooldown_s: float = 30.0
+                          ) -> None:
+        self._inflight_splits[region_id] = time.monotonic() + cooldown_s
+        self._keys.pop(region_id, None)
+
+
+@dataclass
+class PlacementDriverOptions:
+    endpoints: list[str] = field(default_factory=list)  # PD cluster peers
+    election_timeout_ms: int = 1000
+    data_path: str = ""
+    # emit a RANGE_SPLIT instruction when a region reports >= this many
+    # keys (0 disables auto-split)
+    split_threshold_keys: int = 0
+    initial_regions: list[Region] = field(default_factory=list)
+
+
+class PlacementDriverServer:
+    """One PD cluster member: raft node + pd_* RPC processors."""
+
+    def __init__(self, opts: PlacementDriverOptions, server_id: str,
+                 rpc_server, transport) -> None:
+        self.opts = opts
+        self.server_id = PeerId.parse(server_id)
+        self.rpc_server = rpc_server
+        self.transport = transport
+        self.node_manager = NodeManager(rpc_server)
+        self.fsm = PDMetadataFSM()
+        self.stats = ClusterStatsManager(opts.split_threshold_keys)
+        self._group: Optional[RaftGroupService] = None
+        for method, handler in [
+            ("pd_list_regions", self._list_regions),
+            ("pd_list_stores", self._list_stores),
+            ("pd_store_heartbeat", self._store_heartbeat),
+            ("pd_region_heartbeat", self._region_heartbeat),
+            ("pd_report_split", self._report_split),
+            ("pd_create_region_id", self._create_region_id),
+        ]:
+            rpc_server.register(method, handler)
+
+    @property
+    def node(self):
+        return self._group.node if self._group else None
+
+    async def start(self) -> None:
+        node_opts = NodeOptions(
+            election_timeout_ms=self.opts.election_timeout_ms,
+            initial_conf=Configuration.parse(",".join(self.opts.endpoints)),
+            fsm=self.fsm,
+        )
+        if self.opts.data_path:
+            base = (f"{self.opts.data_path}/pd_"
+                    f"{self.server_id.ip}_{self.server_id.port}")
+            node_opts.log_uri = f"file://{base}/log"
+            node_opts.raft_meta_uri = f"file://{base}/meta"
+            node_opts.snapshot_uri = f"file://{base}/snapshot"
+        else:
+            node_opts.log_uri = "memory://"
+            node_opts.raft_meta_uri = "memory://"
+        self._group = RaftGroupService(
+            PD_GROUP_ID, self.server_id, node_opts, self.node_manager,
+            self.transport)
+        node = await self._group.start()
+        # seed the initial region layout once the PD leader emerges
+        if self.opts.initial_regions:
+            self._seed_regions = list(self.opts.initial_regions)
+        else:
+            self._seed_regions = []
+
+    async def shutdown(self) -> None:
+        if self._group:
+            await self._group.shutdown()
+            self._group = None
+
+    # -- raft plumbing -------------------------------------------------------
+
+    def _not_leader(self, resp_cls):
+        leader = self.node.get_leader_id() if self.node else None
+        redirect = ""
+        if leader is not None and not leader.is_empty():
+            redirect = leader.endpoint
+        return resp_cls(success=False, redirect=redirect, msg="not PD leader")
+
+    async def _apply(self, data: bytes):
+        import asyncio
+
+        fut = asyncio.get_running_loop().create_future()
+
+        class _Done:
+            result = None
+
+            def __call__(self, status: Status) -> None:
+                if not fut.done():
+                    fut.set_result((status, self.result))
+
+        await self.node.apply(Task(data=data, done=_Done()))
+        status, result = await fut
+        if not status.is_ok():
+            raise RuntimeError(str(status))
+        return result
+
+    async def _maybe_seed(self) -> None:
+        """Replicate the initial region layout once (leader, first contact)."""
+        if not self._seed_regions or not self.fsm or self.fsm.regions:
+            return
+        for region in self._seed_regions:
+            payload = struct.pack("<H", 0) + region.encode()
+            await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
+        self._seed_regions = []
+
+    # -- processors ----------------------------------------------------------
+
+    async def _list_regions(self, req: ListRegionsRequest
+                            ) -> ListRegionsResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(ListRegionsResponse)
+        await self._maybe_seed()
+        await node.read_index()
+        return ListRegionsResponse(
+            regions=[r.encode() for r in self.fsm.regions.values()])
+
+    async def _list_stores(self, req: ListStoresRequest) -> ListStoresResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(ListStoresResponse)
+        await node.read_index()
+        return ListStoresResponse(
+            stores=[encode_store_meta(r.store_id, r.endpoint)
+                    for r in self.fsm.stores.values()])
+
+    def _region_changed(self, region: Region, leader: str = "") -> bool:
+        cur = self.fsm.regions.get(region.id)
+        if cur is None:
+            return True
+        if (cur.epoch.conf_ver, cur.epoch.version,
+                cur.start_key, cur.end_key, cur.peers) != \
+                (region.epoch.conf_ver, region.epoch.version,
+                 region.start_key, region.end_key, region.peers):
+            return True
+        return bool(leader) and \
+            self.fsm.region_leaders.get(region.id) != leader
+
+    async def _store_heartbeat(self, req: StoreHeartbeatRequest
+                               ) -> StoreHeartbeatResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(StoreHeartbeatResponse)
+        await self._maybe_seed()
+        # only replicate *changes* — heartbeats repeat at 1s cadence and
+        # must not grow the PD log when nothing moved
+        cur = self.fsm.stores.get(req.endpoint)
+        if cur is None or cur.store_id != req.store_id:
+            await self._apply(_cmd(
+                _CMD_STORE_UPSERT,
+                encode_store_meta(req.store_id, req.endpoint)))
+        for blob in req.regions:
+            region = Region.decode(blob)
+            if self._region_changed(region):
+                payload = struct.pack("<H", 0) + region.encode()
+                await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
+        return StoreHeartbeatResponse()
+
+    async def _region_heartbeat(self, req: RegionHeartbeatRequest
+                                ) -> RegionHeartbeatResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(RegionHeartbeatResponse)
+        await self._maybe_seed()
+        region = Region.decode(req.region)
+        if self._region_changed(region, req.leader):
+            leader = req.leader.encode()
+            payload = struct.pack("<H", len(leader)) + leader + region.encode()
+            await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
+        self.stats.record(region.id, req.approximate_keys)
+        instructions: list[Instruction] = []
+        if self.stats.should_split(region.id):
+            new_id = await self._apply(_cmd(_CMD_ALLOC_ID))
+            self.stats.mark_split_issued(region.id)
+            instructions.append(Instruction(
+                kind=Instruction.KIND_SPLIT, region_id=region.id,
+                new_region_id=new_id))
+        return RegionHeartbeatResponse(
+            instructions=[i.encode() for i in instructions])
+
+    async def _report_split(self, req: ReportSplitRequest
+                            ) -> ReportSplitResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(ReportSplitResponse)
+        parent = req.parent
+        payload = struct.pack("<I", len(parent)) + parent + req.child
+        await self._apply(_cmd(_CMD_SPLIT, payload))
+        return ReportSplitResponse()
+
+    async def _create_region_id(self, req: CreateRegionIdRequest
+                                ) -> CreateRegionIdResponse:
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(CreateRegionIdResponse)
+        rid = await self._apply(_cmd(_CMD_ALLOC_ID))
+        return CreateRegionIdResponse(region_id=rid)

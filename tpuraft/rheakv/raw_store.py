@@ -230,10 +230,11 @@ class MemoryRawKVStore(RawKVStore):
         owner = self._locks.get(key)
         if owner is not None and not owner.expired(now):
             if owner.locker_id == locker_id:
-                # reentrant / lease renewal
                 if keep_lease:
+                    # pure lease renewal (watchdog): no new hold to release
                     owner.deadline = now + lease_ms / 1000.0
-                owner.acquires += 1
+                else:
+                    owner.acquires += 1  # reentrant acquire
                 return True, owner.fencing_token, locker_id
             return False, owner.fencing_token, owner.locker_id
         self._fencing += 1

@@ -1,0 +1,85 @@
+"""RegionRouteTable: client-side key-range -> region routing.
+
+Reference parity: ``rhea:RegionRouteTable`` (SURVEY.md §3.2 "Client")
+— a sorted range map from region start keys to Region metadata, patched
+from INVALID_REGION_EPOCH responses and PD refreshes; plus range → list
+of covering regions for multi-region scans.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+from tpuraft.rheakv.metadata import Region
+
+
+class RegionRouteTable:
+    def __init__(self) -> None:
+        self._starts: list[bytes] = []     # sorted region start keys
+        self._regions: dict[bytes, Region] = {}
+
+    def reset(self, regions: list[Region]) -> None:
+        self._starts = []
+        self._regions = {}
+        for r in regions:
+            self.add_or_update(r)
+
+    def add_or_update(self, region: Region) -> None:
+        r = region.copy()
+        # drop any stale entry for the same region id under a different start
+        for start, old in list(self._regions.items()):
+            if old.id == r.id and start != r.start_key:
+                self._remove_start(start)
+        cur = self._regions.get(r.start_key)
+        if cur is not None and cur.id != r.id \
+                and (cur.epoch.version > r.epoch.version):
+            return  # keep the fresher view
+        if r.start_key not in self._regions:
+            bisect.insort(self._starts, r.start_key)
+        self._regions[r.start_key] = r
+
+    def _remove_start(self, start: bytes) -> None:
+        if start in self._regions:
+            del self._regions[start]
+            i = bisect.bisect_left(self._starts, start)
+            if i < len(self._starts) and self._starts[i] == start:
+                self._starts.pop(i)
+
+    def remove_region(self, region_id: int) -> None:
+        for start, r in list(self._regions.items()):
+            if r.id == region_id:
+                self._remove_start(start)
+
+    def find_region_by_key(self, key: bytes) -> Optional[Region]:
+        """Rightmost region whose start <= key, if key is inside it."""
+        i = bisect.bisect_right(self._starts, key) - 1
+        if i < 0:
+            return None
+        r = self._regions[self._starts[i]]
+        return r if r.contains_key(key) else None
+
+    def find_region_by_id(self, region_id: int) -> Optional[Region]:
+        for r in self._regions.values():
+            if r.id == region_id:
+                return r
+        return None
+
+    def find_regions_by_range(self, start: bytes, end: bytes) -> list[Region]:
+        """All regions intersecting [start, end); ordered by start key."""
+        out = []
+        i = max(0, bisect.bisect_right(self._starts, start) - 1)
+        for s in self._starts[i:]:
+            r = self._regions[s]
+            if end and r.start_key >= end:
+                break
+            if r.end_key and r.end_key <= start:
+                continue
+            out.append(r)
+        return out
+
+    def list_regions(self) -> list[Region]:
+        return [self._regions[s] for s in self._starts]
+
+    def is_empty(self) -> bool:
+        return not self._starts

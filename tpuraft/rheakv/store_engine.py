@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -44,6 +45,8 @@ class StoreEngineOptions:
     raw_store_factory: Callable[[], RawKVStore] = MemoryRawKVStore
     # least keys a region must hold before a split is sensible
     least_keys_on_split: int = 16
+    # PD heartbeat cadence (only used when a pd_client is wired)
+    heartbeat_interval_ms: int = 1000
 
 
 class StoreEngine:
@@ -64,6 +67,7 @@ class StoreEngine:
         self._leader_regions: set[int] = set()
         self._started = False
         self._pending_splits: set[int] = set()
+        self._heartbeat_task: Optional[asyncio.Task] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -73,16 +77,63 @@ class StoreEngine:
         for region in self.opts.initial_regions:
             await self._start_region(region)
         self._started = True
+        if self.pd_client is not None:
+            self._heartbeat_task = asyncio.ensure_future(
+                self._heartbeat_loop())
         LOG.info("store engine %s up with %d regions", self.server_id,
                  len(self._regions))
 
     async def shutdown(self) -> None:
         self._started = False
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
         for engine in list(self._regions.values()):
             await engine.shutdown()
         self._regions.clear()
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.shutdown()
+
+    # -- PD heartbeats -------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        """Reference: ``rhea:StoreEngine``'s Store/Region heartbeat senders
+        — report meta + stats to the PD, execute returned Instructions."""
+        interval = self.opts.heartbeat_interval_ms / 1000.0
+        while self._started:
+            try:
+                await self._heartbeat_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — PD may be down; keep trying
+                LOG.debug("pd heartbeat failed", exc_info=True)
+            await asyncio.sleep(interval)
+
+    async def _heartbeat_once(self) -> None:
+        from tpuraft.rheakv.pd_messages import Instruction
+
+        await self.pd_client.store_heartbeat(self.store_meta())
+        for rid in self.leader_region_ids():
+            engine = self._regions.get(rid)
+            if engine is None or not engine.is_leader():
+                continue
+            region = engine.region
+            keys = self.raw_store.approximate_keys_in_range(
+                region.start_key, region.end_key)
+            instructions = await self.pd_client.region_heartbeat(
+                region, str(self.server_id),
+                {"approximate_keys": keys})
+            for ins in instructions:
+                if ins.kind == Instruction.KIND_SPLIT \
+                        and ins.region_id == rid:
+                    st = await self.apply_split(rid, ins.new_region_id)
+                    if not st.is_ok():
+                        LOG.info("pd-ordered split of region %d failed: %s",
+                                 rid, st)
+                elif ins.kind == Instruction.KIND_TRANSFER_LEADER \
+                        and ins.target_peer:
+                    await engine.transfer_leadership_to(
+                        PeerId.parse(ins.target_peer))
 
     async def _start_region(self, region: Region) -> RegionEngine:
         engine = RegionEngine(region, self)
@@ -99,7 +150,9 @@ class StoreEngine:
         return [e.region for e in self._regions.values()]
 
     def store_meta(self) -> StoreMeta:
-        return StoreMeta(id=hash(str(self.server_id)) & 0x7FFFFFFF,
+        # stable across restarts/processes (builtin hash() is seeded)
+        sid = zlib.crc32(str(self.server_id).encode())
+        return StoreMeta(id=sid,
                          endpoint=self.server_id.endpoint,
                          regions=[r.copy() for r in self.list_regions()])
 
