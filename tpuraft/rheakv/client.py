@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import struct
 import uuid
 from typing import Optional
 
@@ -252,8 +251,7 @@ class RheaKVStore:
     async def multi_get(self, keys: list[bytes]
                         ) -> dict[bytes, Optional[bytes]]:
         parts = await self._run_sharded(
-            keys, lambda k: k,
-            lambda ks: KVOperation(KVOp.MULTI_GET, value=_pack_keys(ks)))
+            keys, lambda k: k, KVOperation.multi_get)
         out: dict[bytes, Optional[bytes]] = {}
         for pairs in parts:
             out.update(dict(pairs))
@@ -282,49 +280,89 @@ class RheaKVStore:
             e = end
         return s, e
 
+    async def _ranged(self, start: bytes, end: bytes, make_op,
+                      reverse: bool = False,
+                      remaining=lambda results: -1) -> list:
+        """Cursor walk over the regions intersecting [start, end).
+
+        The region AND its clip are re-resolved from the current route
+        table on every attempt, so a split racing the walk narrows the
+        next step instead of wedging the whole call on a permanently
+        out-of-range pre-clipped op (the server range-checks every op).
+        ``make_op(s, e, remaining)`` builds the per-slice op;
+        ``remaining(results)`` returns the item budget left (-1 =
+        unlimited, 0 = stop).
+        """
+        results: list = []
+        attempts = 0
+        last = Status.error(RaftError.EAGAIN, "exhausted retries")
+        cursor = end if reverse else start
+        while remaining(results) != 0:
+            lo, hi = (start, cursor) if reverse else (cursor, end)
+            regions = self.route_table.find_regions_by_range(lo, hi)
+            if not regions:
+                await self._refresh_routes()
+                regions = self.route_table.find_regions_by_range(lo, hi)
+                if not regions:
+                    break
+            region = regions[-1] if reverse else regions[0]
+            s, e = self._clip(region, lo, hi)
+            try:
+                results.append(await self._call_region(
+                    region, make_op(s, e, remaining(results))))
+            except _Retry as r:
+                attempts += 1
+                if attempts >= self.max_retries:
+                    raise RheaKVError(r.status or last)
+                if r.status is not None:
+                    last = r.status
+                if r.refresh:
+                    await self._refresh_routes()
+                await asyncio.sleep(
+                    self.retry_interval_ms * attempts / 1000.0)
+                continue
+            if reverse:
+                if not region.start_key or (start and region.start_key <= start):
+                    break
+                cursor = region.start_key
+            else:
+                if not region.end_key or (end and region.end_key >= end):
+                    break
+                cursor = region.end_key
+        return results
+
+    @staticmethod
+    def _scan_budget(limit: int):
+        def remaining(parts: list) -> int:
+            if limit < 0:
+                return -1
+            return max(limit - sum(len(p) for p in parts), 0)
+        return remaining
+
     async def scan(self, start: bytes, end: bytes, limit: int = -1,
                    return_value: bool = True
                    ) -> list[tuple[bytes, Optional[bytes]]]:
-        out: list[tuple[bytes, Optional[bytes]]] = []
-        regions = self.route_table.find_regions_by_range(start, end)
-        if not regions:
-            await self._refresh_routes()
-            regions = self.route_table.find_regions_by_range(start, end)
-        for region in regions:
-            s, e = self._clip(region, start, end)
-            part_limit = -1 if limit < 0 else limit - len(out)
-            if part_limit == 0:
-                break
-            part = await self._execute(
-                s if s else region.start_key,
-                scan_op(s, e, part_limit, return_value))
-            out.extend(part)
-        return out
+        parts = await self._ranged(
+            start, end,
+            lambda s, e, rem: scan_op(s, e, rem, return_value),
+            remaining=self._scan_budget(limit))
+        return [kv for p in parts for kv in p]
 
     async def reverse_scan(self, start: bytes, end: bytes, limit: int = -1,
                            return_value: bool = True
                            ) -> list[tuple[bytes, Optional[bytes]]]:
-        out: list[tuple[bytes, Optional[bytes]]] = []
-        regions = self.route_table.find_regions_by_range(start, end)
-        for region in reversed(regions):
-            s, e = self._clip(region, start, end)
-            part_limit = -1 if limit < 0 else limit - len(out)
-            if part_limit == 0:
-                break
-            part = await self._execute(
-                s if s else region.start_key,
-                scan_op(s, e, part_limit, return_value, reverse=True))
-            out.extend(part)
-        return out
+        parts = await self._ranged(
+            start, end,
+            lambda s, e, rem: scan_op(s, e, rem, return_value, reverse=True),
+            reverse=True,
+            remaining=self._scan_budget(limit))
+        return [kv for p in parts for kv in p]
 
     async def delete_range(self, start: bytes, end: bytes) -> bool:
-        ok = True
-        for region in self.route_table.find_regions_by_range(start, end):
-            s, e = self._clip(region, start, end)
-            ok = await self._execute(
-                s if s else region.start_key,
-                KVOperation.delete_range(s, e)) and ok
-        return ok
+        parts = await self._ranged(
+            start, end,
+            lambda s, e, rem: KVOperation.delete_range(s, e))
+        return all(parts)
 
     # ------------------------------------------------------------------
     # sequences & locks
@@ -356,13 +394,6 @@ class _Retry(Exception):
 def _endpoint(peer_str: str) -> str:
     """PeerId string ('ip:port[:idx[:priority]]') -> store endpoint."""
     return ":".join(peer_str.split(":")[:2])
-
-
-def _pack_keys(keys: list[bytes]) -> bytes:
-    blob = bytearray(struct.pack("<I", len(keys)))
-    for k in keys:
-        blob += struct.pack("<I", len(k)) + k
-    return bytes(blob)
 
 
 class DistributedLock:
@@ -420,7 +451,7 @@ class DistributedLock:
                 if not self._held:
                     break
                 try:
-                    ok, _, _ = await self._store._execute(
+                    ok, token, _ = await self._store._execute(
                         self.key,
                         KVOperation.key_lock(self.key, self.locker_id,
                                              self.lease_ms, keep_lease=True))
@@ -431,6 +462,20 @@ class DistributedLock:
                 if not ok:
                     # someone else owns it now — we lost the lease for real
                     self._held = False
+                    break
+                if token != self.fencing_token:
+                    # our lease lapsed and the store silently re-granted
+                    # under a NEW fencing token: someone else may have held
+                    # (and released) the lock in the gap, so continuity is
+                    # broken — surrender the accidental re-acquisition
+                    # rather than masquerade as an unbroken hold
+                    self._held = False
+                    try:
+                        await self._store._execute(
+                            self.key, KVOperation.key_unlock(
+                                self.key, self.locker_id))
+                    except Exception:  # noqa: BLE001 — lease will expire
+                        pass
                     break
         except asyncio.CancelledError:
             pass

@@ -120,11 +120,21 @@ class KVOperation:
         return out
 
     @staticmethod
-    def delete_list(keys: list[bytes]) -> "KVOperation":
+    def pack_key_list(keys: list[bytes]) -> bytes:
         blob = bytearray(struct.pack("<I", len(keys)))
         for k in keys:
             blob += struct.pack("<I", len(k)) + k
-        return KVOperation(KVOp.DELETE_LIST, value=bytes(blob))
+        return bytes(blob)
+
+    @staticmethod
+    def delete_list(keys: list[bytes]) -> "KVOperation":
+        return KVOperation(KVOp.DELETE_LIST,
+                           value=KVOperation.pack_key_list(keys))
+
+    @staticmethod
+    def multi_get(keys: list[bytes]) -> "KVOperation":
+        return KVOperation(KVOp.MULTI_GET,
+                           value=KVOperation.pack_key_list(keys))
 
     @staticmethod
     def unpack_key_list(blob: bytes) -> list[bytes]:

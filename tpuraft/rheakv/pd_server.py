@@ -118,8 +118,15 @@ class PDMetadataFSM(StateMachine):
             (pn,) = struct.unpack_from("<I", payload, 0)
             parent = Region.decode(payload[4:4 + pn])
             child = Region.decode(payload[4 + pn:])
-            self.regions[parent.id] = parent
-            self.regions[child.id] = child
+            # epoch-guarded like _CMD_REGION_UPSERT: a replayed
+            # report_split (client retry after a lost response) must not
+            # stomp fresher metadata from heartbeats or a later split
+            for region in (parent, child):
+                cur = self.regions.get(region.id)
+                if cur is None or (region.epoch.version,
+                                   region.epoch.conf_ver) >= \
+                        (cur.epoch.version, cur.epoch.conf_ver):
+                    self.regions[region.id] = region
             self.next_region_id = max(self.next_region_id, child.id + 1)
             return True
         if kind == _CMD_ALLOC_ID:

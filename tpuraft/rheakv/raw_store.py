@@ -206,6 +206,15 @@ class MemoryRawKVStore(RawKVStore):
             self._dirty = True
         self._data[key] = value
 
+    def approximate_keys_in_range(self, start: bytes, end: bytes) -> int:
+        # O(log n) against the sorted index — this runs on the store
+        # heartbeat hot loop for every leader region, so the base class's
+        # materialize-the-whole-range default is not acceptable here
+        keys = self._keys()
+        lo = bisect.bisect_left(keys, start) if start else 0
+        hi = bisect.bisect_left(keys, end) if end else len(keys)
+        return hi - lo
+
     def delete(self, key: bytes) -> None:
         if self._data.pop(key, None) is not None:
             self._dirty = True
