@@ -310,6 +310,7 @@ class RheaKVStore:
             try:
                 results.append(await self._call_region(
                     region, make_op(s, e, remaining(results))))
+                attempts = 0  # per-slice retry budget, not per-walk
             except _Retry as r:
                 attempts += 1
                 if attempts >= self.max_retries:

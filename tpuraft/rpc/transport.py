@@ -135,20 +135,15 @@ class InProcNetwork:
             raise RpcError(Status.error(RaftError.ETIMEDOUT, f"{method} to {dst}"))
 
 
-class InProcTransport:
-    """The RaftClientService bound to one local endpoint."""
+class TransportBase:
+    """RaftClientService surface shared by every transport backend
+    (in-proc loopback, TCP/DCN): ``call`` plus typed helpers."""
 
-    def __init__(self, network: InProcNetwork, endpoint: str,
-                 default_timeout_ms: float = 1000.0):
-        self._net = network
-        self.endpoint = endpoint
-        self._timeout_ms = default_timeout_ms
+    endpoint: str
 
     async def call(self, dst: str, method: str, request: Any,
                    timeout_ms: Optional[float] = None) -> Any:
-        return await self._net.call(
-            self.endpoint, dst, method, request,
-            timeout_ms if timeout_ms is not None else self._timeout_ms)
+        raise NotImplementedError
 
     # typed helpers (reference: RaftClientService methods)
 
@@ -169,3 +164,20 @@ class InProcTransport:
 
     async def get_file(self, dst: str, req, timeout_ms=None):
         return await self.call(dst, "get_file", req, timeout_ms)
+
+
+class InProcTransport(TransportBase):
+    """The RaftClientService bound to one local endpoint."""
+
+    def __init__(self, network: InProcNetwork, endpoint: str,
+                 default_timeout_ms: float = 1000.0):
+        self._net = network
+        self.endpoint = endpoint
+        self._timeout_ms = default_timeout_ms
+
+    async def call(self, dst: str, method: str, request: Any,
+                   timeout_ms: Optional[float] = None) -> Any:
+        return await self._net.call(
+            self.endpoint, dst, method, request,
+            timeout_ms if timeout_ms is not None else self._timeout_ms)
+
