@@ -1,0 +1,74 @@
+"""Pallas fused-quorum kernel vs the XLA oracle (tpuraft.ops.ballot).
+
+The kernel runs under ``interpret=True`` here (CPU test mesh); on real
+TPU hardware the same kernel body compiles via Mosaic.  Bit-equality is
+required — the kernel replaces the oracle, it must not approximate it.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from tpuraft.ops.quorum_pallas import fused_quorum
+from tpuraft.ops.tick import ROLE_LEADER, GroupState, TickParams, raft_tick
+
+
+def _random_case(rng, G, P, joint_frac=0.3):
+    match = jnp.asarray(rng.integers(-1, 100, (G, P)).astype(np.int32))
+    ack = jnp.asarray(rng.integers(0, 10_000, (G, P)).astype(np.int32))
+    granted = jnp.asarray(rng.random((G, P)) < 0.5)
+    vm = jnp.asarray(rng.random((G, P)) < 0.6)
+    ovm = jnp.asarray(
+        (rng.random((G, P)) < 0.4) & (rng.random((G, 1)) < joint_frac))
+    return match, granted, ack, vm, ovm
+
+
+@pytest.mark.parametrize("g,p", [(1, 4), (7, 8), (130, 8), (700, 16)])
+def test_kernel_matches_oracle(g, p):
+    rng = np.random.default_rng(g * 31 + p)
+    match, granted, ack, vm, ovm = _random_case(rng, g, p)
+    ref = fused_quorum(match, granted, ack, vm, ovm, impl="xla")
+    out = fused_quorum(match, granted, ack, vm, ovm, impl="pallas_interpret")
+    for name, x, y in zip(("quorum_idx", "elected", "q_ack"), ref, out):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{name} G={g} P={p}")
+
+
+def test_all_masked_and_single_voter():
+    """Degenerate configurations: no voters (inactive slot rows) and
+    single-voter groups (commit == own match, elected by self-vote)."""
+    G, P = 8, 4
+    match = jnp.arange(G * P, dtype=jnp.int32).reshape(G, P)
+    ack = match * 2
+    granted = jnp.ones((G, P), bool)
+    vm = jnp.zeros((G, P), bool).at[4:, 0].set(True)  # rows 0-3: no voters
+    ovm = jnp.zeros((G, P), bool)
+    ref = fused_quorum(match, granted, ack, vm, ovm, impl="xla")
+    out = fused_quorum(match, granted, ack, vm, ovm, impl="pallas_interpret")
+    for x, y in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_raft_tick_same_under_both_impls():
+    rng = np.random.default_rng(7)
+    G, P = 64, 8
+    state = GroupState.zeros(G, P)
+    state.role = jnp.asarray(rng.integers(0, 3, (G,)).astype(np.int32))
+    state.match_rel = jnp.asarray(rng.integers(0, 50, (G, P)).astype(np.int32))
+    state.pending_rel = jnp.ones((G,), jnp.int32)
+    state.granted = jnp.asarray(rng.random((G, P)) < 0.6)
+    voter = np.zeros((G, P), bool)
+    voter[:, :3] = True
+    state.voter_mask = jnp.asarray(voter)
+    state.last_ack = jnp.asarray(rng.integers(0, 2_000, (G, P)).astype(np.int32))
+    params = TickParams.make(1000, 100, 900)
+    s1, o1 = raft_tick(state, jnp.int32(1500), params, quorum_impl="xla")
+    s2, o2 = raft_tick(state, jnp.int32(1500), params,
+                       quorum_impl="pallas_interpret")
+    for name in ("commit_rel", "commit_advanced", "elected", "election_due",
+                 "step_down", "hb_due", "lease_valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(o1, name)), np.asarray(getattr(o2, name)),
+            err_msg=name)
+    np.testing.assert_array_equal(np.asarray(s1.commit_rel),
+                                  np.asarray(s2.commit_rel))

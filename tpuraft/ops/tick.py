@@ -26,12 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpuraft.ops.ballot import (
-    NEG_INF_I32,
-    joint_quorum_match_index,
-    joint_vote_quorum,
-    quorum_ack_time,
-)
+from tpuraft.ops.ballot import NEG_INF_I32
+from tpuraft.ops.quorum_pallas import fused_quorum
 
 # Role encoding (device plane). Learners are not a role: they sit in peer
 # slots with voter_mask=False.
@@ -110,17 +106,24 @@ class TickOutputs:
     lease_valid: jnp.ndarray    # bool [G] leader lease currently valid (for reads)
 
 
-def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams
+def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
+              quorum_impl: str | None = None
               ) -> tuple[GroupState, TickOutputs]:
-    """Advance all groups one tick. Pure; jit/shard_map over the G axis."""
+    """Advance all groups one tick. Pure; jit/shard_map over the G axis.
+
+    quorum_impl selects the [G,P]-reduction backend (see
+    tpuraft.ops.quorum_pallas.fused_quorum); it must be static under jit.
+    """
     is_leader = state.role == ROLE_LEADER
     is_follower = state.role == ROLE_FOLLOWER
     is_candidate = state.role == ROLE_CANDIDATE
 
+    # The three [G,P] -> [G] quorum reductions in one (fusable) pass.
+    quorum_idx, vote_ok, q_ack = fused_quorum(
+        state.match_rel, state.granted, state.last_ack,
+        state.voter_mask, state.old_voter_mask, impl=quorum_impl)
+
     # --- commit advancement (BallotBox#commitAt, vectorized) ---------------
-    quorum_idx = joint_quorum_match_index(
-        state.match_rel, state.voter_mask, state.old_voter_mask
-    )
     # Entries before pending_rel belong to prior leaderships: never counted
     # (this IS the Raft §5.4.2 current-term commit gate — pending_rel is set
     # to lastLogIndex+1 at becomeLeader, mirroring BallotBox#resetPendingIndex).
@@ -131,9 +134,7 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams
     commit_advanced = new_commit > state.commit_rel
 
     # --- election tally (NodeImpl#handleRequestVoteResponse, vectorized) ---
-    elected = is_candidate & joint_vote_quorum(
-        state.granted, state.voter_mask, state.old_voter_mask
-    )
+    elected = is_candidate & vote_ok
 
     # --- election timeout (RepeatedTimer electionTimer, vectorized) --------
     election_due = (is_follower | is_candidate) & (now_ms >= state.elect_deadline)
@@ -141,7 +142,6 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams
     # --- leader lease / step-down (NodeImpl#checkDeadNodes) ----------------
     # Count the leader itself as acked "now" via its self slot: the host
     # keeps last_ack[g, self] == now. Quorum ack time = q-th newest response.
-    q_ack = quorum_ack_time(state.last_ack, state.voter_mask)
     have_quorum_ack = q_ack > NEG_INF_I32
     lease_valid = is_leader & have_quorum_ack & (now_ms - q_ack < params.lease_ms)
     step_down = is_leader & have_quorum_ack & (
@@ -176,4 +176,5 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams
     return new_state, outputs
 
 
-raft_tick_jit = jax.jit(raft_tick, donate_argnums=(0,))
+raft_tick_jit = jax.jit(raft_tick, donate_argnums=(0,),
+                        static_argnames=("quorum_impl",))

@@ -200,6 +200,10 @@ class _Connection:
 
     def _fail_all(self, exc: Exception) -> None:
         self.closed = True
+        # close the socket here too: the pool overwrites failed
+        # connections without awaiting close(), and StreamReaderProtocol
+        # keeps the transport registered on EOF (CLOSE_WAIT leak otherwise)
+        self.writer.close()
         status = Status.error(RaftError.EHOSTDOWN, f"connection lost: {exc}")
         for fut in self.pending.values():
             if not fut.done():
@@ -259,12 +263,14 @@ class TcpTransport(TransportBase):
         timeout = (timeout_ms if timeout_ms is not None
                    else self._timeout_ms) / 1000.0
         conn = await self._get_connection(dst)
+        m = method.encode()
+        # encode BEFORE registering the future: a codec failure must raise
+        # cleanly, not orphan a pending entry
+        payload = struct.pack("<H", len(m)) + m + encode_message(request)
         self._seq += 1
         seq = self._seq
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         conn.pending[seq] = fut
-        m = method.encode()
-        payload = struct.pack("<H", len(m)) + m + encode_message(request)
         try:
             async with conn.write_lock:
                 conn.writer.write(_frame(seq, 0, payload))
