@@ -1,0 +1,189 @@
+"""RheaKV multi-region store + YCSB-style benchmark driver.
+
+Reference parity: ``example:rheakv/*`` benchmark (SURVEY.md §3.3) — boots
+an N-store, R-region RheaKV cluster in one process (the reference's
+benchmark yaml topology), loads keys, then runs a mixed workload and
+reports throughput + latency percentiles.
+
+    python -m examples.rheakv_bench                 # defaults: 3x4, quick
+    python -m examples.rheakv_bench --regions 16 --keys 20000 --ops 50000 \
+        --workload a    # 50/50 read-update (YCSB-A); b = 95/5
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import struct
+import time
+
+import numpy as np
+
+from tpuraft.rheakv.client import RheaKVStore
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+from tpuraft.options import ReadOnlyOption
+from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+
+def make_regions(n_regions: int, n_keys_space: int = 1 << 32) -> list[Region]:
+    """Pre-split the 4-byte big-endian key space into n_regions ranges
+    (the reference benchmark pre-splits via PD before loading)."""
+    bounds = [int(i * n_keys_space / n_regions) for i in range(n_regions + 1)]
+    regions = []
+    for i in range(n_regions):
+        start = struct.pack(">I", bounds[i]) if i else b""
+        end = struct.pack(">I", bounds[i + 1]) if i < n_regions - 1 else b""
+        regions.append(Region(id=i + 1, start_key=start, end_key=end))
+    return regions
+
+
+class BenchCluster:
+    """N stores x R regions over the in-proc loopback fabric."""
+
+    def __init__(self, n_stores: int, regions: list[Region],
+                 election_timeout_ms: int = 1000, lease_reads: bool = False):
+        self.lease_reads = lease_reads
+        self.net = InProcNetwork()
+        self.endpoints = [f"127.0.0.1:{6100 + i}" for i in range(n_stores)]
+        for r in regions:
+            r.peers = list(self.endpoints)
+        self.regions = regions
+        self.election_timeout_ms = election_timeout_ms
+        self.stores: dict[str, StoreEngine] = {}
+
+    async def start(self) -> None:
+        for ep in self.endpoints:
+            server = RpcServer(ep)
+            self.net.bind(server)
+            opts = StoreEngineOptions(
+                server_id=ep,
+                initial_regions=[r.copy() for r in self.regions],
+                election_timeout_ms=self.election_timeout_ms,
+                read_only_option=(ReadOnlyOption.LEASE_BASED
+                                  if self.lease_reads
+                                  else ReadOnlyOption.SAFE))
+            store = StoreEngine(opts, server, InProcTransport(self.net, ep))
+            await store.start()
+            self.stores[ep] = store
+
+    async def wait_leaders(self, timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        want = {r.id for r in self.regions}
+        while time.monotonic() < deadline:
+            led = set()
+            for s in self.stores.values():
+                for r in s.list_regions():
+                    eng = s.get_region_engine(r.id)
+                    if eng and eng.is_leader():
+                        led.add(r.id)
+            if led >= want:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError("regions without leaders")
+
+    async def client(self) -> RheaKVStore:
+        pd = FakePlacementDriverClient(
+            [r.copy() for r in next(iter(self.stores.values())).list_regions()])
+        kv = RheaKVStore(pd, InProcTransport(self.net, "bench-client:0"))
+        await kv.start()
+        return kv
+
+    async def stop(self) -> None:
+        for ep, s in list(self.stores.items()):
+            self.net.unbind(ep)
+            await s.shutdown()
+        self.stores.clear()
+
+
+def _key(i: int) -> bytes:
+    # spread keys uniformly over the pre-split >I space
+    return struct.pack(">I", (i * 2654435761) & 0xFFFFFFFF)
+
+
+async def run_bench(n_stores: int = 3, n_regions: int = 4,
+                    n_keys: int = 2000, n_ops: int = 5000,
+                    value_size: int = 100, workload: str = "b",
+                    concurrency: int = 64, lease_reads: bool = False,
+                    verbose: bool = True) -> dict:
+    read_frac = {"a": 0.5, "b": 0.95, "c": 1.0}[workload]
+    cluster = BenchCluster(n_stores, make_regions(n_regions),
+                           lease_reads=lease_reads)
+    await cluster.start()
+    await cluster.wait_leaders()
+    kv = await cluster.client()
+    value = b"v" * value_size
+    rng = np.random.default_rng(0)
+
+    def say(*a):
+        if verbose:
+            print(*a)
+
+    try:
+        # -- load phase ----------------------------------------------------
+        t0 = time.perf_counter()
+        sem = asyncio.Semaphore(concurrency)
+
+        async def put_one(i: int):
+            async with sem:
+                assert await kv.put(_key(i), value)
+
+        await asyncio.gather(*(put_one(i) for i in range(n_keys)))
+        load_s = time.perf_counter() - t0
+        say(f"load: {n_keys} keys across {n_regions} regions "
+            f"in {load_s:.2f}s ({n_keys / load_s:,.0f} ops/s)")
+
+        # -- mixed phase (YCSB-{a,b,c}: zipf-less uniform picks) ----------
+        ops = rng.random(n_ops) < read_frac
+        picks = rng.integers(0, n_keys, n_ops)
+        lat: list[float] = []
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            async with sem:
+                s = time.perf_counter()
+                if ops[i]:
+                    await kv.get(_key(int(picks[i])))
+                else:
+                    await kv.put(_key(int(picks[i])), value)
+                lat.append(time.perf_counter() - s)
+
+        await asyncio.gather(*(one(i) for i in range(n_ops)))
+        run_s = time.perf_counter() - t0
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        result = {
+            "workload": workload,
+            "stores": n_stores, "regions": n_regions,
+            "ops_per_s": n_ops / run_s,
+            "p50_ms": float(lat_ms[int(0.50 * len(lat_ms))]),
+            "p99_ms": float(lat_ms[int(0.99 * len(lat_ms)) - 1]),
+        }
+        say(f"workload-{workload}: {n_ops} ops ({read_frac:.0%} reads) "
+            f"in {run_s:.2f}s -> {result['ops_per_s']:,.0f} ops/s, "
+            f"p50 {result['p50_ms']:.2f}ms, p99 {result['p99_ms']:.2f}ms")
+        return result
+    finally:
+        await kv.shutdown()
+        await cluster.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--stores", type=int, default=3)
+    ap.add_argument("--regions", type=int, default=4)
+    ap.add_argument("--keys", type=int, default=2000)
+    ap.add_argument("--ops", type=int, default=5000)
+    ap.add_argument("--value-size", type=int, default=100)
+    ap.add_argument("--workload", choices=["a", "b", "c"], default="b")
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--lease-reads", action="store_true",
+                    help="LEASE_BASED readIndex (no per-read quorum round)")
+    args = ap.parse_args()
+    asyncio.run(run_bench(args.stores, args.regions, args.keys, args.ops,
+                          args.value_size, args.workload, args.concurrency,
+                          args.lease_reads))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Smoke tests for the L7 examples (reference: jraft-example — SURVEY.md
+§3.3): each demo must run end-to-end in-process, including its failure
+injection (leader kill)."""
+
+import asyncio
+
+from examples.counter import demo as counter_demo
+from examples.election import demo as election_demo
+from examples.rheakv_bench import run_bench
+
+
+async def test_counter_demo(tmp_path):
+    v = await asyncio.wait_for(
+        counter_demo(increments=4, data_root=str(tmp_path), verbose=False),
+        60)
+    assert v == 9  # 4 increments + 5 after failover
+
+
+async def test_election_demo():
+    first, second = await asyncio.wait_for(election_demo(verbose=False), 60)
+    assert first != second
+
+
+async def test_rheakv_bench_small():
+    r = await asyncio.wait_for(
+        run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
+                  concurrency=16, verbose=False), 120)
+    assert r["ops_per_s"] > 0 and r["p99_ms"] > 0
+
+
+async def test_rheakv_bench_lease_reads():
+    r = await asyncio.wait_for(
+        run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
+                  concurrency=16, lease_reads=True, verbose=False), 120)
+    assert r["ops_per_s"] > 0

@@ -177,6 +177,24 @@ async def test_read_index_leader_and_follower():
     await c.stop_all()
 
 
+async def test_read_index_burst_no_orphans():
+    """Regression: readers arriving WHILE a confirmation round is in
+    flight must be served by a follow-up round, not orphaned until the
+    next unrelated request (observed as client-timeout p99 tails)."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"r1")
+    # staggered burst: waves land mid-round repeatedly
+    async def one(delay):
+        await asyncio.sleep(delay)
+        return await leader.read_index()
+    results = await asyncio.wait_for(
+        asyncio.gather(*(one((i % 7) * 0.001) for i in range(40))), 5.0)
+    assert all(r >= 1 for r in results)
+    await c.stop_all()
+
+
 async def test_read_index_fails_without_quorum():
     c = TestCluster(3, election_timeout_ms=200)
     await c.start_all()

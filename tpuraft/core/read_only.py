@@ -59,37 +59,46 @@ class ReadOnlyService:
         return await fut
 
     async def _run_round(self) -> None:
+        # Drain until no requests remain: futures appended WHILE a round is
+        # confirming must be picked up by a follow-up round here — callers
+        # only spawn a round task when none is running, so exiting with
+        # _pending non-empty would orphan those readers until the next
+        # request happens to arrive (observed as client-timeout p99 tails).
+        while self._pending:
+            batch, self._pending = self._pending, []
+            try:
+                ok, read_index = await self._confirm_once()
+            except Exception as e:  # noqa: BLE001 — transport/storage error
+                for fut in batch:
+                    if not fut.done():
+                        fut.set_exception(_read_error(
+                            RaftError.EINTERNAL, f"readIndex round: {e!r}"))
+                continue
+            for fut in batch:
+                if fut.done():
+                    continue
+                if ok:
+                    fut.set_result(read_index)
+                else:
+                    fut.set_exception(_read_error(
+                        RaftError.ERAFTTIMEDOUT,
+                        "readIndex quorum confirmation failed"))
+
+    async def _confirm_once(self) -> tuple[bool, int]:
         node = self._node
-        batch, self._pending = self._pending, []
         read_index = node.ballot_box.last_committed_index
-        # the commit index right after election is from a prior term until
-        # the leader's conf entry commits — must wait for that first
-        # (reference: ReadOnlyServiceImpl error "node is still electing")
-        if node.ballot_box.pending_index > 0 and \
-                node.ballot_box.last_committed_index < node.ballot_box.pending_index - 1:
-            pass  # commit index is behind this leadership's start; still valid:
-            # entries up to it were committed by prior leaders
-        ok = False
+        # A commit index carried over from a prior term is still a valid
+        # read barrier — those entries were committed by prior leaders
+        # (reference: ReadOnlyServiceImpl's electing-state handling).
         opt = node.options.raft_options.read_only_option
         if opt == ReadOnlyOption.LEASE_BASED and node.leader_lease_is_valid():
-            ok = True
-        else:
-            # SAFE: quorum heartbeat round
-            voters = len(node.conf_entry.conf.peers)
-            if voters <= 1:
-                ok = node.is_leader()
-            else:
-                acks = 1 + await node.replicators.heartbeat_round()
-                ok = acks >= voters // 2 + 1 and node.is_leader()
-        for fut in batch:
-            if fut.done():
-                continue
-            if ok:
-                fut.set_result(read_index)
-            else:
-                fut.set_exception(_read_error(
-                    RaftError.ERAFTTIMEDOUT,
-                    "readIndex quorum confirmation failed"))
+            return True, read_index
+        # SAFE: quorum heartbeat round
+        voters = len(node.conf_entry.conf.peers)
+        if voters <= 1:
+            return node.is_leader(), read_index
+        acks = 1 + await node.replicators.heartbeat_round()
+        return acks >= voters // 2 + 1 and node.is_leader(), read_index
 
     async def _forward_to_leader(self) -> int:
         node = self._node

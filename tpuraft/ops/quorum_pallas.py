@@ -8,7 +8,7 @@ vote tally + ``NodeImpl#checkDeadNodes``):
   elected     — vote quorum reached (joint-consensus aware)
   q_ack       — q-th newest voter ack timestamp (lease / step-down)
 
-Design notes (see /opt/skills/guides/pallas_guide.md):
+Design notes:
   - Arrays enter transposed as [P, G] so the large G axis lies on the
     128-lane dimension (P <= 16 would waste 112/128 lanes the other way).
   - The q-th order statistic uses rank counting, not sorting: for slot j,

@@ -144,8 +144,10 @@ class RheaKVStore:
                 raise _Retry(refresh=True)
             if resp.code in (int(RaftError.EPERM), int(RaftError.EBUSY),
                              int(RaftError.EAGAIN),
-                             int(RaftError.ERAFTTIMEDOUT)):
-                # not leader / electing: try the next store
+                             int(RaftError.ERAFTTIMEDOUT),
+                             int(RaftError.ETIMEDOUT)):
+                # not leader / electing / readIndex round timed out under
+                # load: try the next store
                 last_status = Status(resp.code, resp.msg)
                 self._leaders.pop(region.id, None)
                 continue

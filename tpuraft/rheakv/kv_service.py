@@ -17,6 +17,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Optional
 
+from tpuraft.core.read_only import ReadIndexError
 from tpuraft.errors import RaftError, Status
 from tpuraft.rheakv.kv_operation import KVOp, KVOperation
 from tpuraft.rheakv.metadata import Region
@@ -203,9 +204,11 @@ class KVCommandProcessor:
                                          msg=f"bad op {op.op}")
         except KVStoreError as e:
             return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
-        except RpcError as e:
+        except (RpcError, ReadIndexError) as e:
+            # keep the real status code: ETIMEDOUT/EPERM/ERAFTTIMEDOUT are
+            # retryable by the client; EINTERNAL would hard-fail the call
             return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
-        except Exception as e:  # noqa: BLE001 — e.g. ReadIndexError
+        except Exception as e:  # noqa: BLE001
             return KVCommandResponse(code=int(RaftError.EINTERNAL), msg=str(e))
         return KVCommandResponse(result=encode_result(result))
 

@@ -25,7 +25,7 @@ from tpuraft.core.cli_service import CliProcessors
 from tpuraft.core.node_manager import NodeManager
 from tpuraft.entity import PeerId
 from tpuraft.errors import RaftError, Status
-from tpuraft.options import NodeOptions, SnapshotOptions
+from tpuraft.options import NodeOptions, ReadOnlyOption, SnapshotOptions
 from tpuraft.rheakv.kv_service import KVCommandProcessor
 from tpuraft.rheakv.metadata import Region, StoreMeta
 from tpuraft.rheakv.raw_store import MemoryRawKVStore, RawKVStore
@@ -47,6 +47,10 @@ class StoreEngineOptions:
     least_keys_on_split: int = 16
     # PD heartbeat cadence (only used when a pd_client is wired)
     heartbeat_interval_ms: int = 1000
+    # linearizable read mode for region groups (SAFE: quorum heartbeat
+    # round per read batch; LEASE_BASED: trust the leader lease — the
+    # reference's ReadOnlyOption, surfaced here like RheaKVStoreOptions)
+    read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
 
 
 class StoreEngine:
@@ -164,6 +168,7 @@ class StoreEngine:
             initial_conf=Configuration.parse(",".join(region.peers)),
             fsm=fsm,
         )
+        opts.raft_options.read_only_option = self.opts.read_only_option
         if self.opts.data_path:
             base = (f"{self.opts.data_path}/"
                     f"{self.server_id.ip}_{self.server_id.port}/r{region.id}")
