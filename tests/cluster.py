@@ -157,11 +157,32 @@ class TestCluster:
             f"no leader in {timeout_s}s; states="
             f"{[(str(p), n.state.value) for p, n in self.nodes.items()]}")
 
-    async def apply_ok(self, node: Node, data: bytes, timeout_s: float = 5.0
-                       ) -> Status:
-        fut = asyncio.get_running_loop().create_future()
-        await node.apply(Task(data=data, done=lambda st: fut.set_result(st)))
-        return await asyncio.wait_for(fut, timeout_s)
+    async def apply_ok(self, node: Node, data: bytes, timeout_s: float = 5.0,
+                       retry: bool = True) -> Status:
+        """Apply `data` and wait for the commit ack. With retry=True (the
+        default), a not-leader/stepped-down rejection is retried through
+        the current leader (what a real client does via RouteTable
+        refresh) — tests asserting the rejection itself pass retry=False."""
+        from tpuraft.errors import RaftError
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            fut = asyncio.get_running_loop().create_future()
+            await node.apply(Task(data=data, done=lambda st: fut.set_result(st)))
+            st = await asyncio.wait_for(
+                fut, max(0.1, deadline - time.monotonic()))
+            # Only EPERM (rejected at propose time, never appended) is safe
+            # to resubmit; ENEWLEADER means the entry was already appended
+            # and may yet commit — retrying would duplicate it.
+            if (st.is_ok() or not retry or st.raft_error != RaftError.EPERM
+                    or time.monotonic() >= deadline):
+                return st
+            await asyncio.sleep(0.05)
+            try:
+                node = await self.wait_leader(
+                    max(0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                return st
 
     async def wait_applied(self, count: int, timeout_s: float = 5.0,
                            nodes=None) -> None:

@@ -32,6 +32,12 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
 
 
+# Hard cap per async test so a protocol deadlock fails the one test loudly
+# instead of wedging the whole suite (first JAX compiles can take ~40s;
+# integration tests poll with 5s deadlines — 120s is comfortably above both).
+ASYNC_TEST_TIMEOUT_S = 120
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Minimal asyncio test support (pytest-asyncio is not in the image)."""
     func = pyfuncitem.obj
@@ -40,6 +46,10 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(func(**kwargs))
+
+        async def capped():
+            await asyncio.wait_for(func(**kwargs), ASYNC_TEST_TIMEOUT_S)
+
+        asyncio.run(capped())
         return True
     return None

@@ -45,7 +45,7 @@ async def test_apply_on_follower_rejected():
     await c.start_all()
     leader = await c.wait_leader()
     follower = next(n for n in c.nodes.values() if n is not leader)
-    st = await c.apply_ok(follower, b"nope")
+    st = await c.apply_ok(follower, b"nope", retry=False)
     assert not st.is_ok()
     assert st.raft_error == RaftError.EPERM
     await c.stop_all()
@@ -145,8 +145,16 @@ async def test_transfer_leadership():
     await c.start_all()
     leader = await c.wait_leader()
     await c.apply_ok(leader, b"x")
-    target = next(p for p in c.peers if p != leader.server_id)
-    st = await leader.transfer_leadership_to(target)
+    # re-resolve + retry: under suite load the leader can step down between
+    # the apply ack and the transfer call (EPERM "not leader")
+    st = Status.error(RaftError.EPERM)
+    for _ in range(3):
+        leader = await c.wait_leader()
+        target = next(p for p in c.peers if p != leader.server_id)
+        st = await leader.transfer_leadership_to(target)
+        if st.is_ok():
+            break
+        await asyncio.sleep(0.1)
     assert st.is_ok(), str(st)
     deadline = asyncio.get_running_loop().time() + 5
     while asyncio.get_running_loop().time() < deadline:
