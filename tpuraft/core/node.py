@@ -25,7 +25,15 @@ from tpuraft.core.ballot_box import BallotBox
 from tpuraft.core.fsm_caller import FSMCaller
 from tpuraft.core.replicator import Replicator, ReplicatorGroup
 from tpuraft.core.state_machine import StateMachine
-from tpuraft.entity import EMPTY_PEER, EntryType, LogEntry, LogId, PeerId, Task
+from tpuraft.entity import (
+    EMPTY_PEER,
+    ElectionPriority,
+    EntryType,
+    LogEntry,
+    LogId,
+    PeerId,
+    Task,
+)
 from tpuraft.errors import RaftError, Status
 from tpuraft.options import NodeOptions
 from tpuraft.rpc.messages import (
@@ -39,6 +47,7 @@ from tpuraft.rpc.messages import (
     TimeoutNowResponse,
 )
 from tpuraft.rpc.transport import RpcError
+from tpuraft.util import describer
 from tpuraft.storage.log_manager import LogManager
 from tpuraft.storage.log_storage import create_log_storage
 from tpuraft.storage.meta_storage import MemoryRaftMetaStorage, RaftMetaStorage
@@ -118,6 +127,13 @@ class Node:
         self._transfer_deadline: float = 0.0
         self._shutdown_event = asyncio.Event()
         self._wakeup_candidate: Optional[PeerId] = None
+        # priority election [1.3+] (reference: NodeImpl targetPriority /
+        # electionTimeoutCounter): a node whose priority is below the
+        # current target skips election rounds; the target decays after
+        # repeated skipped rounds so the group still converges when all
+        # high-priority nodes are dead
+        self.target_priority: int = ElectionPriority.DISABLED
+        self._election_round: int = 0
 
     # ======================================================================
     # lifecycle
@@ -173,6 +189,7 @@ class Node:
 
         self.ballot_box.update_conf(self.conf_entry.conf,
                                     self.conf_entry.old_conf)
+        self._refresh_target_priority()
 
         st = self.log_manager.check_consistency()
         if not st.is_ok():
@@ -205,6 +222,8 @@ class Node:
         LOG.info("%s initialized: term=%d conf=%s", self, self.current_term,
                  self.conf_entry.conf)
 
+        describer.register(self)
+
         # single-voter group elects itself immediately
         if (self.conf_entry.conf.peers == [self.server_id]
                 and self.conf_entry.old_conf.is_empty()):
@@ -234,6 +253,7 @@ class Node:
         await self.log_manager.shutdown()
         self.ballot_box.close()
         self._meta.shutdown()
+        describer.unregister(self)
         self.state = State.SHUTDOWN
         self._shutdown_event.set()
 
@@ -246,6 +266,33 @@ class Node:
 
     def get_leader_id(self) -> PeerId:
         return self.leader_id
+
+    def describe(self) -> str:
+        """Live-state text dump (reference [1.3+]: NodeImpl#describe)."""
+        lm = self.log_manager
+        lines = [
+            f"{self}:",
+            f"  state: {self.state.value}  term: {self.current_term}"
+            f"  leader: {self.leader_id}",
+            f"  conf: {self.conf_entry.conf}"
+            + (f"  old_conf: {self.conf_entry.old_conf}"
+               if not self.conf_entry.old_conf.is_empty() else ""),
+            f"  log: [{lm.first_log_index()}, {lm.last_log_index()}]"
+            f"  snapshot: {lm.last_snapshot_id()}",
+            f"  commit: {self.ballot_box.last_committed_index}"
+            f"  applied: {self.fsm_caller.last_applied_index}"
+            f"  pending: {self.ballot_box.pending_index}",
+            f"  target_priority: {self.target_priority}",
+        ]
+        rows = self.replicators.progress()
+        if rows:
+            lines.append("  replicators:")
+            for peer, next_index, matched in rows:
+                lines.append(
+                    f"    {peer}: next={next_index} matched={matched}")
+        if self.metrics.counters:
+            lines.append(f"  counters: {dict(self.metrics.counters)}")
+        return "\n".join(lines)
 
     def list_peers(self) -> list[PeerId]:
         return list(self.conf_entry.conf.peers)
@@ -357,6 +404,45 @@ class Node:
                 < self.options.election_timeout_ms
                 * self.options.raft_options.leader_lease_time_ratio / 1000.0)
 
+    # -- priority election [1.3+] ------------------------------------------
+
+    def _refresh_target_priority(self) -> None:
+        """Target = max priority among current voters (incl. self).
+        Reference: NodeImpl#getMaxPriorityOfNodes on conf / leader change."""
+        prios = [p.priority for p in
+                 set(self.conf_entry.conf.peers)
+                 | set(self.conf_entry.old_conf.peers)
+                 | {self.server_id}]
+        self.target_priority = max(prios) if prios else ElectionPriority.DISABLED
+        self._election_round = 0
+
+    def _allow_launch_election(self) -> bool:
+        """Gate an election round by priority (reference:
+        NodeImpl#allowLaunchElection).  Caller holds the lock."""
+        prio = self.server_id.priority
+        if prio == ElectionPriority.DISABLED:
+            return True
+        if prio == ElectionPriority.NOT_ELECTED:
+            LOG.debug("%s priority NOT_ELECTED: never starts elections", self)
+            return False
+        if prio >= self.target_priority:
+            self._election_round = 0
+            return True
+        self._election_round += 1
+        if self._election_round > 1:
+            # nobody higher won in time: decay the bar so the group
+            # still converges with all high-priority nodes dead
+            gap = max(self.options.raft_options.decay_priority_gap,
+                      self.target_priority // 5)
+            self.target_priority = max(ElectionPriority.MIN_VALUE,
+                                       self.target_priority - gap)
+            self._election_round = 0
+            LOG.info("%s decayed target priority to %d", self,
+                     self.target_priority)
+            if prio >= self.target_priority:
+                return True  # elect this round, not an extra timeout later
+        return False
+
     async def _handle_election_timeout(self) -> None:
         async with self._lock:
             if self.state != State.FOLLOWER:
@@ -364,6 +450,8 @@ class Node:
             if not self.conf_entry.contains(self.server_id):
                 return  # not a participant (e.g. learner or removed)
             if self._leader_lease_valid():
+                return
+            if not self._allow_launch_election():
                 return
             prev_leader = self.leader_id
             self.leader_id = EMPTY_PEER
@@ -535,6 +623,7 @@ class Node:
         self.state = State.FOLLOWER
         self.leader_id = new_leader
         self._last_leader_timestamp = time.monotonic()
+        self._refresh_target_priority()
         if term > self.current_term:
             self.current_term = term
             self.voted_for = EMPTY_PEER
@@ -706,6 +795,7 @@ class Node:
         if not last.conf.is_empty() and last.id.index > self.conf_entry.id.index:
             self.conf_entry = last
             self.ballot_box.update_conf(last.conf, last.old_conf)
+            self._refresh_target_priority()
 
     async def handle_timeout_now(self, req: TimeoutNowRequest
                                  ) -> TimeoutNowResponse:
@@ -911,6 +1001,7 @@ class _ConfigurationCtx:
             self.old_conf.copy() if in_joint else Configuration())
         node.ballot_box.update_conf(node.conf_entry.conf,
                                     node.conf_entry.old_conf)
+        node._refresh_target_priority()
         # new peers may now vote/commit; replicators for removed peers keep
         # running until the change commits
         node.replicators.wake_all()
@@ -935,6 +1026,7 @@ class _ConfigurationCtx:
                     last_id, self.new_conf.copy())
                 node.ballot_box.update_conf(node.conf_entry.conf,
                                             node.conf_entry.old_conf)
+                node._refresh_target_priority()
                 node.replicators.wake_all()
                 asyncio.ensure_future(
                     node._flush_and_self_commit(term, last_id.index))

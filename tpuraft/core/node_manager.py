@@ -73,6 +73,9 @@ class NodeManager:
         if reader is None:
             raise RpcError(Status.error(
                 RaftError.ENOENT, f"no file reader {request.reader_id}"))
-        data, eof = reader.read_file(request.filename, request.offset,
-                                     request.count)
+        count = request.count
+        throttle = getattr(reader, "throttle", None)
+        if throttle is not None:
+            count = await throttle.acquire_upto(count)
+        data, eof = reader.read_file(request.filename, request.offset, count)
         return GetFileResponse(eof=eof, data=data)

@@ -28,6 +28,7 @@ from tpuraft.storage.snapshot import (
     LocalSnapshotStorage,
     RemoteFileCopier,
     SnapshotReader,
+    ThroughputSnapshotThrottle,
     _MANIFEST,
     _decode_manifest,
 )
@@ -43,6 +44,17 @@ class SnapshotExecutor:
         self.last_snapshot_id = LogId(0, 0)
         self.installing = False
         self._saving = False
+        # one throttle for the whole node so concurrent installs share the
+        # byte budget; rebuilt if the configured rate changes
+        self._throttle: Optional[ThroughputSnapshotThrottle] = None
+        self._throttle_bps = 0
+
+    def _get_throttle(self) -> Optional[ThroughputSnapshotThrottle]:
+        bps = self._node.options.snapshot.throttle_bytes_per_sec
+        if bps != self._throttle_bps:
+            self._throttle = ThroughputSnapshotThrottle(bps) if bps > 0 else None
+            self._throttle_bps = bps
+        return self._throttle
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -142,7 +154,8 @@ class SnapshotExecutor:
         meta = reader.load_meta()
         if meta.last_included_index < replicator.next_index:
             return False  # snapshot too old to help
-        reader_id = node.node_manager.register_file_reader(_ChunkAdapter(reader))
+        reader_id = node.node_manager.register_file_reader(
+            _ChunkAdapter(reader, self._get_throttle()))
         try:
             req = InstallSnapshotRequest(
                 group_id=node.group_id,
@@ -152,10 +165,16 @@ class SnapshotExecutor:
                 meta=meta,
                 uri=f"remote://{node.server_id.endpoint}/{reader_id}",
             )
+            # the RPC stays open for the whole file copy: under a byte
+            # throttle that takes total_size/bps, so scale the timeout
+            # (2x for contention with other installs sharing the budget)
+            timeout_ms = node.options.election_timeout_ms * 10
+            if self._throttle is not None:
+                timeout_ms += int(
+                    reader.total_size() / self._throttle_bps * 2000)
             try:
                 resp: InstallSnapshotResponse = await node.transport.install_snapshot(
-                    peer.endpoint, req,
-                    timeout_ms=node.options.election_timeout_ms * 10)
+                    peer.endpoint, req, timeout_ms=timeout_ms)
             except RpcError as e:
                 LOG.warning("%s install_snapshot to %s failed: %s", node, peer, e)
                 return False
@@ -244,10 +263,13 @@ class SnapshotExecutor:
 
 class _ChunkAdapter:
     """Adapts SnapshotReader to the file-service read_file(name, off, count)
-    protocol (reference: FileService + SnapshotFileReader)."""
+    protocol (reference: FileService + SnapshotFileReader).  ``throttle``
+    (if set) is consulted by the file service before each chunk read."""
 
-    def __init__(self, reader: SnapshotReader):
+    def __init__(self, reader: SnapshotReader,
+                 throttle: Optional[ThroughputSnapshotThrottle] = None):
         self._reader = reader
+        self.throttle = throttle
 
     def read_file(self, name: str, offset: int, count: int):
         return self._reader.read_chunk(name, offset, count)

@@ -44,6 +44,10 @@ class RaftOptions:
     read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
     max_replicator_retry_times: int = 3
     step_down_when_vote_timedout: bool = True
+    # priority election [1.3+]: minimum amount the target priority decays
+    # by after a node skips consecutive election rounds (reference:
+    # RaftOptions#decayPriorityGap)
+    decay_priority_gap: int = 10
     # lease safety margin: leader lease = election_timeout * ratio
     leader_lease_time_ratio: float = 0.9
 

@@ -16,9 +16,11 @@ Layout::
 
 from __future__ import annotations
 
+import asyncio
 import os
 import shutil
 import struct
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Optional
@@ -26,6 +28,47 @@ from typing import Optional
 from tpuraft.rpc.messages import SnapshotMeta
 
 _MANIFEST = "__snapshot_meta"
+
+
+class ThroughputSnapshotThrottle:
+    """Byte-rate throttle for snapshot file copy.
+
+    Reference parity: ``core:storage/ThroughputSnapshotThrottle`` —
+    caps install-snapshot bandwidth so a bulk file copy can't starve
+    the log-replication traffic sharing the transport.  Token bucket
+    with a one-second burst capacity; the file service asks it how many
+    of the requested bytes may be served *now* and awaits the rest.
+    """
+
+    def __init__(self, bytes_per_sec: int, clock=time.monotonic):
+        assert bytes_per_sec > 0
+        self._rate = float(bytes_per_sec)
+        self._avail = float(bytes_per_sec)
+        self._clock = clock
+        self._last = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._avail = min(self._rate, self._avail + (now - self._last) * self._rate)
+        self._last = now
+
+    def throttled_by_throughput(self, n: int) -> int:
+        """Take up to ``n`` bytes from the bucket; returns the granted count."""
+        self._refill()
+        take = min(n, int(self._avail))
+        self._avail -= take
+        return take
+
+    async def acquire_upto(self, n: int) -> int:
+        """Await until at least one byte is available, then grant <= n."""
+        if n <= 0:
+            return 0
+        while True:
+            take = self.throttled_by_throughput(n)
+            if take > 0:
+                return take
+            # time until one byte refills (bounded for clock hiccups)
+            await asyncio.sleep(min(0.1, max(1.0 / self._rate, 1e-4)))
 
 
 @dataclass
@@ -122,6 +165,9 @@ class SnapshotReader:
 
     def list_files(self) -> list[str]:
         return [f.name for f in self._files]
+
+    def total_size(self) -> int:
+        return sum(f.size for f in self._files)
 
     def read_file(self, name: str) -> Optional[bytes]:
         rec = next((f for f in self._files if f.name == name), None)

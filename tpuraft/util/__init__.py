@@ -2,5 +2,6 @@
 
 from tpuraft.util.timer import RepeatedTimer
 from tpuraft.util.metrics import MetricRegistry
+from tpuraft.util import describer
 
-__all__ = ["RepeatedTimer", "MetricRegistry"]
+__all__ = ["RepeatedTimer", "MetricRegistry", "describer"]
