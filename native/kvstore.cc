@@ -1,0 +1,495 @@
+// tpuraft native KV storage engine.
+//
+// Reference parity: the role RocksDB (C++, via rocksdbjni) plays under
+// rhea:storage/RocksRawKVStore — the durable ordered-KV engine shared by
+// every RegionEngine of a process (SURVEY.md §3.2/§3.4).  Where the
+// reference leans on a general-purpose LSM, this engine is purpose-built
+// for RheaKV's access pattern — point ops + range scans from a
+// single-writer state-machine thread, with recovery bounded by a
+// checkpoint: an ordered in-memory table per column, a CRC-framed
+// write-ahead log for durability, and an atomic sorted checkpoint that
+// truncates the WAL when it grows past a threshold.
+//
+// Columns (fixed): 0=data 1=sequence 2=lock 3=meta.  Column semantics
+// (what a sequence/lock value means) live in the Python wrapper
+// (tpuraft/rheakv/native_store.py) — apply-time logic is single-threaded
+// through the raft state machine, so read-modify-write up there is safe.
+//
+// On-disk layout under the store dir:
+//   wal.log     repeated [ u32le len | u32le crc32(payload) | payload ]
+//               payload = 1+ ops: op(1) col(1) klen(4) key vlen(4) val
+//               op: 1=put 2=delete 3=delete_range(key=start, val=end)
+//               One record per write call -> each call is atomic; a torn
+//               tail (short frame or CRC mismatch) is dropped on replay.
+//   checkpoint  magic "TKV1" | per col: u32 count, (klen key vlen val)* |
+//               u32 crc32(everything after magic)
+//               written tmp+fsync+rename+dirsync, then the WAL truncates.
+//
+// Exposed as a C ABI for ctypes.  All returned buffers are malloc'd and
+// released with tkv_free.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <tuple>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+constexpr int kNumCols = 4;
+constexpr char kCkptMagic[4] = {'T', 'K', 'V', '1'};
+constexpr uint8_t kOpPut = 1;
+constexpr uint8_t kOpDelete = 2;
+constexpr uint8_t kOpDeleteRange = 3;
+constexpr int64_t kDefaultCkptWalBytes = 64LL << 20;
+
+uint32_t load_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+void put_u32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+uint32_t crc32_of(const void* data, size_t n) {
+  return static_cast<uint32_t>(
+      crc32(0L, static_cast<const Bytef*>(data), static_cast<uInt>(n)));
+}
+
+bool fsync_fd(int fd) { return fsync(fd) == 0; }
+
+bool fsync_dir(const std::string& dir) {
+  int fd = open(dir.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  bool ok = fsync(fd) == 0;
+  close(fd);
+  return ok;
+}
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+using Table = std::map<std::string, std::string>;
+
+struct Store {
+  std::mutex mu;
+  std::string dir;
+  Table cols[kNumCols];
+  int wal_fd = -1;
+  int64_t wal_bytes = 0;
+  bool sync = true;
+  int64_t ckpt_wal_bytes = kDefaultCkptWalBytes;
+
+  std::string wal_path() const { return dir + "/wal.log"; }
+  std::string ckpt_path() const { return dir + "/checkpoint"; }
+};
+
+// -- op encoding shared by WAL records and tkv_apply_batch ------------------
+
+// Validates and applies one op stream to the tables. Returns false on a
+// malformed stream (nothing about partial application matters to callers:
+// WAL replay treats malformed == torn tail, and tkv_apply_batch validates
+// before applying).
+bool parse_ops(const uint8_t* p, size_t n,
+               std::vector<std::tuple<uint8_t, uint8_t, std::string,
+                                      std::string>>* out) {
+  size_t off = 0;
+  while (off < n) {
+    if (off + 2 + 4 > n) return false;
+    uint8_t op = p[off], col = p[off + 1];
+    off += 2;
+    if (op < kOpPut || op > kOpDeleteRange || col >= kNumCols) return false;
+    uint32_t klen = load_u32(p + off);
+    off += 4;
+    if (off + klen + 4 > n) return false;
+    std::string key(reinterpret_cast<const char*>(p + off), klen);
+    off += klen;
+    uint32_t vlen = load_u32(p + off);
+    off += 4;
+    if (off + vlen > n) return false;
+    std::string val(reinterpret_cast<const char*>(p + off), vlen);
+    off += vlen;
+    out->emplace_back(op, col, std::move(key), std::move(val));
+  }
+  return true;
+}
+
+void apply_ops(Store* s,
+               const std::vector<std::tuple<uint8_t, uint8_t, std::string,
+                                            std::string>>& ops) {
+  for (const auto& [op, col, key, val] : ops) {
+    Table& t = s->cols[col];
+    switch (op) {
+      case kOpPut:
+        t[key] = val;
+        break;
+      case kOpDelete:
+        t.erase(key);
+        break;
+      case kOpDeleteRange: {
+        auto lo = key.empty() ? t.begin() : t.lower_bound(key);
+        auto hi = val.empty() ? t.end() : t.lower_bound(val);
+        t.erase(lo, hi);
+        break;
+      }
+    }
+  }
+}
+
+// -- WAL --------------------------------------------------------------------
+
+bool wal_append(Store* s, const uint8_t* payload, size_t n, std::string* err) {
+  std::string rec;
+  rec.reserve(8 + n);
+  put_u32(&rec, static_cast<uint32_t>(n));
+  put_u32(&rec, crc32_of(payload, n));
+  rec.append(reinterpret_cast<const char*>(payload), n);
+  const char* p = rec.data();
+  size_t left = rec.size();
+  while (left > 0) {
+    ssize_t w = write(s->wal_fd, p, left);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      *err = std::string("wal write: ") + strerror(errno);
+      return false;
+    }
+    p += w;
+    left -= static_cast<size_t>(w);
+  }
+  if (s->sync && !fsync_fd(s->wal_fd)) {
+    *err = std::string("wal fsync: ") + strerror(errno);
+    return false;
+  }
+  s->wal_bytes += static_cast<int64_t>(rec.size());
+  return true;
+}
+
+// Replays wal.log over the tables; stops cleanly at a torn tail.
+bool wal_replay(Store* s, std::string* err) {
+  FILE* f = fopen(s->wal_path().c_str(), "rb");
+  if (!f) {
+    if (errno == ENOENT) return true;
+    *err = std::string("wal open: ") + strerror(errno);
+    return false;
+  }
+  std::vector<uint8_t> buf;
+  int64_t valid_end = 0;
+  for (;;) {
+    uint8_t hdr[8];
+    if (fread(hdr, 1, 8, f) != 8) break;
+    uint32_t len = load_u32(hdr), crc = load_u32(hdr + 4);
+    buf.resize(len);
+    if (len > 0 && fread(buf.data(), 1, len, f) != len) break;
+    if (crc32_of(buf.data(), len) != crc) break;
+    std::vector<std::tuple<uint8_t, uint8_t, std::string, std::string>> ops;
+    if (!parse_ops(buf.data(), len, &ops)) break;
+    apply_ops(s, ops);
+    valid_end += 8 + static_cast<int64_t>(len);
+  }
+  fclose(f);
+  // drop the torn tail so future appends never sit after garbage
+  if (truncate(s->wal_path().c_str(), valid_end) != 0 && errno != ENOENT) {
+    *err = std::string("wal truncate: ") + strerror(errno);
+    return false;
+  }
+  s->wal_bytes = valid_end;
+  return true;
+}
+
+// -- checkpoint -------------------------------------------------------------
+
+bool ckpt_load(Store* s, std::string* err) {
+  FILE* f = fopen(s->ckpt_path().c_str(), "rb");
+  if (!f) {
+    if (errno == ENOENT) return true;
+    *err = std::string("checkpoint open: ") + strerror(errno);
+    return false;
+  }
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (size < 8) {
+    fclose(f);
+    *err = "checkpoint too short";
+    return false;
+  }
+  std::vector<uint8_t> blob(static_cast<size_t>(size));
+  bool read_ok = fread(blob.data(), 1, blob.size(), f) == blob.size();
+  fclose(f);
+  if (!read_ok || memcmp(blob.data(), kCkptMagic, 4) != 0) {
+    *err = "checkpoint magic/read failure";
+    return false;
+  }
+  size_t body_len = blob.size() - 8;
+  uint32_t want = load_u32(blob.data() + 4 + body_len);
+  if (crc32_of(blob.data() + 4, body_len) != want) {
+    *err = "checkpoint crc mismatch";
+    return false;
+  }
+  size_t off = 4;
+  for (int c = 0; c < kNumCols; ++c) {
+    if (off + 4 > 4 + body_len) { *err = "checkpoint truncated"; return false; }
+    uint32_t count = load_u32(blob.data() + off);
+    off += 4;
+    auto hint = s->cols[c].end();
+    for (uint32_t i = 0; i < count; ++i) {
+      if (off + 4 > 4 + body_len) { *err = "checkpoint truncated"; return false; }
+      uint32_t klen = load_u32(blob.data() + off);
+      off += 4;
+      if (off + klen + 4 > 4 + body_len) { *err = "checkpoint truncated"; return false; }
+      std::string key(reinterpret_cast<const char*>(blob.data() + off), klen);
+      off += klen;
+      uint32_t vlen = load_u32(blob.data() + off);
+      off += 4;
+      if (off + vlen > 4 + body_len) { *err = "checkpoint truncated"; return false; }
+      std::string val(reinterpret_cast<const char*>(blob.data() + off), vlen);
+      off += vlen;
+      // checkpoint is written in order: amortized O(1) insertion at end
+      hint = s->cols[c].emplace_hint(hint, std::move(key), std::move(val));
+    }
+  }
+  return true;
+}
+
+bool ckpt_write(Store* s, std::string* err) {
+  std::string body;
+  for (int c = 0; c < kNumCols; ++c) {
+    put_u32(&body, static_cast<uint32_t>(s->cols[c].size()));
+    for (const auto& [k, v] : s->cols[c]) {
+      put_u32(&body, static_cast<uint32_t>(k.size()));
+      body += k;
+      put_u32(&body, static_cast<uint32_t>(v.size()));
+      body += v;
+    }
+  }
+  std::string tmp = s->ckpt_path() + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) {
+    *err = std::string("checkpoint tmp open: ") + strerror(errno);
+    return false;
+  }
+  std::string blob(kCkptMagic, 4);
+  blob += body;
+  put_u32(&blob, crc32_of(body.data(), body.size()));
+  const char* p = blob.data();
+  size_t left = blob.size();
+  bool ok = true;
+  while (left > 0) {
+    ssize_t w = write(fd, p, left);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    p += w;
+    left -= static_cast<size_t>(w);
+  }
+  ok = ok && fsync_fd(fd);
+  close(fd);
+  if (!ok) {
+    *err = std::string("checkpoint write: ") + strerror(errno);
+    unlink(tmp.c_str());
+    return false;
+  }
+  if (rename(tmp.c_str(), s->ckpt_path().c_str()) != 0 ||
+      !fsync_dir(s->dir)) {
+    *err = std::string("checkpoint rename: ") + strerror(errno);
+    return false;
+  }
+  // the checkpoint now covers everything: restart the WAL
+  if (ftruncate(s->wal_fd, 0) != 0 ||
+      lseek(s->wal_fd, 0, SEEK_SET) < 0 ||
+      (s->sync && !fsync_fd(s->wal_fd))) {
+    *err = std::string("wal restart: ") + strerror(errno);
+    return false;
+  }
+  s->wal_bytes = 0;
+  return true;
+}
+
+bool maybe_ckpt(Store* s, std::string* err) {
+  if (s->ckpt_wal_bytes > 0 && s->wal_bytes >= s->ckpt_wal_bytes) {
+    return ckpt_write(s, err);
+  }
+  return true;
+}
+
+// One durable write: WAL first, then tables, then maybe checkpoint.
+bool do_write(Store* s, const uint8_t* payload, size_t n, std::string* err) {
+  std::vector<std::tuple<uint8_t, uint8_t, std::string, std::string>> ops;
+  if (!parse_ops(payload, n, &ops)) {
+    *err = "malformed op stream";
+    return false;
+  }
+  if (!wal_append(s, payload, n, err)) return false;
+  apply_ops(s, ops);
+  return maybe_ckpt(s, err);
+}
+
+uint8_t* copy_out(const std::string& data) {
+  uint8_t* out = static_cast<uint8_t*>(malloc(data.size() ? data.size() : 1));
+  if (out) memcpy(out, data.data(), data.size());
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tkv_open(const char* dir, int sync, int64_t ckpt_wal_bytes,
+               char* err, int errlen) {
+  auto s = std::make_unique<Store>();
+  s->dir = dir;
+  s->sync = sync != 0;
+  if (ckpt_wal_bytes > 0) s->ckpt_wal_bytes = ckpt_wal_bytes;
+  if (mkdir(dir, 0755) != 0 && errno != EEXIST) {
+    set_err(err, errlen, std::string("mkdir: ") + strerror(errno));
+    return nullptr;
+  }
+  std::string msg;
+  if (!ckpt_load(s.get(), &msg) || !wal_replay(s.get(), &msg)) {
+    set_err(err, errlen, msg);
+    return nullptr;
+  }
+  s->wal_fd = open(s->wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (s->wal_fd < 0) {
+    set_err(err, errlen, std::string("wal open: ") + strerror(errno));
+    return nullptr;
+  }
+  return s.release();
+}
+
+void tkv_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return;
+  if (s->wal_fd >= 0) close(s->wal_fd);
+  delete s;
+}
+
+void tkv_free(uint8_t* p) { free(p); }
+
+int tkv_apply_batch(void* h, const uint8_t* ops, int64_t len,
+                    char* err, int errlen) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string msg;
+  if (!do_write(s, ops, static_cast<size_t>(len), &msg)) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  return 0;
+}
+
+int64_t tkv_get(void* h, int col, const uint8_t* k, int64_t kl,
+                uint8_t** out) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  if (col < 0 || col >= kNumCols) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->cols[col].find(
+      std::string(reinterpret_cast<const char*>(k), kl));
+  if (it == s->cols[col].end()) return -1;
+  *out = copy_out(it->second);
+  return static_cast<int64_t>(it->second.size());
+}
+
+// Packed result: u32 count | repeated (u32 klen key [u32 vlen val]).
+// with_values=0 omits values. reverse=1 returns descending order.
+// limit<0 means unbounded.
+int64_t tkv_scan(void* h, int col, const uint8_t* start, int64_t sl,
+                 const uint8_t* end, int64_t el, int64_t limit,
+                 int with_values, int reverse, uint8_t** out) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  if (col < 0 || col >= kNumCols) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  Table& t = s->cols[col];
+  std::string skey(reinterpret_cast<const char*>(start), sl);
+  std::string ekey(reinterpret_cast<const char*>(end), el);
+  auto lo = skey.empty() ? t.begin() : t.lower_bound(skey);
+  auto hi = ekey.empty() ? t.end() : t.lower_bound(ekey);
+  std::string body;
+  uint32_t count = 0;
+  auto emit = [&](const Table::value_type& kv) {
+    put_u32(&body, static_cast<uint32_t>(kv.first.size()));
+    body += kv.first;
+    if (with_values) {
+      put_u32(&body, static_cast<uint32_t>(kv.second.size()));
+      body += kv.second;
+    }
+    ++count;
+  };
+  if (!reverse) {
+    for (auto it = lo; it != hi; ++it) {
+      if (limit >= 0 && count >= static_cast<uint64_t>(limit)) break;
+      emit(*it);
+    }
+  } else {
+    for (auto it = hi; it != lo;) {
+      --it;
+      if (limit >= 0 && count >= static_cast<uint64_t>(limit)) break;
+      emit(*it);
+    }
+  }
+  std::string packed;
+  packed.reserve(4 + body.size());
+  put_u32(&packed, count);
+  packed += body;
+  *out = copy_out(packed);
+  return static_cast<int64_t>(packed.size());
+}
+
+int64_t tkv_count_range(void* h, int col, const uint8_t* start, int64_t sl,
+                        const uint8_t* end, int64_t el) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  if (col < 0 || col >= kNumCols) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  Table& t = s->cols[col];
+  std::string skey(reinterpret_cast<const char*>(start), sl);
+  std::string ekey(reinterpret_cast<const char*>(end), el);
+  auto lo = skey.empty() ? t.begin() : t.lower_bound(skey);
+  auto hi = ekey.empty() ? t.end() : t.lower_bound(ekey);
+  return static_cast<int64_t>(std::distance(lo, hi));
+}
+
+int tkv_checkpoint(void* h, char* err, int errlen) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string msg;
+  if (!ckpt_write(s, &msg)) {
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  return 0;
+}
+
+int64_t tkv_wal_bytes(void* h) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->wal_bytes;
+}
+
+int64_t tkv_count(void* h, int col) {
+  auto* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  if (col < 0 || col >= kNumCols) return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  return static_cast<int64_t>(s->cols[col].size());
+}
+
+}  // extern "C"

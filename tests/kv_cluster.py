@@ -29,7 +29,10 @@ class KVTestCluster:
     def __init__(self, n_stores: int = 3, tmp_path=None,
                  regions: Optional[list[Region]] = None,
                  election_timeout_ms: int = 300,
-                 multi_raft_engine_factory=None):
+                 multi_raft_engine_factory=None,
+                 raw_store_factory=None):
+        # raw_store_factory: Callable[[endpoint], RawKVStore] — lets tests
+        # swap the memory store for the native C++ engine per store
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6000 + i}" for i in range(n_stores)]
         peers = list(self.endpoints)
@@ -43,6 +46,7 @@ class KVTestCluster:
         self.tmp_path = tmp_path
         self.election_timeout_ms = election_timeout_ms
         self.engine_factory = multi_raft_engine_factory
+        self.raw_store_factory = raw_store_factory
         self.stores: dict[str, StoreEngine] = {}
 
     async def start_all(self) -> None:
@@ -60,6 +64,9 @@ class KVTestCluster:
             data_path=str(self.tmp_path) if self.tmp_path else "",
             election_timeout_ms=self.election_timeout_ms,
         )
+        if self.raw_store_factory is not None:
+            opts.raw_store_factory = (
+                lambda ep=endpoint: self.raw_store_factory(ep))
         engine = self.engine_factory() if self.engine_factory else None
         store = StoreEngine(opts, server, transport, multi_raft_engine=engine)
         await store.start()

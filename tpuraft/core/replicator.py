@@ -297,6 +297,7 @@ class ReplicatorGroup:
     def stop_all(self) -> None:
         for r in self._replicators.values():
             r.stop()
+        self._replicators.clear()
 
     def progress(self) -> list[tuple[PeerId, int, bool]]:
         """Public snapshot of (peer, next_index, matched) for observability
@@ -304,7 +305,6 @@ class ReplicatorGroup:
         return sorted(((p, r.next_index, r._matched)
                        for p, r in self._replicators.items()),
                       key=lambda row: str(row[0]))
-        self._replicators.clear()
 
     def wake_all(self) -> None:
         for r in self._replicators.values():

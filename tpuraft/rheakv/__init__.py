@@ -27,4 +27,13 @@ __all__ = [
     "RegionEpoch",
     "StoreEngine",
     "StoreMeta",
+    "create_raw_kv_store",
 ]
+
+
+def create_raw_kv_store(uri: str) -> RawKVStore:
+    """SPI factory: ``memory://`` or ``native://<dir>`` (C++ engine).
+    Imported lazily so the memory path never touches ctypes."""
+    from tpuraft.rheakv.native_store import create_raw_kv_store as _create
+
+    return _create(uri)

@@ -111,6 +111,13 @@ class RawKVStore:
         for k, _ in self.scan(start, end, -1, return_value=False):
             self.delete(k)
 
+    def reset_range(self, start: bytes, end: bytes) -> None:
+        """Clear EVERY namespace (data, sequences, locks) in [start, end).
+        Snapshot load must be an exact state reset — merging would leave
+        post-snapshot sequence/lock keys behind and make log replay after
+        restart non-deterministic across replicas."""
+        raise NotImplementedError
+
     # -- sequences -----------------------------------------------------------
 
     def get_sequence(self, key: bytes, step: int) -> Sequence:
@@ -218,6 +225,12 @@ class MemoryRawKVStore(RawKVStore):
     def delete(self, key: bytes) -> None:
         if self._data.pop(key, None) is not None:
             self._dirty = True
+
+    def reset_range(self, start: bytes, end: bytes) -> None:
+        self.delete_range(start, end)
+        for d in (self._sequences, self._locks):
+            for k in [k for k in d if _in_range(k, start, end)]:
+                del d[k]
 
     # -- sequences -----------------------------------------------------------
 

@@ -153,8 +153,10 @@ class KVStoreStateMachine(StateMachine):
             self.region.start_key = saved.start_key
             self.region.end_key = saved.end_key
             self.region.epoch = saved.epoch
-        # clear our slice of the keyspace, then load
-        self.store.delete_range(self.region.start_key, self.region.end_key)
+        # exact state reset of our slice (data + sequences + locks), then
+        # load — merging would leave post-snapshot keys behind and make
+        # log replay after restart non-deterministic across replicas
+        self.store.reset_range(self.region.start_key, self.region.end_key)
         self.store.load_serialized(blob)
         return True
 

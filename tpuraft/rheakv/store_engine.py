@@ -97,6 +97,9 @@ class StoreEngine:
         self._regions.clear()
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.shutdown()
+        close = getattr(self.raw_store, "close", None)
+        if close is not None:
+            close()  # native engine: flush + release the WAL fd
 
     # -- PD heartbeats -------------------------------------------------------
 
