@@ -90,6 +90,7 @@ struct Store {
   int64_t wal_bytes = 0;
   bool sync = true;
   int64_t ckpt_wal_bytes = kDefaultCkptWalBytes;
+  int64_t ckpt_retry_floor = 0;  // backoff marker after a failed auto-ckpt
 
   std::string wal_path() const { return dir + "/wal.log"; }
   std::string ckpt_path() const { return dir + "/checkpoint"; }
@@ -183,12 +184,26 @@ bool wal_replay(Store* s, std::string* err) {
     *err = std::string("wal open: ") + strerror(errno);
     return false;
   }
+  fseek(f, 0, SEEK_END);
+  int64_t file_size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (file_size < 0) {
+    // can't size the file: a bookkeeping failure must not become data
+    // loss via the truncate below
+    fclose(f);
+    *err = std::string("wal size probe: ") + strerror(errno);
+    return false;
+  }
   std::vector<uint8_t> buf;
   int64_t valid_end = 0;
   for (;;) {
     uint8_t hdr[8];
     if (fread(hdr, 1, 8, f) != 8) break;
     uint32_t len = load_u32(hdr), crc = load_u32(hdr + 4);
+    // The header is not self-checksummed: clamp the length field against
+    // the bytes actually present so a corrupted tail can't trigger a
+    // giant allocation — anything oversized is by definition torn.
+    if (static_cast<int64_t>(len) > file_size - valid_end - 8) break;
     buf.resize(len);
     if (len > 0 && fread(buf.data(), 1, len, f) != len) break;
     if (crc32_of(buf.data(), len) != crc) break;
@@ -279,21 +294,28 @@ bool ckpt_write(Store* s, std::string* err) {
     *err = std::string("checkpoint tmp open: ") + strerror(errno);
     return false;
   }
-  std::string blob(kCkptMagic, 4);
-  blob += body;
-  put_u32(&blob, crc32_of(body.data(), body.size()));
-  const char* p = blob.data();
-  size_t left = blob.size();
+  // Stream magic, body, CRC trailer — no concatenated second copy of the
+  // dataset while the store mutex is held.
+  char trailer[4];
+  uint32_t crc = crc32_of(body.data(), body.size());
+  memcpy(trailer, &crc, 4);  // native-endian, matching load_u32
+  const std::pair<const char*, size_t> parts[] = {
+      {kCkptMagic, 4}, {body.data(), body.size()}, {trailer, 4}};
   bool ok = true;
-  while (left > 0) {
-    ssize_t w = write(fd, p, left);
-    if (w < 0) {
-      if (errno == EINTR) continue;
-      ok = false;
-      break;
+  for (const auto& [p0, n0] : parts) {
+    const char* p = p0;
+    size_t left = n0;
+    while (ok && left > 0) {
+      ssize_t w = write(fd, p, left);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        ok = false;
+        break;
+      }
+      p += w;
+      left -= static_cast<size_t>(w);
     }
-    p += w;
-    left -= static_cast<size_t>(w);
+    if (!ok) break;
   }
   ok = ok && fsync_fd(fd);
   close(fd);
@@ -315,14 +337,28 @@ bool ckpt_write(Store* s, std::string* err) {
     return false;
   }
   s->wal_bytes = 0;
+  s->ckpt_retry_floor = 0;  // any successful checkpoint clears the backoff
   return true;
 }
 
-bool maybe_ckpt(Store* s, std::string* err) {
-  if (s->ckpt_wal_bytes > 0 && s->wal_bytes >= s->ckpt_wal_bytes) {
-    return ckpt_write(s, err);
+// Auto-checkpoint if the WAL has grown past the threshold.  A checkpoint
+// failure is NOT a write failure: by this point the op is fsynced in the
+// WAL and applied, so it must be reported durable.  Replay is idempotent
+// (put/delete/delete-range), so even a rename-then-truncate-failed half
+// checkpoint recovers correctly.  On failure, back off: don't retry the
+// full O(n) serialization until the WAL grows by another threshold.
+void maybe_ckpt(Store* s) {
+  if (s->ckpt_wal_bytes <= 0 || s->wal_bytes < s->ckpt_wal_bytes) return;
+  if (s->wal_bytes < s->ckpt_retry_floor) return;
+  std::string cerr;
+  if (!ckpt_write(s, &cerr)) {
+    s->ckpt_retry_floor = s->wal_bytes + s->ckpt_wal_bytes;
+    fprintf(stderr, "tpuraft-kvstore: auto-checkpoint failed (%s); "
+            "will retry after %lld more WAL bytes\n",
+            cerr.c_str(), static_cast<long long>(s->ckpt_wal_bytes));
+  } else {
+    s->ckpt_retry_floor = 0;
   }
-  return true;
 }
 
 // One durable write: WAL first, then tables, then maybe checkpoint.
@@ -334,7 +370,8 @@ bool do_write(Store* s, const uint8_t* payload, size_t n, std::string* err) {
   }
   if (!wal_append(s, payload, n, err)) return false;
   apply_ops(s, ops);
-  return maybe_ckpt(s, err);
+  maybe_ckpt(s);
+  return true;
 }
 
 uint8_t* copy_out(const std::string& data) {

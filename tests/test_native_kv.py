@@ -160,6 +160,30 @@ def test_torn_wal_tail_dropped(tmp_path):
     s.close()
 
 
+def test_oversized_wal_length_field_dropped(tmp_path):
+    """A corrupted header whose length field reads huge must be treated
+    as a torn tail (the header is not self-checksummed) — not trigger a
+    multi-GB allocation that aborts the reopening process."""
+    path = str(tmp_path / "kv")
+    s = NativeRawKVStore(path)
+    s.put(b"good", b"1")
+    s.close()
+    wal = os.path.join(path, "wal.log")
+    blob = open(wal, "rb").read()
+    # append a frame claiming 0xFFFFFFF0 payload bytes
+    open(wal, "ab").write(struct.pack("=II", 0xFFFFFFF0, 0xDEADBEEF))
+    s = NativeRawKVStore(path)
+    assert s.get(b"good") == b"1"
+    s.close()
+    assert os.path.getsize(wal) == len(blob)  # bogus frame truncated away
+    s = NativeRawKVStore(path)
+    s.put(b"new", b"2")
+    s.close()
+    s = NativeRawKVStore(path)
+    assert s.get(b"new") == b"2"
+    s.close()
+
+
 def test_kill9_mid_write_recovers(tmp_path):
     """The reference's durability contract: kill -9 a writer mid-stream,
     reopen, and the surviving prefix is contiguous and uncorrupted."""

@@ -298,3 +298,60 @@ async def test_split_too_small_rejected():
         await leader.raft_store.put(b"only", b"one")
         st = await leader.store_engine.apply_split(1, 2)
         assert not st.is_ok()
+
+
+def test_metrics_raw_kv_store_forwards_everything():
+    """The latency decorator (reference: MetricsRawKVStore) must forward
+    every op — including reset_range, which snapshot install calls, and
+    the batch ops whose base-class defaults would shadow an inner store's
+    specialized implementations — while recording timings."""
+    from tpuraft.rheakv.raw_store import MetricsRawKVStore
+    from tpuraft.util.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    inner = MemoryRawKVStore()
+    s = MetricsRawKVStore(inner, reg)
+
+    s.put(b"a", b"1")
+    s.put_list([(b"b", b"2"), (b"c", b"3")])
+    assert s.get(b"a") == b"1"
+    assert s.multi_get([b"a", b"zz"]) == {b"a": b"1", b"zz": None}
+    assert s.contains_key(b"b")
+    assert s.compare_and_put(b"a", b"1", b"9")
+    s.merge(b"m", b"x")
+    assert [k for k, _ in s.scan(b"", b"")] == [b"a", b"b", b"c", b"m"]
+    blob = s.serialize_range(b"", b"")
+
+    # snapshot install path: reset_range + load_serialized through the
+    # decorator must hit the inner store, not the abstract base
+    s.delete_range(b"a", b"c")
+    s.reset_range(b"", b"")
+    assert s.scan(b"", b"") == []
+    s.load_serialized(blob)
+    assert s.get(b"a") == b"9"
+    assert inner.get(b"m") == b"x"  # merged once before serialize
+
+    snap = reg.snapshot()
+    for op in ("kv_put", "kv_get", "kv_multi_get", "kv_reset_range",
+               "kv_serialize_range", "kv_load_serialized"):
+        assert op in snap["histograms"], op
+
+
+def test_store_engine_kv_metrics_option():
+    from tpuraft.rheakv.raw_store import MetricsRawKVStore
+    from tpuraft.rheakv.store_engine import StoreEngineOptions
+
+    opts = StoreEngineOptions(server_id="127.0.0.1:9001",
+                              enable_kv_metrics=True)
+    # constructing the engine wraps the raw store in the decorator
+    from tpuraft.core.node_manager import NodeManager  # noqa: F401
+    from tpuraft.rheakv.store_engine import StoreEngine
+    from tpuraft.rpc.transport import InProcNetwork, RpcServer
+
+    net = InProcNetwork()
+    server = RpcServer("127.0.0.1:9001")
+    net.bind(server)
+    se = StoreEngine(opts, server, net)
+    assert isinstance(se.raw_store, MetricsRawKVStore)
+    se.raw_store.put(b"k", b"v")
+    assert "kv_put" in se.metrics.snapshot()["histograms"]

@@ -338,55 +338,37 @@ class MemoryRawKVStore(RawKVStore):
 
 
 class MetricsRawKVStore(RawKVStore):
-    """Latency/ops decorator (reference: ``rhea:storage/MetricsRawKVStore``)."""
+    """Latency/ops decorator (reference: ``rhea:storage/MetricsRawKVStore``).
+
+    Forwarders are generated from the inner store's public callables at
+    construction time (instance attributes shadow the abstract base-class
+    methods), so new ``RawKVStore`` methods — and any specialized batch
+    implementations a concrete store adds — forward automatically and get
+    a ``kv_<op>`` timing histogram without hand-written boilerplate.
+    """
 
     def __init__(self, inner: RawKVStore, metrics) -> None:
         self._inner = inner
         self._metrics = metrics
+        for name in dir(inner):
+            if name.startswith("_"):
+                continue
+            attr = getattr(inner, name)
+            if callable(attr):
+                setattr(self, name, self._timed(name, attr))
 
-    def __getattr__(self, name: str):
-        attr = getattr(self._inner, name)
-        if not callable(attr):
-            return attr
-
+    def _timed(self, name: str, fn):
         def timed(*a, **kw):
             t0 = time.monotonic()
             try:
-                return attr(*a, **kw)
+                return fn(*a, **kw)
             finally:
-                self._metrics.timer_observe(
+                self._metrics.update(
                     f"kv_{name}", (time.monotonic() - t0) * 1000.0)
 
         return timed
 
-    # route the abstract methods through __getattr__'s timing wrapper
-    def get(self, key):  # type: ignore[override]
-        return self.__getattr__("get")(key)
-
-    def put(self, key, value):  # type: ignore[override]
-        return self.__getattr__("put")(key, value)
-
-    def delete(self, key):  # type: ignore[override]
-        return self.__getattr__("delete")(key)
-
-    def scan(self, start, end, limit=-1, return_value=True):  # type: ignore[override]
-        return self.__getattr__("scan")(start, end, limit, return_value)
-
-    def get_sequence(self, key, step):  # type: ignore[override]
-        return self.__getattr__("get_sequence")(key, step)
-
-    def reset_sequence(self, key):  # type: ignore[override]
-        return self.__getattr__("reset_sequence")(key)
-
-    def try_lock_with(self, key, locker_id, lease_ms, keep_lease):  # type: ignore[override]
-        return self.__getattr__("try_lock_with")(key, locker_id, lease_ms,
-                                                 keep_lease)
-
-    def release_lock(self, key, locker_id):  # type: ignore[override]
-        return self.__getattr__("release_lock")(key, locker_id)
-
-    def serialize_range(self, start, end):  # type: ignore[override]
-        return self.__getattr__("serialize_range")(start, end)
-
-    def load_serialized(self, blob):  # type: ignore[override]
-        return self.__getattr__("load_serialized")(blob)
+    def __getattr__(self, name: str):
+        # non-callable attributes and anything set on the inner store
+        # after construction
+        return getattr(self._inner, name)

@@ -28,7 +28,12 @@ from tpuraft.errors import RaftError, Status
 from tpuraft.options import NodeOptions, ReadOnlyOption, SnapshotOptions
 from tpuraft.rheakv.kv_service import KVCommandProcessor
 from tpuraft.rheakv.metadata import Region, StoreMeta
-from tpuraft.rheakv.raw_store import MemoryRawKVStore, RawKVStore
+from tpuraft.rheakv.raw_store import (
+    MemoryRawKVStore,
+    MetricsRawKVStore,
+    RawKVStore,
+)
+from tpuraft.util.metrics import MetricRegistry
 from tpuraft.rheakv.region_engine import RegionEngine
 
 LOG = logging.getLogger(__name__)
@@ -51,6 +56,9 @@ class StoreEngineOptions:
     # round per read batch; LEASE_BASED: trust the leader lease — the
     # reference's ReadOnlyOption, surfaced here like RheaKVStoreOptions)
     read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
+    # wrap the raw store in the op-latency decorator (reference:
+    # MetricsRawKVStore, enabled by RheaKVStoreOptions metrics flags)
+    enable_kv_metrics: bool = False
 
 
 class StoreEngine:
@@ -64,7 +72,11 @@ class StoreEngine:
         self.node_manager = NodeManager(rpc_server)
         CliProcessors(self.node_manager)
         KVCommandProcessor(self)
-        self.raw_store: RawKVStore = opts.raw_store_factory()
+        self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
+        raw: RawKVStore = opts.raw_store_factory()
+        if opts.enable_kv_metrics:
+            raw = MetricsRawKVStore(raw, self.metrics)
+        self.raw_store: RawKVStore = raw
         self.multi_raft_engine = multi_raft_engine
         self.pd_client = pd_client
         self._regions: dict[int, RegionEngine] = {}
