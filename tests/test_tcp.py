@@ -25,8 +25,9 @@ def _rir(i: int) -> ReadIndexResponse:
     return ReadIndexResponse(index=i, success=True)
 
 
-async def _start_server() -> TcpRpcServer:
-    srv = TcpRpcServer("127.0.0.1:0")
+async def _start_server(server_cls=TcpRpcServer):
+    """Start an ephemeral-port server and pin its real endpoint."""
+    srv = server_cls("127.0.0.1:0")
     await srv.start()
     srv.endpoint = f"127.0.0.1:{srv.bound_port}"
     return srv
@@ -140,6 +141,9 @@ class TestTcpRpc:
 class TcpCluster:
     """3 full raft nodes over real TCP sockets on ephemeral ports."""
 
+    server_cls = TcpRpcServer
+    transport_cls = TcpTransport
+
     def __init__(self, tmp_path=None):
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
@@ -152,7 +156,7 @@ class TcpCluster:
     async def start(self, n: int) -> None:
         servers = []
         for _ in range(n):
-            servers.append(await _start_server())
+            servers.append(await _start_server(self.server_cls))
         self.peers = [PeerId.parse(s.endpoint) for s in servers]
         self.conf = Configuration(list(self.peers))
         for peer, srv in zip(self.peers, servers):
@@ -162,7 +166,7 @@ class TcpCluster:
         fsm = self.fsms.setdefault(peer, MockStateMachine())
         manager = NodeManager(srv)
         CliProcessors(manager)
-        transport = TcpTransport(endpoint=peer.endpoint)
+        transport = self.transport_cls(endpoint=peer.endpoint)
         opts = NodeOptions(election_timeout_ms=300,
                            initial_conf=self.conf.copy(), fsm=fsm)
         if self.tmp_path is not None:
@@ -188,7 +192,7 @@ class TcpCluster:
         await node.shutdown()
 
     async def restart(self, peer: PeerId) -> None:
-        srv = TcpRpcServer(peer.endpoint)
+        srv = self.server_cls(peer.endpoint)
         await srv.start()
         await self._boot(peer, srv)
 
