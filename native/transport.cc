@@ -32,6 +32,7 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -112,6 +113,10 @@ struct Ctx {
   std::map<int64_t, std::unique_ptr<Conn>> conns;
   std::map<std::string, int64_t> pool;   // outbound endpoint -> conn id
   std::map<int64_t, int> listeners;      // id -> listen fd
+  // listeners parked after a persistent accept error (e.g. EMFILE),
+  // re-armed once their deadline passes — avoids a level-triggered
+  // busy-spin while the condition lasts
+  std::map<int64_t, std::chrono::steady_clock::time_point> parked;
   std::deque<Event> events;
   std::thread io;
 
@@ -249,13 +254,21 @@ void handle_readable(Ctx* c, Conn* conn) {
 }
 
 void handle_accept(Ctx* c, int64_t listener_id, int lfd) {
-  (void)listener_id;
   for (;;) {
     sockaddr_in addr{};
     socklen_t alen = sizeof(addr);
     int fd = accept4(lfd, reinterpret_cast<sockaddr*>(&addr), &alen,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
-    if (fd < 0) return;  // EAGAIN or transient error: next epoll round
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      // persistent failure (EMFILE/ENFILE/...): park the listener so
+      // level-triggered epoll doesn't busy-spin while it lasts
+      epoll_ctl(c->ep, EPOLL_CTL_DEL, lfd, nullptr);
+      c->parked[listener_id] =
+          std::chrono::steady_clock::now() + std::chrono::milliseconds(100);
+      return;
+    }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     auto conn = std::make_unique<Conn>();
@@ -279,9 +292,32 @@ void handle_accept(Ctx* c, int64_t listener_id, int lfd) {
 void io_loop(Ctx* c) {
   epoll_event evs[64];
   for (;;) {
-    int n = epoll_wait(c->ep, evs, 64, 1000);
+    int timeout_ms = 1000;
+    {
+      std::lock_guard<std::mutex> g0(c->mu);
+      if (!c->parked.empty()) timeout_ms = 50;
+    }
+    int n = epoll_wait(c->ep, evs, 64, timeout_ms);
     std::lock_guard<std::mutex> g(c->mu);
     if (c->stopping) return;
+    // re-arm listeners parked after persistent accept errors
+    if (!c->parked.empty()) {
+      auto now = std::chrono::steady_clock::now();
+      for (auto it = c->parked.begin(); it != c->parked.end();) {
+        if (now < it->second) {
+          ++it;
+          continue;
+        }
+        auto lit = c->listeners.find(it->first);
+        if (lit != c->listeners.end()) {
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.u64 = static_cast<uint64_t>(it->first);
+          epoll_ctl(c->ep, EPOLL_CTL_ADD, lit->second, &ev);
+        }
+        it = c->parked.erase(it);
+      }
+    }
     for (int i = 0; i < n; ++i) {
       uint64_t id64 = evs[i].data.u64;
       if (id64 == 0) {  // wakeup eventfd
@@ -467,15 +503,7 @@ int64_t tnt_send_to(void* h, const char* endpoint, uint64_t seq,
     set_err(err, errlen, "endpoint must be host:port");
     return -1;
   }
-  // resolve outside the lock (may hit DNS)
-  sockaddr_in addr;
-  std::string emsg;
-  if (!resolve(ep.substr(0, colon), atoi(ep.c_str() + colon + 1), &addr,
-               &emsg)) {
-    set_err(err, errlen, emsg);
-    return -1;
-  }
-  std::lock_guard<std::mutex> g(c->mu);
+  std::unique_lock<std::mutex> g(c->mu);
   auto pit = c->pool.find(ep);
   Conn* conn = nullptr;
   if (pit != c->pool.end()) {
@@ -483,6 +511,34 @@ int64_t tnt_send_to(void* h, const char* endpoint, uint64_t seq,
     if (it != c->conns.end()) conn = it->second.get();
   }
   if (conn == nullptr) {
+    // resolve only on new-connection creation, outside the lock (may
+    // hit DNS; the pooled fast path above never pays for it)
+    g.unlock();
+    sockaddr_in addr;
+    std::string emsg;
+    if (!resolve(ep.substr(0, colon), atoi(ep.c_str() + colon + 1), &addr,
+                 &emsg)) {
+      set_err(err, errlen, emsg);
+      return -1;
+    }
+    g.lock();
+    // another caller may have created the connection meanwhile
+    pit = c->pool.find(ep);
+    if (pit != c->pool.end()) {
+      auto it = c->conns.find(pit->second);
+      if (it != c->conns.end()) conn = it->second.get();
+    }
+    if (conn != nullptr) {
+      conn->wq.push_back(frame(seq, flags, payload, len));
+      if (!conn->want_write) {
+        conn->want_write = true;
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u64 = static_cast<uint64_t>(conn->id);
+        epoll_ctl(c->ep, EPOLL_CTL_MOD, conn->fd, &ev);
+      }
+      return conn->id;
+    }
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) {
       set_err(err, errlen, std::string("socket: ") + strerror(errno));

@@ -4,6 +4,7 @@ partitions, leadership transfer, membership change, linearizable reads.
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -313,4 +314,145 @@ async def test_expected_term_guard():
                             expected_term=leader.current_term + 5))
     st = await fut
     assert not st.is_ok()
+    await c.stop_all()
+
+
+async def test_change_peers_joint_consensus():
+    """Arbitrary membership change (reference: NodeTest changePeers):
+    {a,b,c} -> {a,d,e} goes through joint consensus; the new majority
+    carries writes, the removed peers are gone from the conf."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(3):
+        await c.apply_ok(leader, b"pre%d" % i)
+    await c.wait_applied(3)
+
+    from tpuraft.conf import Configuration
+
+    d = PeerId.parse("127.0.0.1:5003")
+    e = PeerId.parse("127.0.0.1:5004")
+    save = c.conf
+    c.conf = Configuration()  # joiners start empty, learn via replication
+    c.peers.extend([d, e])
+    await c.start(d)
+    await c.start(e)
+    c.conf = save
+
+    new_conf = Configuration([leader.server_id, d, e])
+    st = await asyncio.wait_for(leader.change_peers(new_conf), 15)
+    assert st.is_ok(), str(st)
+    assert set(leader.list_peers()) == {leader.server_id, d, e}
+
+    st = await c.apply_ok(leader, b"post")
+    assert st.is_ok(), str(st)
+    await c.wait_applied(4, nodes=[c.nodes[d], c.nodes[e]])
+    assert c.fsms[d].logs == [b"pre0", b"pre1", b"pre2", b"post"]
+    # removed voters are no longer in the committed conf
+    removed = [p for p in save.peers if p != leader.server_id]
+    for p in removed:
+        assert p not in leader.list_peers()
+    await c.stop_all()
+
+
+async def test_reset_peers_recovers_lost_quorum():
+    """Unsafe manual reset when a majority is permanently dead
+    (reference: NodeTest resetPeers): the survivor, told it is now a
+    single-voter group, elects itself and serves writes again."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+    await c.apply_ok(leader, b"before")
+    await c.wait_applied(1)
+    # kill both followers: quorum permanently lost
+    followers = [p for p in c.peers if p != leader.server_id]
+    for p in followers:
+        await c.stop(p)
+    # a write cannot commit now
+    fut = asyncio.get_running_loop().create_future()
+    await leader.apply(Task(data=b"stuck", done=lambda s: fut.set_result(s)))
+    from tpuraft.conf import Configuration
+
+    st = await asyncio.wait_for(
+        leader.reset_peers(Configuration([leader.server_id])), 5)
+    assert st.is_ok(), str(st)
+    # it re-elects itself as the sole voter and accepts writes
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and leader.state != State.LEADER:
+        await asyncio.sleep(0.02)
+    assert leader.state == State.LEADER
+    st = await c.apply_ok(leader, b"after-reset")
+    assert st.is_ok(), str(st)
+    await c.stop_all()
+
+
+async def test_chaos_rolling_crashes_converge():
+    """Chaos tier (reference: rheakv ChaosTest-style): sustained client
+    load while nodes crash and restart one at a time; at the end all
+    replicas converge to identical, gap-free, duplicate-free logs."""
+    import random
+
+    rng = random.Random(7)
+    c = TestCluster(3, election_timeout_ms=150)
+    await c.start_all()
+    await c.wait_leader()
+
+    applied: list[bytes] = []
+    stop_writer = asyncio.Event()
+
+    async def writer():
+        # unique payload per ATTEMPT: an attempt whose ack timed out may
+        # still have committed, so reusing its payload on retry would
+        # legitimately commit the same bytes twice and break the
+        # exactly-once assertion below
+        attempt = 0
+        while not stop_writer.is_set():
+            data = b"chaos-%d" % attempt
+            attempt += 1
+            try:
+                leader = await c.wait_leader(3.0)
+                st = await c.apply_ok(leader, data, timeout_s=3.0)
+                if st.is_ok():
+                    applied.append(data)
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0)
+
+    wtask = asyncio.ensure_future(writer())
+    try:
+        for _round in range(4):
+            await asyncio.sleep(0.3)
+            victim = rng.choice(c.peers)
+            if victim not in c.nodes:
+                continue
+            await c.stop(victim)
+            await asyncio.sleep(0.3)
+            # memory:// log: the node rejoins empty and is re-replicated
+            # from scratch, so give it a fresh FSM recorder too
+            await c.start(victim, fsm=MockStateMachine())
+    finally:
+        stop_writer.set()
+        await wtask
+
+    assert len(applied) > 10, f"only {len(applied)} writes survived chaos"
+    # quiesce: every replica must contain every acked write (a raw count
+    # would under-wait, since logs also hold timed-out-but-committed
+    # attempts)
+    acked_set = set(applied)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if all(acked_set <= set(c.fsms[p].logs) for p in c.peers):
+            break
+        await asyncio.sleep(0.05)
+    logs = {str(p): c.fsms[p].logs for p in c.peers}
+    reference_log = None
+    for p, log in logs.items():
+        acked = [x for x in log if x in acked_set]
+        # every acked write appears exactly once, in order
+        assert acked == applied, (
+            f"{p}: {len(acked)} acked in log vs {len(applied)} acked")
+        if reference_log is None:
+            reference_log = log
+        else:
+            assert log == reference_log, f"{p} diverged"
     await c.stop_all()

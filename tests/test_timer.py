@@ -1,0 +1,76 @@
+"""RepeatedTimer unit tests (reference: test:util/RepeatedTimerTest —
+SURVEY.md §5 "Pure unit")."""
+
+import asyncio
+
+import pytest
+
+from tpuraft.util.timer import RepeatedTimer
+
+
+@pytest.mark.asyncio
+async def test_fires_repeatedly_and_stops():
+    fires = []
+    t = RepeatedTimer("t", 10, lambda: _record(fires))
+    t.start()
+    await asyncio.sleep(0.08)
+    t.stop()
+    count = len(fires)
+    assert count >= 3
+    await asyncio.sleep(0.05)
+    assert len(fires) == count  # no fires after stop
+
+
+async def _record(lst):
+    lst.append(1)
+
+
+@pytest.mark.asyncio
+async def test_stop_from_within_handler_does_not_kill_handler():
+    """Regression: a handler stopping its OWN timer (the way _elect_self
+    stops the election timer that fired it) must finish executing — the
+    old implementation cancelled the in-flight task, silently killing
+    the handler at its next await point."""
+    done = asyncio.Event()
+    t = None
+
+    async def handler():
+        t.stop()
+        await asyncio.sleep(0)  # the await the cancel used to land on
+        done.set()
+
+    t = RepeatedTimer("self-stop", 10, handler)
+    t.start()
+    await asyncio.wait_for(done.wait(), 2.0)
+    assert not t.running
+
+
+@pytest.mark.asyncio
+async def test_restart_from_within_handler_single_generation():
+    """A restart() from inside the handler must not double-schedule:
+    only the fresh generation keeps firing."""
+    fires = []
+    t = None
+
+    async def handler():
+        fires.append(1)
+        if len(fires) == 1:
+            t.restart()
+        if len(fires) >= 4:
+            t.stop()
+
+    t = RepeatedTimer("restart", 10, handler)
+    t.start()
+    await asyncio.sleep(0.25)
+    count = len(fires)
+    assert count >= 4
+    await asyncio.sleep(0.1)
+    # stopped, and no runaway extra generation kept firing
+    assert len(fires) == count
+
+
+@pytest.mark.asyncio
+async def test_random_adjust_bounds():
+    for _ in range(100):
+        v = RepeatedTimer.random_adjust(100)
+        assert 100 <= v < 200

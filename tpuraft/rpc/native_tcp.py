@@ -24,7 +24,7 @@ import threading
 from typing import Any, Callable, Optional
 
 from tpuraft.errors import RaftError, Status
-from tpuraft.rpc.messages import ErrorResponse, decode_message, encode_message
+from tpuraft.rpc.messages import decode_message, encode_message
 from tpuraft.rpc.transport import RpcError, RpcServer, TransportBase
 
 LOG = logging.getLogger(__name__)
@@ -240,28 +240,8 @@ class NativeTcpRpcServer(RpcServer):
 
     async def _serve_one(self, conn_id: int, seq: int,
                          payload: bytes) -> None:
-        flags = _F_RESPONSE
-        try:
-            (mlen,) = struct.unpack_from("<H", payload, 0)
-            method = payload[2:2 + mlen].decode()
-            request = decode_message(memoryview(payload)[2 + mlen:])
-            response = await self.dispatch(method, request)
-        except asyncio.CancelledError:
-            raise
-        except RpcError as e:
-            flags |= _F_ERROR
-            response = ErrorResponse(e.status.code, e.status.error_msg)
-        except Exception as e:  # noqa: BLE001 — handler bug must not kill
-            LOG.exception("rpc handler failed (seq=%d)", seq)
-            flags |= _F_ERROR
-            response = ErrorResponse(int(RaftError.EINTERNAL), repr(e))
-        try:
-            blob = encode_message(response)
-        except Exception as e:  # noqa: BLE001
-            flags |= _F_ERROR
-            blob = encode_message(
-                ErrorResponse(int(RaftError.EINTERNAL),
-                              f"unencodable response: {e!r}"))
+        flags, blob = await self.serve_framed_payload(
+            seq, payload, _F_RESPONSE, _F_ERROR)
         if self._ctx is not None:
             self._ctx.send_conn(conn_id, seq, flags, blob)
 

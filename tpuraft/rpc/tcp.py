@@ -133,28 +133,8 @@ class TcpRpcServer(RpcServer):
     async def _serve_one(self, seq: int, payload: bytes,
                          writer: asyncio.StreamWriter,
                          write_lock: asyncio.Lock) -> None:
-        flags = _F_RESPONSE
-        try:
-            (mlen,) = struct.unpack_from("<H", payload, 0)
-            method = payload[2:2 + mlen].decode()
-            request = decode_message(memoryview(payload)[2 + mlen:])
-            response = await self.dispatch(method, request)
-        except asyncio.CancelledError:
-            raise
-        except RpcError as e:
-            flags |= _F_ERROR
-            response = ErrorResponse(e.status.code, e.status.error_msg)
-        except Exception as e:  # noqa: BLE001 — handler bug must not kill conn
-            LOG.exception("rpc handler failed (seq=%d)", seq)
-            flags |= _F_ERROR
-            response = ErrorResponse(int(RaftError.EINTERNAL), repr(e))
-        try:
-            blob = encode_message(response)
-        except Exception as e:  # noqa: BLE001
-            flags |= _F_ERROR
-            blob = encode_message(
-                ErrorResponse(int(RaftError.EINTERNAL),
-                              f"unencodable response: {e!r}"))
+        flags, blob = await self.serve_framed_payload(
+            seq, payload, _F_RESPONSE, _F_ERROR)
         async with write_lock:
             try:
                 writer.write(_frame(seq, flags, blob))

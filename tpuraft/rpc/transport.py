@@ -47,6 +47,47 @@ class RpcServer:
             raise RpcError(Status.error(RaftError.EINTERNAL, f"no handler {method}"))
         return await h(request)
 
+    async def serve_framed_payload(self, seq: int, payload: bytes,
+                                   response_flag: int, error_flag: int
+                                   ) -> tuple[int, bytes]:
+        """Decode a wire request payload (u16 method_len | method |
+        message), dispatch it, and encode the response envelope.
+        Shared by every framed transport backend (asyncio TCP, native
+        epoll); returns (flags, encoded_response)."""
+        import logging
+        import struct
+
+        from tpuraft.rpc.messages import (
+            ErrorResponse,
+            decode_message,
+            encode_message,
+        )
+
+        flags = response_flag
+        try:
+            (mlen,) = struct.unpack_from("<H", payload, 0)
+            method = payload[2:2 + mlen].decode()
+            request = decode_message(memoryview(payload)[2 + mlen:])
+            response = await self.dispatch(method, request)
+        except asyncio.CancelledError:
+            raise
+        except RpcError as e:
+            flags |= error_flag
+            response = ErrorResponse(e.status.code, e.status.error_msg)
+        except Exception as e:  # noqa: BLE001 — handler bug must not kill conn
+            logging.getLogger(__name__).exception(
+                "rpc handler failed (seq=%d)", seq)
+            flags |= error_flag
+            response = ErrorResponse(int(RaftError.EINTERNAL), repr(e))
+        try:
+            blob = encode_message(response)
+        except Exception as e:  # noqa: BLE001
+            flags |= error_flag
+            blob = encode_message(
+                ErrorResponse(int(RaftError.EINTERNAL),
+                              f"unencodable response: {e!r}"))
+        return flags, blob
+
 
 class InProcNetwork:
     """Shared fabric for in-process transports; owns fault injection.

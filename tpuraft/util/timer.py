@@ -60,14 +60,21 @@ class RepeatedTimer:
             import logging
 
             logging.getLogger(__name__).exception("timer %s handler failed", self._name)
-        if not self._stopped and not self._destroyed:
+        # only the active generation reschedules: a restart() from inside
+        # the handler already created a fresh task
+        if (not self._stopped and not self._destroyed
+                and self._task is asyncio.current_task()):
             self._schedule()
 
     def stop(self) -> None:
         self._stopped = True
-        if self._task:
-            self._task.cancel()
-            self._task = None
+        task, self._task = self._task, None
+        # A handler may stop its own timer (e.g. _elect_self stopping the
+        # election timer that fired it).  Cancelling the current task
+        # would kill the handler at its next await — mark stopped instead;
+        # _run won't reschedule.
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
 
     def restart(self) -> None:
         self.stop()
