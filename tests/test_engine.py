@@ -157,3 +157,46 @@ async def test_tpu_ballot_box_membership_conf_sync():
         assert eng.voter_mask[slot].sum() == 2
     finally:
         await c.stop_all()
+
+
+async def test_engine_scale_64_groups():
+    """Multi-group scale tier (SURVEY.md §8 step 4: G in the thousands
+    per process; test-scale 64): 3 endpoints x 64 groups = 192 nodes in
+    one process, every endpoint batching all its groups' quorum math
+    through ONE engine tick plane. One write per group, all of them
+    committing through the batched [G, P] reduce."""
+    c = MultiRaftCluster(3, 64, election_timeout_ms=400, tick_ms=2)
+    await c.start_all()
+    try:
+        leaders = {}
+        for gid in c.groups:
+            leaders[gid] = await c.wait_leader(gid, timeout_s=20.0)
+
+        async def put(gid, leader):
+            fut = asyncio.get_running_loop().create_future()
+            await leader.apply(Task(data=b"w-" + gid.encode(),
+                                    done=lambda st: fut.set_result(st)))
+            return await asyncio.wait_for(fut, 10.0)
+
+        results = await asyncio.gather(
+            *[put(g, ld) for g, ld in leaders.items()])
+        assert all(st.is_ok() for st in results), \
+            [str(s) for s in results if not s.is_ok()][:3]
+
+        # every group's write must reach every replica's FSM
+        deadline = asyncio.get_running_loop().time() + 15.0
+        def done():
+            return all(len(c.fsms[(g, ep)].logs) >= 1
+                       for g in c.groups for ep in c.endpoints)
+        while asyncio.get_running_loop().time() < deadline and not done():
+            await asyncio.sleep(0.05)
+        assert done()
+        for g in c.groups:
+            for ep in c.endpoints:
+                assert c.fsms[(g, ep)].logs[-1] == b"w-" + g.encode()
+
+        # the commits actually flowed through the batched device plane
+        total_advances = sum(e.commit_advances for e in c.engines.values())
+        assert total_advances >= len(c.groups), total_advances
+    finally:
+        await c.stop_all()

@@ -138,3 +138,59 @@ async def test_client_lock_watchdog_renews_short_lease():
         await lock.unlock()
         assert await other.try_lock()
         await other.unlock()
+
+
+async def test_chaos_rolling_store_kills_no_acked_loss(tmp_path):
+    """Chaos tier (reference: rheakv ChaosTest): sustained client load
+    across two regions while stores are killed and restarted one at a
+    time.  Every acked put must be readable afterwards."""
+    import random
+
+    rng = random.Random(11)
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    async with kv_client_cluster(regions=regions, tmp_path=tmp_path) as (c, kv):
+        acked: dict[bytes, bytes] = {}
+        stop = asyncio.Event()
+
+        async def writer():
+            attempt = 0
+            while not stop.is_set():
+                # unique key per attempt: an attempt whose ack was lost
+                # may still have committed, which must not confuse the
+                # exactly-the-acked-set verification
+                side = b"a" if attempt % 2 == 0 else b"z"
+                k = side + b"-chaos-%06d" % attempt
+                v = b"v%d" % attempt
+                attempt += 1
+                try:
+                    if await asyncio.wait_for(kv.put(k, v), 3.0):
+                        acked[k] = v
+                except Exception:
+                    pass
+                await asyncio.sleep(0)
+
+        wtask = asyncio.ensure_future(writer())
+        try:
+            for _round in range(3):
+                await asyncio.sleep(0.4)
+                victim = rng.choice(c.endpoints)
+                if victim not in c.stores:
+                    continue
+                await c.stop_store(victim)
+                await asyncio.sleep(0.4)
+                await c.start_store(victim)
+        finally:
+            stop.set()
+            await wtask
+
+        assert len(acked) > 20, f"only {len(acked)} acked under chaos"
+        await c.wait_region_leader(1)
+        await c.wait_region_leader(2)
+        for k, v in acked.items():
+            got = await kv.get(k)
+            assert got == v, (k, got, v)
+        # range reads see every acked key too
+        rows = dict(await kv.scan(b"", b""))
+        for k, v in acked.items():
+            assert rows.get(k) == v, k

@@ -157,6 +157,7 @@ class Node:
             storage,
             sync=opts.raft_options.sync,
             max_flush_batch=opts.raft_options.max_entries_size,
+            max_logs_in_memory=opts.raft_options.max_logs_in_memory,
         )
         await self.log_manager.init()
 
@@ -734,9 +735,13 @@ class Node:
         async with self._lock:
             if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
                               State.UNINITIALIZED):
-                return AppendEntriesResponse(
-                    term=self.current_term, success=False,
-                    last_log_index=0)
+                # NOT a protocol response: a success=False/last=0 reply
+                # here reads as "my log is empty" and drives the leader
+                # into a full-speed probe livelock at next_index=1.  An
+                # RPC error takes the leader's paced-retry path instead.
+                raise RpcError(Status.error(
+                    RaftError.EHOSTDOWN, f"node not serviceable: "
+                    f"{self.state.value}"))
             if req.term < self.current_term:
                 return AppendEntriesResponse(
                     term=self.current_term, success=False,
@@ -767,9 +772,17 @@ class Node:
                         req.prev_log_index >= lm.first_log_index() - 1
                         and local_prev_term != req.prev_log_term
                         and req.prev_log_index != lm.last_snapshot_id().index):
+                    # term mismatch (not merely a short log): tell the
+                    # leader where our conflicting term run starts
+                    hint = 0
+                    if (req.prev_log_index <= lm.last_log_index()
+                            and local_prev_term != 0):
+                        hint = lm.conflict_hint(req.prev_log_index,
+                                                local_prev_term)
                     return AppendEntriesResponse(
                         term=self.current_term, success=False,
-                        last_log_index=lm.last_log_index())
+                        last_log_index=lm.last_log_index(),
+                        conflict_index=hint)
                 self.ballot_box.set_last_committed_index(
                     min(req.committed_index, req.prev_log_index))
                 return AppendEntriesResponse(

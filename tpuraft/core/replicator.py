@@ -89,10 +89,15 @@ class Replicator:
                     self._wake.clear()
                     waiter = lm.wait_for(self.next_index)
                     wake = asyncio.ensure_future(self._wake.wait())
-                    done, pending = await asyncio.wait(
-                        [waiter, wake], return_when=asyncio.FIRST_COMPLETED)
-                    for p in pending:
-                        p.cancel()
+                    try:
+                        await asyncio.wait(
+                            [waiter, wake],
+                            return_when=asyncio.FIRST_COMPLETED)
+                    finally:
+                        # also on cancellation, or the Event.wait task
+                        # outlives the replicator ("destroyed pending")
+                        waiter.cancel()
+                        wake.cancel()
                     continue
                 await self._send_entries()
         except asyncio.CancelledError:
@@ -110,8 +115,15 @@ class Replicator:
             self.next_index = lm.first_log_index() - 1 if lm.first_log_index() > 1 else 1
             return
         ropts = node.options.raft_options
-        entries = lm.get_entries(self.next_index, ropts.max_entries_size,
-                                 ropts.max_body_size)
+        # until the first successful probe, send EMPTY AppendEntries
+        # (reference: sendEmptyEntries): reading payload batches for a
+        # follower whose match point is unknown wastes a disk batch per
+        # backoff step on a diverged log
+        if self._matched:
+            entries = lm.get_entries(self.next_index, ropts.max_entries_size,
+                                     ropts.max_body_size)
+        else:
+            entries = []
         req = AppendEntriesRequest(
             group_id=node.group_id,
             server_id=str(node.server_id),
@@ -141,10 +153,20 @@ class Replicator:
                 resp.term, f"append_entries response from {self.peer}")
             return
         if not resp.success:
-            # log mismatch: back off using the follower's hint, re-probe
+            # log mismatch: back off using the follower's hints, re-probe.
+            # conflict_index (first index of the follower's conflicting
+            # term) skips a whole term run per round trip.
             self._matched = False
-            self.next_index = max(1, min(self.next_index - 1,
-                                         resp.last_log_index + 1))
+            before = self.next_index
+            candidates = [self.next_index - 1, resp.last_log_index + 1]
+            if resp.conflict_index > 0:
+                candidates.append(resp.conflict_index)
+            self.next_index = max(1, min(candidates))
+            if self.next_index == before:
+                # no progress (e.g. a follower that rejects everything):
+                # pace the probe loop instead of spinning at full speed
+                await asyncio.sleep(
+                    node.options.election_timeout_ms / 1000.0 / 20)
             return
         # success: follower's log matches through prev + entries
         # (reference: matchIndex = request.prevLogIndex + entriesCount)

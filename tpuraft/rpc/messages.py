@@ -101,6 +101,11 @@ class AppendEntriesResponse:
     term: int
     success: bool
     last_log_index: int  # hint for nextIndex backoff on rejection
+    # on a prev-term conflict: the first index of the follower's
+    # conflicting term, so the leader can skip the whole term run in one
+    # step instead of one-entry-per-RTT linear backoff (classic Raft §5.3
+    # fast-backoff optimization; 0 = no hint)
+    conflict_index: int = 0
 
 
 @dataclass

@@ -39,11 +39,16 @@ class LogManager:
         conf_manager: Optional[ConfigurationManager] = None,
         sync: bool = True,
         max_flush_batch: int = 256,
+        max_logs_in_memory: int = 1024,
     ):
         self._storage = storage
         self.conf_manager = conf_manager or ConfigurationManager()
         self._sync = sync
         self._max_flush_batch = max_flush_batch
+        # retained recent window beyond stability/apply, so replication to
+        # slightly-lagging followers is served from memory, not disk
+        # (reference: LogManagerImpl's logsInMemory / maxLogsInMemory)
+        self._max_in_memory = max_logs_in_memory
 
         self._mem: dict[int, LogEntry] = {}  # unstable + recent window
         self._first_index = 1
@@ -120,6 +125,27 @@ class LogManager:
             return self._last_snapshot_id.term
         e = self.get_entry(index)
         return e.id.term if e else 0
+
+    def conflict_hint(self, prev_index: int,
+                      prev_term: Optional[int] = None) -> int:
+        """Start index of the term run containing ``prev_index`` in OUR
+        log — returned to a leader whose prev-term probe mismatched, so
+        its next probe skips the conflicting term run (classic Raft
+        fast-backoff).  The walk only consults the in-memory window:
+        this runs under the node lock on the event loop, so it must
+        never fall through to storage reads.  A partial walk still
+        returns a correct (just less aggressive) probe point; 0 = no
+        hint."""
+        t = prev_term if prev_term is not None else self.get_term(prev_index)
+        if t == 0:
+            return 0
+        i = prev_index
+        while i - 1 >= self._first_index:
+            e = self._mem.get(i - 1)
+            if e is None or e.id.term != t:
+                break
+            i -= 1
+        return i
 
     def get_entries(self, from_index: int, max_count: int, max_bytes: int
                     ) -> list[LogEntry]:
@@ -356,10 +382,14 @@ class LogManager:
 
     def set_applied_index(self, index: int) -> None:
         self._applied_index = max(self._applied_index, index)
-        # trim the in-memory window: stable AND applied entries can be dropped
-        trim_to = min(self._applied_index, self._stable_index)
-        for i in [i for i in self._mem if i <= trim_to]:
-            del self._mem[i]
+        # trim the in-memory window: stable AND applied entries can be
+        # dropped, but keep the most recent max_logs_in_memory regardless
+        # so replication reads stay off disk in the steady state
+        trim_to = min(self._applied_index, self._stable_index,
+                      self._last_index - self._max_in_memory)
+        if trim_to >= self._first_index:
+            for i in [i for i in self._mem if i <= trim_to]:
+                del self._mem[i]
 
     # -- waiters (replicator wakeup) -----------------------------------------
 
