@@ -194,3 +194,59 @@ async def test_chaos_rolling_store_kills_no_acked_loss(tmp_path):
         rows = dict(await kv.scan(b"", b""))
         for k, v in acked.items():
             assert rows.get(k) == v, k
+
+
+async def test_client_side_batching_coalesces_rpcs():
+    """BatchingOptions (reference: rhea client Batching ring buffers):
+    concurrent put/get calls issued in one loop iteration coalesce into
+    per-region put_list/multi_get RPCs, preserving per-call results."""
+    from tpuraft.rheakv.client import BatchingOptions, RheaKVStore
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    c = KVTestCluster(3, regions=regions)
+    await c.start_all()
+    pd = FakePlacementDriverClient(c.region_template)
+    pd._regions = {r.id: r.copy() for s in [next(iter(c.stores.values()))]
+                   for r in s.list_regions()}
+    transport = c.client_transport()
+    calls = []
+    orig_call = transport.call
+
+    async def counting_call(dst, method, req, timeout_ms=None):
+        calls.append(method)
+        return await orig_call(dst, method, req, timeout_ms)
+
+    transport.call = counting_call
+    kv = RheaKVStore(pd, transport,
+                     batching=BatchingOptions(enabled=True))
+    await kv.start()
+    try:
+        for rid in (1, 2):
+            await c.wait_region_leader(rid)
+        n0 = len(calls)
+        oks = await asyncio.gather(
+            *[kv.put(b"a%03d" % i, b"v%d" % i) for i in range(20)],
+            *[kv.put(b"z%03d" % i, b"w%d" % i) for i in range(20)])
+        assert all(oks)
+        put_rpcs = len(calls) - n0
+        # 40 concurrent puts over 2 regions: a handful of batch RPCs,
+        # not one per key
+        assert put_rpcs <= 6, f"{put_rpcs} RPCs for 40 batched puts"
+
+        n1 = len(calls)
+        got = await asyncio.gather(
+            *[kv.get(b"a%03d" % i) for i in range(20)],
+            kv.get(b"missing"))
+        assert got[:20] == [b"v%d" % i for i in range(20)]
+        assert got[20] is None
+        get_rpcs = len(calls) - n1
+        assert get_rpcs <= 4, f"{get_rpcs} RPCs for 21 batched gets"
+
+        # unbatched path still works alongside
+        assert await kv.compare_and_put(b"a000", b"v0", b"v0x")
+        assert await kv.get(b"a000") == b"v0x"
+    finally:
+        await kv.shutdown()
+        await c.stop_all()

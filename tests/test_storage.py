@@ -355,3 +355,39 @@ class TestLogManager:
         await asyncio.gather(*[one(i) for i in range(50)])
         assert lm.last_log_index() == 50
         await lm.shutdown()
+
+    async def test_in_memory_window_retention_and_caps(self):
+        """The recent-entry window (reference: logsInMemory) keeps
+        stable+applied entries in RAM up to count AND bytes caps, so
+        steady-state replication reads avoid storage."""
+        lm = LogManager(MemoryLogStorage(), max_logs_in_memory=8,
+                        max_logs_in_memory_bytes=64)
+        await lm.init()
+        entries = [LogEntry(type=EntryType.DATA, data=b"x" * 10)
+                   for _ in range(20)]
+        await lm.append_entries_leader(entries, term=1)
+        lm.set_applied_index(20)
+        # count cap 8, but bytes cap 64 allows only 6 entries of 10B
+        kept = sorted(lm._mem)
+        assert len(kept) <= 8
+        assert sum(len(lm._mem[i].data) for i in kept) <= 64 + 10
+        assert kept[-1] == 20  # most recent retained
+        # entries are still readable (from storage) below the window
+        assert lm.get_entry(1).data == b"x" * 10
+        await lm.shutdown()
+
+    async def test_conflict_hint_walks_term_run_in_memory(self):
+        lm = LogManager(MemoryLogStorage(), max_logs_in_memory=64)
+        await lm.init()
+        await lm.append_entries_leader(
+            [LogEntry(type=EntryType.DATA, data=b"a") for _ in range(5)],
+            term=2)
+        await lm.append_entries_leader(
+            [LogEntry(type=EntryType.DATA, data=b"b") for _ in range(5)],
+            term=4)
+        # term-4 run starts at index 6
+        assert lm.conflict_hint(10) == 6
+        assert lm.conflict_hint(10, 4) == 6
+        assert lm.conflict_hint(5) == 1  # term-2 run starts at 1
+        assert lm.conflict_hint(0) == 0  # no term -> no hint
+        await lm.shutdown()

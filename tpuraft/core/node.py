@@ -158,6 +158,8 @@ class Node:
             sync=opts.raft_options.sync,
             max_flush_batch=opts.raft_options.max_entries_size,
             max_logs_in_memory=opts.raft_options.max_logs_in_memory,
+            max_logs_in_memory_bytes=(
+                opts.raft_options.max_logs_in_memory_bytes),
         )
         await self.log_manager.init()
 

@@ -34,8 +34,12 @@ class RaftOptions:
     max_entries_size: int = 1024          # max entries per AppendEntries
     max_body_size: int = 512 * 1024       # max bytes per AppendEntries
     max_append_buffer_size: int = 256 * 1024  # log-storage flush batch bytes
-    max_logs_in_memory: int = 1024        # recent-entry window kept in RAM
-                                          # (reference: maxLogsInMemory)
+    # Recent-entry window kept in RAM past stability/apply so replication
+    # reads stay off disk (reference: maxLogsInMemory).  PER GROUP: a
+    # process hosting G groups retains up to G x min(count, bytes) — the
+    # bytes cap keeps thousand-group deployments bounded.
+    max_logs_in_memory: int = 256
+    max_logs_in_memory_bytes: int = 256 * 1024
     apply_batch: int = 32                 # tasks batched per apply event
     sync: bool = True                     # fsync log writes
     sync_meta: bool = True                # fsync term/votedFor changes

@@ -11,6 +11,7 @@ math in one fused tick (SURVEY.md §3.5 "multi-group data parallelism").
 The KV data path stays host-side (storage + RPC), as in the reference.
 """
 
+from tpuraft.rheakv.client import BatchingOptions, RheaKVStore
 from tpuraft.rheakv.kv_operation import KVOp, KVOperation
 from tpuraft.rheakv.metadata import Region, RegionEpoch, StoreMeta
 from tpuraft.rheakv.raw_store import MemoryRawKVStore, RawKVStore
@@ -18,6 +19,7 @@ from tpuraft.rheakv.region_engine import RegionEngine
 from tpuraft.rheakv.store_engine import StoreEngine
 
 __all__ = [
+    "BatchingOptions",
     "KVOp",
     "KVOperation",
     "MemoryRawKVStore",
@@ -25,6 +27,7 @@ __all__ = [
     "Region",
     "RegionEngine",
     "RegionEpoch",
+    "RheaKVStore",
     "StoreEngine",
     "StoreMeta",
     "create_raw_kv_store",

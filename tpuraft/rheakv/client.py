@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import uuid
+from dataclasses import dataclass
 from typing import Optional
 
 from tpuraft.errors import RaftError, Status
@@ -43,10 +44,63 @@ class RheaKVError(Exception):
         self.status = status
 
 
+@dataclass
+class BatchingOptions:
+    """Client-side op coalescing (reference: ``rhea:options/
+    BatchingOptions`` + the ``Batching`` ring buffers in
+    DefaultRheaKVStore).  The asyncio analog of the reference's
+    disruptor consumers: concurrent ``put``/``get`` calls issued within
+    the same event-loop iteration are drained into one ``put_list`` /
+    ``multi_get`` per region instead of one RPC each."""
+
+    enabled: bool = False
+    max_write_batch: int = 128
+    max_read_batch: int = 128
+
+
+class _Batcher:
+    """Coalesces items queued in one loop iteration into chunked flushes."""
+
+    def __init__(self, max_batch: int, flush_fn):
+        self._max = max_batch
+        self._flush_fn = flush_fn
+        self._pending: list = []  # (item, future)
+        self._scheduled = False
+
+    def add(self, item) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((item, fut))
+        if not self._scheduled:
+            self._scheduled = True
+            asyncio.ensure_future(self._drain())
+        return fut
+
+    async def _drain(self) -> None:
+        # one microtask hop: everything enqueued by tasks runnable in
+        # this loop iteration joins the batch
+        await asyncio.sleep(0)
+        self._scheduled = False
+        batch, self._pending = self._pending, []
+
+        async def flush(chunk):
+            try:
+                await self._flush_fn(chunk)
+            except Exception as e:  # noqa: BLE001 — fail the whole chunk
+                for _, fut in chunk:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+        # chunks are independent: flush them concurrently
+        await asyncio.gather(*[
+            flush(batch[i:i + self._max])
+            for i in range(0, len(batch), self._max)])
+
+
 class RheaKVStore:
     def __init__(self, pd_client: PlacementDriverClient, transport,
                  timeout_ms: float = 5000, max_retries: int = 8,
-                 retry_interval_ms: float = 50):
+                 retry_interval_ms: float = 50,
+                 batching: Optional[BatchingOptions] = None):
         self.pd = pd_client
         self.transport = transport
         self.route_table = RegionRouteTable()
@@ -56,6 +110,58 @@ class RheaKVStore:
         # region id -> endpoint of the last known leader's store
         self._leaders: dict[int, str] = {}
         self._started = False
+        self._put_batcher: Optional[_Batcher] = None
+        self._get_batcher: Optional[_Batcher] = None
+        if batching is not None and batching.enabled:
+            self._put_batcher = _Batcher(batching.max_write_batch,
+                                         self._flush_put_batch)
+            self._get_batcher = _Batcher(batching.max_read_batch,
+                                         self._flush_get_batch)
+
+    def _group_by_region(self, chunk, key_fn):
+        """Shard a batcher chunk by owning region so one region's failure
+        only fails ITS calls — per-region result granularity, as in the
+        reference's per-region batch dispatch."""
+        groups: dict[int, list] = {}
+        for item, fut in chunk:
+            r = self.route_table.find_region_by_key(key_fn(item))
+            groups.setdefault(r.id if r else -1, []).append((item, fut))
+        return list(groups.values())
+
+    async def _flush_put_batch(self, chunk) -> None:
+        async def flush_group(items):
+            try:
+                ok = await self.put_list([kv for kv, _ in items])
+            except Exception as e:  # noqa: BLE001
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
+            for _, fut in items:
+                if not fut.done():
+                    fut.set_result(ok)
+
+        await asyncio.gather(*[
+            flush_group(g)
+            for g in self._group_by_region(chunk, lambda kv: kv[0])])
+
+    async def _flush_get_batch(self, chunk) -> None:
+        async def flush_group(items):
+            try:
+                res = await self.multi_get(
+                    list(dict.fromkeys(k for k, _ in items)))
+            except Exception as e:  # noqa: BLE001
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
+            for k, fut in items:
+                if not fut.done():
+                    fut.set_result(res.get(k))
+
+        await asyncio.gather(*[
+            flush_group(g)
+            for g in self._group_by_region(chunk, lambda k: k)])
 
     async def start(self) -> None:
         self.route_table.reset(await self.pd.list_regions())
@@ -182,12 +288,16 @@ class RheaKVStore:
     # ------------------------------------------------------------------
 
     async def get(self, key: bytes) -> Optional[bytes]:
+        if self._get_batcher is not None:
+            return await self._get_batcher.add(key)
         return await self._execute(key, KVOperation(KVOp.GET, key))
 
     async def contains_key(self, key: bytes) -> bool:
         return await self._execute(key, KVOperation(KVOp.CONTAINS_KEY, key))
 
     async def put(self, key: bytes, value: bytes) -> bool:
+        if self._put_batcher is not None:
+            return await self._put_batcher.add((key, value))
         return await self._execute(key, KVOperation(KVOp.PUT, key, value))
 
     async def put_if_absent(self, key: bytes, value: bytes) -> Optional[bytes]:

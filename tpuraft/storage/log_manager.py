@@ -39,7 +39,8 @@ class LogManager:
         conf_manager: Optional[ConfigurationManager] = None,
         sync: bool = True,
         max_flush_batch: int = 256,
-        max_logs_in_memory: int = 1024,
+        max_logs_in_memory: int = 256,
+        max_logs_in_memory_bytes: int = 256 * 1024,
     ):
         self._storage = storage
         self.conf_manager = conf_manager or ConfigurationManager()
@@ -47,8 +48,10 @@ class LogManager:
         self._max_flush_batch = max_flush_batch
         # retained recent window beyond stability/apply, so replication to
         # slightly-lagging followers is served from memory, not disk
-        # (reference: LogManagerImpl's logsInMemory / maxLogsInMemory)
+        # (reference: LogManagerImpl's logsInMemory / maxLogsInMemory).
+        # Both caps are per group; the bytes cap bounds multi-group RAM.
         self._max_in_memory = max_logs_in_memory
+        self._max_in_memory_bytes = max_logs_in_memory_bytes
 
         self._mem: dict[int, LogEntry] = {}  # unstable + recent window
         self._first_index = 1
@@ -383,10 +386,21 @@ class LogManager:
     def set_applied_index(self, index: int) -> None:
         self._applied_index = max(self._applied_index, index)
         # trim the in-memory window: stable AND applied entries can be
-        # dropped, but keep the most recent max_logs_in_memory regardless
+        # dropped, but keep a recent window (bounded by count AND bytes)
         # so replication reads stay off disk in the steady state
+        window = self._max_in_memory
+        size = 0
+        for i in range(self._last_index,
+                       max(self._last_index - window, 0), -1):
+            e = self._mem.get(i)
+            if e is None:
+                break
+            size += len(e.data)
+            if size > self._max_in_memory_bytes:
+                window = self._last_index - i
+                break
         trim_to = min(self._applied_index, self._stable_index,
-                      self._last_index - self._max_in_memory)
+                      self._last_index - window)
         if trim_to >= self._first_index:
             for i in [i for i in self._mem if i <= trim_to]:
                 del self._mem[i]
