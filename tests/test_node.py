@@ -456,3 +456,16 @@ async def test_chaos_rolling_crashes_converge():
         else:
             assert log == reference_log, f"{p} diverged"
     await c.stop_all()
+
+
+async def test_join_unblocks_on_shutdown():
+    """Node#join / RaftGroupService#join parity: join() blocks until
+    shutdown completes."""
+    c = TestCluster(1)
+    await c.start_all()
+    leader = await c.wait_leader()
+    joiner = asyncio.ensure_future(leader.join())
+    await asyncio.sleep(0.05)
+    assert not joiner.done()
+    await c.stop_all()
+    await asyncio.wait_for(joiner, 2.0)

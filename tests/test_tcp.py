@@ -194,6 +194,10 @@ class TcpCluster:
     async def restart(self, peer: PeerId) -> None:
         srv = self.server_cls(peer.endpoint)
         await srv.start()
+        # fresh FSM recorder: the node replays its durable log from the
+        # start on init, so a reused recorder would hold duplicates and
+        # make entry-count waits pass before catch-up actually finishes
+        self.fsms.pop(peer, None)
         await self._boot(peer, srv)
 
     async def stop_all(self) -> None:

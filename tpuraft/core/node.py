@@ -260,6 +260,10 @@ class Node:
         self.state = State.SHUTDOWN
         self._shutdown_event.set()
 
+    async def join(self) -> None:
+        """Block until shutdown completes (reference: Node#join)."""
+        await self._shutdown_event.wait()
+
     # ======================================================================
     # public API (reference: Node interface — SURVEY.md §9)
     # ======================================================================

@@ -35,6 +35,12 @@ class RaftGroupService:
         self.node = node
         return node
 
+    async def join(self) -> None:
+        """Block until the node has fully shut down (reference:
+        RaftGroupService#join)."""
+        if self.node is not None:
+            await self.node.join()
+
     async def shutdown(self) -> None:
         if self.node:
             await self.node.shutdown()
