@@ -54,6 +54,8 @@ class LogManager:
         self._max_in_memory_bytes = max_logs_in_memory_bytes
 
         self._mem: dict[int, LogEntry] = {}  # unstable + recent window
+        self._mem_bytes = 0      # sum of len(e.data) over _mem
+        self._trim_floor = 0     # all indexes <= this are trimmed from _mem
         self._first_index = 1
         self._last_index = 0          # includes unstable entries
         self._stable_index = 0        # flushed to storage
@@ -77,6 +79,10 @@ class LogManager:
         self._first_index = self._storage.first_log_index()
         self._last_index = self._storage.last_log_index()
         self._stable_index = self._last_index
+        # _mem is empty after init: everything recovered lives in storage,
+        # so the incremental trim must start from the recovered tail (a
+        # floor of 0 would make the first trim walk the whole log range)
+        self._trim_floor = self._last_index
         # rebuild configuration history from the stored log (sidecar index:
         # O(#conf entries), not O(n) — see LogStorage#configuration_indexes)
         loop = asyncio.get_running_loop()
@@ -112,6 +118,18 @@ class LogManager:
 
     def last_snapshot_id(self) -> LogId:
         return self._last_snapshot_id
+
+    def _mem_put(self, e) -> None:
+        prev = self._mem.get(e.id.index)
+        if prev is not None:
+            self._mem_bytes -= len(prev.data)
+        self._mem[e.id.index] = e
+        self._mem_bytes += len(e.data)
+
+    def _mem_pop(self, index: int) -> None:
+        e = self._mem.pop(index, None)
+        if e is not None:
+            self._mem_bytes -= len(e.data)
 
     def get_entry(self, index: int) -> Optional[LogEntry]:
         if index > self._last_index or index < self._first_index:
@@ -176,7 +194,7 @@ class LogManager:
         for e in entries:
             self._last_index += 1
             e.id = LogId(self._last_index, term)
-            self._mem[e.id.index] = e
+            self._mem_put(e)
             if e.type == EntryType.CONFIGURATION:
                 self._track_conf(e)
         self._staged.extend(entries)
@@ -251,7 +269,7 @@ class LogManager:
         if not new_entries:
             return True
         for e in new_entries:
-            self._mem[e.id.index] = e
+            self._mem_put(e)
             self._last_index = e.id.index
             if e.type == EntryType.CONFIGURATION:
                 self._track_conf(e)
@@ -341,7 +359,8 @@ class LogManager:
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, self._storage.truncate_suffix, last_index_kept)
         for i in range(last_index_kept + 1, self._last_index + 1):
-            self._mem.pop(i, None)
+            self._mem_pop(i)
+        self._trim_floor = min(self._trim_floor, last_index_kept)
         self._last_index = last_index_kept
         self._stable_index = min(self._stable_index, last_index_kept)
         self.conf_manager.truncate_suffix(last_index_kept)
@@ -368,6 +387,8 @@ class LogManager:
             await loop.run_in_executor(
                 None, self._storage.reset, snapshot_id.index + 1)
             self._mem.clear()
+            self._mem_bytes = 0
+            self._trim_floor = snapshot_id.index
             self._first_index = snapshot_id.index + 1
             self._last_index = snapshot_id.index
             self._stable_index = snapshot_id.index
@@ -379,7 +400,8 @@ class LogManager:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self._storage.truncate_prefix, first_kept)
             for i in range(self._first_index, first_kept):
-                self._mem.pop(i, None)
+                self._mem_pop(i)
+            self._trim_floor = max(self._trim_floor, first_kept - 1)
             self._first_index = first_kept
             self.conf_manager.truncate_prefix(first_kept)
 
@@ -387,23 +409,21 @@ class LogManager:
         self._applied_index = max(self._applied_index, index)
         # trim the in-memory window: stable AND applied entries can be
         # dropped, but keep a recent window (bounded by count AND bytes)
-        # so replication reads stay off disk in the steady state
-        window = self._max_in_memory
-        size = 0
-        for i in range(self._last_index,
-                       max(self._last_index - window, 0), -1):
-            e = self._mem.get(i)
-            if e is None:
-                break
-            size += len(e.data)
-            if size > self._max_in_memory_bytes:
-                window = self._last_index - i
-                break
+        # so replication reads stay off disk in the steady state.
+        # Incremental: walk from the trim floor, never rescan _mem.
         trim_to = min(self._applied_index, self._stable_index,
-                      self._last_index - window)
-        if trim_to >= self._first_index:
-            for i in [i for i in self._mem if i <= trim_to]:
-                del self._mem[i]
+                      self._last_index - self._max_in_memory)
+        for i in range(self._trim_floor + 1, trim_to + 1):
+            self._mem_pop(i)
+        self._trim_floor = max(self._trim_floor, trim_to)
+        # bytes cap: evict more of the oldest retained entries while
+        # over budget, but never unstable or unapplied ones
+        hard_to = min(self._applied_index, self._stable_index)
+        i = self._trim_floor + 1
+        while self._mem_bytes > self._max_in_memory_bytes and i <= hard_to:
+            self._mem_pop(i)
+            i += 1
+        self._trim_floor = max(self._trim_floor, i - 1)
 
     # -- waiters (replicator wakeup) -----------------------------------------
 
