@@ -210,9 +210,31 @@ class MultiRaftEngine:
 
             from tpuraft.ops.ballot import joint_quorum_match_index
 
-            # jitted once: eager per-tick dispatch would cost ~100ms over
-            # a tunneled device and starve the asyncio loop
-            self._tick_fn = jax.jit(joint_quorum_match_index)
+            if self.opts.mesh_devices and self.opts.mesh_devices > 1:
+                # SPMD over the group axis: each chip reduces its own
+                # group rows; upload scatters, download gathers (the
+                # "vote-matrix over ICI" configuration in BASELINE.md)
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                from tpuraft.parallel.mesh import make_mesh
+
+                n = self.opts.mesh_devices
+                if self.G % n != 0:
+                    raise ValueError(
+                        f"max_groups={self.G} not divisible by "
+                        f"mesh_devices={n}")
+                mesh = make_mesh(n)  # raises if fewer devices exist
+                row = NamedSharding(mesh, P("groups", None))
+                out = NamedSharding(mesh, P("groups"))
+                self._tick_fn = jax.jit(
+                    joint_quorum_match_index,
+                    in_shardings=(row, row, row),
+                    out_shardings=out)
+            else:
+                # jitted once: eager per-tick dispatch would cost ~100ms
+                # over a tunneled device and starve the asyncio loop
+                self._tick_fn = jax.jit(joint_quorum_match_index)
         self._task = asyncio.ensure_future(self._loop())
 
     async def shutdown(self) -> None:

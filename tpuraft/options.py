@@ -72,6 +72,11 @@ class TickOptions:
     tick_interval_ms: int = 10    # host tick cadence
     backend: str = "auto"         # "auto" | "jax" | "numpy" (numpy for tiny tests)
     donate_state: bool = True     # donate state buffers to the tick kernel
+    # Shard the engine's [G, P] planes over a device mesh along the group
+    # axis (0/1 = single device).  max_groups must divide evenly.  The
+    # quorum reduce then runs SPMD across chips with the per-tick upload
+    # scattered and the commit download gathered over ICI.
+    mesh_devices: int = 0
 
 
 @dataclass

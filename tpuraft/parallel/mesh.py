@@ -25,6 +25,11 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "groups"
               ) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"mesh of {n} devices requested but only {len(devs)} present "
+            f"({devs[0].platform}) — a silent truncation would change the "
+            f"sharding the caller validated against")
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
