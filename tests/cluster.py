@@ -74,7 +74,8 @@ class TestCluster:
     __test__ = False  # not a pytest class
 
     def __init__(self, n: int, tmp_path=None, election_timeout_ms: int = 300,
-                 snapshot: bool = False, group_id: str = "test_group"):
+                 snapshot: bool = False, group_id: str = "test_group",
+                 snapshot_interval_secs: int = 0):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
@@ -82,6 +83,11 @@ class TestCluster:
         self.tmp_path = tmp_path
         self.election_timeout_ms = election_timeout_ms
         self.snapshot = snapshot
+        if snapshot_interval_secs > 0 and not snapshot:
+            raise ValueError(
+                "snapshot_interval_secs needs snapshot=True (no snapshot "
+                "storage -> no executor -> the timer never fires)")
+        self.snapshot_interval_secs = snapshot_interval_secs
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
@@ -101,7 +107,8 @@ class TestCluster:
         else:
             opts.log_uri = "memory://"
             opts.raft_meta_uri = "memory://"
-        opts.snapshot.interval_secs = 0  # only on-demand snapshots in tests
+        # 0 = only on-demand snapshots (the default for tests)
+        opts.snapshot.interval_secs = self.snapshot_interval_secs
         return opts
 
     async def start_all(self) -> None:

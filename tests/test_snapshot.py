@@ -134,3 +134,33 @@ async def test_snapshot_nothing_new_rejected(tmp_path):
     st2 = await leader.snapshot()
     assert not st2.is_ok()  # nothing new
     await c.stop_all()
+
+
+async def test_periodic_snapshot_timer_compacts(tmp_path):
+    """The snapshot timer (reference: snapshotIntervalSecs, default 3600)
+    must fire on its own, save a snapshot, and compact the log — no
+    explicit Node#snapshot call."""
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True,
+                    snapshot_interval_secs=1)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(10):
+        await c.apply_ok(leader, b"p%d" % i)
+    await c.wait_applied(10)
+    deadline = asyncio.get_running_loop().time() + 6
+    while asyncio.get_running_loop().time() < deadline:
+        if c.fsms[leader.server_id].snapshots_saved >= 1:
+            break
+        await asyncio.sleep(0.1)
+    assert c.fsms[leader.server_id].snapshots_saved >= 1
+    # compaction follows the periodic save
+    deadline = asyncio.get_running_loop().time() + 3
+    while asyncio.get_running_loop().time() < deadline:
+        if leader.log_manager.first_log_index() > 1:
+            break
+        await asyncio.sleep(0.1)
+    assert leader.log_manager.first_log_index() > 1
+    # the cluster still serves writes afterwards
+    st = await c.apply_ok(leader, b"post-snap")
+    assert st.is_ok()
+    await c.stop_all()

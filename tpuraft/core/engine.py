@@ -214,10 +214,7 @@ class MultiRaftEngine:
                 # SPMD over the group axis: each chip reduces its own
                 # group rows; upload scatters, download gathers (the
                 # "vote-matrix over ICI" configuration in BASELINE.md)
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-
-                from tpuraft.parallel.mesh import make_mesh
+                from tpuraft.parallel.mesh import group_shardings, make_mesh
 
                 n = self.opts.mesh_devices
                 if self.G % n != 0:
@@ -225,8 +222,7 @@ class MultiRaftEngine:
                         f"max_groups={self.G} not divisible by "
                         f"mesh_devices={n}")
                 mesh = make_mesh(n)  # raises if fewer devices exist
-                row = NamedSharding(mesh, P("groups", None))
-                out = NamedSharding(mesh, P("groups"))
+                out, row = group_shardings(mesh)
                 self._tick_fn = jax.jit(
                     joint_quorum_match_index,
                     in_shardings=(row, row, row),

@@ -45,11 +45,19 @@ def shard_group_state(state: GroupState, mesh: Mesh, axis_name: str = "groups"
     return jax.tree_util.tree_map(put, state)
 
 
+def group_shardings(mesh: Mesh, axis_name: str = "groups"
+                    ) -> tuple[NamedSharding, NamedSharding]:
+    """(vector, matrix) shardings over the group axis: ``[G]`` fields get
+    the first, ``[G, P]`` fields the second.  The single home for the
+    group-axis layout — the engine and tick compilers both use it."""
+    return (NamedSharding(mesh, P(axis_name)),
+            NamedSharding(mesh, P(axis_name, None)))
+
+
 def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
     """Compile raft_tick with G sharded over the mesh.  Returns the jitted
     function; call with (state, now_ms, params)."""
-    row = NamedSharding(mesh, P(axis_name))
-    mat = NamedSharding(mesh, P(axis_name, None))
+    row, mat = group_shardings(mesh, axis_name)
     scalar = NamedSharding(mesh, P())
 
     def state_shardings(state_cls=GroupState):
