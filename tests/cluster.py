@@ -83,10 +83,12 @@ class TestCluster:
         self.tmp_path = tmp_path
         self.election_timeout_ms = election_timeout_ms
         self.snapshot = snapshot
-        if snapshot_interval_secs > 0 and not snapshot:
+        if snapshot_interval_secs > 0 and not (snapshot and
+                                               tmp_path is not None):
             raise ValueError(
-                "snapshot_interval_secs needs snapshot=True (no snapshot "
-                "storage -> no executor -> the timer never fires)")
+                "snapshot_interval_secs needs snapshot=True AND a "
+                "tmp_path (no snapshot storage -> no executor -> the "
+                "timer never fires)")
         self.snapshot_interval_secs = snapshot_interval_secs
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
