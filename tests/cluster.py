@@ -75,7 +75,8 @@ class TestCluster:
 
     def __init__(self, n: int, tmp_path=None, election_timeout_ms: int = 300,
                  snapshot: bool = False, group_id: str = "test_group",
-                 snapshot_interval_secs: int = 0):
+                 snapshot_interval_secs: int = 0,
+                 coalesce_heartbeats: bool = False):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
@@ -90,6 +91,7 @@ class TestCluster:
                 "tmp_path (no snapshot storage -> no executor -> the "
                 "timer never fires)")
         self.snapshot_interval_secs = snapshot_interval_secs
+        self.coalesce_heartbeats = coalesce_heartbeats
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
@@ -111,6 +113,7 @@ class TestCluster:
             opts.raft_meta_uri = "memory://"
         # 0 = only on-demand snapshots (the default for tests)
         opts.snapshot.interval_secs = self.snapshot_interval_secs
+        opts.raft_options.coalesce_heartbeats = self.coalesce_heartbeats
         return opts
 
     async def start_all(self) -> None:

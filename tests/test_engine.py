@@ -19,6 +19,8 @@ class MultiRaftCluster:
     """N endpoints x G groups; each endpoint hosts one replica of every
     group and ONE MultiRaftEngine batching all its groups' commits."""
 
+    coalesce_heartbeats = False
+
     def __init__(self, n_endpoints: int, n_groups: int,
                  election_timeout_ms: int = 300, tick_ms: int = 5):
         self.net = InProcNetwork()
@@ -51,6 +53,8 @@ class MultiRaftCluster:
                     election_timeout_ms=self.election_timeout_ms,
                     initial_conf=self.conf.copy(),
                     fsm=fsm, log_uri="memory://", raft_meta_uri="memory://")
+                opts.raft_options.coalesce_heartbeats = \
+                    self.coalesce_heartbeats
                 node = Node(gid, ep, opts, transport,
                             ballot_box_factory=factory)
                 node.node_manager = manager

@@ -1,0 +1,104 @@
+"""HeartbeatHub: coalesced cross-group heartbeats (SURVEY.md §3.5
+batched send-matrix plane — a TPU-native scaling feature with no
+reference counterpart)."""
+
+import asyncio
+
+import pytest  # noqa: F401
+
+from tests.cluster import TestCluster
+from tests.test_engine import MultiRaftCluster
+from tpuraft.core.node import State
+from tpuraft.entity import Task
+
+
+async def test_coalesced_cluster_stable_and_applies():
+    """Leadership must stay stable on hub heartbeats alone (no per-group
+    heartbeat loops), and replication/commit still works."""
+    c = TestCluster(3, coalesce_heartbeats=True)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        term0 = leader.current_term
+        st = await c.apply_ok(leader, b"hub-1")
+        assert st.is_ok()
+        # several election timeouts of quiet time: followers must keep
+        # receiving (coalesced) heartbeats, so no re-election happens
+        await asyncio.sleep(1.2)
+        assert leader.state == State.LEADER
+        assert leader.current_term == term0
+        st = await c.apply_ok(leader, b"hub-2")
+        assert st.is_ok()
+        await c.wait_applied(2)
+        hub = c.managers[leader.server_id].heartbeat_hub
+        assert hub.rpcs_sent > 0
+    finally:
+        await c.stop_all()
+
+
+async def test_coalesced_leader_detects_dead_quorum():
+    """Hub silence must feed dead-node detection exactly like direct
+    heartbeats: an isolated leader steps down."""
+    c = TestCluster(3, election_timeout_ms=200, coalesce_heartbeats=True)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        c.net.isolate(leader.server_id.endpoint)
+        deadline = asyncio.get_running_loop().time() + 5
+        while asyncio.get_running_loop().time() < deadline:
+            if leader.state != State.LEADER:
+                break
+            await asyncio.sleep(0.02)
+        assert leader.state != State.LEADER
+        c.net.heal()
+    finally:
+        await c.stop_all()
+
+
+class CoalescedMultiRaftCluster(MultiRaftCluster):
+    coalesce_heartbeats = True
+
+
+async def test_multi_group_idle_rpc_reduction():
+    """The point of the hub: G groups x P peers idle heartbeats collapse
+    to one multi_heartbeat RPC per endpoint pair per interval."""
+    c = CoalescedMultiRaftCluster(3, 16, election_timeout_ms=400)
+    calls: list[str] = []
+    orig_call = c.net.call
+
+    async def counting_call(src, dst, method, request, timeout_ms=None):
+        calls.append(method)
+        return await orig_call(src, dst, method, request, timeout_ms)
+
+    c.net.call = counting_call
+    await c.start_all()
+    try:
+        for gid in c.groups:
+            await c.wait_leader(gid, timeout_s=20.0)
+        # one write per group so every group has a leader with followers
+        async def put(gid):
+            leader = await c.wait_leader(gid)
+            fut = asyncio.get_running_loop().create_future()
+            await leader.apply(Task(data=b"x", done=fut.set_result))
+            assert (await asyncio.wait_for(fut, 10)).is_ok()
+        await asyncio.gather(*[put(g) for g in c.groups])
+
+        # quiet window: count idle-traffic RPCs
+        calls.clear()
+        await asyncio.sleep(1.0)
+        n_multi = calls.count("multi_heartbeat")
+        n_append = calls.count("append_entries")
+        assert n_multi > 0
+        # without coalescing, idle heartbeats would be ~16 groups x 2
+        # followers per interval per endpoint; with the hub, per-group
+        # append_entries RPCs in a quiet window stay far below that
+        assert n_append < n_multi * 4, (n_append, n_multi)
+        # and the hub actually batched many beats per RPC
+        hubs = [m.heartbeat_hub for m in
+                (c.nodes[(c.groups[0], ep)].node_manager
+                 for ep in c.endpoints)]
+        total_rpcs = sum(h.rpcs_sent for h in hubs)
+        total_beats = sum(h.beats_sent for h in hubs)
+        assert total_beats > total_rpcs * 4, (total_beats, total_rpcs)
+    finally:
+        await c.stop_all()

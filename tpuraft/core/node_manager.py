@@ -31,6 +31,80 @@ class NodeManager:
         self._file_readers: dict[int, object] = {}
         self._next_reader_id = 1
         server.register("get_file", self._handle_get_file)
+        # coalesced heartbeats (HeartbeatHub): one RPC per endpoint pair
+        server.register("multi_heartbeat", self._handle_multi_heartbeat)
+        self._heartbeat_hub = None  # created on first coalescing leader
+        # at most ONE outstanding beat handler per (group, peer): beats
+        # behind a busy node lock must answer EBUSY, not stack a new
+        # lock waiter every round (queue flooding starves vote handling)
+        self._beat_inflight: set[tuple[str, str]] = set()
+
+    @property
+    def heartbeat_hub(self):
+        if self._heartbeat_hub is None:
+            from tpuraft.core.heartbeat_hub import HeartbeatHub
+
+            self._heartbeat_hub = HeartbeatHub()
+        return self._heartbeat_hub
+
+    async def _handle_multi_heartbeat(self, request):
+        """Fan a MultiHeartbeatRequest out to the local nodes; each beat
+        gets a full per-group response frame, in order."""
+        from tpuraft.rpc.messages import (
+            ErrorResponse,
+            MultiHeartbeatResponse,
+            decode_message,
+            encode_message,
+        )
+
+        import asyncio
+
+        async def one(blob: bytes) -> bytes:
+            # concurrent fan-out: each beat takes its own node's lock; a
+            # group mid-election (lock held across awaits) must not
+            # head-of-line-block the whole batch's ack — the batch only
+            # returns when its SLOWEST beat does.  A beat that can't be
+            # served promptly answers EBUSY while the real handler keeps
+            # running shielded (cancelling a handler mid-step-down would
+            # corrupt state); the sender just misses one group's ack for
+            # one round, exactly like a dropped direct heartbeat.
+            try:
+                beat = decode_message(blob)
+                key = (beat.group_id, beat.peer_id)
+                node = self._nodes.get(key)
+                if node is None:
+                    raise RpcError(Status.error(
+                        RaftError.ENOENT, f"no node for {beat.group_id}"))
+                if key in self._beat_inflight:
+                    # previous beat still waiting on this node's lock
+                    return encode_message(ErrorResponse(
+                        int(RaftError.EBUSY), f"{beat.group_id} busy"))
+                budget = node.options.election_timeout_ms / 1000.0 / 2
+                self._beat_inflight.add(key)
+                task = asyncio.ensure_future(
+                    node.handle_append_entries(beat))
+
+                def _done(t, key=key):
+                    self._beat_inflight.discard(key)
+                    if not t.cancelled():
+                        t.exception()  # consume if we timed out below
+
+                task.add_done_callback(_done)
+                try:
+                    resp = await asyncio.wait_for(
+                        asyncio.shield(task), budget)
+                except asyncio.TimeoutError:
+                    resp = ErrorResponse(int(RaftError.EBUSY),
+                                         f"{beat.group_id} busy")
+            except RpcError as e:
+                resp = ErrorResponse(e.status.code, e.status.error_msg)
+            except Exception as e:  # noqa: BLE001 — one bad beat only
+                LOG.exception("multi_heartbeat beat failed")
+                resp = ErrorResponse(int(RaftError.EINTERNAL), repr(e))
+            return encode_message(resp)
+
+        acks = await asyncio.gather(*(one(b) for b in request.beats))
+        return MultiHeartbeatResponse(acks=list(acks))
 
     def _make_handler(self, method: str):
         async def handler(request):

@@ -43,6 +43,7 @@ class Replicator:
         self._task: Optional[asyncio.Task] = None
         self._hb_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        self._hub = None  # HeartbeatHub when coalescing is enabled
         self._transfer_target_index: Optional[int] = None
         self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
 
@@ -51,10 +52,22 @@ class Replicator:
     def start(self) -> None:
         self._running = True
         self._task = asyncio.ensure_future(self._run())
-        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        node = self._node
+        hub = None
+        if (node.options.raft_options.coalesce_heartbeats
+                and node.node_manager is not None):
+            hub = node.node_manager.heartbeat_hub
+        self._hub = hub
+        if hub is not None:
+            hub.register(self)
+        else:
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
 
     def stop(self) -> None:
         self._running = False
+        if self._hub is not None:
+            self._hub.deregister(self)
+            self._hub = None
         for t in (self._task, self._hb_task):
             if t:
                 t.cancel()
@@ -194,30 +207,28 @@ class Replicator:
         except asyncio.CancelledError:
             return
 
-    async def send_heartbeat(self) -> bool:
-        """One empty AppendEntries; returns True on in-term ack.
-        Also the quorum-confirmation primitive for ReadIndex (SAFE)."""
+    def build_heartbeat_request(self) -> AppendEntriesRequest:
+        """The empty AppendEntries beat for this (group, peer) — shared
+        by the direct path and the coalescing HeartbeatHub."""
         node = self._node
-        if not node.is_leader():
-            return False
         lm = node.log_manager
         prev_index = min(self.match_index, lm.last_log_index())
-        req = AppendEntriesRequest(
+        return AppendEntriesRequest(
             group_id=node.group_id,
             server_id=str(node.server_id),
             peer_id=str(self.peer),
             term=node.current_term,
             prev_log_index=prev_index,
             prev_log_term=lm.get_term(prev_index),
-            committed_index=min(node.ballot_box.last_committed_index, prev_index),
+            committed_index=min(node.ballot_box.last_committed_index,
+                                prev_index),
             entries=[],
         )
-        try:
-            resp = await node.transport.append_entries(
-                self.peer.endpoint, req,
-                timeout_ms=node.options.election_timeout_ms // 2 or 1)
-        except RpcError:
-            return False
+
+    async def process_heartbeat_response(self, resp) -> bool:
+        """Ack bookkeeping shared by both heartbeat paths: lease acks,
+        step-down on higher term, re-probe on lost match."""
+        node = self._node
         if resp.term > node.current_term:
             await node.step_down_on_higher_term(
                 resp.term, f"heartbeat response from {self.peer}")
@@ -230,6 +241,21 @@ class Replicator:
             self.next_index = min(self.next_index, resp.last_log_index + 1) or 1
             self.wake()
         return True
+
+    async def send_heartbeat(self) -> bool:
+        """One empty AppendEntries; returns True on in-term ack.
+        Also the quorum-confirmation primitive for ReadIndex (SAFE)."""
+        node = self._node
+        if not node.is_leader():
+            return False
+        req = self.build_heartbeat_request()
+        try:
+            resp = await node.transport.append_entries(
+                self.peer.endpoint, req,
+                timeout_ms=node.options.election_timeout_ms // 2 or 1)
+        except RpcError:
+            return False
+        return await self.process_heartbeat_response(resp)
 
     # -- catch-up (membership change) ----------------------------------------
 

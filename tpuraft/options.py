@@ -47,6 +47,11 @@ class RaftOptions:
     max_inflight_msgs: int = 256          # replication pipeline window
     max_election_delay_ms: int = 1000     # random election timeout jitter
     election_heartbeat_factor: int = 10   # heartbeat = election_timeout / factor
+    # Coalesce leader heartbeats across ALL local raft groups into one
+    # multi_heartbeat RPC per destination endpoint per interval (the
+    # batched send-matrix plane — O(endpoints) instead of O(groups x
+    # peers) idle RPCs).  Needs the node wired to a NodeManager.
+    coalesce_heartbeats: bool = False
     read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
     max_replicator_retry_times: int = 3
     step_down_when_vote_timedout: bool = True

@@ -204,6 +204,26 @@ def register_message(tid: int, cls: type) -> type:
     return cls
 
 
+@dataclass
+class MultiHeartbeatRequest:
+    """Coalesced heartbeats: one RPC per (src, dst) endpoint pair carries
+    the empty-AppendEntries beats of EVERY leader group between them
+    (the batched send-matrix plane — SURVEY.md §3.5; no reference
+    counterpart, the reference sends per-group heartbeats).  Each beat
+    is an encoded AppendEntriesRequest."""
+
+    beats: list[bytes]
+
+
+@dataclass
+class MultiHeartbeatResponse:
+    """One frame per beat, in request order: an encoded
+    AppendEntriesResponse, or an encoded ErrorResponse for a group that
+    was unroutable/unserviceable on the receiver."""
+
+    acks: list[bytes]
+
+
 for _i, _t in enumerate([
     AppendEntriesRequest,
     AppendEntriesResponse,
@@ -218,8 +238,12 @@ for _i, _t in enumerate([
     GetFileRequest,
     GetFileResponse,
     ErrorResponse,
+    MultiHeartbeatRequest,
+    MultiHeartbeatResponse,
 ]):
     register_message(_i, _t)
+
+
 
 
 def _ann(f) -> str:
