@@ -1,0 +1,95 @@
+"""Admin CLI: cluster operations against a live raft group over TCP.
+
+Reference parity: the operator surface of ``CliService`` (SURVEY.md
+§3.1 "CLI service & processors") as a command-line tool, the way the
+reference's jraft-example tooling drives CliServiceImpl.
+
+    python -m examples.admin --group counter \\
+        --peers 127.0.0.1:8081,127.0.0.1:8082,127.0.0.1:8083 <command>
+
+Commands:
+    leader                    print the current leader
+    peers                     print voters (and learners)
+    snapshot <peer>           trigger an on-demand snapshot on <peer>
+    transfer <peer>           transfer leadership to <peer>
+    add-peer <peer>           add a voter
+    remove-peer <peer>        remove a voter
+    change-peers <p1,p2,...>  arbitrary membership change
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from tpuraft.conf import Configuration
+from tpuraft.core.cli_service import CliService
+from tpuraft.entity import PeerId
+from tpuraft.rpc.tcp import TcpTransport
+
+
+async def run(args) -> int:
+    conf = Configuration.parse(args.peers)
+    transport = TcpTransport()
+    cli = CliService(transport)
+    rc = 0
+    try:
+        cmd = args.command[0]
+        if cmd == "leader":
+            leader = await cli.get_leader(args.group, conf)
+            if leader is None:
+                print("error: no leader found")
+                return 1
+            print(leader)
+        elif cmd == "peers":
+            full = await cli.get_configuration(args.group, conf)
+            print("voters:", ",".join(str(p) for p in full.peers))
+            if full.learners:
+                print("learners:", ",".join(str(p) for p in full.learners))
+        elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer"):
+            if len(args.command) < 2:
+                print(f"{cmd} needs a peer argument", file=sys.stderr)
+                return 2
+            peer = PeerId.parse(args.command[1])
+            if cmd == "snapshot":
+                st = await cli.snapshot(args.group, peer)
+            elif cmd == "transfer":
+                st = await cli.transfer_leader(args.group, conf, peer)
+            elif cmd == "add-peer":
+                st = await cli.add_peer(args.group, conf, peer)
+            else:
+                st = await cli.remove_peer(args.group, conf, peer)
+            print("OK" if st.is_ok() else f"error: {st}")
+            rc = 0 if st.is_ok() else 1
+        elif cmd == "change-peers":
+            if len(args.command) < 2:
+                print("change-peers needs a conf argument", file=sys.stderr)
+                return 2
+            new_conf = Configuration.parse(args.command[1])
+            st = await cli.change_peers(args.group, conf, new_conf)
+            print("OK" if st.is_ok() else f"error: {st}")
+            rc = 0 if st.is_ok() else 1
+        else:
+            print(f"unknown command: {cmd}", file=sys.stderr)
+            rc = 2
+    finally:
+        await transport.close()
+    return rc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--group", required=True, help="raft group id")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated cluster conf (ip:port,...)")
+    ap.add_argument("command", nargs="+",
+                    help="leader | peers | snapshot <peer> | transfer <peer>"
+                         " | add-peer <peer> | remove-peer <peer>"
+                         " | change-peers <p1,p2,...>")
+    sys.exit(asyncio.run(run(ap.parse_args())))
+
+
+if __name__ == "__main__":
+    main()

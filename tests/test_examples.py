@@ -33,3 +33,51 @@ async def test_rheakv_bench_lease_reads():
         run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
                   concurrency=16, lease_reads=True, verbose=False), 120)
     assert r["ops_per_s"] > 0
+
+
+async def test_admin_cli_against_live_cluster(tmp_path):
+    """The admin CLI (examples/admin.py) drives a live TCP cluster as a
+    separate OS process: leader lookup, peer listing, leadership
+    transfer (reference: the CliService operator surface)."""
+    import os
+    import subprocess
+    import sys
+
+    from tests.test_tcp import TcpCluster
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    c = TcpCluster(tmp_path)
+    await c.start(3)
+    try:
+        leader = await c.wait_leader()
+        peers_arg = ",".join(str(p) for p in c.peers)
+
+        def admin(*cmd):
+            return subprocess.run(
+                [sys.executable, "-m", "examples.admin",
+                 "--group", "tcp_group", "--peers", peers_arg, *cmd],
+                cwd=repo, env=dict(os.environ, PYTHONPATH=repo),
+                capture_output=True, text=True, timeout=60)
+
+        loop = asyncio.get_running_loop()
+        r = await loop.run_in_executor(None, admin, "leader")
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() == str(leader.server_id)
+
+        r = await loop.run_in_executor(None, admin, "peers")
+        assert r.returncode == 0, r.stderr
+        assert set(r.stdout.split("voters: ")[1].strip().split(",")) == \
+            {str(p) for p in c.peers}
+
+        target = next(p for p in c.peers if p != leader.server_id)
+        r = await loop.run_in_executor(
+            None, admin, "transfer", str(target))
+        assert r.returncode == 0, r.stderr + r.stdout
+        deadline = loop.time() + 8
+        while loop.time() < deadline:
+            if c.nodes[target].state.value == "leader":
+                break
+            await asyncio.sleep(0.05)
+        assert c.nodes[target].state.value == "leader"
+    finally:
+        await c.stop_all()
