@@ -30,7 +30,13 @@ from tpuraft.rpc.tcp import TcpTransport
 
 
 async def run(args) -> int:
-    conf = Configuration.parse(args.peers)
+    from tpuraft.rpc.transport import RpcError
+
+    try:
+        conf = Configuration.parse(args.peers)
+    except ValueError as e:
+        print(f"error: bad --peers: {e}", file=sys.stderr)
+        return 2
     transport = TcpTransport()
     cli = CliService(transport)
     rc = 0
@@ -73,6 +79,12 @@ async def run(args) -> int:
         else:
             print(f"unknown command: {cmd}", file=sys.stderr)
             rc = 2
+    except RpcError as e:
+        print(f"error: {e.status}", file=sys.stderr)
+        rc = 1
+    except ValueError as e:  # malformed peer argument
+        print(f"error: {e}", file=sys.stderr)
+        rc = 2
     finally:
         await transport.close()
     return rc
