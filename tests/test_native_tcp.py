@@ -212,3 +212,33 @@ class TestRaftOverNativeTransport:
             assert c.fsms[dead].logs[-1] == b"after-failover"
         finally:
             await c.stop_all()
+
+
+class TestSnapshotInstallOverNativeTransport:
+    @pytest.mark.asyncio
+    async def test_install_snapshot_remote_copy(self, tmp_path):
+        """InstallSnapshot's chunked remote file copy (GetFileRequest /
+        FileService) over the native epoll transport: a follower that
+        crashed past the compaction horizon pulls the snapshot over real
+        sockets through the C++ engine."""
+        c = NativeCluster(tmp_path, snapshot=True)
+        await c.start(3)
+        try:
+            leader = await c.wait_leader()
+            victim = next(p for p in c.peers if p != leader.server_id)
+            st = await c.apply_ok(leader, b"s0")
+            assert st.is_ok()
+            await c.wait_applied(1)
+            await c.crash(victim)
+            for i in range(1, 15):
+                st = await c.apply_ok(leader, b"s%d" % i)
+                assert st.is_ok(), st
+            st = await leader.snapshot()
+            assert st.is_ok(), str(st)
+            assert leader.log_manager.first_log_index() > 1
+            await c.restart(victim)
+            await c.wait_applied(15, timeout_s=15)
+            assert c.fsms[victim].logs == [b"s%d" % i for i in range(15)]
+            assert c.fsms[victim].snapshots_loaded >= 1
+        finally:
+            await c.stop_all()

@@ -469,3 +469,39 @@ async def test_join_unblocks_on_shutdown():
     assert not joiner.done()
     await c.stop_all()
     await asyncio.wait_for(joiner, 2.0)
+
+
+async def test_lease_based_read_index():
+    """LEASE_BASED linearizable reads skip the quorum heartbeat round
+    while the leader lease holds (reference: ReadOnlyOption.LEASE_BASED),
+    and still fail when the lease lapses under isolation."""
+    from tpuraft.options import ReadOnlyOption
+
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for n in c.nodes.values():
+        n.options.raft_options.read_only_option = ReadOnlyOption.LEASE_BASED
+    await c.apply_ok(leader, b"lr")
+    await c.wait_applied(1)
+    # the lease path must answer WITHOUT invoking the quorum heartbeat
+    # round at all (that's the whole point vs SAFE)
+    rounds = []
+    orig_round = leader.replicators.heartbeat_round
+
+    async def counting_round():
+        rounds.append(1)
+        return await orig_round()
+
+    leader.replicators.heartbeat_round = counting_round
+    idx = await leader.read_index()
+    assert idx >= 1
+    assert rounds == [], "lease read fell back to the SAFE quorum round"
+    leader.replicators.heartbeat_round = orig_round
+    # isolated leader: the lease lapses and lease reads stop succeeding
+    c.net.isolate(leader.server_id.endpoint)
+    await asyncio.sleep(0.8)  # > lease window
+    with pytest.raises(ReadIndexError):
+        await asyncio.wait_for(leader.read_index(), 3)
+    c.net.heal()
+    await c.stop_all()

@@ -144,7 +144,8 @@ class TcpCluster:
     server_cls = TcpRpcServer
     transport_cls = TcpTransport
 
-    def __init__(self, tmp_path=None):
+    def __init__(self, tmp_path=None, snapshot: bool = False):
+        self.snapshot = snapshot
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.servers: dict[PeerId, TcpRpcServer] = {}
@@ -173,6 +174,8 @@ class TcpCluster:
             base = f"{self.tmp_path}/{peer.ip}_{peer.port}"
             opts.log_uri = f"file://{base}/log"
             opts.raft_meta_uri = f"file://{base}/meta"
+            if self.snapshot:
+                opts.snapshot_uri = f"file://{base}/snapshot"
         else:
             opts.log_uri = "memory://"
             opts.raft_meta_uri = "memory://"
