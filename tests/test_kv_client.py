@@ -14,14 +14,15 @@ from tpuraft.rheakv.pd_client import FakePlacementDriverClient
 
 
 @contextlib.asynccontextmanager
-async def kv_client_cluster(regions=None, tmp_path=None, **kw):
+async def kv_client_cluster(regions=None, tmp_path=None, batching=None,
+                            **kw):
     c = KVTestCluster(3, tmp_path=tmp_path, regions=regions, **kw)
     await c.start_all()
     pd = FakePlacementDriverClient(c.region_template)
     # FakePD's static view lacks peers filled in by the cluster helper
     pd._regions = {r.id: r.copy() for s in [next(iter(c.stores.values()))]
                    for r in s.list_regions()}
-    client = RheaKVStore(pd, c.client_transport())
+    client = RheaKVStore(pd, c.client_transport(), batching=batching)
     await client.start()
     try:
         yield c, client

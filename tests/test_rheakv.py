@@ -355,3 +355,44 @@ def test_store_engine_kv_metrics_option():
     assert isinstance(se.raw_store, MetricsRawKVStore)
     se.raw_store.put(b"k", b"v")
     assert "kv_put" in se.metrics.snapshot()["histograms"]
+
+
+async def test_64_region_store_with_engine_plane():
+    """The BASELINE.md 'RheaKV 64-region' configuration at test scale:
+    64 regions x 3 stores, every store batching all its regions' quorum
+    math through one MultiRaftEngine plane, batched client ops spread
+    across every region."""
+    from tests.test_kv_client import kv_client_cluster
+    from tpuraft.core.engine import MultiRaftEngine
+    from tpuraft.options import TickOptions
+    from tpuraft.rheakv.client import BatchingOptions
+
+    # 64 key-range regions over 1-byte prefixes
+    bounds = [bytes([i * 4]) for i in range(64)] + [b""]
+    regions = [Region(id=i + 1, start_key=bounds[i] if i else b"",
+                      end_key=bounds[i + 1]) for i in range(64)]
+
+    def factory():
+        return MultiRaftEngine(TickOptions(
+            max_groups=72, max_peers=4, tick_interval_ms=2,
+            backend="numpy"))
+
+    async with kv_client_cluster(
+            regions=regions, election_timeout_ms=1000,
+            multi_raft_engine_factory=factory,
+            batching=BatchingOptions(enabled=True)) as (c, kv):
+        for rid in range(1, 65):
+            await c.wait_region_leader(rid, timeout_s=30)
+        # one key per region, written concurrently through batching
+        keys = [bytes([i * 4]) + b"-k" for i in range(64)]
+        oks = await asyncio.gather(*[kv.put(k, b"v-" + k) for k in keys])
+        assert all(oks)
+        got = await kv.multi_get(keys)
+        assert all(got[k] == b"v-" + k for k in keys)
+        # a full scan crosses all 64 regions in order
+        rows = await kv.scan(b"", b"")
+        assert [k for k, _ in rows] == sorted(keys)
+        # commits flowed through the batched engine planes
+        advances = sum(s.multi_raft_engine.commit_advances
+                       for s in c.stores.values())
+        assert advances >= 64, advances

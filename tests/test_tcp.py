@@ -145,6 +145,9 @@ class TcpCluster:
     transport_cls = TcpTransport
 
     def __init__(self, tmp_path=None, snapshot: bool = False):
+        if snapshot and tmp_path is None:
+            raise ValueError("snapshot=True needs a tmp_path (snapshot "
+                             "storage is file-based)")
         self.snapshot = snapshot
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
