@@ -8,6 +8,8 @@ reports throughput + latency percentiles.
     python -m examples.rheakv_bench                 # defaults: 3x4, quick
     python -m examples.rheakv_bench --regions 16 --keys 20000 --ops 50000 \
         --workload a    # 50/50 read-update (YCSB-A); b = 95/5
+    python -m examples.rheakv_bench --transport native --store native \
+        --data /tmp/rkv # real epoll sockets + C++ KV engine
 """
 
 from __future__ import annotations
@@ -40,23 +42,78 @@ def make_regions(n_regions: int, n_keys_space: int = 1 << 32) -> list[Region]:
 
 
 class BenchCluster:
-    """N stores x R regions over the in-proc loopback fabric."""
+    """N stores x R regions, over in-proc loopback or real sockets
+    (``transport``: "inproc" | "tcp" | "native" — the latter two bind
+    ephemeral localhost ports; "native" is the C++ epoll engine).
+    ``store``: "memory" or "native" (C++ KV engine; needs data_path)."""
 
     def __init__(self, n_stores: int, regions: list[Region],
-                 election_timeout_ms: int = 1000, lease_reads: bool = False):
+                 election_timeout_ms: int = 1000, lease_reads: bool = False,
+                 transport: str = "inproc", store: str = "memory",
+                 data_path: str = ""):
         self.lease_reads = lease_reads
-        self.net = InProcNetwork()
-        self.endpoints = [f"127.0.0.1:{6100 + i}" for i in range(n_stores)]
-        for r in regions:
-            r.peers = list(self.endpoints)
+        self.transport_kind = transport
+        self.store_kind = store
+        self.data_path = data_path
+        self.net = InProcNetwork() if transport == "inproc" else None
+        self.n_stores = n_stores
+        self.endpoints: list[str] = []
+        self._regions_template = regions
         self.regions = regions
         self.election_timeout_ms = election_timeout_ms
         self.stores: dict[str, StoreEngine] = {}
+        self._servers = []
+        self._transports = []
 
-    async def start(self) -> None:
-        for ep in self.endpoints:
+    def _transport_classes(self):
+        """(server_cls, transport_cls) for the socket fabrics."""
+        if self.transport_kind == "tcp":
+            from tpuraft.rpc.tcp import TcpRpcServer, TcpTransport
+            return TcpRpcServer, TcpTransport
+        from tpuraft.rpc.native_tcp import (
+            NativeTcpRpcServer,
+            NativeTcpTransport,
+        )
+        return NativeTcpRpcServer, NativeTcpTransport
+
+    async def _make_server(self, i: int):
+        if self.transport_kind == "inproc":
+            ep = f"127.0.0.1:{6100 + i}"
             server = RpcServer(ep)
             self.net.bind(server)
+            return ep, server, InProcTransport(self.net, ep)
+        srv_cls, t_cls = self._transport_classes()
+        server = srv_cls("127.0.0.1:0")
+        await server.start()
+        ep = f"127.0.0.1:{server.bound_port}"
+        server.endpoint = ep
+        return ep, server, t_cls(endpoint=ep)
+
+    def _raw_store_factory(self, ep: str):
+        if self.store_kind != "native":
+            return None
+        import os
+        import tempfile
+
+        from tpuraft.rheakv.native_store import NativeRawKVStore
+        if not self.data_path:
+            # per-run unique: a fixed default would replay a previous
+            # run's WAL when the OS reuses an ephemeral port
+            self.data_path = tempfile.mkdtemp(prefix="rheakv_bench_")
+        base = self.data_path
+        os.makedirs(base, exist_ok=True)  # engine mkdirs only the leaf
+        return lambda: NativeRawKVStore(f"{base}/{ep.replace(':', '_')}")
+
+    async def start(self) -> None:
+        made = [await self._make_server(i) for i in range(self.n_stores)]
+        self.endpoints = [ep for ep, _, _ in made]
+        # register for cleanup BEFORE any store starts, so a failed
+        # store.start() can't strand later servers' io threads/fds
+        self._servers.extend(server for _, server, _ in made)
+        self._transports.extend(t for _, _, t in made)
+        for r in self._regions_template:
+            r.peers = list(self.endpoints)
+        for ep, server, transport in made:
             opts = StoreEngineOptions(
                 server_id=ep,
                 initial_regions=[r.copy() for r in self.regions],
@@ -64,7 +121,10 @@ class BenchCluster:
                 read_only_option=(ReadOnlyOption.LEASE_BASED
                                   if self.lease_reads
                                   else ReadOnlyOption.SAFE))
-            store = StoreEngine(opts, server, InProcTransport(self.net, ep))
+            factory = self._raw_store_factory(ep)
+            if factory is not None:
+                opts.raw_store_factory = factory
+            store = StoreEngine(opts, server, transport)
             await store.start()
             self.stores[ep] = store
 
@@ -86,15 +146,34 @@ class BenchCluster:
     async def client(self) -> RheaKVStore:
         pd = FakePlacementDriverClient(
             [r.copy() for r in next(iter(self.stores.values())).list_regions()])
-        kv = RheaKVStore(pd, InProcTransport(self.net, "bench-client:0"))
+        if self.transport_kind == "inproc":
+            t = InProcTransport(self.net, "bench-client:0")
+        else:
+            t = self._transport_classes()[1]()
+        self._client_transport = t
+        kv = RheaKVStore(pd, t)
         await kv.start()
         return kv
 
     async def stop(self) -> None:
         for ep, s in list(self.stores.items()):
-            self.net.unbind(ep)
+            if self.net is not None:
+                self.net.unbind(ep)
             await s.shutdown()
         self.stores.clear()
+        for server in self._servers:
+            stop = getattr(server, "stop", None)
+            if stop is not None:
+                await stop()
+        self._servers.clear()
+        for t in self._transports:
+            close = getattr(t, "close", None)
+            if close is not None:
+                await close()
+        self._transports.clear()
+        ct = getattr(self, "_client_transport", None)
+        if ct is not None and hasattr(ct, "close"):
+            await ct.close()
 
 
 def _key(i: int) -> bytes:
@@ -106,21 +185,26 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
                     n_keys: int = 2000, n_ops: int = 5000,
                     value_size: int = 100, workload: str = "b",
                     concurrency: int = 64, lease_reads: bool = False,
-                    verbose: bool = True) -> dict:
+                    transport: str = "inproc", store: str = "memory",
+                    data_path: str = "", verbose: bool = True) -> dict:
     read_frac = {"a": 0.5, "b": 0.95, "c": 1.0}[workload]
     cluster = BenchCluster(n_stores, make_regions(n_regions),
-                           lease_reads=lease_reads)
-    await cluster.start()
-    await cluster.wait_leaders()
-    kv = await cluster.client()
+                           lease_reads=lease_reads, transport=transport,
+                           store=store, data_path=data_path)
     value = b"v" * value_size
     rng = np.random.default_rng(0)
+    kv = None
 
     def say(*a):
         if verbose:
             print(*a)
 
     try:
+        # setup inside the try: a wait_leaders timeout must still tear
+        # the native io threads / sockets / WAL fds down via finally
+        await cluster.start()
+        await cluster.wait_leaders()
+        kv = await cluster.client()
         # -- load phase ----------------------------------------------------
         t0 = time.perf_counter()
         sem = asyncio.Semaphore(concurrency)
@@ -153,7 +237,7 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
         run_s = time.perf_counter() - t0
         lat_ms = np.sort(np.asarray(lat)) * 1e3
         result = {
-            "workload": workload,
+            "workload": workload, "transport": transport, "store": store,
             "stores": n_stores, "regions": n_regions,
             "ops_per_s": n_ops / run_s,
             "p50_ms": float(lat_ms[int(0.50 * len(lat_ms))]),
@@ -164,7 +248,8 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
             f"p50 {result['p50_ms']:.2f}ms, p99 {result['p99_ms']:.2f}ms")
         return result
     finally:
-        await kv.shutdown()
+        if kv is not None:
+            await kv.shutdown()
         await cluster.stop()
 
 
@@ -179,10 +264,20 @@ def main() -> None:
     ap.add_argument("--concurrency", type=int, default=64)
     ap.add_argument("--lease-reads", action="store_true",
                     help="LEASE_BASED readIndex (no per-read quorum round)")
+    ap.add_argument("--transport", choices=["inproc", "tcp", "native"],
+                    default="inproc",
+                    help="RPC fabric: in-proc loopback, asyncio TCP, or "
+                         "the native C++ epoll engine")
+    ap.add_argument("--store", choices=["memory", "native"],
+                    default="memory",
+                    help="data engine: in-memory or the native C++ engine")
+    ap.add_argument("--data", default="",
+                    help="data dir for --store native")
     args = ap.parse_args()
     asyncio.run(run_bench(args.stores, args.regions, args.keys, args.ops,
                           args.value_size, args.workload, args.concurrency,
-                          args.lease_reads))
+                          args.lease_reads, args.transport, args.store,
+                          args.data))
 
 
 if __name__ == "__main__":

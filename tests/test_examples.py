@@ -81,3 +81,13 @@ async def test_admin_cli_against_live_cluster(tmp_path):
         assert c.nodes[target].state.value == "leader"
     finally:
         await c.stop_all()
+
+
+async def test_rheakv_bench_native_stack(tmp_path):
+    """The benchmark's full-native mode: C++ epoll transport + C++ KV
+    engine, small sizes."""
+    r = await run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
+                        concurrency=16, transport="native", store="native",
+                        data_path=str(tmp_path), verbose=False)
+    assert r["ops_per_s"] > 0
+    assert r["transport"] == "native" and r["store"] == "native"
