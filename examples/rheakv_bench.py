@@ -58,7 +58,6 @@ class BenchCluster:
         self.net = InProcNetwork() if transport == "inproc" else None
         self.n_stores = n_stores
         self.endpoints: list[str] = []
-        self._regions_template = regions
         self.regions = regions
         self.election_timeout_ms = election_timeout_ms
         self.stores: dict[str, StoreEngine] = {}
@@ -105,13 +104,16 @@ class BenchCluster:
         return lambda: NativeRawKVStore(f"{base}/{ep.replace(':', '_')}")
 
     async def start(self) -> None:
-        made = [await self._make_server(i) for i in range(self.n_stores)]
+        made = []
+        for i in range(self.n_stores):
+            ep, server, transport = await self._make_server(i)
+            # register for cleanup AS EACH is made, so a failure midway
+            # (or during store.start below) can't strand io threads/fds
+            self._servers.append(server)
+            self._transports.append(transport)
+            made.append((ep, server, transport))
         self.endpoints = [ep for ep, _, _ in made]
-        # register for cleanup BEFORE any store starts, so a failed
-        # store.start() can't strand later servers' io threads/fds
-        self._servers.extend(server for _, server, _ in made)
-        self._transports.extend(t for _, _, t in made)
-        for r in self._regions_template:
+        for r in self.regions:
             r.peers = list(self.endpoints)
         for ep, server, transport in made:
             opts = StoreEngineOptions(

@@ -124,12 +124,14 @@ class PDTestCluster(KVTestCluster):
                  regions: Optional[list[Region]] = None,
                  election_timeout_ms: int = 300,
                  split_threshold_keys: int = 0,
-                 heartbeat_interval_ms: int = 100):
+                 heartbeat_interval_ms: int = 100,
+                 balance_leaders: bool = False):
         super().__init__(n_stores, tmp_path=tmp_path, regions=regions,
                          election_timeout_ms=election_timeout_ms)
         self.pd_endpoints = [f"127.0.0.1:{7000 + i}" for i in range(n_pd)]
         self.split_threshold_keys = split_threshold_keys
         self.heartbeat_interval_ms = heartbeat_interval_ms
+        self.balance_leaders = balance_leaders
         self.pd_servers: dict[str, PlacementDriverServer] = {}
 
     async def start_all(self) -> None:
@@ -147,6 +149,7 @@ class PDTestCluster(KVTestCluster):
             election_timeout_ms=self.election_timeout_ms,
             data_path=str(self.tmp_path) if self.tmp_path else "",
             split_threshold_keys=self.split_threshold_keys,
+            balance_leaders=self.balance_leaders,
             initial_regions=[r.copy() for r in self.region_template],
         )
         pd = PlacementDriverServer(opts, endpoint, server, transport)

@@ -8,6 +8,7 @@ import asyncio
 import contextlib
 import time
 
+from tpuraft.rheakv.metadata import Region
 from tests.kv_cluster import PDTestCluster
 from tpuraft.rheakv.client import RheaKVStore
 
@@ -104,3 +105,52 @@ async def test_client_with_remote_pd():
         s = await kv.get_sequence(b"pd-seq", 5)
         assert (s.start, s.end) == (0, 5)
         await kv.shutdown()
+
+
+async def test_pd_balances_leaders():
+    """PD leader balancing (reference: PD-stats-driven rebalance): all
+    regions' leaders piled onto one store get TRANSFER_LEADER
+    instructions until counts even out."""
+    regions = [Region(id=i + 1,
+                      start_key=bytes([i * 40]) if i else b"",
+                      end_key=bytes([(i + 1) * 40]) if i < 5 else b"")
+               for i in range(6)]
+    async with pd_cluster(regions=regions, balance_leaders=True) as c:
+        await c.wait_pd_leader()
+        for rid in range(1, 7):
+            await c.wait_region_leader(rid)
+        # pile every region's leadership onto store 0
+        target = c.endpoints[0]
+        for rid in range(1, 7):
+            for _ in range(4):
+                leader = await c.wait_region_leader(rid)
+                if leader.store_engine.server_id.endpoint == target:
+                    break
+                from tpuraft.entity import PeerId
+                st = await leader.transfer_leadership_to(
+                    PeerId.parse(target))
+                await asyncio.sleep(0.2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            leader0 = sum(
+                1 for rid in range(1, 7)
+                for s in [c.stores[target].get_region_engine(rid)]
+                if s is not None and s.is_leader())
+            if leader0 >= 5:
+                break
+            await asyncio.sleep(0.1)
+        # PD heartbeats should now spread leadership back out
+        deadline = time.monotonic() + 20
+        spread = None
+        while time.monotonic() < deadline:
+            counts = {ep: 0 for ep in c.endpoints}
+            for rid in range(1, 7):
+                for ep, s in c.stores.items():
+                    eng = s.get_region_engine(rid)
+                    if eng is not None and eng.is_leader():
+                        counts[ep] += 1
+            spread = max(counts.values()) - min(counts.values())
+            if sum(counts.values()) == 6 and spread <= 2:
+                break
+            await asyncio.sleep(0.2)
+        assert spread is not None and spread <= 2, counts

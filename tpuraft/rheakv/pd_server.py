@@ -199,6 +199,7 @@ class ClusterStatsManager:
         self.split_threshold_keys = split_threshold_keys
         self._keys: dict[int, int] = {}
         self._inflight_splits: dict[int, float] = {}  # region -> deadline
+        self._transfer_cooldown: dict[int, float] = {}  # region -> deadline
 
     def record(self, region_id: int, approximate_keys: int) -> None:
         self._keys[region_id] = approximate_keys
@@ -218,6 +219,38 @@ class ClusterStatsManager:
         self._inflight_splits[region_id] = time.monotonic() + cooldown_s
         self._keys.pop(region_id, None)
 
+    # -- leader balancing (reference: ClusterStatsManager's busiest-store
+    # accounting feeding rebalance) ------------------------------------
+
+    def pick_transfer_target(self, region: Region, leader_ep: str,
+                             region_leaders: dict[int, str],
+                             cooldown_s: float = 5.0) -> Optional[str]:
+        """If ``leader_ep`` leads at least 2 more regions than the
+        least-loaded peer of ``region``, return that peer as the
+        transfer target (with a per-region cooldown so one imbalance
+        doesn't spray repeated transfers).  Ties between equally-loaded
+        targets break on a per-region hash so concurrent decisions
+        spread across stores instead of herding onto the first one."""
+        now = time.monotonic()
+        self._transfer_cooldown = {
+            r: d for r, d in self._transfer_cooldown.items() if d > now}
+        if region.id in self._transfer_cooldown:
+            return None
+        counts: dict[str, int] = {}
+        for _, ep in region_leaders.items():
+            counts[ep] = counts.get(ep, 0) + 1
+        my = counts.get(leader_ep, 0)
+        candidates = [p for p in region.peers if p != leader_ep]
+        if not candidates:
+            return None
+        target = min(candidates,
+                     key=lambda p: (counts.get(p, 0),
+                                    hash((region.id, p)) & 0xffff))
+        if my - counts.get(target, 0) < 2:
+            return None
+        self._transfer_cooldown[region.id] = now + cooldown_s
+        return target
+
 
 @dataclass
 class PlacementDriverOptions:
@@ -227,6 +260,9 @@ class PlacementDriverOptions:
     # emit a RANGE_SPLIT instruction when a region reports >= this many
     # keys (0 disables auto-split)
     split_threshold_keys: int = 0
+    # emit TRANSFER_LEADER instructions to even out per-store leader
+    # counts (reference: CliServiceImpl#rebalance driven by PD stats)
+    balance_leaders: bool = False
     initial_regions: list[Region] = field(default_factory=list)
 
 
@@ -395,6 +431,13 @@ class PlacementDriverServer:
             instructions.append(Instruction(
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
                 new_region_id=new_id))
+        elif self.opts.balance_leaders:
+            target = self.stats.pick_transfer_target(
+                region, req.leader, self.fsm.region_leaders)
+            if target is not None:
+                instructions.append(Instruction(
+                    kind=Instruction.KIND_TRANSFER_LEADER,
+                    region_id=region.id, target_peer=target))
         return RegionHeartbeatResponse(
             instructions=[i.encode() for i in instructions])
 
