@@ -505,3 +505,81 @@ async def test_lease_based_read_index():
         await asyncio.wait_for(leader.read_index(), 3)
     c.net.heal()
     await c.stop_all()
+
+
+async def test_adversarial_network_invariants():
+    """Short adversarial soak: 5% packet drop + 3ms delay + rolling
+    one-way partitions under sustained writes, with an election-safety
+    monitor (never two leaders in one term) and exactly-once + identical
+    convergent logs asserted at the end."""
+    import random
+
+    rng = random.Random(42)
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    await c.wait_leader()
+    c.net.set_delay_ms(3)
+    c.net.set_drop_rate(0.05)
+
+    violations: list[str] = []
+    stop = False
+
+    async def monitor():
+        while not stop:
+            by_term: dict[int, list[str]] = {}
+            for p, n in c.nodes.items():
+                if n.state == State.LEADER:
+                    by_term.setdefault(n.current_term, []).append(str(p))
+            for t, ls in by_term.items():
+                if len(ls) > 1:
+                    violations.append(f"two leaders in term {t}: {ls}")
+            await asyncio.sleep(0.005)
+
+    acked: list[bytes] = []
+
+    async def writer(wid):
+        i = 0
+        while not stop:
+            try:
+                leader = await c.wait_leader(3.0)
+                st = await c.apply_ok(leader, b"w%d-%05d" % (wid, i),
+                                      timeout_s=3.0)
+                if st.is_ok():
+                    acked.append(b"w%d-%05d" % (wid, i))
+            except Exception:
+                pass
+            i += 1
+            await asyncio.sleep(0.002)
+
+    mon = asyncio.ensure_future(monitor())
+    writers = [asyncio.ensure_future(writer(w)) for w in range(2)]
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 8:
+        await asyncio.sleep(1.5)
+        a, b = rng.choice(c.peers), rng.choice(c.peers)
+        if a != b:
+            c.net.partition_one_way({a.endpoint}, {b.endpoint})
+            await asyncio.sleep(0.5)
+            c.net.heal()
+            c.net.set_delay_ms(3)
+            c.net.set_drop_rate(0.05)
+    stop = True
+    await asyncio.gather(*writers)
+    mon.cancel()
+    c.net.set_drop_rate(0)
+    c.net.set_delay_ms(0)
+
+    assert not violations, violations[:3]
+    assert len(acked) > 50, len(acked)
+    acked_set = set(acked)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if all(acked_set <= set(c.fsms[p].logs) for p in c.peers):
+            break
+        await asyncio.sleep(0.1)
+    logs = [c.fsms[p].logs for p in c.peers]
+    assert logs[0] == logs[1] == logs[2], "replica logs diverged"
+    for lg in logs:
+        acked_in_log = [x for x in lg if x in acked_set]
+        assert len(acked_in_log) == len(acked_set), "duplicate/lost ack"
+    await c.stop_all()
