@@ -560,9 +560,8 @@ async def test_adversarial_network_invariants():
         if a != b:
             c.net.partition_one_way({a.endpoint}, {b.endpoint})
             await asyncio.sleep(0.5)
-            c.net.heal()
-            c.net.set_delay_ms(3)
-            c.net.set_drop_rate(0.05)
+            c.net.heal()  # note: heal() clears partitions only; the
+            # delay/drop settings stay in effect throughout
     stop = True
     await asyncio.gather(*writers)
     mon.cancel()
@@ -572,14 +571,24 @@ async def test_adversarial_network_invariants():
     assert not violations, violations[:3]
     assert len(acked) > 50, len(acked)
     acked_set = set(acked)
+    # converge on the condition actually asserted below: identical logs
+    # containing every acked entry (a leader can briefly hold applied
+    # tail entries its followers haven't applied yet)
     deadline = time.monotonic() + 15
+    converged = False
     while time.monotonic() < deadline:
-        if all(acked_set <= set(c.fsms[p].logs) for p in c.peers):
+        logs = [c.fsms[p].logs for p in c.peers]
+        if (logs[0] == logs[1] == logs[2]
+                and acked_set <= set(logs[0])):
+            converged = True
             break
         await asyncio.sleep(0.1)
-    logs = [c.fsms[p].logs for p in c.peers]
-    assert logs[0] == logs[1] == logs[2], "replica logs diverged"
-    for lg in logs:
-        acked_in_log = [x for x in lg if x in acked_set]
-        assert len(acked_in_log) == len(acked_set), "duplicate/lost ack"
+    assert converged, "replicas failed to converge on identical logs"
+    # exactly-once PER ENTRY: a compensating duplicate+loss pair must
+    # not cancel out in an aggregate count
+    from collections import Counter
+
+    occurrences = Counter(logs[0])
+    for entry in acked_set:
+        assert occurrences[entry] == 1, (entry, occurrences[entry])
     await c.stop_all()
