@@ -48,11 +48,6 @@ def main():
     BATCH = 32      # entries acked per follower per tick (apply_batch)
     TICKS = 400
     WARMUP = 40
-    # Max ticks in flight.  Bounds commit-ack latency; must cover the
-    # dispatch->completion latency of the link to the chip (measured and
-    # reported as completion_rtt_ms — ~120ms over the axon tunnel used in
-    # CI, sub-ms when the host is co-located with the TPU).
-    DEPTH = 16
 
     rng = np.random.default_rng(0)
     state = GroupState.zeros(G, P)
@@ -77,6 +72,7 @@ def main():
     inflight = deque()   # (submit_time, tick_idx, device commit array)
     lat = []
     last_commit = None   # most recently materialized commit array
+    DEPTH = 16           # provisional for warmup; re-sized to the link below
 
     def drain_one():
         nonlocal last_commit
@@ -118,12 +114,27 @@ def main():
         state = state2
     completion_rtt_ms = round(min(rtts) * 1000, 2)
 
+    # post-compile dispatch cost: a short unsynchronized burst
+    burst = 8
+    t_b = time.perf_counter()
+    for i in range(WARMUP, WARMUP + burst):
+        submit(i)
+    dispatch_s = (time.perf_counter() - t_b) / burst
+    while inflight:
+        drain_one()
+
+    # size the in-flight window to the LINK, not a constant: enough
+    # outstanding ticks to cover the completion RTT at the measured
+    # dispatch cost (plus margin), so a co-located chip (sub-ms RTT)
+    # isn't saddled with tunnel-sized ack latency
+    DEPTH = max(4, min(64, int(min(rtts) / max(dispatch_s, 1e-4)) + 4))
+
     # three measurement passes, report the MEDIAN: the tunnel to the
     # chip shares a congested link with ~2x run-to-run variance, and the
     # median is robust to one bad window without the upward bias of max
     passes = []
-    half = TICKS // 3
-    start_i = WARMUP
+    half = (TICKS - burst) // 3
+    start_i = WARMUP + burst
     for _ in range(3):
         lat.clear()
         base_commits = int(last_commit.sum())
@@ -154,6 +165,7 @@ def main():
         "extra": {
             "groups": G, "peer_slots": P, "voters": VOTERS,
             "pipeline_depth": DEPTH,
+            "dispatch_ms": round(dispatch_s * 1000, 2),
             "ticks_per_sec": round(med["tps"], 1),
             # all raw passes reported so the aggregation is explicit
             "aggregation": "median_of_3_passes",

@@ -76,7 +76,8 @@ class TestCluster:
     def __init__(self, n: int, tmp_path=None, election_timeout_ms: int = 300,
                  snapshot: bool = False, group_id: str = "test_group",
                  snapshot_interval_secs: int = 0,
-                 coalesce_heartbeats: bool = False):
+                 coalesce_heartbeats: bool = False,
+                 log_scheme: str = "file"):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
@@ -92,6 +93,7 @@ class TestCluster:
                 "timer never fires)")
         self.snapshot_interval_secs = snapshot_interval_secs
         self.coalesce_heartbeats = coalesce_heartbeats
+        self.log_scheme = log_scheme  # "file" | "native" (needs tmp_path)
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
@@ -104,7 +106,7 @@ class TestCluster:
         )
         if self.tmp_path is not None:
             base = f"{self.tmp_path}/{peer.ip}_{peer.port}"
-            opts.log_uri = f"file://{base}/log"
+            opts.log_uri = f"{self.log_scheme}://{base}/log"
             opts.raft_meta_uri = f"file://{base}/meta"
             if self.snapshot:
                 opts.snapshot_uri = f"file://{base}/snapshot"

@@ -592,3 +592,29 @@ async def test_adversarial_network_invariants():
     for entry in acked_set:
         assert occurrences[entry] == 1, (entry, occurrences[entry])
     await c.stop_all()
+
+
+async def test_cluster_on_native_log_engine(tmp_path):
+    """A raft cluster whose durable log is the C++ engine
+    (native/logstore.cc via log_uri=native://): replicate, crash the
+    leader, restart it, recover from the native log."""
+    from tests.test_storage import _native_available
+
+    if not _native_available():
+        pytest.skip("C++ engine not buildable")
+    c = TestCluster(3, tmp_path=tmp_path, log_scheme="native")
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(10):
+        st = await c.apply_ok(leader, b"n%d" % i)
+        assert st.is_ok(), st
+    await c.wait_applied(10)
+    dead = leader.server_id
+    await c.stop(dead)
+    leader2 = await c.wait_leader()
+    st = await c.apply_ok(leader2, b"post")
+    assert st.is_ok()
+    await c.start(dead, fsm=MockStateMachine())
+    await c.wait_applied(11)
+    assert c.fsms[dead].logs == [b"n%d" % i for i in range(10)] + [b"post"]
+    await c.stop_all()
