@@ -93,6 +93,9 @@ class TestCluster:
                 "timer never fires)")
         self.snapshot_interval_secs = snapshot_interval_secs
         self.coalesce_heartbeats = coalesce_heartbeats
+        if log_scheme != "file" and tmp_path is None:
+            raise ValueError(f"log_scheme={log_scheme!r} needs a tmp_path "
+                             "(memory:// would silently be used instead)")
         self.log_scheme = log_scheme  # "file" | "native" (needs tmp_path)
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}

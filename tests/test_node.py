@@ -618,3 +618,103 @@ async def test_cluster_on_native_log_engine(tmp_path):
     await c.wait_applied(11)
     assert c.fsms[dead].logs == [b"n%d" % i for i in range(10)] + [b"post"]
     await c.stop_all()
+
+
+async def test_five_node_quorum_survives_two_failures():
+    """5 voters tolerate 2 crashes (reference NodeTest's larger-quorum
+    coverage): writes keep committing with 3/5, and the crashed pair
+    catches up on restart."""
+    c = TestCluster(5)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(5):
+        st = await c.apply_ok(leader, b"q%d" % i)
+        assert st.is_ok()
+    await c.wait_applied(5)
+    victims = [p for p in c.peers if p != leader.server_id][:2]
+    for v in victims:
+        await c.stop(v)
+    leader = await c.wait_leader()
+    st = await c.apply_ok(leader, b"with-3-of-5")
+    assert st.is_ok(), st
+    # a third failure would break quorum: verify 3/5 still commits but
+    # don't go below (that's covered by reset_peers tests)
+    for v in victims:
+        await c.start(v, fsm=MockStateMachine())
+    await c.wait_applied(6)
+    for v in victims:
+        assert c.fsms[v].logs == [b"q%d" % i for i in range(5)] + \
+            [b"with-3-of-5"]
+    await c.stop_all()
+
+
+async def test_change_peers_under_sustained_load():
+    """Membership change under fire (reference: NodeTest changePeers
+    with concurrent applies): grow 3 -> 5 while writers run, then
+    shrink back to the new pair + leader, losing no acked write."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader()
+
+    acked: list[bytes] = []
+    stop = False
+
+    async def writer():
+        i = 0
+        while not stop:
+            try:
+                ld = await c.wait_leader(3.0)
+                st = await c.apply_ok(ld, b"m%05d" % i, timeout_s=3.0)
+                if st.is_ok():
+                    acked.append(b"m%05d" % i)
+            except Exception:
+                pass
+            i += 1
+            await asyncio.sleep(0.002)
+
+    w = asyncio.ensure_future(writer())
+    try:
+        from tpuraft.conf import Configuration
+
+        d = PeerId.parse("127.0.0.1:5005")
+        e = PeerId.parse("127.0.0.1:5006")
+        c.peers.extend([d, e])
+        save = c.conf
+        c.conf = Configuration()
+        await c.start(d)
+        await c.start(e)
+        c.conf = save
+        leader = await c.wait_leader()
+        st = await asyncio.wait_for(
+            leader.change_peers(Configuration(
+                list(save.peers) + [d, e])), 20)
+        assert st.is_ok(), st
+        assert len(leader.list_peers()) == 5
+        await asyncio.sleep(0.3)  # writes through the 5-voter quorum
+        leader = await c.wait_leader()
+        st = await asyncio.wait_for(
+            leader.change_peers(Configuration(
+                [leader.server_id, d, e])), 20)
+        assert st.is_ok(), st
+        assert set(leader.list_peers()) == {leader.server_id, d, e}
+        await asyncio.sleep(0.3)
+    finally:
+        stop = True
+        await w
+    assert len(acked) > 30, len(acked)
+    # every acked write is exactly-once on the final membership
+    acked_set = set(acked)
+    final_nodes = [n for n in c.nodes.values()
+                   if n.server_id in leader.list_peers()]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if all(acked_set <= set(c.fsms[n.server_id].logs)
+               for n in final_nodes):
+            break
+        await asyncio.sleep(0.1)
+    from collections import Counter
+    for n in final_nodes:
+        occ = Counter(c.fsms[n.server_id].logs)
+        for entry in acked_set:
+            assert occ[entry] == 1, (str(n.server_id), entry, occ[entry])
+    await c.stop_all()

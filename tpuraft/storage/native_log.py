@@ -99,6 +99,11 @@ class NativeLogStorage(LogStorage):
         self._lib = _load()
 
     def init(self) -> None:
+        # the C engine mkdirs only the leaf; create parents here so the
+        # scheme doesn't depend on a sibling store initializing first
+        parent = os.path.dirname(self._dir.rstrip("/"))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         err = ctypes.create_string_buffer(256)
         h = self._lib.tls_open(self._dir.encode(), self._seg_max, err, 256)
         if not h:
