@@ -1,0 +1,70 @@
+"""FaultInjectingTransport: drops/delays/partitions over ANY transport.
+
+The in-proc loopback has fault injection built in (the reference's
+TestCluster pattern); this wrapper adds the same injection surface on
+top of the real-socket transports (asyncio TCP, native epoll), so
+chaos and adversarial drives run against production wire paths too.
+
+A dropped call raises EHOSTDOWN after a short delay, modeling a lost
+request the way the loopback does; the caller's retry/timeout machinery
+reacts identically either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Optional
+
+from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.transport import RpcError, TransportBase
+
+
+class FaultInjectingTransport(TransportBase):
+    def __init__(self, inner: TransportBase, seed: Optional[int] = None):
+        self._inner = inner
+        self.endpoint = inner.endpoint
+        self._rng = random.Random(seed)
+        self.drop_rate = 0.0
+        self.delay_ms = 0.0
+        self._blocked_dsts: set[str] = set()
+
+    # -- injection controls --------------------------------------------------
+
+    def set_drop_rate(self, rate: float) -> None:
+        self.drop_rate = rate
+
+    def set_delay_ms(self, ms: float) -> None:
+        self.delay_ms = ms
+
+    def block(self, dst: str) -> None:
+        """Partition: calls to dst fail (one-way, from this side)."""
+        self._blocked_dsts.add(dst)
+
+    def unblock(self, dst: str) -> None:
+        self._blocked_dsts.discard(dst)
+
+    def heal(self) -> None:
+        self._blocked_dsts.clear()
+
+    # -- transport surface ---------------------------------------------------
+
+    async def call(self, dst: str, method: str, request: Any,
+                   timeout_ms: Optional[float] = None) -> Any:
+        if self.delay_ms > 0:
+            await asyncio.sleep(self.delay_ms / 1000.0)
+        if dst in self._blocked_dsts or (
+                self.drop_rate > 0 and self._rng.random() < self.drop_rate):
+            # match the loopback's drop behavior (transport.py): a lost
+            # request is only detected after a wait, so callers' timeout
+            # and backoff machinery engages instead of hot-loop retrying
+            wait_ms = min(timeout_ms, 50.0) if timeout_ms else 50.0
+            await asyncio.sleep(wait_ms / 1000.0)
+            raise RpcError(Status.error(
+                RaftError.EHOSTDOWN, f"injected drop to {dst}"))
+        return await self._inner.call(dst, method, request, timeout_ms)
+
+    async def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            await close()
