@@ -206,8 +206,14 @@ class _Segment:
         self.size = new_size
 
     def sync(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        f = self._f
+        if f is None:
+            return  # deleted/closed concurrently; its data is gone anyway
+        try:
+            f.flush()
+            os.fsync(f.fileno())
+        except ValueError:
+            pass  # closed between the check and the flush
 
     def close(self) -> None:
         if self._f:
@@ -361,6 +367,22 @@ class FileLogStorage(LogStorage):
     def append_entries(self, entries: list[LogEntry], sync: bool = True) -> int:
         if not entries:
             return 0
+        # The WHOLE mutation must hold the lock: this runs in executor
+        # threads while the event loop reads get_entry on the same
+        # segment file objects — an unlocked seek+write interleaving a
+        # locked seek+read corrupts the read (and a misaligned frame can
+        # silently return the WRONG entry to a replicator).  The fsync
+        # happens OUTSIDE the lock (position-independent), so event-loop
+        # readers never stall behind a disk flush.
+        with self._lock:
+            touched = self._append_entries_locked(entries, sync)
+        # fsync oldest-first so a crash leaves a prefix, never a hole
+        for seg in touched:
+            seg.sync()
+        return len(entries)
+
+    def _append_entries_locked(self, entries: list[LogEntry],
+                               sync: bool) -> list["_Segment"]:
         expected = self.last_log_index() + 1
         if entries[0].id.index != expected:
             raise ValueError(
@@ -389,13 +411,13 @@ class FileLogStorage(LogStorage):
             # first<=i<=last filter drops; the reverse order would
             # permanently hide a durable CONFIGURATION entry
             self._rewrite_conf_indexes()
-        if sync:
-            # fsync oldest-first so a crash leaves a prefix, never a hole
-            for seg in touched:
-                seg.sync()
-        return len(entries)
+        return touched if sync else []
 
     def truncate_prefix(self, first_index_kept: int) -> None:
+        with self._lock:
+            self._truncate_prefix_locked(first_index_kept)
+
+    def _truncate_prefix_locked(self, first_index_kept: int) -> None:
         if first_index_kept <= self._first:
             return
         self._first = first_index_kept
@@ -408,6 +430,10 @@ class FileLogStorage(LogStorage):
             self._rewrite_conf_indexes()
 
     def truncate_suffix(self, last_index_kept: int) -> None:
+        with self._lock:
+            self._truncate_suffix_locked(last_index_kept)
+
+    def _truncate_suffix_locked(self, last_index_kept: int) -> None:
         while self._segments and self._segments[-1].first_index > last_index_kept:
             self._segments.pop().delete()
         if self._segments:
@@ -417,6 +443,10 @@ class FileLogStorage(LogStorage):
             self._rewrite_conf_indexes()
 
     def reset(self, next_log_index: int) -> None:
+        with self._lock:
+            self._reset_locked(next_log_index)
+
+    def _reset_locked(self, next_log_index: int) -> None:
         for s in self._segments:
             s.delete()
         self._segments.clear()
