@@ -30,7 +30,8 @@ class KVTestCluster:
                  regions: Optional[list[Region]] = None,
                  election_timeout_ms: int = 300,
                  multi_raft_engine_factory=None,
-                 raw_store_factory=None):
+                 raw_store_factory=None,
+                 read_only_option=None):
         # raw_store_factory: Callable[[endpoint], RawKVStore] — lets tests
         # swap the memory store for the native C++ engine per store
         self.net = InProcNetwork()
@@ -47,6 +48,7 @@ class KVTestCluster:
         self.election_timeout_ms = election_timeout_ms
         self.engine_factory = multi_raft_engine_factory
         self.raw_store_factory = raw_store_factory
+        self.read_only_option = read_only_option
         self.stores: dict[str, StoreEngine] = {}
 
     async def start_all(self) -> None:
@@ -64,6 +66,8 @@ class KVTestCluster:
             data_path=str(self.tmp_path) if self.tmp_path else "",
             election_timeout_ms=self.election_timeout_ms,
         )
+        if self.read_only_option is not None:
+            opts.read_only_option = self.read_only_option
         if self.raw_store_factory is not None:
             opts.raw_store_factory = (
                 lambda ep=endpoint: self.raw_store_factory(ep))

@@ -307,3 +307,62 @@ async def test_chaos_rolling_kills_on_native_engine(tmp_path):
         await c.wait_region_leader(2)
         for k, v in acked.items():
             assert await kv.get(k) == v, k
+
+
+async def test_learner_store_replicates_kv_data():
+    """A region with a ``/learner`` replica: the learner store applies all
+    KV data but never becomes leader, the client routes around it, and a
+    split preserves the learner set (BASELINE config 5's feature tier:
+    regions w/ learners + lease reads).
+
+    Reference parity: learners at the RheaKV tier ride jraft-core's
+    `[1.3+]` learner support (SURVEY.md §3.1) — the fork's region peers
+    are voters only, so routing must simply never treat a learner as a
+    leader candidate.
+    """
+    from tpuraft.options import ReadOnlyOption
+
+    # lease reads from boot, as in the BASELINE config
+    c = KVTestCluster(4, read_only_option=ReadOnlyOption.LEASE_BASED)
+    voters, learner_ep = c.endpoints[:3], c.endpoints[3]
+    c.region_template = [Region(
+        id=1, peers=voters + [learner_ep + "/learner"])]
+    await c.start_all()
+    pd = FakePlacementDriverClient([r.copy() for r in c.region_template])
+    kv = RheaKVStore(pd, c.client_transport())
+    await kv.start()
+    try:
+        for i in range(24):
+            assert await kv.put(b"lk%02d" % i, b"v%d" % i)
+        assert await kv.get(b"lk07") == b"v7"
+
+        # the learner's local store converges to the replicated data
+        learner_store = c.stores[learner_ep]
+        for _ in range(200):
+            if learner_store.raw_store.get(b"lk23") == b"v23":
+                break
+            await asyncio.sleep(0.02)
+        assert learner_store.raw_store.get(b"lk00") == b"v0"
+        assert learner_store.raw_store.get(b"lk23") == b"v23"
+
+        # the learner never leads its region
+        eng = learner_store.get_region_engine(1)
+        assert eng is not None and not eng.is_leader()
+        leader = await c.wait_region_leader(1)
+        assert leader.store_engine.server_id.endpoint != learner_ep
+
+        # split preserves the learner replica on both halves
+        st = await leader.store_engine.apply_split(1, 2)
+        assert st.is_ok(), str(st)
+        await c.wait_region_on_all(2)
+        await c.wait_region_leader(2)
+        for s in c.stores.values():
+            for rid in (1, 2):
+                region = s.get_region_engine(rid).region
+                assert learner_ep + "/learner" in region.peers
+        # and the cluster still serves reads+writes through the client
+        assert await kv.put(b"after-split", b"ok")
+        assert await kv.get(b"after-split") == b"ok"
+    finally:
+        await kv.shutdown()
+        await c.stop_all()

@@ -185,13 +185,15 @@ class RheaKVStore:
         except Exception:  # noqa: BLE001 — PD unreachable / electing
             LOG.debug("pd route refresh failed; falling back to stores",
                       exc_info=True)
-        endpoints = {p for r in regions for p in r.peers}
+        # dedupe on the store endpoint: the same store may be a voter in
+        # one region and a '/learner' in another
+        endpoints = {_endpoint(p) for r in regions for p in r.peers}
         # also ask every store we already know about (covers PD-down case)
-        endpoints.update(p for r in self.route_table.list_regions()
+        endpoints.update(_endpoint(p) for r in self.route_table.list_regions()
                          for p in r.peers)
-        async def ask(peer: str):
+        async def ask(ep: str):
             return await self.transport.call(
-                _endpoint(peer), "kv_list_regions",
+                ep, "kv_list_regions",
                 ListRegionsOnStoreRequest(), self.timeout_ms)
 
         answers = await asyncio.gather(
@@ -212,12 +214,19 @@ class RheaKVStore:
             self.route_table.reset(list(best.values()))
 
     def _endpoints_for(self, region: Region) -> list[str]:
-        """Leader-first candidate ordering of the region's store endpoints."""
+        """Leader-first candidate ordering of the region's store endpoints.
+
+        Learner replicas (``/learner``-suffixed peers — read-only, never
+        leaders) go last: they can only serve by forwarding, so they are
+        a fallback when no voter answers, not a first hop.
+        """
         eps = []
+        voters = [p for p in region.peers if not p.endswith("/learner")]
         leader = self._leaders.get(region.id)
-        if leader and leader in region.peers:
+        if leader and leader in voters:
             eps.append(leader)
-        eps.extend(p for p in region.peers if p not in eps)
+        eps.extend(p for p in voters if p not in eps)
+        eps.extend(p for p in region.peers if p.endswith("/learner"))
         return eps
 
     async def _call_region(self, region: Region, op: KVOperation):
@@ -505,8 +514,8 @@ class _Retry(Exception):
 
 
 def _endpoint(peer_str: str) -> str:
-    """PeerId string ('ip:port[:idx[:priority]]') -> store endpoint."""
-    return ":".join(peer_str.split(":")[:2])
+    """PeerId string ('ip:port[:idx[:priority]][/learner]') -> endpoint."""
+    return ":".join(peer_str.split("/", 1)[0].split(":")[:2])
 
 
 class DistributedLock:

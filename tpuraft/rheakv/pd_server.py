@@ -240,7 +240,9 @@ class ClusterStatsManager:
         for _, ep in region_leaders.items():
             counts[ep] = counts.get(ep, 0) + 1
         my = counts.get(leader_ep, 0)
-        candidates = [p for p in region.peers if p != leader_ep]
+        # learners are read-only replicas — never leadership targets
+        candidates = [p for p in region.peers
+                      if p != leader_ep and not p.endswith("/learner")]
         if not candidates:
             return None
         target = min(candidates,
