@@ -718,3 +718,64 @@ async def test_change_peers_under_sustained_load():
         for entry in acked_set:
             assert occ[entry] == 1, (str(n.server_id), entry, occ[entry])
     await c.stop_all()
+
+
+async def test_divergence_below_applied_fails_node_not_rpc_storm():
+    """A replica whose applied state diverges from the leader's
+    committed log (only reachable via storage loss / amnesiac restart)
+    must fail FATALLY — enter ERROR state and answer EHOSTDOWN so
+    leaders take the paced-retry path — instead of rejecting the same
+    AppendEntries forever (reference: NodeImpl#onError semantics)."""
+    from tpuraft.entity import EntryType, LogEntry, LogId
+    from tpuraft.errors import RaftError
+    from tpuraft.rpc.messages import AppendEntriesRequest
+    from tpuraft.rpc.transport import RpcError
+
+    c = TestCluster(3)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        for i in range(3):
+            st = await c.apply_ok(leader, b"e%d" % i)
+            assert st.is_ok(), str(st)
+        follower_id = next(p for p in c.peers if p != leader.server_id)
+        await c.wait_applied(3, nodes=[c.nodes[follower_id]])
+        fnode = c.nodes[follower_id]
+        lm = fnode.log_manager
+        # fabricate a conflicting entry BELOW the applied index, as a
+        # fake higher-term leader would present after divergence
+        bad_term = fnode.current_term + 5
+        idx = lm.last_log_index()          # an applied, committed index
+        prev = idx - 1
+        req = AppendEntriesRequest(
+            group_id=c.group_id, server_id="127.0.0.1:9999",
+            peer_id=str(follower_id), term=bad_term,
+            prev_log_index=prev, prev_log_term=lm.get_term(prev),
+            committed_index=0,
+            entries=[LogEntry(type=EntryType.NO_OP,
+                              id=LogId(index=idx, term=bad_term))])
+        try:
+            await fnode.handle_append_entries(req)
+            raise AssertionError("conflicting append below applied "
+                                 "index was accepted")
+        except RpcError as e:
+            assert e.status.code == int(RaftError.EHOSTDOWN), e.status
+        assert fnode.state == State.ERROR
+        # and it stays failed: the retry answers the same way
+        try:
+            await fnode.handle_append_entries(req)
+            raise AssertionError("ERROR-state node served an RPC")
+        except RpcError as e:
+            assert e.status.code == int(RaftError.EHOSTDOWN), e.status
+        # the application's StateMachine#onError hook hears about it
+        for _ in range(100):
+            if c.fsms[follower_id].errors:
+                break
+            await asyncio.sleep(0.02)
+        assert c.fsms[follower_id].errors, "fsm.on_error never fired"
+        # ERROR is sticky: a straggler higher-term response must not
+        # resurrect the node into FOLLOWER with live timers
+        await fnode.step_down_on_higher_term(bad_term + 1, "straggler")
+        assert fnode.state == State.ERROR
+    finally:
+        await c.stop_all()

@@ -34,7 +34,7 @@ from tpuraft.entity import (
     PeerId,
     Task,
 )
-from tpuraft.errors import RaftError, Status
+from tpuraft.errors import RaftError, RaftException, Status
 from tpuraft.options import NodeOptions
 from tpuraft.rpc.messages import (
     AppendEntriesRequest,
@@ -614,6 +614,11 @@ class Node:
     async def _step_down(self, term: int, status: Status,
                          new_leader: PeerId = EMPTY_PEER) -> None:
         """Caller holds the lock (reference: NodeImpl#stepDown)."""
+        if self.state in (State.ERROR, State.SHUTTING, State.SHUTDOWN):
+            # ERROR is sticky: a straggler RPC response (e.g. an
+            # in-flight heartbeat seeing a higher term) must not
+            # resurrect a failed node into FOLLOWER with live timers
+            return
         LOG.info("%s step down at term %d -> %d: %s", self, self.current_term,
                  term, status)
         was_leader = self.state in (State.LEADER, State.TRANSFERRING)
@@ -795,8 +800,24 @@ class Node:
                     term=self.current_term, success=True,
                     last_log_index=lm.last_log_index())
 
-            ok = await lm.append_entries_follower(
-                req.prev_log_index, req.prev_log_term, list(req.entries))
+            try:
+                ok = await lm.append_entries_follower(
+                    req.prev_log_index, req.prev_log_term, list(req.entries))
+            except RaftException as e:
+                # conflict below the applied index: this replica's state
+                # machine has diverged from the leader's committed log —
+                # unrecoverable (only reachable through storage loss /
+                # amnesiac restart, which Raft does not tolerate).  Fail
+                # the node loudly (reference: NodeImpl#onError) instead
+                # of rejecting this RPC forever.  The FSM hears about it
+                # too (StateMachine#onError) via the caller queue; the
+                # ERROR transition itself happens now, under the lock,
+                # so no further RPC is served meanwhile.
+                self._enter_error_locked(e.status)
+                self.fsm_caller.on_error(e.status)
+                raise RpcError(Status.error(
+                    RaftError.EHOSTDOWN,
+                    f"node failed: {e.status}")) from e
             if not ok:
                 return AppendEntriesResponse(
                     term=self.current_term, success=False,
@@ -933,17 +954,21 @@ class Node:
 
     async def _on_fsm_error(self, status: Status) -> None:
         async with self._lock:
-            if self.state in (State.SHUTTING, State.SHUTDOWN):
-                return
-            LOG.error("%s entering ERROR state: %s", self, status)
-            if self.is_leader():
-                self.replicators.stop_all()
-                self.fsm_caller.fail_pending_closures(status)
-            self.state = State.ERROR
-            for t in (self._election_timer, self._vote_timer,
-                      self._stepdown_timer):
-                if t:
-                    t.stop()
+            self._enter_error_locked(status)
+
+    def _enter_error_locked(self, status: Status) -> None:
+        """Transition to ERROR state; caller holds the node lock."""
+        if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR):
+            return
+        LOG.error("%s entering ERROR state: %s", self, status)
+        if self.is_leader():
+            self.replicators.stop_all()
+            self.fsm_caller.fail_pending_closures(status)
+        self.state = State.ERROR
+        for t in (self._election_timer, self._vote_timer,
+                  self._stepdown_timer):
+            if t:
+                t.stop()
 
     def __str__(self) -> str:
         return f"Node<{self.group_id}/{self.server_id}>"
