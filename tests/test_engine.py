@@ -249,3 +249,73 @@ async def test_engine_mesh_sharded_quorum_matches_numpy():
         assert len(commits_mesh) > 0  # something actually committed
     finally:
         await eng_mesh.shutdown()
+
+
+async def test_engine_64k_groups_mesh_sharded_with_learners():
+    """BASELINE config 5 at dry-run scale: 65536 groups (the 64K-region
+    target), each 3 voters + 1 learner slot, quorum plane sharded over
+    the 8-device CPU mesh — SPMD reduce must stay bit-identical to the
+    numpy oracle across ticks, learner acks never counting toward
+    quorum."""
+    import numpy as np
+
+    from tpuraft.conf import Configuration
+    from tpuraft.entity import PeerId as PID
+
+    G, P = 65536, 8
+    peers = [PID.parse(f"127.0.0.1:{7000 + i}") for i in range(3)]
+    learner = PID.parse("127.0.0.1:7100")
+    conf = Configuration(list(peers), [learner])
+
+    def build(opts):
+        eng = MultiRaftEngine(opts)
+        commits = {}
+        factory = eng.ballot_box_factory()
+        boxes = []
+        rng = np.random.default_rng(42)
+        for g in range(G):
+            box = factory(lambda idx, g=g: commits.__setitem__(g, idx))
+            box.update_conf(conf, Configuration())
+            box.reset_pending_index(1)
+            boxes.append(box)
+        for box in boxes:
+            for p in peers:
+                box.commit_at(p, int(rng.integers(0, 100)), conf,
+                              Configuration())
+            # learner acks far ahead of everyone: must not move quorum
+            box.commit_at(learner, 10_000, conf, Configuration())
+        return eng, boxes, commits
+
+    opts_np = TickOptions(max_groups=G, max_peers=P, backend="numpy")
+    eng_np, boxes_np, commits_np = build(opts_np)
+    eng_np.tick_once()
+
+    opts_mesh = TickOptions(max_groups=G, max_peers=P, backend="jax",
+                            mesh_devices=8)
+    eng_mesh, boxes_mesh, commits_mesh = build(opts_mesh)
+    await eng_mesh.start()
+    try:
+        eng_mesh.tick_once()
+        assert commits_mesh == commits_np
+        assert len(commits_mesh) > G * 0.99
+        # learner-only progress on one group: quorum must not advance
+        g_probe = 17
+        before = commits_mesh.get(g_probe)
+        for boxes, eng in ((boxes_np, eng_np), (boxes_mesh, eng_mesh)):
+            boxes[g_probe].commit_at(learner, 20_000, conf, Configuration())
+            eng.tick_once()
+        assert commits_mesh.get(g_probe) == before
+        assert commits_np.get(g_probe) == before
+        # voter progress on a stride of groups: both planes agree again
+        rng = np.random.default_rng(7)
+        advances = {g: (100 + int(rng.integers(0, 50)),
+                        100 + int(rng.integers(0, 50)))
+                    for g in range(0, G, 5)}
+        for boxes, eng in ((boxes_np, eng_np), (boxes_mesh, eng_mesh)):
+            for g, (a, b) in advances.items():
+                boxes[g].commit_at(peers[1], a, conf, Configuration())
+                boxes[g].commit_at(peers[2], b, conf, Configuration())
+            eng.tick_once()
+        assert commits_mesh == commits_np
+    finally:
+        await eng_mesh.shutdown()
