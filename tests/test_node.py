@@ -726,6 +726,7 @@ async def test_divergence_below_applied_fails_node_not_rpc_storm():
     must fail FATALLY — enter ERROR state and answer EHOSTDOWN so
     leaders take the paced-retry path — instead of rejecting the same
     AppendEntries forever (reference: NodeImpl#onError semantics)."""
+    from tpuraft.conf import Configuration
     from tpuraft.entity import EntryType, LogEntry, LogId
     from tpuraft.errors import RaftError
     from tpuraft.rpc.messages import AppendEntriesRequest
@@ -777,5 +778,17 @@ async def test_divergence_below_applied_fails_node_not_rpc_storm():
         # resurrect the node into FOLLOWER with live timers
         await fnode.step_down_on_higher_term(bad_term + 1, "straggler")
         assert fnode.state == State.ERROR
+        # the apply pipeline is poisoned (no further commits reach the
+        # FSM) and InstallSnapshot is refused like AppendEntries
+        assert fnode.fsm_caller._error is not None
+        try:
+            await fnode.handle_install_snapshot(object())
+            raise AssertionError("ERROR-state node accepted a snapshot")
+        except RpcError as e:
+            assert e.status.code == int(RaftError.EHOSTDOWN), e.status
+        # conf surgery can't revive it — and must say so
+        st = await fnode.reset_peers(
+            Configuration([follower_id]))
+        assert st.code == int(RaftError.EHOSTDOWN), str(st)
     finally:
         await c.stop_all()

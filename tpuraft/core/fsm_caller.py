@@ -87,6 +87,16 @@ class FSMCaller:
     def on_error(self, status: Status) -> None:
         self._queue.put_nowait(("error", status))
 
+    def poison(self, status: Status) -> None:
+        """Externally-detected fatal error (e.g. divergence below the
+        applied index): poison the apply pipeline exactly like an
+        internal `_set_error` — no further committed/snapshot events
+        reach the FSM — and deliver `on_error` through the queue.  Sync
+        so the node can call it while holding its lock."""
+        if self._error is None:
+            self._error = status
+            self._queue.put_nowait(("error", status))
+
     async def on_snapshot_save(self, writer, done: Callable[[Status], None]) -> None:
         self._queue.put_nowait(("snapshot_save", (writer, done)))
 

@@ -814,7 +814,7 @@ class Node:
                 # ERROR transition itself happens now, under the lock,
                 # so no further RPC is served meanwhile.
                 self._enter_error_locked(e.status)
-                self.fsm_caller.on_error(e.status)
+                self.fsm_caller.poison(e.status)
                 raise RpcError(Status.error(
                     RaftError.EHOSTDOWN,
                     f"node failed: {e.status}")) from e
@@ -850,6 +850,13 @@ class Node:
     async def handle_install_snapshot(self, req):
         from tpuraft.rpc.messages import InstallSnapshotResponse
 
+        if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
+                          State.UNINITIALIZED):
+            # same contract as handle_append_entries: a failed node must
+            # not load snapshots into its (poisoned) state machine
+            raise RpcError(Status.error(
+                RaftError.EHOSTDOWN, f"node not serviceable: "
+                f"{self.state.value}"))
         if not self.snapshot_executor:
             return InstallSnapshotResponse(term=self.current_term, success=False)
         return await self.snapshot_executor.handle_install_snapshot(req)
@@ -919,6 +926,14 @@ class Node:
         """Unsafe manual override when quorum is permanently lost
         (reference: Node#resetPeers)."""
         async with self._lock:
+            if self.state in (State.ERROR, State.SHUTTING, State.SHUTDOWN,
+                              State.UNINITIALIZED):
+                # a failed node can't be revived by conf surgery — and
+                # the sticky-ERROR _step_down would silently skip the
+                # term bump while conf had already mutated
+                return Status.error(
+                    RaftError.EHOSTDOWN,
+                    f"cannot reset peers in state {self.state.value}")
             if not new_conf.is_valid():
                 return Status.error(RaftError.EINVAL, str(new_conf))
             self.conf_entry = ConfigurationEntry(
@@ -966,7 +981,7 @@ class Node:
             self.fsm_caller.fail_pending_closures(status)
         self.state = State.ERROR
         for t in (self._election_timer, self._vote_timer,
-                  self._stepdown_timer):
+                  self._stepdown_timer, self._snapshot_timer):
             if t:
                 t.stop()
 
