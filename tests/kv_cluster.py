@@ -129,13 +129,15 @@ class PDTestCluster(KVTestCluster):
                  election_timeout_ms: int = 300,
                  split_threshold_keys: int = 0,
                  heartbeat_interval_ms: int = 100,
-                 balance_leaders: bool = False):
+                 balance_leaders: bool = False,
+                 transfer_cooldown_s: float = 5.0):
         super().__init__(n_stores, tmp_path=tmp_path, regions=regions,
                          election_timeout_ms=election_timeout_ms)
         self.pd_endpoints = [f"127.0.0.1:{7000 + i}" for i in range(n_pd)]
         self.split_threshold_keys = split_threshold_keys
         self.heartbeat_interval_ms = heartbeat_interval_ms
         self.balance_leaders = balance_leaders
+        self.transfer_cooldown_s = transfer_cooldown_s
         self.pd_servers: dict[str, PlacementDriverServer] = {}
 
     async def start_all(self) -> None:
@@ -154,6 +156,7 @@ class PDTestCluster(KVTestCluster):
             data_path=str(self.tmp_path) if self.tmp_path else "",
             split_threshold_keys=self.split_threshold_keys,
             balance_leaders=self.balance_leaders,
+            transfer_cooldown_s=self.transfer_cooldown_s,
             initial_regions=[r.copy() for r in self.region_template],
         )
         pd = PlacementDriverServer(opts, endpoint, server, transport)

@@ -366,3 +366,126 @@ async def test_learner_store_replicates_kv_data():
     finally:
         await kv.shutdown()
         await c.stop_all()
+
+
+async def test_read_preference_any_spreads_linearizable_reads():
+    """read_preference='any': read-only ops round-robin over ALL
+    replicas — follower and learner stores serve them via the readIndex
+    barrier (forward to leader + wait for local apply), so results stay
+    linearizable.  No reference counterpart: RheaKV routes every read
+    through the leader."""
+    import collections
+
+    c = KVTestCluster(4)
+    voters, learner_ep = c.endpoints[:3], c.endpoints[3]
+    c.region_template = [Region(
+        id=1, peers=voters + [learner_ep + "/learner"])]
+    await c.start_all()
+    pd = FakePlacementDriverClient([r.copy() for r in c.region_template])
+
+    served = collections.Counter()
+    base_transport = c.client_transport()
+
+    class CountingTransport:
+        def __init__(self, inner):
+            self._inner = inner
+
+        async def call(self, endpoint, method, req, timeout_ms=None):
+            resp = await self._inner.call(endpoint, method, req, timeout_ms)
+            # count successful SERVES, not attempts: a replica that
+            # rejects (forcing failover to the leader) must not count,
+            # or a silent regression to leader-only reads would pass
+            if method == "kv_command" and resp.code == 0:
+                served[endpoint] += 1
+            return resp
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    kv = RheaKVStore(pd, CountingTransport(base_transport),
+                     read_preference="any")
+    await kv.start()
+    try:
+        await c.wait_region_leader(1)
+        for i in range(8):
+            assert await kv.put(b"rp%02d" % i, b"v%d" % i)
+        served.clear()
+        for _ in range(3):
+            for i in range(8):
+                assert await kv.get(b"rp%02d" % i) == b"v%d" % i
+        # every replica served some reads — including the learner
+        assert len(served) == 4, served
+        assert served[learner_ep] > 0, served
+        # writes still reach the leader only (reads didn't poison routing)
+        assert await kv.put(b"rp-last", b"z")
+        assert await kv.get(b"rp-last") == b"z"
+    finally:
+        await kv.shutdown()
+        await c.stop_all()
+
+
+async def test_spread_reads_are_linearizable_under_writes(tmp_path):
+    """Concurrent writers + spread readers (follower/learner-served):
+    the recorded history must still check out linearizable — the
+    readIndex barrier is doing its job on every replica."""
+    from tpuraft.util.linearizability import History, check_history
+
+    c = KVTestCluster(4, tmp_path=tmp_path)
+    voters, learner_ep = c.endpoints[:3], c.endpoints[3]
+    c.region_template = [Region(
+        id=1, peers=voters + [learner_ep + "/learner"])]
+    await c.start_all()
+    pd = FakePlacementDriverClient([r.copy() for r in c.region_template])
+    kv = RheaKVStore(pd, c.client_transport(), max_retries=1,
+                     read_preference="any")
+    await kv.start()
+    try:
+        await c.wait_region_leader(1)
+        h = History()
+        stop = asyncio.Event()
+        keys = [b"sr-%d" % i for i in range(3)]
+        n_ok = [0]
+
+        async def writer(cid):
+            n = 0
+            while not stop.is_set():
+                n += 1
+                key = keys[n % len(keys)]
+                val = b"c%d-%d" % (cid, n)
+                tok = h.invoke(cid, "w", (key, val))
+                try:
+                    await asyncio.wait_for(kv.put(key, val), 4.0)
+                    h.complete(tok, True)
+                    n_ok[0] += 1
+                except Exception:
+                    pass
+                await asyncio.sleep(0.004)
+
+        async def reader(cid):
+            n = 0
+            while not stop.is_set():
+                n += 1
+                key = keys[n % len(keys)]
+                tok = h.invoke(cid, "r", (key,))
+                try:
+                    v = await asyncio.wait_for(kv.get(key), 4.0)
+                    h.complete(tok, v)
+                    n_ok[0] += 1
+                except Exception:
+                    pass
+                await asyncio.sleep(0.002)
+
+        tasks = [asyncio.ensure_future(writer(0)),
+                 asyncio.ensure_future(writer(1)),
+                 asyncio.ensure_future(reader(2)),
+                 asyncio.ensure_future(reader(3)),
+                 asyncio.ensure_future(reader(4))]
+        await asyncio.sleep(2.5)
+        stop.set()
+        await asyncio.gather(*tasks)
+        assert n_ok[0] > 100, f"only {n_ok[0]} ops completed"
+        rep = check_history(h)
+        assert rep.ok, str(rep)
+    finally:
+        await kv.shutdown()
+        await c.stop_all()

@@ -115,7 +115,8 @@ async def test_pd_balances_leaders():
                       start_key=bytes([i * 40]) if i else b"",
                       end_key=bytes([(i + 1) * 40]) if i < 5 else b"")
                for i in range(6)]
-    async with pd_cluster(regions=regions, balance_leaders=True) as c:
+    async with pd_cluster(regions=regions, balance_leaders=True,
+                          transfer_cooldown_s=1.5) as c:
         await c.wait_pd_leader()
         for rid in range(1, 7):
             await c.wait_region_leader(rid)
@@ -144,6 +145,7 @@ async def test_pd_balances_leaders():
         # transfer cooldown stretches each balancing round)
         deadline = time.monotonic() + 45
         spread = None
+        trajectory = []
         while time.monotonic() < deadline:
             counts = {ep: 0 for ep in c.endpoints}
             for rid in range(1, 7):
@@ -152,7 +154,11 @@ async def test_pd_balances_leaders():
                     if eng is not None and eng.is_leader():
                         counts[ep] += 1
             spread = max(counts.values()) - min(counts.values())
+            if not trajectory or trajectory[-1][1] != counts:
+                trajectory.append((round(time.monotonic() - deadline + 45, 1),
+                                   dict(counts)))
             if sum(counts.values()) == 6 and spread <= 2:
                 break
             await asyncio.sleep(0.2)
-        assert spread is not None and spread <= 2, counts
+        assert spread is not None and spread <= 2, \
+            f"final={counts} trajectory={trajectory}"

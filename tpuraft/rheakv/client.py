@@ -37,6 +37,9 @@ from tpuraft.rpc.transport import RpcError
 
 LOG = logging.getLogger(__name__)
 
+# ops any replica can serve linearizably (readIndex barrier + local read)
+_READONLY_OPS = {KVOp.GET, KVOp.MULTI_GET, KVOp.CONTAINS_KEY, KVOp.SCAN}
+
 
 class RheaKVError(Exception):
     def __init__(self, status: Status):
@@ -100,13 +103,24 @@ class RheaKVStore:
     def __init__(self, pd_client: PlacementDriverClient, transport,
                  timeout_ms: float = 5000, max_retries: int = 8,
                  retry_interval_ms: float = 50,
-                 batching: Optional[BatchingOptions] = None):
+                 batching: Optional[BatchingOptions] = None,
+                 read_preference: str = "leader"):
+        if read_preference not in ("leader", "any"):
+            raise ValueError(f"read_preference {read_preference!r} "
+                             "(must be 'leader' or 'any')")
         self.pd = pd_client
         self.transport = transport
         self.route_table = RegionRouteTable()
         self.timeout_ms = timeout_ms
         self.max_retries = max_retries
         self.retry_interval_ms = retry_interval_ms
+        # "any": spread read-only ops round-robin over ALL replicas —
+        # followers and learners serve them linearizably by forwarding
+        # the readIndex barrier to the leader and waiting for local
+        # apply (core read path; no reference counterpart — RheaKV
+        # routes every read through the leader)
+        self.read_preference = read_preference
+        self._read_rr: dict[int, int] = {}   # region id -> rotation cursor
         # region id -> endpoint of the last known leader's store
         self._leaders: dict[int, str] = {}
         self._started = False
@@ -229,10 +243,22 @@ class RheaKVStore:
         eps.extend(p for p in region.peers if p.endswith("/learner"))
         return eps
 
+    def _read_endpoints_for(self, region: Region) -> list[str]:
+        """Round-robin over ALL replicas (voters, learners, leader alike)
+        for read-only ops under read_preference='any'."""
+        peers = list(region.peers)
+        cur = self._read_rr.get(region.id, region.id)
+        self._read_rr[region.id] = cur + 1
+        return [peers[(cur + i) % len(peers)] for i in range(len(peers))]
+
     async def _call_region(self, region: Region, op: KVOperation):
         """One attempt cycle over a region's stores; raises on hard error."""
         last_status = Status.error(RaftError.EAGAIN, "no store reachable")
-        for ep_str in self._endpoints_for(region):
+        spread_read = (self.read_preference == "any"
+                       and op.op in _READONLY_OPS)
+        eps = (self._read_endpoints_for(region) if spread_read
+               else self._endpoints_for(region))
+        for ep_str in eps:
             # peers are PeerId strings; the store serves on ip:port
             endpoint = _endpoint(ep_str)
             req = KVCommandRequest(
@@ -245,10 +271,12 @@ class RheaKVStore:
                                                  self.timeout_ms)
             except RpcError as e:
                 last_status = e.status
-                self._leaders.pop(region.id, None)
+                if not spread_read:   # a dead read replica says nothing
+                    self._leaders.pop(region.id, None)   # about the leader
                 continue
             if resp.code == 0:
-                self._leaders[region.id] = ep_str
+                if not spread_read:
+                    self._leaders[region.id] = ep_str
                 return decode_result(resp.result)
             if resp.code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
                 fresh = Region.decode(resp.region_meta)
@@ -264,7 +292,8 @@ class RheaKVStore:
                 # not leader / electing / readIndex round timed out under
                 # load: try the next store
                 last_status = Status(resp.code, resp.msg)
-                self._leaders.pop(region.id, None)
+                if not spread_read:
+                    self._leaders.pop(region.id, None)
                 continue
             raise RheaKVError(Status(resp.code, resp.msg))
         raise _Retry(status=last_status)

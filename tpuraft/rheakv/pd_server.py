@@ -224,7 +224,7 @@ class ClusterStatsManager:
 
     def pick_transfer_target(self, region: Region, leader_ep: str,
                              region_leaders: dict[int, str],
-                             cooldown_s: float = 5.0) -> Optional[str]:
+                             cooldown_s: float) -> Optional[str]:
         """If ``leader_ep`` leads at least 2 more regions than the
         least-loaded peer of ``region``, return that peer as the
         transfer target (with a per-region cooldown so one imbalance
@@ -265,6 +265,9 @@ class PlacementDriverOptions:
     # emit TRANSFER_LEADER instructions to even out per-store leader
     # counts (reference: CliServiceImpl#rebalance driven by PD stats)
     balance_leaders: bool = False
+    # per-region pause between ordered transfers, so one imbalance
+    # doesn't spray repeated TRANSFER_LEADER at a region mid-move
+    transfer_cooldown_s: float = 5.0
     initial_regions: list[Region] = field(default_factory=list)
 
 
@@ -435,7 +438,8 @@ class PlacementDriverServer:
                 new_region_id=new_id))
         elif self.opts.balance_leaders:
             target = self.stats.pick_transfer_target(
-                region, req.leader, self.fsm.region_leaders)
+                region, req.leader, self.fsm.region_leaders,
+                cooldown_s=self.opts.transfer_cooldown_s)
             if target is not None:
                 instructions.append(Instruction(
                     kind=Instruction.KIND_TRANSFER_LEADER,

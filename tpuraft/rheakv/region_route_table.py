@@ -27,6 +27,14 @@ class RegionRouteTable:
 
     def add_or_update(self, region: Region) -> None:
         r = region.copy()
+        # never regress: a same-id entry with a fresher epoch wins (a
+        # lagging replica's ERR_INVALID_EPOCH meta must not overwrite
+        # the post-split view — spread reads hit lagging replicas often)
+        for old in self._regions.values():
+            if old.id == r.id and \
+                    (old.epoch.version, old.epoch.conf_ver) > \
+                    (r.epoch.version, r.epoch.conf_ver):
+                return
         # drop any stale entry for the same region id under a different start
         for start, old in list(self._regions.items()):
             if old.id == r.id and start != r.start_key:
