@@ -145,7 +145,7 @@ class BenchCluster:
             await asyncio.sleep(0.05)
         raise TimeoutError("regions without leaders")
 
-    async def client(self) -> RheaKVStore:
+    async def client(self, read_preference: str = "leader") -> RheaKVStore:
         pd = FakePlacementDriverClient(
             [r.copy() for r in next(iter(self.stores.values())).list_regions()])
         if self.transport_kind == "inproc":
@@ -153,7 +153,7 @@ class BenchCluster:
         else:
             t = self._transport_classes()[1]()
         self._client_transport = t
-        kv = RheaKVStore(pd, t)
+        kv = RheaKVStore(pd, t, read_preference=read_preference)
         await kv.start()
         return kv
 
@@ -188,7 +188,8 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
                     value_size: int = 100, workload: str = "b",
                     concurrency: int = 64, lease_reads: bool = False,
                     transport: str = "inproc", store: str = "memory",
-                    data_path: str = "", verbose: bool = True) -> dict:
+                    data_path: str = "", verbose: bool = True,
+                    read_preference: str = "leader") -> dict:
     read_frac = {"a": 0.5, "b": 0.95, "c": 1.0}[workload]
     cluster = BenchCluster(n_stores, make_regions(n_regions),
                            lease_reads=lease_reads, transport=transport,
@@ -206,7 +207,7 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
         # the native io threads / sockets / WAL fds down via finally
         await cluster.start()
         await cluster.wait_leaders()
-        kv = await cluster.client()
+        kv = await cluster.client(read_preference)
         # -- load phase ----------------------------------------------------
         t0 = time.perf_counter()
         sem = asyncio.Semaphore(concurrency)
@@ -241,6 +242,7 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
         result = {
             "workload": workload, "transport": transport, "store": store,
             "stores": n_stores, "regions": n_regions,
+            "read_preference": read_preference,
             "ops_per_s": n_ops / run_s,
             "p50_ms": float(lat_ms[int(0.50 * len(lat_ms))]),
             "p99_ms": float(lat_ms[int(0.99 * len(lat_ms)) - 1]),
@@ -275,11 +277,19 @@ def main() -> None:
                     help="data engine: in-memory or the native C++ engine")
     ap.add_argument("--data", default="",
                     help="data dir for --store native")
+    ap.add_argument("--read-preference", choices=["leader", "any"],
+                    default="leader",
+                    help="'any' spreads linearizable reads over ALL "
+                         "replicas (followers/learners serve via the "
+                         "readIndex barrier). NOTE: pays off when "
+                         "replicas own separate CPUs (multi-host); in "
+                         "this single-process harness the forwarding "
+                         "hop only adds latency")
     args = ap.parse_args()
     asyncio.run(run_bench(args.stores, args.regions, args.keys, args.ops,
                           args.value_size, args.workload, args.concurrency,
                           args.lease_reads, args.transport, args.store,
-                          args.data))
+                          args.data, read_preference=args.read_preference))
 
 
 if __name__ == "__main__":

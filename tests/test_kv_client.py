@@ -489,3 +489,38 @@ async def test_spread_reads_are_linearizable_under_writes(tmp_path):
     finally:
         await kv.shutdown()
         await c.stop_all()
+
+
+async def test_route_refresh_cannot_regress_to_presplit_view():
+    """A refresh answered only by lagging replicas (leader down, PD
+    stale) must not replace a fresher post-split route view with the
+    pre-split one: the fold is seeded with the cached table."""
+    from tpuraft.rheakv.metadata import RegionEpoch
+    from tpuraft.rheakv.kv_service import ListRegionsOnStoreResponse
+
+    pre = Region(id=1, start_key=b"", end_key=b"",
+                 epoch=RegionEpoch(conf_ver=1, version=1),
+                 peers=["127.0.0.1:6000"])
+    post1 = Region(id=1, start_key=b"", end_key=b"m",
+                   epoch=RegionEpoch(conf_ver=1, version=2),
+                   peers=["127.0.0.1:6000"])
+    post2 = Region(id=2, start_key=b"m", end_key=b"",
+                   epoch=RegionEpoch(conf_ver=1, version=1),
+                   peers=["127.0.0.1:6000"])
+
+    class StalePD:
+        async def list_regions(self):
+            return [pre.copy()]
+
+    class StaleTransport:
+        async def call(self, endpoint, method, req, timeout_ms=None):
+            assert method == "kv_list_regions"
+            return ListRegionsOnStoreResponse(regions=[pre.encode()])
+
+    kv = RheaKVStore(StalePD(), StaleTransport())
+    kv.route_table.reset([post1.copy(), post2.copy()])
+    await kv._refresh_routes()
+    got = {r.id: r for r in kv.route_table.list_regions()}
+    assert set(got) == {1, 2}, got
+    assert got[1].epoch.version == 2
+    assert got[1].end_key == b"m"

@@ -217,7 +217,11 @@ class RheaKVStore:
                 continue
             for blob in resp.regions:
                 regions.append(Region.decode(blob))
-        # fold: keep the freshest epoch per region id
+        # fold: keep the freshest epoch per region id — seeded with the
+        # table we already hold, so a refresh answered only by lagging
+        # replicas (leader down, PD stale) can never regress the view
+        # (regions only ever split; they never merge back)
+        regions.extend(self.route_table.list_regions())
         best: dict[int, Region] = {}
         for r in regions:
             cur = best.get(r.id)
@@ -280,6 +284,14 @@ class RheaKVStore:
                 return decode_result(resp.result)
             if resp.code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
                 fresh = Region.decode(resp.region_meta)
+                if spread_read and (fresh.epoch.version,
+                                    fresh.epoch.conf_ver) < \
+                        (region.epoch.version, region.epoch.conf_ver):
+                    # a LAGGING replica (pre-split view): its meta is
+                    # useless and the other replicas can still serve —
+                    # don't abort the cycle into a full route refresh
+                    last_status = Status(resp.code, resp.msg)
+                    continue
                 self.route_table.add_or_update(fresh)
                 raise _Retry(refresh=True)
             if resp.code == ERR_NO_REGION:
