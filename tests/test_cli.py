@@ -132,3 +132,36 @@ async def test_rebalance(tmp_path):
         cli = CliService(c.client_transport())
         st = await cli.rebalance([c.group_id], c.conf)
         assert st.is_ok(), st
+
+
+async def test_reset_learners_via_cli(tmp_path):
+    """`[1.3+]` CliService#resetLearners: replace the whole learner set
+    in one joint-consensus change."""
+    from tpuraft.entity import PeerId
+
+    async with cluster3(tmp_path) as c:
+        leader = await c.wait_leader()
+        cli = CliService(c.client_transport())
+        l1 = PeerId.parse("127.0.0.1:5103")
+        l2 = PeerId.parse("127.0.0.1:5104")
+        c.peers.append(l1)
+        await c.start(l1)
+        c.peers.append(l2)
+        await c.start(l2)
+        st = await cli.add_learners(c.group_id, c.conf, [l1])
+        assert st.is_ok(), st
+        assert await cli.get_learners(c.group_id, c.conf) == [l1]
+        # reset: l1 out, l2 in — one atomic change
+        st = await cli.reset_learners(c.group_id, c.conf, [l2])
+        assert st.is_ok(), st
+        assert await cli.get_learners(c.group_id, c.conf) == [l2]
+        # the new learner replicates; the removed one stops receiving
+        st = await c.apply_ok(leader, b"post-reset")
+        assert st.is_ok(), st
+        for _ in range(100):
+            if b"post-reset" in c.fsms[l2].logs:
+                break
+            await asyncio.sleep(0.02)
+        assert b"post-reset" in c.fsms[l2].logs
+        assert l2 in c.nodes[leader.server_id].list_learners()
+        assert l1 not in c.nodes[leader.server_id].list_learners()

@@ -5,6 +5,7 @@ on the wire and on disk owned by the native layer, Python orchestrating
 control plane)."""
 
 import asyncio
+import contextlib
 
 import pytest
 
@@ -25,56 +26,99 @@ def built():
     ensure_built()
 
 
+class NativeKVCluster:
+    """3 StoreEngines over native epoll servers + native KV engines.
+
+    `regions_fn(endpoints)` builds the region layout once the ephemeral
+    ports are known.  Owns teardown of every server/store/transport and
+    any client made via `client()`.
+    """
+
+    def __init__(self, tmp_path, regions_fn=None):
+        self._tmp = tmp_path
+        self._regions_fn = regions_fn or (
+            lambda eps: [Region(id=1, peers=list(eps))])
+        self.servers: list = []
+        self.stores: list[StoreEngine] = []
+        self.transports: list = []
+        self.regions: list[Region] = []
+        self._clients: list[RheaKVStore] = []
+
+    async def __aenter__(self) -> "NativeKVCluster":
+        for _ in range(3):
+            srv = NativeTcpRpcServer("127.0.0.1:0")
+            await srv.start()
+            srv.endpoint = f"127.0.0.1:{srv.bound_port}"
+            self.servers.append(srv)
+        endpoints = [s.endpoint for s in self.servers]
+        self.regions = self._regions_fn(endpoints)
+        for srv in self.servers:
+            transport = NativeTcpTransport(endpoint=srv.endpoint)
+            self.transports.append(transport)
+            opts = StoreEngineOptions(
+                server_id=srv.endpoint,
+                initial_regions=[r.copy() for r in self.regions],
+                data_path=str(self._tmp),
+                election_timeout_ms=500,
+                raw_store_factory=lambda ep=srv.endpoint: NativeRawKVStore(
+                    str(self._tmp / ("kv_" + ep.replace(":", "_")))),
+            )
+            store = StoreEngine(opts, srv, transport)
+            await store.start()
+            self.stores.append(store)
+        return self
+
+    async def __aexit__(self, *exc):
+        for kv in self._clients:
+            with contextlib.suppress(Exception):
+                await kv.shutdown()
+        for s in self.stores:
+            await s.shutdown()
+        for srv in self.servers:
+            await srv.stop()
+        for t in self.transports:
+            await t.close()
+
+    async def client(self, **kw) -> RheaKVStore:
+        transport = NativeTcpTransport()
+        self.transports.append(transport)
+        pd = FakePlacementDriverClient([r.copy() for r in self.regions])
+        kv = RheaKVStore(pd, transport, **kw)
+        await kv.start()
+        self._clients.append(kv)
+        return kv
+
+    async def wait_leader(self, rid: int):
+        deadline = asyncio.get_running_loop().time() + 10
+        while asyncio.get_running_loop().time() < deadline:
+            for s in self.stores:
+                re = s.get_region_engine(rid)
+                if re is not None and re.is_leader():
+                    return re
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"no leader for region {rid}")
+
+    async def kill_leader(self, rid: int) -> None:
+        """Crash-stop the region leader's whole server process-analog
+        (store + server + its outbound transport)."""
+        leader = await self.wait_leader(rid)
+        i = next(j for j, s in enumerate(self.stores)
+                 if s is leader.store_engine)
+        await self.stores.pop(i).shutdown()
+        await self.servers.pop(i).stop()
+        await self.transports.pop(i).close()
+
+
 @pytest.mark.asyncio
 async def test_kv_cluster_over_native_transport_and_engine(tmp_path):
-    # bind ephemeral ports first so the region conf can name real peers
-    servers = []
-    for _ in range(3):
-        srv = NativeTcpRpcServer("127.0.0.1:0")
-        await srv.start()
-        srv.endpoint = f"127.0.0.1:{srv.bound_port}"
-        servers.append(srv)
-    endpoints = [s.endpoint for s in servers]
-    regions = [Region(id=1, start_key=b"", end_key=b"m",
-                      peers=list(endpoints)),
-               Region(id=2, start_key=b"m", end_key=b"",
-                      peers=list(endpoints))]
+    def two_regions(eps):
+        return [Region(id=1, start_key=b"", end_key=b"m", peers=list(eps)),
+                Region(id=2, start_key=b"m", end_key=b"", peers=list(eps))]
 
-    stores: list[StoreEngine] = []
-    transports = []
-    for srv in servers:
-        transport = NativeTcpTransport(endpoint=srv.endpoint)
-        transports.append(transport)
-        opts = StoreEngineOptions(
-            server_id=srv.endpoint,
-            initial_regions=[r.copy() for r in regions],
-            data_path=str(tmp_path),
-            election_timeout_ms=500,
-            raw_store_factory=lambda ep=srv.endpoint: NativeRawKVStore(
-                str(tmp_path / ("kv_" + ep.replace(":", "_")))),
-        )
-        store = StoreEngine(opts, srv, transport)
-        await store.start()
-        stores.append(store)
-
-    client_transport = NativeTcpTransport()
-    pd = FakePlacementDriverClient([r.copy() for r in regions])
-    kv = RheaKVStore(pd, client_transport)
-    await kv.start()
-    try:
-        # leaders for both regions
-        async def wait_leader(rid):
-            deadline = asyncio.get_running_loop().time() + 10
-            while asyncio.get_running_loop().time() < deadline:
-                for s in stores:
-                    re = s.get_region_engine(rid)
-                    if re is not None and re.is_leader():
-                        return re
-                await asyncio.sleep(0.05)
-            raise TimeoutError(f"no leader for region {rid}")
-
-        await wait_leader(1)
-        await wait_leader(2)
+    async with NativeKVCluster(tmp_path, two_regions) as c:
+        kv = await c.client()
+        await c.wait_leader(1)
+        await c.wait_leader(2)
 
         assert await kv.put(b"alpha", b"1")
         assert await kv.put(b"zulu", b"2")
@@ -91,30 +135,60 @@ async def test_kv_cluster_over_native_transport_and_engine(tmp_path):
         assert await lock.try_lock()
         await lock.unlock()
 
-        # kill the region-1 leader's whole server process-analog (server
-        # + transport), survivors re-elect, client fails over
-        leader1 = await wait_leader(1)
-        victim_idx = next(
-            i for i, s in enumerate(stores)
-            if s is leader1.store_engine)
-        await stores[victim_idx].shutdown()
-        await servers[victim_idx].stop()
-        await transports[victim_idx].close()
-        dead = stores.pop(victim_idx)
-        servers.pop(victim_idx)
-        transports.pop(victim_idx)
-        assert dead is not None
-
-        await wait_leader(1)
+        # crash the region-1 leader, survivors re-elect, client fails over
+        await c.kill_leader(1)
+        await c.wait_leader(1)
         assert await kv.get(b"alpha") == b"1"
         assert await kv.put(b"after", b"failover")
         assert await kv.get(b"after") == b"failover"
-    finally:
-        await kv.shutdown()
-        await client_transport.close()
-        for s in stores:
-            await s.shutdown()
-        for srv in servers:
-            await srv.stop()
-        for t in transports:
-            await t.close()
+
+
+@pytest.mark.asyncio
+async def test_native_stack_history_is_linearizable(tmp_path):
+    """Full native stack under concurrent load + leader kill, with the
+    recorded client history proven linearizable: C++ epoll sockets on
+    the wire, C++ KV engine on disk, readIndex barriers over both."""
+    from tpuraft.util.linearizability import History, check_history
+
+    async with NativeKVCluster(tmp_path) as c:
+        kv = await c.client(max_retries=1)
+        await c.wait_leader(1)
+        h = History()
+        stop = asyncio.Event()
+        keys = [b"nl-%d" % i for i in range(3)]
+
+        async def worker(cid):
+            n = 0
+            while not stop.is_set():
+                n += 1
+                key = keys[n % len(keys)]
+                if n % 2 == 0:
+                    val = b"c%d-%d" % (cid, n)
+                    tok = h.invoke(cid, "w", (key, val))
+                    try:
+                        await asyncio.wait_for(kv.put(key, val), 4.0)
+                        h.complete(tok, True)
+                    except Exception:
+                        pass
+                else:
+                    tok = h.invoke(cid, "r", (key,))
+                    try:
+                        v = await asyncio.wait_for(kv.get(key), 4.0)
+                        h.complete(tok, v)
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.003)
+
+        workers = [asyncio.ensure_future(worker(i)) for i in range(4)]
+        await asyncio.sleep(1.2)
+        await c.kill_leader(1)       # crash mid-load
+        await c.wait_leader(1)
+        await asyncio.sleep(1.2)
+        stop.set()
+        await asyncio.gather(*workers)
+
+        ops = h.ops()
+        done = sum(1 for o in ops if o.ret is not None)
+        assert done > 100, f"only {done}/{len(ops)} completed"
+        rep = check_history(h)
+        assert rep.ok, str(rep)

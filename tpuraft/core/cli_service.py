@@ -31,6 +31,7 @@ from tpuraft.rpc.cli_messages import (
     GetPeersResponse,
     RemoveLearnersRequest,
     RemovePeerRequest,
+    ResetLearnersRequest,
     ResetPeersRequest,
     SnapshotRequest,
     TransferLeaderRequest,
@@ -63,6 +64,7 @@ class CliProcessors:
         s.register("cli_transfer_leader", self._transfer_leader)
         s.register("cli_add_learners", self._add_learners)
         s.register("cli_remove_learners", self._remove_learners)
+        s.register("cli_reset_learners", self._reset_learners)
 
     def _find(self, group_id: str, peer_id: str) -> Optional[Node]:
         if peer_id:
@@ -185,6 +187,13 @@ class CliProcessors:
         if err:
             return err
         st = await node.remove_learners([PeerId.parse(p) for p in req.learners])
+        return self._from_status(st, node)
+
+    async def _reset_learners(self, req: ResetLearnersRequest) -> CliResponse:
+        node, err = self._leader_node(req)
+        if err:
+            return err
+        st = await node.reset_learners([PeerId.parse(p) for p in req.learners])
         return self._from_status(st, node)
 
 
@@ -335,6 +344,14 @@ class CliService:
         return await self._leader_op(
             group_id, conf, "cli_remove_learners",
             lambda leader: RemoveLearnersRequest(
+                group_id=group_id, peer_id=str(leader),
+                learners=[str(p) for p in learners]))
+
+    async def reset_learners(self, group_id: str, conf: Configuration,
+                             learners: list[PeerId]) -> Status:
+        return await self._leader_op(
+            group_id, conf, "cli_reset_learners",
+            lambda leader: ResetLearnersRequest(
                 group_id=group_id, peer_id=str(leader),
                 learners=[str(p) for p in learners]))
 

@@ -901,6 +901,13 @@ class Node:
         new_conf.learners = [l for l in new_conf.learners if l not in learners]
         return await self.change_peers(new_conf)
 
+    async def reset_learners(self, learners: list[PeerId]) -> Status:
+        """Replace the learner set atomically (reference: `[1.3+]`
+        CliServiceImpl#resetLearners)."""
+        new_conf = self.conf_entry.conf.copy()
+        new_conf.learners = list(dict.fromkeys(learners))
+        return await self.change_peers(new_conf)
+
     async def change_peers(self, new_conf: Configuration) -> Status:
         """Arbitrary configuration change via joint consensus."""
         async with self._lock:

@@ -108,6 +108,13 @@ class RemoveLearnersRequest:
     learners: list[str] = field(default_factory=list)
 
 
+@_cli(77)
+class ResetLearnersRequest:
+    group_id: str
+    peer_id: str
+    learners: list[str] = field(default_factory=list)
+
+
 @_cli(76)
 class CliResponse:
     """Uniform admin-op outcome: ok/error code/msg + new conf if changed."""
