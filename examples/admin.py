@@ -15,6 +15,10 @@ Commands:
     add-peer <peer>           add a voter
     remove-peer <peer>        remove a voter
     change-peers <p1,p2,...>  arbitrary membership change
+    add-learners <p1,...>     add read-only replicas
+    remove-learners <p1,...>  remove read-only replicas
+    reset-learners <p1,...>   replace the learner set atomically
+    reset-learners none       clear the learner set
 """
 
 from __future__ import annotations
@@ -76,6 +80,21 @@ async def run(args) -> int:
             st = await cli.change_peers(args.group, conf, new_conf)
             print("OK" if st.is_ok() else f"error: {st}")
             rc = 0 if st.is_ok() else 1
+        elif cmd in ("add-learners", "remove-learners", "reset-learners"):
+            if len(args.command) < 2:
+                print(f"{cmd} needs a peer-list argument "
+                      "('none' clears the set for reset-learners)",
+                      file=sys.stderr)
+                return 2
+            arg = args.command[1]
+            learners = ([] if arg in ("none", "") else
+                        [PeerId.parse(t) for t in arg.split(",") if t])
+            op = {"add-learners": cli.add_learners,
+                  "remove-learners": cli.remove_learners,
+                  "reset-learners": cli.reset_learners}[cmd]
+            st = await op(args.group, conf, learners)
+            print("OK" if st.is_ok() else f"error: {st}")
+            rc = 0 if st.is_ok() else 1
         else:
             print(f"unknown command: {cmd}", file=sys.stderr)
             rc = 2
@@ -99,7 +118,9 @@ def main() -> None:
     ap.add_argument("command", nargs="+",
                     help="leader | peers | snapshot <peer> | transfer <peer>"
                          " | add-peer <peer> | remove-peer <peer>"
-                         " | change-peers <p1,p2,...>")
+                         " | change-peers <p1,p2,...>"
+                         " | add-learners <p1,...> | remove-learners <p1,...>"
+                         " | reset-learners <p1,...>")
     sys.exit(asyncio.run(run(ap.parse_args())))
 
 

@@ -79,6 +79,24 @@ async def test_admin_cli_against_live_cluster(tmp_path):
                 break
             await asyncio.sleep(0.05)
         assert c.nodes[target].state.value == "leader"
+
+        # learner lifecycle through the CLI: boot a 4th node outside
+        # the conf, add it as learner, then clear the set atomically
+        from tests.test_tcp import _start_server
+        from tpuraft.entity import PeerId
+
+        srv = await _start_server(c.server_cls)
+        lp = PeerId.parse(srv.endpoint)
+        await c._boot(lp, srv)
+        r = await loop.run_in_executor(
+            None, admin, "add-learners", str(lp))
+        assert r.returncode == 0, r.stderr + r.stdout
+        r = await loop.run_in_executor(None, admin, "peers")
+        assert f"learners: {lp}" in r.stdout, r.stdout
+        r = await loop.run_in_executor(None, admin, "reset-learners", "none")
+        assert r.returncode == 0, r.stderr + r.stdout
+        r = await loop.run_in_executor(None, admin, "peers")
+        assert "learners:" not in r.stdout, r.stdout
     finally:
         await c.stop_all()
 
