@@ -87,8 +87,12 @@ async def run(args) -> int:
                       file=sys.stderr)
                 return 2
             arg = args.command[1]
-            learners = ([] if arg in ("none", "") else
+            clear = arg in ("none", "") and cmd == "reset-learners"
+            learners = ([] if clear else
                         [PeerId.parse(t) for t in arg.split(",") if t])
+            if not learners and not clear:
+                print(f"{cmd} needs at least one peer", file=sys.stderr)
+                return 2
             op = {"add-learners": cli.add_learners,
                   "remove-learners": cli.remove_learners,
                   "reset-learners": cli.reset_learners}[cmd]

@@ -1,0 +1,105 @@
+"""Standalone RheaKV store server: one OS process per store.
+
+Reference parity: the server side of ``example:rheakv/*`` (SURVEY.md
+§3.3) — the reference boots `RheaKVStore` server mains from yaml
+topologies; here the topology is CLI flags shared by every member.
+
+    # a 3-store cluster, 4 pre-split regions, durable native engines:
+    python -m examples.rheakv_server --serve 127.0.0.1:9001 \\
+        --stores 127.0.0.1:9001,127.0.0.1:9002,127.0.0.1:9003 \\
+        --regions 4 --data /tmp/rkv1 [--transport native] [--store native]
+
+Every member derives the same region layout from (--stores, --regions),
+so a client needs only the store list (see `client_for`); region
+discovery and split survival ride the `kv_list_regions` refresh path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from examples.rheakv_bench import make_regions
+from tpuraft.rheakv.client import RheaKVStore
+from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+
+
+def derive_regions(stores: list[str], n_regions: int):
+    regions = make_regions(n_regions)
+    for r in regions:
+        r.peers = list(stores)
+    return regions
+
+
+async def serve(endpoint: str, stores: list[str], n_regions: int,
+                data_path: str, transport_kind: str = "tcp",
+                store_kind: str = "memory") -> None:
+    if transport_kind == "native":
+        from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
+        from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
+    else:
+        from tpuraft.rpc.tcp import TcpRpcServer as Server
+        from tpuraft.rpc.tcp import TcpTransport as Transport
+
+    server = Server(endpoint)
+    await server.start()
+    transport = Transport(endpoint=endpoint)
+    opts = StoreEngineOptions(
+        server_id=endpoint,
+        initial_regions=derive_regions(stores, n_regions),
+        data_path=data_path,
+        election_timeout_ms=1000,
+    )
+    if store_kind == "native":
+        from tpuraft.rheakv.native_store import NativeRawKVStore
+        opts.raw_store_factory = lambda: NativeRawKVStore(
+            f"{data_path}/kv_{endpoint.replace(':', '_')}")
+    engine = StoreEngine(opts, server, transport)
+    await engine.start()
+    print(f"rheakv store {endpoint} up "
+          f"({n_regions} regions, {len(stores)} stores)", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await engine.shutdown()
+        await server.stop()
+        await transport.close()
+
+
+def client_for(stores: list[str], n_regions: int,
+               transport=None, **kw) -> RheaKVStore:
+    """Client against a cluster started with the same (stores, regions)."""
+    if transport is None:
+        from tpuraft.rpc.tcp import TcpTransport
+        transport = TcpTransport()
+    pd = FakePlacementDriverClient(derive_regions(stores, n_regions))
+    return RheaKVStore(pd, transport, **kw)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serve", required=True, help="this store's ip:port")
+    ap.add_argument("--stores", required=True,
+                    help="comma-separated store endpoints (all members)")
+    ap.add_argument("--regions", type=int, default=2)
+    ap.add_argument("--data", required=True, help="durable state dir")
+    ap.add_argument("--transport", choices=["tcp", "native"], default="tcp")
+    ap.add_argument("--store", choices=["memory", "native"],
+                    default="memory")
+    args = ap.parse_args()
+    stores = [s for s in args.stores.split(",") if s]
+    if args.serve not in stores:
+        print("error: --serve must be one of --stores", file=sys.stderr)
+        sys.exit(2)
+    try:
+        asyncio.run(serve(args.serve, stores, args.regions, args.data,
+                          args.transport, args.store))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

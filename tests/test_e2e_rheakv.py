@@ -1,0 +1,116 @@
+"""Multi-process RheaKV end-to-end: 3 store OS processes over real TCP,
+a client in this process, and a kill -9 of a leader store.
+
+The KV-tier analog of test_e2e_counter (reference: running the rheakv
+server example on three machines — SURVEY.md §3.3).
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.asyncio
+async def test_three_process_kv_cluster_kill9_leader(tmp_path):
+    ports = _free_ports(3)
+    stores = [f"127.0.0.1:{p}" for p in ports]
+    procs: dict[int, subprocess.Popen] = {}
+    env = dict(os.environ, PYTHONPATH=REPO)
+    try:
+        for p, ep in zip(ports, stores):
+            procs[p] = subprocess.Popen(
+                [sys.executable, "-m", "examples.rheakv_server",
+                 "--serve", ep, "--stores", ",".join(stores),
+                 "--regions", "2", "--data", str(tmp_path / str(p))],
+                cwd=REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        from examples.rheakv_server import client_for
+
+        kv = client_for(stores, 2, timeout_ms=3000)
+        await kv.start()
+        try:
+            # ride out interpreter boot (~2s each) + first elections
+            deadline = time.monotonic() + 60
+            ok = False
+            while time.monotonic() < deadline:
+                try:
+                    ok = await kv.put(b"\x10boot", b"up")
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            assert ok, "cluster never became writable"
+
+            import struct
+            keys = [struct.pack(">I", i * 0x30000000) for i in range(5)]
+            for i, k in enumerate(keys):       # both regions
+                assert await kv.put(k, b"v%d" % i)
+            for i, k in enumerate(keys):
+                assert await kv.get(k) == b"v%d" % i
+
+            # SIGKILL whichever store currently leads region 1
+            leader_ep = kv._leaders.get(1) or stores[0]
+            port = int(leader_ep.split(":")[1].split("/")[0])
+            procs[port].send_signal(signal.SIGKILL)
+            procs[port].wait()
+
+            # survivors re-elect; acked data survives the hard crash
+            deadline = time.monotonic() + 30
+            v = None
+            while time.monotonic() < deadline:
+                try:
+                    v = await kv.get(keys[0])
+                    if v is not None:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+            assert v == b"v0", v
+            for i, k in enumerate(keys):
+                got = None
+                for _ in range(20):
+                    try:
+                        got = await kv.get(k)
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.5)
+                assert got == b"v%d" % i, (i, got)
+            # and it still accepts writes
+            wrote = False
+            for _ in range(20):
+                try:
+                    wrote = await kv.put(b"\x20after", b"crash")
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            assert wrote
+            assert await kv.get(b"\x20after") == b"crash"
+        finally:
+            await kv.shutdown()
+            await kv.transport.close()
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        for proc in procs.values():
+            proc.wait()

@@ -92,10 +92,12 @@ async def test_admin_cli_against_live_cluster(tmp_path):
             None, admin, "add-learners", str(lp))
         assert r.returncode == 0, r.stderr + r.stdout
         r = await loop.run_in_executor(None, admin, "peers")
+        assert r.returncode == 0, r.stderr
         assert f"learners: {lp}" in r.stdout, r.stdout
         r = await loop.run_in_executor(None, admin, "reset-learners", "none")
         assert r.returncode == 0, r.stderr + r.stdout
         r = await loop.run_in_executor(None, admin, "peers")
+        assert r.returncode == 0, r.stderr
         assert "learners:" not in r.stdout, r.stdout
     finally:
         await c.stop_all()
