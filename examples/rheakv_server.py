@@ -53,9 +53,13 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         election_timeout_ms=1000,
     )
     if store_kind == "native":
+        import os
+
         from tpuraft.rheakv.native_store import NativeRawKVStore
-        opts.raw_store_factory = lambda: NativeRawKVStore(
-            f"{data_path}/kv_{endpoint.replace(':', '_')}")
+        base = f"{data_path}/kv_{endpoint.replace(':', '_')}"
+        # the C++ engine mkdirs only the leaf — ensure the parents exist
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        opts.raw_store_factory = lambda: NativeRawKVStore(base)
     engine = StoreEngine(opts, server, transport)
     await engine.start()
     print(f"rheakv store {endpoint} up "

@@ -419,3 +419,80 @@ async def test_engine_adversarial_network_invariants():
         assert any(e.commit_advances > 0 for e in c.engines.values())
     finally:
         await c.stop_all()
+
+
+async def test_engine_grows_capacity_on_demand():
+    """A full engine doubles its [G, P] planes instead of refusing the
+    next group (region splits mint groups at runtime).  Existing slots'
+    state must survive the growth and new slots must commit."""
+    from tpuraft.conf import Configuration
+    from tpuraft.entity import PeerId as PID
+
+    peers = [PID.parse(f"127.0.0.1:{7100 + i}") for i in range(3)]
+    conf = Configuration(list(peers))
+    for backend in ("numpy", "jax"):
+        eng = MultiRaftEngine(TickOptions(
+            max_groups=2, max_peers=4, backend=backend))
+        await eng.start()
+        try:
+            commits: dict[int, int] = {}
+            factory = eng.ballot_box_factory()
+            boxes = []
+            for g in range(5):          # 2 -> grows to 4 -> grows to 8
+                box = factory(lambda idx, g=g: commits.__setitem__(g, idx))
+                box.update_conf(conf, Configuration())
+                box.reset_pending_index(1)
+                boxes.append(box)
+            assert eng.G == 8
+            for g, box in enumerate(boxes):
+                for p in peers:
+                    box.commit_at(p, 10 + g, conf, Configuration())
+            eng.tick_once()
+            assert commits == {g: 10 + g for g in range(5)}, commits
+            # slots released by shut-down groups are reused before growth
+            eng.release(boxes[0])
+            box5 = factory(lambda idx: commits.__setitem__(5, idx))
+            assert eng.G == 8
+            box5.update_conf(conf, Configuration())
+            box5.reset_pending_index(1)
+            for p in peers:
+                box5.commit_at(p, 99, conf, Configuration())
+            eng.tick_once()
+            assert commits[5] == 99
+        finally:
+            await eng.shutdown()
+
+
+async def test_engine_grows_under_mesh_sharding():
+    """Growth preserves mesh divisibility: 8 groups over 8 devices grows
+    to 16 and the SPMD reduce still matches the numpy oracle."""
+    from tpuraft.conf import Configuration
+    from tpuraft.entity import PeerId as PID
+
+    peers = [PID.parse(f"127.0.0.1:{7200 + i}") for i in range(3)]
+    conf = Configuration(list(peers))
+    eng = MultiRaftEngine(TickOptions(
+        max_groups=8, max_peers=4, backend="jax", mesh_devices=8))
+    ref = MultiRaftEngine(TickOptions(
+        max_groups=8, max_peers=4, backend="numpy"))
+    await eng.start()
+    try:
+        got: dict[int, int] = {}
+        want: dict[int, int] = {}
+        for g in range(12):             # exceeds 8: grow to 16
+            b1 = eng.ballot_box_factory()(
+                lambda idx, g=g: got.__setitem__(g, idx))
+            b2 = ref.ballot_box_factory()(
+                lambda idx, g=g: want.__setitem__(g, idx))
+            for b in (b1, b2):
+                b.update_conf(conf, Configuration())
+                b.reset_pending_index(1)
+                for i, p in enumerate(peers):
+                    b.commit_at(p, 3 * g + i, conf, Configuration())
+        assert eng.G == 16
+        eng.tick_once()
+        ref.tick_once()
+        assert got == want and len(got) == 12
+    finally:
+        await eng.shutdown()
+        await ref.shutdown()

@@ -396,3 +396,37 @@ async def test_64_region_store_with_engine_plane():
         advances = sum(s.multi_raft_engine.commit_advances
                        for s in c.stores.values())
         assert advances >= 64, advances
+
+
+async def test_split_on_full_engine_grows_plane():
+    """A region split on a store whose engine plane is at capacity must
+    grow the [G, P] plane, not crash the new RegionEngine (splits mint
+    raft groups at runtime)."""
+    from tpuraft.core.engine import MultiRaftEngine
+    from tpuraft.options import TickOptions
+
+    engines = []
+
+    def factory():
+        e = MultiRaftEngine(TickOptions(
+            max_groups=1, max_peers=4, tick_interval_ms=2,
+            backend="numpy"))
+        engines.append(e)
+        return e
+
+    async with kv_cluster(multi_raft_engine_factory=factory) as c:
+        leader = await c.wait_region_leader(1)
+        rs = leader.raft_store
+        for i in range(32):
+            assert await rs.put(b"gk%02d" % i, b"v%d" % i)
+        assert all(e.G == 1 for e in engines)
+        st = await leader.store_engine.apply_split(1, 2)
+        assert st.is_ok(), str(st)
+        await c.wait_region_on_all(2)
+        l2 = await c.wait_region_leader(2)
+        # every store's engine doubled to fit the new group
+        assert all(e.G == 2 for e in engines), [e.G for e in engines]
+        # both halves serve through the (grown) batched plane
+        assert await leader.raft_store.get(b"gk00") == b"v0"
+        assert await l2.raft_store.put(b"zz-new", b"after-grow")
+        assert await l2.raft_store.get(b"zz-new") == b"after-grow"

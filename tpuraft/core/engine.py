@@ -149,8 +149,34 @@ class MultiRaftEngine:
 
     def alloc_slot(self) -> int:
         if not self._free:
-            raise RuntimeError(f"engine full: {self.G} groups")
+            self._grow()
         return self._free.pop()
+
+    def _grow(self) -> None:
+        """Double group capacity in place.  Region splits mint new raft
+        groups at runtime; a full engine must absorb them, not crash
+        the new RegionEngine.  The next tick recompiles once for the
+        new shape (jit caches per shape); doubling preserves
+        divisibility by mesh_devices for the sharded path."""
+        old_g = self.G
+        new_g = old_g * 2
+
+        def pad(a: np.ndarray, fill=0) -> np.ndarray:
+            extra = np.full((old_g,) + a.shape[1:], fill, a.dtype)
+            return np.concatenate([a, extra])
+
+        self.match_abs = pad(self.match_abs)
+        self.base = pad(self.base)
+        self.pending_rel = pad(self.pending_rel, 1)
+        self.voter_mask = pad(self.voter_mask)
+        self.old_voter_mask = pad(self.old_voter_mask)
+        self.leader_mask = pad(self.leader_mask)
+        self.commit_abs = pad(self.commit_abs)
+        self._peer_cols.extend(dict() for _ in range(old_g))
+        self._boxes.extend([None] * old_g)
+        self._free = list(range(new_g - 1, old_g - 1, -1))
+        self.G = new_g
+        LOG.info("engine grew: %d -> %d group slots", old_g, new_g)
 
     def release(self, box: TpuBallotBox) -> None:
         s = box.slot
