@@ -524,3 +524,25 @@ async def test_route_refresh_cannot_regress_to_presplit_view():
     assert set(got) == {1, 2}, got
     assert got[1].epoch.version == 2
     assert got[1].end_key == b"m"
+
+
+async def test_client_paged_iterator_crosses_regions():
+    """kv.iterator pages with buf_size-sized scans across region
+    boundaries, in order, without skipping or duplicating (reference:
+    DefaultRheaKVStore#iterator / RheaIterator)."""
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    async with kv_client_cluster(regions=regions) as (c, kv):
+        keys = [b"it%02d" % i for i in range(12)] + \
+               [b"zz%02d" % i for i in range(9)]
+        for i, k in enumerate(keys):
+            assert await kv.put(k, b"v%d" % i)
+        got = []
+        async for k, v in kv.iterator(b"", b"", buf_size=4):
+            got.append((k, v))
+        assert [k for k, _ in got] == sorted(keys)
+        assert dict(got) == {k: b"v%d" % i for i, k in enumerate(keys)}
+        # keys-only mode and bounded range
+        names = [k async for k, _ in kv.iterator(b"it", b"iz", buf_size=5,
+                                                 return_value=False)]
+        assert names == [b"it%02d" % i for i in range(12)]

@@ -792,3 +792,51 @@ async def test_divergence_below_applied_fails_node_not_rpc_storm():
         assert st.code == int(RaftError.EHOSTDOWN), str(st)
     finally:
         await c.stop_all()
+
+
+async def test_read_committed_user_log():
+    """Node#readCommittedUserLog parity: first DATA entry at/after the
+    index; EINVAL beyond commit; ENOENT once compacted."""
+    from tpuraft.errors import RaftError, RaftException
+
+    c = TestCluster(3)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        for i in range(5):
+            st = await c.apply_ok(leader, b"u%d" % i)
+            assert st.is_ok(), str(st)
+        # index 1 is the leader's no-op CONFIGURATION entry: skipped
+        # forward to the first DATA entry
+        e = leader.read_committed_user_log(1)
+        assert e.data == b"u0"
+        assert leader.read_committed_user_log(e.id.index + 1).data == b"u1"
+        try:
+            leader.read_committed_user_log(
+                leader.ballot_box.last_committed_index + 1)
+            raise AssertionError("index beyond commit accepted")
+        except RaftException as ex:
+            assert ex.status.raft_error == RaftError.EINVAL
+    finally:
+        await c.stop_all()
+
+
+async def test_read_committed_user_log_compacted(tmp_path):
+    from tpuraft.errors import RaftError, RaftException
+
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        for i in range(8):
+            await c.apply_ok(leader, b"c%d" % i)
+        await c.wait_applied(8)
+        st = await leader.snapshot()
+        assert st.is_ok(), str(st)
+        try:
+            leader.read_committed_user_log(2)
+            raise AssertionError("compacted index served")
+        except RaftException as ex:
+            assert ex.status.raft_error == RaftError.ENOENT
+    finally:
+        await c.stop_all()

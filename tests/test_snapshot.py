@@ -164,3 +164,126 @@ async def test_periodic_snapshot_timer_compacts(tmp_path):
     st = await c.apply_ok(leader, b"post-snap")
     assert st.is_ok()
     await c.stop_all()
+
+
+async def test_install_snapshot_filter_before_copy(tmp_path):
+    """Files the follower's latest LOCAL snapshot already holds with
+    identical name+size+crc are copied locally during InstallSnapshot,
+    not re-downloaded (reference: LocalSnapshotCopier#filterBeforeCopy).
+    An FSM with a large constant blob + small changing state ships only
+    the changed file."""
+    from tests.cluster import MockStateMachine
+    from tpuraft.errors import Status
+
+    BLOB = bytes(range(256)) * 256          # 64KB, never changes
+
+    class TwoFileFSM(MockStateMachine):
+        async def on_snapshot_save(self, writer, done) -> None:
+            import struct
+            blob = struct.pack("<I", len(self.logs)) + b"".join(
+                struct.pack("<I", len(x)) + x for x in self.logs)
+            writer.write_file("data", blob)
+            writer.write_file("constant-blob", BLOB)
+            self.snapshots_saved += 1
+            done(Status.OK())
+
+        async def on_snapshot_load(self, reader) -> bool:
+            assert reader.read_file("constant-blob") == BLOB
+            return await super().on_snapshot_load(reader)
+
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    for p in c.peers:
+        c.fsms[p] = TwoFileFSM()
+    await c.start_all()
+    leader = await c.wait_leader()
+    victim = next(p for p in c.peers if p != leader.server_id)
+    for i in range(3):
+        await c.apply_ok(leader, b"f%d" % i)
+    await c.wait_applied(3)
+    # the victim takes its OWN local snapshot (so it holds the blob)
+    st = await c.nodes[victim].snapshot()
+    assert st.is_ok(), str(st)
+    # victim crashes; leader moves on and compacts past its log
+    await c.stop(victim)
+    for i in range(3, 16):
+        await c.apply_ok(leader, b"f%d" % i)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    # back up: too far behind -> InstallSnapshot; blob must be reused
+    await c.start(victim, fsm=TwoFileFSM())
+    await c.wait_applied(16, timeout_s=10)
+    node = c.nodes[victim]
+    reused = node.metrics.snapshot().get("counters", {}).get(
+        "install-snapshot-files-reused")
+    assert reused == 1, node.metrics.snapshot()
+    assert c.fsms[victim].logs == [b"f%d" % i for i in range(16)]
+    await c.stop_all()
+
+
+async def test_filter_before_copy_rejects_rotted_local_file(tmp_path):
+    """A local snapshot file whose on-disk bytes rotted after its
+    manifest crc was recorded must NOT be reused: the install detects
+    the rot on its crc-verified local read and falls back to the
+    network copy.  The rot lives in a file the FSM does not touch at
+    load time, so startup recovery stays healthy and the install path
+    is what meets it."""
+    import glob
+    import struct
+
+    from tests.cluster import MockStateMachine
+    from tpuraft.errors import Status
+
+    BLOB = bytes(range(256)) * 256          # reusable, stays intact
+    AUX = b"\x5a" * 4096                    # reusable, gets rotted
+
+    class ThreeFileFSM(MockStateMachine):
+        async def on_snapshot_save(self, writer, done) -> None:
+            blob = struct.pack("<I", len(self.logs)) + b"".join(
+                struct.pack("<I", len(x)) + x for x in self.logs)
+            writer.write_file("data", blob)
+            writer.write_file("constant-blob", BLOB)
+            writer.write_file("aux-blob", AUX)
+            self.snapshots_saved += 1
+            done(Status.OK())
+        # on_snapshot_load: MockStateMachine reads only "data" — the
+        # rotted aux-blob is never read at startup
+
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    for p in c.peers:
+        c.fsms[p] = ThreeFileFSM()
+    await c.start_all()
+    leader = await c.wait_leader()
+    victim = next(p for p in c.peers if p != leader.server_id)
+    for i in range(3):
+        await c.apply_ok(leader, b"r%d" % i)
+    await c.wait_applied(3)
+    st = await c.nodes[victim].snapshot()
+    assert st.is_ok(), str(st)
+    await c.stop(victim)
+    # rot the victim's local aux-blob on disk (crc recorded at save time)
+    pat = f"{tmp_path}/{victim.ip}_{victim.port}/snapshot/snapshot_*/aux-blob"
+    paths = glob.glob(pat)
+    assert paths, pat
+    with open(paths[0], "r+b") as f:
+        f.seek(100)
+        f.write(b"\xff\xff\xff")
+    for i in range(3, 16):
+        await c.apply_ok(leader, b"r%d" % i)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    await c.start(victim, fsm=ThreeFileFSM())
+    await c.wait_applied(16, timeout_s=10)
+    node = c.nodes[victim]
+    # only constant-blob reused; the rotted aux-blob fell back to the
+    # network, and the installed snapshot's aux bytes are the leader's
+    reused = node.metrics.snapshot().get("counters", {}).get(
+        "install-snapshot-files-reused")
+    assert reused == 1, node.metrics.snapshot()
+    from tpuraft.storage.snapshot import SnapshotReader
+    snaps = sorted(glob.glob(
+        f"{tmp_path}/{victim.ip}_{victim.port}/snapshot/snapshot_*"))
+    reader = SnapshotReader(snaps[-1])
+    assert reader.read_file("aux-blob") == AUX
+    assert reader.read_file("constant-blob") == BLOB
+    assert c.fsms[victim].logs == [b"r%d" % i for i in range(16)]
+    await c.stop_all()

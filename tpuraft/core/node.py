@@ -351,6 +351,34 @@ class Node:
         the local FSM has applied up to it (reference: Node#readIndex)."""
         return await self.read_only_service.read_index()
 
+    def read_committed_user_log(self, index: int) -> LogEntry:
+        """Fetch the first committed DATA entry at or after ``index``
+        from the local log (reference: NodeImpl#readCommittedUserLog —
+        same forward-skip over NO_OP/CONFIGURATION entries).  Raises
+        RaftException: EINVAL for an index beyond the commit point,
+        ENOENT when the range was compacted away or holds no user log.
+        """
+        committed = self.ballot_box.last_committed_index
+        if index <= 0 or index > committed:
+            raise RaftException(Status.error(
+                RaftError.EINVAL,
+                f"index {index} out of committed range [1, {committed}]"))
+        first = self.log_manager.first_log_index()
+        if index < first:
+            raise RaftException(Status.error(
+                RaftError.ENOENT,
+                f"log at {index} compacted (first index {first})"))
+        for i in range(index, committed + 1):
+            entry = self.log_manager.get_entry(i)
+            if entry is None:  # compacted under us
+                raise RaftException(Status.error(
+                    RaftError.ENOENT, f"log at {i} compacted concurrently"))
+            if entry.type == EntryType.DATA:
+                return entry
+        raise RaftException(Status.error(
+            RaftError.ENOENT,
+            f"no user log in committed range [{index}, {committed}]"))
+
     async def transfer_leadership_to(self, peer: PeerId) -> Status:
         async with self._lock:
             if self.state != State.LEADER:

@@ -209,7 +209,11 @@ class SnapshotExecutor:
                     RaftError.EHIGHERTERMREQUEST, "install_snapshot"),
                     new_leader=PeerId.parse(req.server_id))
             node._last_leader_timestamp = time.monotonic()
-            if self.installing:
+            if self.installing or self._saving:
+                # save and install share the storage temp dir — mutual
+                # exclusion both ways (reference: savingSnapshot /
+                # downloadingSnapshot guards); the leader's paced retry
+                # comes back after the local save finishes
                 return InstallSnapshotResponse(term=node.current_term,
                                                success=False)
             if req.meta.last_included_index <= self.last_snapshot_id.index:
@@ -233,9 +237,39 @@ class SnapshotExecutor:
         try:
             manifest_blob = await copier.read_bytes(_MANIFEST)
             meta, files = _decode_manifest(manifest_blob)
+            # filter-before-copy (reference: LocalSnapshotCopier#filter
+            # BeforeCopy): files our latest local snapshot already holds
+            # with identical name+size+crc are copied locally, not
+            # re-downloaded — an InstallSnapshot where only part of the
+            # state changed ships only the changed files
+            local = self._storage.open()
+            have = {}
+            if local is not None:
+                have = {(lf.name, lf.size, lf.crc):
+                        os.path.join(local.path, lf.name)
+                        for lf in local.files()}
+            reused = 0
+            loop = asyncio.get_running_loop()
             for f in files:
-                await copier.copy_to(f.name, os.path.join(writer.path, f.name))
+                dst = os.path.join(writer.path, f.name)
+                if (f.name, f.size, f.crc) in have and local is not None:
+                    # verify the LOCAL bytes before trusting them: the
+                    # manifest crc was recorded at save time; rot since
+                    # then must fall back to the network copy, not be
+                    # laundered into a new self-consistent manifest
+                    ok = await loop.run_in_executor(
+                        None, _reuse_local_file, local, f.name, dst)
+                    if ok:
+                        reused += 1
+                    else:
+                        await copier.copy_to(f.name, dst)
+                else:
+                    await copier.copy_to(f.name, dst)
                 writer.add_file(f.name)
+            if reused:
+                node.metrics.counter("install-snapshot-files-reused", reused)
+                LOG.info("%s install: reused %d/%d files from local snapshot",
+                         node, reused, len(files))
         except (RpcError, ValueError, IOError) as e:
             LOG.warning("%s snapshot copy failed: %s", node, e)
             return False
@@ -273,6 +307,24 @@ class _ChunkAdapter:
 
     def read_file(self, name: str, offset: int, count: int):
         return self._reader.read_chunk(name, offset, count)
+
+
+def _reuse_local_file(local, name: str, dst: str) -> bool:
+    """Copy a file from the local snapshot into ``dst`` with the same
+    durability as a network download: crc-verified read (read_file
+    raises on rot), then write + fsync.  False => caller re-downloads.
+    Runs in an executor thread."""
+    try:
+        data = local.read_file(name)
+    except IOError:
+        return False
+    if data is None:
+        return False
+    with open(dst, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    return True
 
 
 def _conf_from_meta(meta: SnapshotMeta) -> ConfigurationEntry:

@@ -521,6 +521,32 @@ class RheaKVStore:
             remaining=self._scan_budget(limit))
         return [kv for p in parts for kv in p]
 
+    def iterator(self, start: bytes, end: bytes, buf_size: int = 64,
+                 return_value: bool = True):
+        """Paged async iterator over [start, end) (reference:
+        ``DefaultRheaKVStore#iterator`` / ``RheaIterator``): fetches
+        ``buf_size`` entries per scan RPC and yields ``(key, value)``
+        in order, transparently crossing region boundaries::
+
+            async for k, v in kv.iterator(b"a", b"z"):
+                ...
+        """
+        if buf_size <= 0:
+            raise ValueError("buf_size must be positive")
+        return self._iterate(start, end, buf_size, return_value)
+
+    async def _iterate(self, start: bytes, end: bytes, buf_size: int,
+                       return_value: bool):
+        cursor = start
+        while True:
+            page = await self.scan(cursor, end, limit=buf_size,
+                                   return_value=return_value)
+            for kv in page:
+                yield kv
+            if len(page) < buf_size:
+                return
+            cursor = page[-1][0] + b"\x00"   # smallest key after the last
+
     async def delete_range(self, start: bytes, end: bytes) -> bool:
         parts = await self._ranged(
             start, end,

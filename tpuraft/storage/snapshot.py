@@ -166,6 +166,10 @@ class SnapshotReader:
     def list_files(self) -> list[str]:
         return [f.name for f in self._files]
 
+    def files(self) -> list[_FileRecord]:
+        """Manifest records (name/size/crc) — the filter-before-copy key."""
+        return list(self._files)
+
     def total_size(self) -> int:
         return sum(f.size for f in self._files)
 
