@@ -496,3 +496,68 @@ async def test_engine_grows_under_mesh_sharding():
     finally:
         await eng.shutdown()
         await ref.shutdown()
+
+
+async def test_engine_profile_trace_written(tmp_path):
+    """TickOptions.profile_dir captures an XLA profiler trace of the
+    device ticks (SURVEY.md §6 tracing: jax.profiler for the device
+    plane) — TensorBoard/Perfetto-viewable files appear on shutdown."""
+    import os
+
+    from tpuraft.conf import Configuration
+    from tpuraft.entity import PeerId as PID
+
+    peers = [PID.parse(f"127.0.0.1:{7400 + i}") for i in range(3)]
+    conf = Configuration(list(peers))
+    eng = MultiRaftEngine(TickOptions(
+        max_groups=4, max_peers=4, backend="jax",
+        profile_dir=str(tmp_path / "trace")))
+    await eng.start()
+    try:
+        box = eng.ballot_box_factory()(lambda idx: None)
+        box.update_conf(conf, Configuration())
+        box.reset_pending_index(1)
+        for p in peers:
+            box.commit_at(p, 7, conf, Configuration())
+        eng.tick_once()
+    finally:
+        await eng.shutdown()
+    found = []
+    for root, _dirs, files in os.walk(tmp_path / "trace"):
+        found += files
+    assert found, "no profiler trace files written"
+
+
+async def test_engine_describe():
+    eng = MultiRaftEngine(TickOptions(max_groups=4, max_peers=4,
+                                      backend="numpy"))
+    await eng.start()
+    try:
+        eng.ballot_box_factory()(lambda idx: None)
+        d = eng.describe()
+        assert "G=4" in d and "used=1" in d and "backend=numpy" in d
+    finally:
+        await eng.shutdown()
+
+
+async def test_engine_in_sigusr2_dump_and_second_trace_harmless(tmp_path):
+    """Engines appear in the describer dump (the SIGUSR2 surface), and a
+    second engine with profile_dir in the same process degrades to a
+    warning instead of failing startup."""
+    from tpuraft.util import describer
+
+    e1 = MultiRaftEngine(TickOptions(max_groups=2, max_peers=4,
+                                     backend="jax",
+                                     profile_dir=str(tmp_path / "t1")))
+    e2 = MultiRaftEngine(TickOptions(max_groups=2, max_peers=4,
+                                     backend="jax",
+                                     profile_dir=str(tmp_path / "t2")))
+    await e1.start()
+    await e2.start()          # must not raise despite the active trace
+    try:
+        dump = describer.dump_all()
+        assert dump.count("MultiRaftEngine<") >= 2, dump
+    finally:
+        await e2.shutdown()
+        await e1.shutdown()
+    assert describer.dump_all().count("MultiRaftEngine<") == 0

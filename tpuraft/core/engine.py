@@ -228,6 +228,16 @@ class MultiRaftEngine:
     def mark_dirty(self) -> None:
         self._dirty = True
 
+    def describe(self) -> str:
+        """Live engine state for operators (the device-plane counterpart
+        of Node#describe)."""
+        used = sum(1 for b in self._boxes if b is not None)
+        return (f"MultiRaftEngine<G={self.G} P={self.P} used={used} "
+                f"backend={self.opts.backend} "
+                f"mesh={self.opts.mesh_devices or 1} "
+                f"ticks={self.ticks} commit_advances={self.commit_advances} "
+                f"leaders={int(self.leader_mask.sum())}>")
+
     # -- tick loop -----------------------------------------------------------
 
     async def start(self) -> None:
@@ -257,10 +267,40 @@ class MultiRaftEngine:
                 # jitted once: eager per-tick dispatch would cost ~100ms
                 # over a tunneled device and starve the asyncio loop
                 self._tick_fn = jax.jit(joint_quorum_match_index)
+        if self.opts.profile_dir:
+            if self.opts.backend == "numpy":
+                LOG.warning("profile_dir set but backend is numpy: the "
+                            "XLA profiler only traces the jax tick path")
+            else:
+                import jax
+
+                try:
+                    # process-global: a second engine in the same
+                    # process cannot start another trace — it keeps
+                    # running without one instead of failing startup
+                    jax.profiler.start_trace(self.opts.profile_dir)
+                    self._profiling = True
+                except Exception as e:  # noqa: BLE001
+                    LOG.warning("profiler trace not started (another "
+                                "engine's trace active?): %s", e)
+        from tpuraft.util import describer
+
+        describer.register(self)
         self._task = asyncio.ensure_future(self._loop())
 
     async def shutdown(self) -> None:
         self._stopped = True
+        from tpuraft.util import describer
+
+        describer.unregister(self)
+        if getattr(self, "_profiling", False):
+            import jax
+
+            self._profiling = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — trace already stopped
+                LOG.exception("profiler stop failed")
         if self._task:
             self._task.cancel()
             try:
@@ -304,9 +344,12 @@ class MultiRaftEngine:
                                  ).astype(np.int32)
 
         if self._tick_fn is not None:
-            q = np.asarray(self._tick_fn(
-                jnp.asarray(rel), jnp.asarray(self.voter_mask),
-                jnp.asarray(self.old_voter_mask)))
+            import jax
+
+            with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
+                q = np.asarray(self._tick_fn(
+                    jnp.asarray(rel), jnp.asarray(self.voter_mask),
+                    jnp.asarray(self.old_voter_mask)))
         else:  # numpy fallback (tiny deployments / no jax)
             q = _np_joint_quorum(rel, self.voter_mask, self.old_voter_mask)
 

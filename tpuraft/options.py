@@ -82,6 +82,13 @@ class TickOptions:
     # quorum reduce then runs SPMD across chips with the per-tick upload
     # scattered and the commit download gathered over ICI.
     mesh_devices: int = 0
+    # Write an XLA profiler trace of the engine's device ticks into this
+    # directory (viewable in TensorBoard / Perfetto — SURVEY.md §6
+    # "tracing": jax.profiler traces for device ticks).  "" = off.
+    # The trace spans from engine start to shutdown.  jax backends only
+    # (ignored with a warning on backend="numpy"); the profiler is
+    # process-global, so one engine per process can trace at a time.
+    profile_dir: str = ""
 
 
 @dataclass
