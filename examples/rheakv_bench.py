@@ -189,7 +189,8 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
                     concurrency: int = 64, lease_reads: bool = False,
                     transport: str = "inproc", store: str = "memory",
                     data_path: str = "", verbose: bool = True,
-                    read_preference: str = "leader") -> dict:
+                    read_preference: str = "leader",
+                    zipf_theta: float = 0.0) -> dict:
     read_frac = {"a": 0.5, "b": 0.95, "c": 1.0}[workload]
     cluster = BenchCluster(n_stores, make_regions(n_regions),
                            lease_reads=lease_reads, transport=transport,
@@ -221,9 +222,20 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
         say(f"load: {n_keys} keys across {n_regions} regions "
             f"in {load_s:.2f}s ({n_keys / load_s:,.0f} ops/s)")
 
-        # -- mixed phase (YCSB-{a,b,c}: zipf-less uniform picks) ----------
+        # -- mixed phase (YCSB-{a,b,c}; uniform or scrambled-zipfian
+        # request distribution, as in the YCSB core workloads) -----------
         ops = rng.random(n_ops) < read_frac
-        picks = rng.integers(0, n_keys, n_ops)
+        if zipf_theta > 0:
+            ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+            weights = ranks ** -zipf_theta
+            weights /= weights.sum()
+            hot = rng.choice(n_keys, size=n_ops, p=weights)
+            # scramble: hot ranks spread over the keyspace (YCSB's
+            # ScrambledZipfian), so the hotspot isn't one region
+            perm = rng.permutation(n_keys)
+            picks = perm[hot]
+        else:
+            picks = rng.integers(0, n_keys, n_ops)
         lat: list[float] = []
         t0 = time.perf_counter()
 
@@ -243,6 +255,7 @@ async def run_bench(n_stores: int = 3, n_regions: int = 4,
             "workload": workload, "transport": transport, "store": store,
             "stores": n_stores, "regions": n_regions,
             "read_preference": read_preference,
+            "zipf_theta": zipf_theta,
             "ops_per_s": n_ops / run_s,
             "p50_ms": float(lat_ms[int(0.50 * len(lat_ms))]),
             "p99_ms": float(lat_ms[int(0.99 * len(lat_ms)) - 1]),
@@ -277,6 +290,9 @@ def main() -> None:
                     help="data engine: in-memory or the native C++ engine")
     ap.add_argument("--data", default="",
                     help="data dir for --store native")
+    ap.add_argument("--zipf", type=float, default=0.0, metavar="THETA",
+                    help="scrambled-zipfian request skew (YCSB default "
+                         "0.99; 0 = uniform)")
     ap.add_argument("--read-preference", choices=["leader", "any"],
                     default="leader",
                     help="'any' spreads linearizable reads over ALL "
@@ -289,7 +305,8 @@ def main() -> None:
     asyncio.run(run_bench(args.stores, args.regions, args.keys, args.ops,
                           args.value_size, args.workload, args.concurrency,
                           args.lease_reads, args.transport, args.store,
-                          args.data, read_preference=args.read_preference))
+                          args.data, read_preference=args.read_preference,
+                          zipf_theta=args.zipf))
 
 
 if __name__ == "__main__":

@@ -111,3 +111,10 @@ async def test_rheakv_bench_native_stack(tmp_path):
                         data_path=str(tmp_path), verbose=False)
     assert r["ops_per_s"] > 0
     assert r["transport"] == "native" and r["store"] == "native"
+
+
+async def test_rheakv_bench_zipfian():
+    r = await asyncio.wait_for(
+        run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
+                  concurrency=16, zipf_theta=0.99, verbose=False), 120)
+    assert r["ops_per_s"] > 0 and r["zipf_theta"] == 0.99

@@ -840,3 +840,37 @@ async def test_read_committed_user_log_compacted(tmp_path):
             assert ex.status.raft_error == RaftError.ENOENT
     finally:
         await c.stop_all()
+
+
+async def test_transfer_timeout_reverts_to_leader():
+    """Transferring to an unreachable target must not wedge the group:
+    applies are rejected EBUSY during the handoff window, then the
+    watchdog reverts to LEADER after an election timeout and service
+    resumes (reference: NodeImpl transfer deadline handling)."""
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        st = await c.apply_ok(leader, b"pre")
+        assert st.is_ok(), str(st)
+        target = next(p for p in c.peers if p != leader.server_id)
+        # cut the target off so TimeoutNow can never reach it
+        c.net.isolate(target.endpoint)
+        st = await leader.transfer_leadership_to(target)
+        assert st.is_ok(), str(st)   # transfer is initiated
+        assert leader.state == State.TRANSFERRING
+        st = await c.apply_ok(leader, b"during", retry=False)
+        assert not st.is_ok() and st.raft_error == RaftError.EBUSY, str(st)
+        # the watchdog gives up after one election timeout
+        deadline = asyncio.get_running_loop().time() + 3
+        while asyncio.get_running_loop().time() < deadline:
+            if leader.state == State.LEADER:
+                break
+            await asyncio.sleep(0.02)
+        assert leader.state == State.LEADER, leader.state
+        c.net.heal()
+        st = await c.apply_ok(leader, b"post")
+        assert st.is_ok(), str(st)
+        await c.wait_applied(2)
+    finally:
+        await c.stop_all()

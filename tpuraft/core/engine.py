@@ -299,8 +299,8 @@ class MultiRaftEngine:
             self._profiling = False
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001 — trace already stopped
-                LOG.exception("profiler stop failed")
+            except Exception as e:  # noqa: BLE001 — trace already stopped
+                LOG.warning("profiler stop: %s", e)
         if self._task:
             self._task.cancel()
             try:
