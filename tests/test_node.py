@@ -874,3 +874,39 @@ async def test_transfer_timeout_reverts_to_leader():
         await c.wait_applied(2)
     finally:
         await c.stop_all()
+
+
+async def test_follower_read_index_forward_batches():
+    """Concurrent forwarded readIndex calls on a follower share RPC
+    rounds (reference: ReadOnlyServiceImpl batches on every node), and
+    late arrivals get a FRESH round, never an already-in-flight one."""
+    c = TestCluster(3)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        await c.apply_ok(leader, b"rr")
+        follower = next(n for n in c.nodes.values() if n is not leader)
+
+        calls = {"n": 0}
+        real = follower.transport.read_index
+
+        async def counting(dst, req, timeout_ms=None):
+            calls["n"] += 1
+            return await real(dst, req, timeout_ms)
+
+        follower.transport.read_index = counting
+        # 30 concurrent readers -> far fewer forward RPCs than readers
+        results = await asyncio.gather(
+            *(follower.read_index() for _ in range(30)))
+        assert all(r >= 1 for r in results)
+        assert calls["n"] < 10, calls["n"]
+        # staggered waves keep landing mid-round without orphaning
+        calls["n"] = 0
+        async def one(delay):
+            await asyncio.sleep(delay)
+            return await follower.read_index()
+        results = await asyncio.wait_for(
+            asyncio.gather(*(one((i % 5) * 0.001) for i in range(25))), 5.0)
+        assert all(r >= 1 for r in results)
+    finally:
+        await c.stop_all()

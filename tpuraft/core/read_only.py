@@ -27,13 +27,19 @@ class ReadOnlyService:
         self._node = node
         self._pending: list[asyncio.Future] = []
         self._round_task: Optional[asyncio.Task] = None
+        # follower side: forwarded readIndex requests batch the same way
+        # (reference: ReadOnlyServiceImpl batches on every node — one
+        # forward RPC serves every reader queued for that round)
+        self._fwd_pending: list[asyncio.Future] = []
+        self._fwd_task: Optional[asyncio.Task] = None
 
     async def shutdown(self) -> None:
-        for fut in self._pending:
+        for fut in self._pending + self._fwd_pending:
             if not fut.done():
                 fut.set_exception(
                     _read_error(RaftError.ENODESHUTTING, "shutting down"))
         self._pending.clear()
+        self._fwd_pending.clear()
 
     async def read_index(self) -> int:
         """Public entry: returns an index I such that (a) I >= commit index
@@ -51,23 +57,41 @@ class ReadOnlyService:
     async def leader_confirm_read_index(self) -> int:
         """Leader side: pin commitIndex, confirm leadership, return index.
         Batching: concurrent callers share one confirmation round."""
-        node = self._node
+        return await self._join_round("_pending", "_round_task",
+                                      self._leader_once)
+
+    async def _join_round(self, pending_attr: str, task_attr: str,
+                          once) -> int:
+        """Enqueue one reader into the named batch and ensure a drain
+        task is running; ``once()`` resolves a whole batch to an index
+        (or raises for the whole batch)."""
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append(fut)
-        if self._round_task is None or self._round_task.done():
-            self._round_task = asyncio.ensure_future(self._run_round())
+        getattr(self, pending_attr).append(fut)
+        task = getattr(self, task_attr)
+        if task is None or task.done():
+            setattr(self, task_attr, asyncio.ensure_future(
+                self._run_rounds(pending_attr, once)))
         return await fut
 
-    async def _run_round(self) -> None:
-        # Drain until no requests remain: futures appended WHILE a round is
-        # confirming must be picked up by a follow-up round here — callers
-        # only spawn a round task when none is running, so exiting with
-        # _pending non-empty would orphan those readers until the next
-        # request happens to arrive (observed as client-timeout p99 tails).
-        while self._pending:
-            batch, self._pending = self._pending, []
+    async def _run_rounds(self, pending_attr: str, once) -> None:
+        # Drain until no requests remain: futures appended WHILE a round
+        # is resolving must be picked up by a follow-up round here —
+        # callers only spawn a drain task when none is running, so
+        # exiting with readers still pending would orphan them until the
+        # next request happens to arrive (observed as client-timeout p99
+        # tails).  This invariant serves BOTH the leader confirmation
+        # rounds and the follower forward rounds.
+        while getattr(self, pending_attr):
+            batch = getattr(self, pending_attr)
+            setattr(self, pending_attr, [])
             try:
-                ok, read_index = await self._confirm_once()
+                read_index = await once()
+            except ReadIndexError as e:
+                for fut in batch:
+                    if not fut.done():
+                        fut.set_exception(_read_error(
+                            e.status.raft_error, e.status.error_msg))
+                continue
             except Exception as e:  # noqa: BLE001 — transport/storage error
                 for fut in batch:
                     if not fut.done():
@@ -75,14 +99,15 @@ class ReadOnlyService:
                             RaftError.EINTERNAL, f"readIndex round: {e!r}"))
                 continue
             for fut in batch:
-                if fut.done():
-                    continue
-                if ok:
+                if not fut.done():
                     fut.set_result(read_index)
-                else:
-                    fut.set_exception(_read_error(
-                        RaftError.ERAFTTIMEDOUT,
-                        "readIndex quorum confirmation failed"))
+
+    async def _leader_once(self) -> int:
+        ok, read_index = await self._confirm_once()
+        if not ok:
+            raise _read_error(RaftError.ERAFTTIMEDOUT,
+                              "readIndex quorum confirmation failed")
+        return read_index
 
     async def _confirm_once(self) -> tuple[bool, int]:
         node = self._node
@@ -101,6 +126,14 @@ class ReadOnlyService:
         return acks >= voters // 2 + 1 and node.is_leader(), read_index
 
     async def _forward_to_leader(self) -> int:
+        """Batched: concurrent forwarded readers share one RPC round.
+        Sharing is linearizable — the shared index was obtained by an
+        RPC SENT after every sharer's invoke (readers arriving while a
+        round is in flight wait for the NEXT round)."""
+        return await self._join_round("_fwd_pending", "_fwd_task",
+                                      self._forward_once)
+
+    async def _forward_once(self) -> int:
         node = self._node
         leader = node.leader_id
         if leader.is_empty():
