@@ -561,3 +561,51 @@ async def test_engine_in_sigusr2_dump_and_second_trace_harmless(tmp_path):
         await e2.shutdown()
         await e1.shutdown()
     assert describer.dump_all().count("MultiRaftEngine<") == 0
+
+
+async def test_engine_1k_groups_5_replicas():
+    """BASELINE config 3: 1K groups x 5 voters, batched TpuBallotBox —
+    the 5-replica quorum (3 of 5) through the jax tick matches the
+    numpy oracle, including a minority (2-ack) stall case."""
+    import numpy as np
+
+    from tpuraft.conf import Configuration
+    from tpuraft.entity import PeerId as PID
+
+    G = 1024
+    peers = [PID.parse(f"127.0.0.1:{7500 + i}") for i in range(5)]
+    conf = Configuration(list(peers))
+
+    def build(opts):
+        eng = MultiRaftEngine(opts)
+        commits = {}
+        factory = eng.ballot_box_factory()
+        rng = np.random.default_rng(3)
+        boxes = []
+        for g in range(G):
+            box = factory(lambda idx, g=g: commits.__setitem__(g, idx))
+            box.update_conf(conf, Configuration())
+            box.reset_pending_index(1)
+            # half the groups: all 5 ack; other half: only 2 ack (stall)
+            ackers = peers if g % 2 == 0 else peers[:2]
+            for p in ackers:
+                box.commit_at(p, int(rng.integers(1, 90)), conf,
+                              Configuration())
+            boxes.append(box)
+        return eng, commits
+
+    eng_np, commits_np = build(TickOptions(
+        max_groups=G, max_peers=8, backend="numpy"))
+    eng_np.tick_once()
+    eng_jax, commits_jax = build(TickOptions(
+        max_groups=G, max_peers=8, backend="jax"))
+    await eng_jax.start()
+    try:
+        eng_jax.tick_once()
+        assert commits_jax == commits_np
+        # exactly the all-ack half committed (2 of 5 is no quorum)
+        assert len(commits_jax) == G // 2, len(commits_jax)
+        assert all(g % 2 == 0 for g in commits_jax)
+    finally:
+        await eng_jax.shutdown()
+        await eng_np.shutdown()
