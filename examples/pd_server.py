@@ -1,0 +1,92 @@
+"""Standalone placement-driver server: one OS process per PD member.
+
+Reference parity: ``pd:PlacementDriverServer`` bootable as its own
+process (SURVEY.md §3.2 "PD server") — a 1-group raft app holding
+cluster metadata, answering routing, and emitting split /
+leader-balancing instructions from store heartbeats.
+
+    python -m examples.pd_server --serve 127.0.0.1:9101 \\
+        --pd 127.0.0.1:9101,127.0.0.1:9102,127.0.0.1:9103 \\
+        --data /tmp/pd1 --split-keys 4096 [--balance-leaders]
+
+Pair with ``examples.rheakv_server --pd ...`` stores: they heartbeat
+region meta + stats here and execute the returned instructions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from examples.rheakv_bench import make_regions
+from tpuraft.rheakv.pd_server import (
+    PlacementDriverOptions,
+    PlacementDriverServer,
+)
+
+
+async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
+                split_threshold_keys: int = 0,
+                balance_leaders: bool = False,
+                seed_regions: int = 0,
+                transport_kind: str = "tcp") -> None:
+    if transport_kind == "native":
+        from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
+        from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
+    else:
+        from tpuraft.rpc.tcp import TcpRpcServer as Server
+        from tpuraft.rpc.tcp import TcpTransport as Transport
+
+    server = Server(endpoint)
+    await server.start()
+    transport = Transport(endpoint=endpoint)
+    opts = PlacementDriverOptions(
+        endpoints=list(pd_endpoints),
+        data_path=data_path,
+        split_threshold_keys=split_threshold_keys,
+        balance_leaders=balance_leaders,
+        initial_regions=make_regions(seed_regions) if seed_regions else [],
+    )
+    pd = PlacementDriverServer(opts, endpoint, server, transport)
+    await pd.start()
+    print(f"pd member {endpoint} up ({len(pd_endpoints)}-member cluster)",
+          flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await pd.shutdown()
+        await server.stop()
+        await transport.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serve", required=True, help="this member's ip:port")
+    ap.add_argument("--pd", required=True,
+                    help="comma-separated PD cluster endpoints")
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--split-keys", type=int, default=0,
+                    help="auto-split threshold (0 = off)")
+    ap.add_argument("--balance-leaders", action="store_true")
+    ap.add_argument("--seed-regions", type=int, default=0,
+                    help="pre-split the keyspace into N regions on first "
+                         "boot (metadata only; stores attach via "
+                         "heartbeats)")
+    ap.add_argument("--transport", choices=["tcp", "native"], default="tcp")
+    args = ap.parse_args()
+    pds = [e for e in args.pd.split(",") if e]
+    if args.serve not in pds:
+        print("error: --serve must be one of --pd", file=sys.stderr)
+        sys.exit(2)
+    try:
+        asyncio.run(serve(args.serve, pds, args.data, args.split_keys,
+                          args.balance_leaders, args.seed_regions,
+                          args.transport))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,8 @@ def derive_regions(stores: list[str], n_regions: int):
 
 async def serve(endpoint: str, stores: list[str], n_regions: int,
                 data_path: str, transport_kind: str = "tcp",
-                store_kind: str = "memory") -> None:
+                store_kind: str = "memory",
+                pd_endpoints: list[str] | None = None) -> None:
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -60,7 +61,11 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         # the C++ engine mkdirs only the leaf — ensure the parents exist
         os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
         opts.raw_store_factory = lambda: NativeRawKVStore(base)
-    engine = StoreEngine(opts, server, transport)
+    pd_client = None
+    if pd_endpoints:
+        from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+        pd_client = RemotePlacementDriverClient(transport, pd_endpoints)
+    engine = StoreEngine(opts, server, transport, pd_client=pd_client)
     await engine.start()
     print(f"rheakv store {endpoint} up "
           f"({n_regions} regions, {len(stores)} stores)", flush=True)
@@ -93,6 +98,10 @@ def main() -> None:
     ap.add_argument("--transport", choices=["tcp", "native"], default="tcp")
     ap.add_argument("--store", choices=["memory", "native"],
                     default="memory")
+    ap.add_argument("--pd", default="",
+                    help="comma-separated PD endpoints: heartbeat region "
+                         "meta + stats there and execute its instructions "
+                         "(splits, leader transfers)")
     args = ap.parse_args()
     stores = [s for s in args.stores.split(",") if s]
     if args.serve not in stores:
@@ -100,7 +109,8 @@ def main() -> None:
         sys.exit(2)
     try:
         asyncio.run(serve(args.serve, stores, args.regions, args.data,
-                          args.transport, args.store))
+                          args.transport, args.store,
+                          [e for e in args.pd.split(",") if e] or None))
     except KeyboardInterrupt:
         pass
 

@@ -1,0 +1,127 @@
+"""Multi-process PD-backed RheaKV: a standalone placement-driver OS
+process + 3 store OS processes heartbeating to it, a PD-routed client,
+and a PD-ordered auto-split — all over real TCP.
+
+The deepest deployment shape (reference: PlacementDriverServer + stores
++ RemotePlacementDriverClient on separate machines — SURVEY.md §3.2).
+"""
+
+import asyncio
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.asyncio
+async def test_pd_backed_multiprocess_cluster_with_auto_split(tmp_path):
+    ports = _free_ports(4)
+    pd_ep = f"127.0.0.1:{ports[0]}"
+    stores = [f"127.0.0.1:{p}" for p in ports[1:]]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs: list[subprocess.Popen] = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "examples.pd_server",
+             "--serve", pd_ep, "--pd", pd_ep,
+             "--data", str(tmp_path / "pd"),
+             "--split-keys", "48"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for ep in stores:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "examples.rheakv_server",
+                 "--serve", ep, "--stores", ",".join(stores),
+                 "--regions", "1", "--data",
+                 str(tmp_path / ep.replace(":", "_")),
+                 "--pd", pd_ep],
+                cwd=REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+
+        from tpuraft.rheakv.client import RheaKVStore
+        from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+        from tpuraft.rpc.tcp import TcpTransport
+
+        transport = TcpTransport()
+        pd = RemotePlacementDriverClient(transport, [pd_ep])
+        kv = RheaKVStore(pd, transport, timeout_ms=3000)
+        await kv.start()
+        try:
+            # ride out interpreter boots + elections; the client routes
+            # through the PD, which learns regions from store heartbeats
+            deadline = time.monotonic() + 90
+            ok = False
+            while time.monotonic() < deadline:
+                try:
+                    ok = await kv.put(struct.pack(">I", 1), b"boot")
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            assert ok, "PD-routed cluster never became writable"
+
+            # load enough keys to cross the PD's split threshold
+            for i in range(2, 202):
+                k = struct.pack(">I", (i * 2654435761) & 0xFFFFFFFF)
+                for _ in range(10):
+                    try:
+                        assert await kv.put(k, b"v%d" % i)
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.3)
+
+            # the PD orders a RANGE_SPLIT; the store splits; the PD
+            # learns the new region from subsequent heartbeats
+            deadline = time.monotonic() + 60
+            n_regions = 1
+            while time.monotonic() < deadline:
+                try:
+                    regions = await pd.list_regions()
+                    n_regions = len(regions)
+                    if n_regions >= 2:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+            assert n_regions >= 2, "PD never ordered/learned the split"
+
+            # data still fully served after the split, via PD routing
+            misses = 0
+            for i in range(2, 202):
+                k = struct.pack(">I", (i * 2654435761) & 0xFFFFFFFF)
+                got = None
+                for _ in range(10):
+                    try:
+                        got = await kv.get(k)
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.3)
+                if got != b"v%d" % i:
+                    misses += 1
+            assert misses == 0, f"{misses} keys unreadable after split"
+        finally:
+            await kv.shutdown()
+            await transport.close()
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            proc.wait()

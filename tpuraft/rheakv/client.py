@@ -178,7 +178,16 @@ class RheaKVStore:
             for g in self._group_by_region(chunk, lambda k: k)])
 
     async def start(self) -> None:
-        self.route_table.reset(await self.pd.list_regions())
+        # best-effort initial route pull: a PD that is still booting (or
+        # electing) must not fail client startup — ops refresh routes on
+        # demand through _execute's ENOENT path
+        try:
+            self.route_table.reset(await self.pd.list_regions())
+        except Exception as e:  # noqa: BLE001
+            # visible at default level: a typo'd PD endpoint would
+            # otherwise surface only as per-op ENOENT after timeouts
+            LOG.warning("initial route pull from PD failed (%s); "
+                        "deferring to on-demand refresh", e)
         self._started = True
 
     async def shutdown(self) -> None:
