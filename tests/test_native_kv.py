@@ -199,7 +199,19 @@ def test_kill9_mid_write_recovers(tmp_path):
             i += 1
     """)
     proc = subprocess.Popen([sys.executable, "-c", code])
-    time.sleep(4.0)  # ~2s of that is interpreter/sitecustomize start
+    # wait for REAL bytes on disk, not a fixed sleep: interpreter boot
+    # (~2s of sitecustomize jax imports) stretches arbitrarily under
+    # full-suite CPU contention
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        total = 0
+        if os.path.isdir(path):
+            total = sum(
+                os.path.getsize(os.path.join(path, f))
+                for f in os.listdir(path))
+        if total > 200_000:
+            break
+        time.sleep(0.2)
     proc.send_signal(signal.SIGKILL)
     proc.wait()
     s = NativeRawKVStore(path)

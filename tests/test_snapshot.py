@@ -287,3 +287,43 @@ async def test_filter_before_copy_rejects_rotted_local_file(tmp_path):
     assert reader.read_file("constant-blob") == BLOB
     assert c.fsms[victim].logs == [b"r%d" % i for i in range(16)]
     await c.stop_all()
+
+
+async def test_install_recovers_from_stale_partial_temp(tmp_path):
+    """A crash mid-InstallSnapshot leaves a partial temp dir; on
+    restart the temp is ignored by snapshot discovery and the next
+    install clears it and succeeds (reference: LocalSnapshotStorage
+    temp handling)."""
+    import os
+
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    leader = await c.wait_leader()
+    victim = next(p for p in c.peers if p != leader.server_id)
+    await c.apply_ok(leader, b"t0")
+    await c.wait_applied(1)
+    await c.stop(victim)
+    # simulate a crash mid-install: partial temp with junk files
+    snap_root = f"{tmp_path}/{victim.ip}_{victim.port}/snapshot"
+    temp = os.path.join(snap_root, "temp")
+    os.makedirs(temp, exist_ok=True)
+    with open(os.path.join(temp, "data"), "wb") as f:
+        f.write(b"half-written garbage")
+    with open(os.path.join(temp, "unrelated-file"), "wb") as f:
+        f.write(b"x" * 100)
+    # leader moves on and compacts so the victim needs an install
+    for i in range(1, 15):
+        await c.apply_ok(leader, b"t%d" % i)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    await c.start(victim)
+    await c.wait_applied(15, timeout_s=10)
+    assert c.fsms[victim].logs == [b"t%d" % i for i in range(15)]
+    assert c.fsms[victim].snapshots_loaded >= 1
+    # the stale junk did not leak into the installed snapshot
+    snaps = [d for d in os.listdir(snap_root) if d.startswith("snapshot_")]
+    assert snaps, os.listdir(snap_root)
+    newest = os.path.join(snap_root, sorted(
+        snaps, key=lambda d: int(d.split("_")[1]))[-1])
+    assert "unrelated-file" not in os.listdir(newest)
+    await c.stop_all()

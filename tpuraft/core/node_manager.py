@@ -7,6 +7,7 @@ multiplex one server; requests route by (group_id, peer_id).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Optional
 
@@ -24,6 +25,8 @@ class NodeManager:
     def __init__(self, server: RpcServer):
         self.server = server
         self._nodes: dict[tuple[str, str], Node] = {}
+        # (group, leader) -> FIFO of in-order AppendEntries execution
+        self._append_lanes: dict[tuple[str, str], asyncio.Queue] = {}
         for method in ("append_entries", "request_vote", "timeout_now",
                        "install_snapshot", "read_index"):
             server.register(method, self._make_handler(method))
@@ -113,15 +116,83 @@ class NodeManager:
                 raise RpcError(Status.error(
                     RaftError.ENOENT,
                     f"no node for group={request.group_id} peer={request.peer_id}"))
+            if method == "append_entries" and request.entries:
+                # pipelined replication: a leader keeps a window of
+                # AppendEntries in flight; execution here must follow
+                # arrival order per (group, leader) or in-window
+                # requests would race to the node lock and shuffle,
+                # tripping prev-log rejections on every dispatch
+                # (reference: AppendEntriesRequestProcessor's
+                # per-connection sequence-keyed executors).  EMPTY
+                # appends (heartbeats, probes) bypass the lane: a beat
+                # must not wait behind a window of synced disk appends
+                # (head-of-line blocking would time out ReadIndex SAFE
+                # rounds while replication is healthy)
+                return await self._ordered_append(node, request)
             return await getattr(node, f"handle_{method}")(request)
 
         return handler
+
+    async def _ordered_append(self, node: Node, request):
+        key = (request.group_id, request.server_id)
+        fut = asyncio.get_running_loop().create_future()
+        entry = self._append_lanes.get(key)
+        if entry is None:
+            lane: asyncio.Queue = asyncio.Queue()
+            worker = asyncio.ensure_future(self._lane_worker(key, lane))
+            entry = self._append_lanes[key] = (lane, worker)
+        entry[0].put_nowait((node, request, fut))
+        return await fut
+
+    async def _lane_worker(self, key, lane: "asyncio.Queue") -> None:
+        idle_reap_s = 60.0
+        try:
+            while True:
+                try:
+                    node, req, fut = await asyncio.wait_for(
+                        lane.get(), idle_reap_s)
+                except asyncio.TimeoutError:
+                    if lane.empty():
+                        return
+                    continue
+                try:
+                    resp = await node.handle_append_entries(req)
+                    if not fut.done():
+                        fut.set_result(resp)
+                except asyncio.CancelledError:
+                    if not fut.done():
+                        fut.set_exception(RpcError(Status.error(
+                            RaftError.ENODESHUTTING, "lane shut down")))
+                    raise
+                except Exception as e:  # noqa: BLE001 — per-request error
+                    if not fut.done():
+                        fut.set_exception(e)
+        finally:
+            entry = self._append_lanes.get(key)
+            if entry is not None and entry[0] is lane:
+                del self._append_lanes[key]
+                while not lane.empty():
+                    _node, _req, fut = lane.get_nowait()
+                    if not fut.done():
+                        fut.set_exception(RpcError(Status.error(
+                            RaftError.ENODESHUTTING, "lane shut down")))
 
     def add(self, node: Node) -> None:
         self._nodes[(node.group_id, str(node.server_id))] = node
 
     def remove(self, node: Node) -> None:
         self._nodes.pop((node.group_id, str(node.server_id)), None)
+        # tear down this group's append lanes: no worker may linger to
+        # execute a queued append against a stopped node, and test
+        # teardowns must not see pending-task warnings
+        for key in [k for k in self._append_lanes if k[0] == node.group_id]:
+            lane, worker = self._append_lanes.pop(key)
+            worker.cancel()
+            while not lane.empty():
+                _n, _r, fut = lane.get_nowait()
+                if not fut.done():
+                    fut.set_exception(RpcError(Status.error(
+                        RaftError.ENODESHUTTING, "node removed")))
 
     def get(self, group_id: str, peer_id: str) -> Optional[Node]:
         return self._nodes.get((group_id, peer_id))

@@ -6,10 +6,13 @@ Reference parity: ``core:core/Replicator`` + ``ReplicatorGroupImpl``
 InstallSnapshot fallback when the follower is behind the compacted log;
 TimeoutNow for leadership transfer.
 
-Design note vs the reference: one outstanding data RPC per peer (the
-asyncio loop pipelines *across* groups/peers instead of per-connection
-inflight FIFOs; the multi-raft engine batches G x P sends per tick, which
-is where the reference's pipelining win actually lands on TPU).
+Pipelining (reference: inflight FIFO, ``maxReplicatorInflightMsgs``):
+up to ``RaftOptions.max_inflight_msgs`` AppendEntries ride per peer,
+resolved strictly in send order against the follower's per-(group,
+leader) ordered execution lane (NodeManager) — single-group throughput
+is batch*window per RTT instead of batch per RTT.  The asyncio loop
+additionally pipelines across groups/peers, and the multi-raft engine
+batches G x P quorum math per device tick.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Optional
 
 from tpuraft.entity import PeerId
@@ -29,6 +33,19 @@ from tpuraft.rpc.messages import (
 from tpuraft.rpc.transport import RpcError
 
 LOG = logging.getLogger(__name__)
+
+
+def _drop_task(t: "asyncio.Task") -> None:
+    """Cancel an in-flight RPC task and make sure a failure that
+    already completed is retrieved (else asyncio logs 'Task exception
+    was never retrieved' per dropped send during any outage)."""
+    t.cancel()
+
+    def _swallow(tt):
+        if not tt.cancelled():
+            tt.exception()
+
+    t.add_done_callback(_swallow)
 
 
 class Replicator:
@@ -46,6 +63,7 @@ class Replicator:
         self._hub = None  # HeartbeatHub when coalescing is enabled
         self._transfer_target_index: Optional[int] = None
         self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
+        self.inflight_peak = 0  # high-water mark of the pipeline window
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -112,11 +130,125 @@ class Replicator:
                         waiter.cancel()
                         wake.cancel()
                     continue
-                await self._send_entries()
+                await self._pipeline_entries()
         except asyncio.CancelledError:
             return
         except Exception:
             LOG.exception("replicator %s crashed", self.peer)
+
+    async def _pipeline_entries(self) -> None:
+        """Windowed pipelined replication (reference: the Replicator
+        inflight FIFO, ``maxReplicatorInflightMsgs``): keep up to W
+        AppendEntries RPCs in flight, advancing ``next_index``
+        optimistically as batches ship.  Responses resolve strictly in
+        send order — the head of the FIFO is awaited, so out-of-order
+        completions just wait their turn.  Any head failure rolls the
+        window back to the confirmed ``match_index`` and re-probes.
+        The follower executes in arrival order (NodeManager's
+        per-(group, leader) lanes), so in-window requests cannot race
+        each other to the log."""
+        node = self._node
+        lm = node.log_manager
+        ropts = node.options.raft_options
+        window = max(1, ropts.max_inflight_msgs)
+        inflight: deque = deque()
+        try:
+            while self._running and node.is_leader() and self._matched:
+                compacted = False
+                while (len(inflight) < window
+                       and self.next_index <= lm.last_log_index()):
+                    prev_index = self.next_index - 1
+                    prev_term = lm.get_term(prev_index)
+                    if prev_index > 0 and prev_term == 0 \
+                            and prev_index >= lm.first_log_index():
+                        compacted = True   # prev gone under us
+                        break
+                    if prev_index < lm.first_log_index() - 1:
+                        compacted = True   # behind the snapshot
+                        break
+                    entries = lm.get_entries(self.next_index,
+                                             ropts.max_entries_size,
+                                             ropts.max_body_size)
+                    if not entries:
+                        break
+                    req = AppendEntriesRequest(
+                        group_id=node.group_id,
+                        server_id=str(node.server_id),
+                        peer_id=str(self.peer),
+                        term=node.current_term,
+                        prev_log_index=prev_index,
+                        prev_log_term=prev_term,
+                        committed_index=node.ballot_box.last_committed_index,
+                        entries=entries)
+                    task = asyncio.ensure_future(
+                        node.transport.append_entries(
+                            self.peer.endpoint, req,
+                            timeout_ms=node.options.election_timeout_ms))
+                    inflight.append((prev_index, len(entries),
+                                     node.current_term, task))
+                    self.next_index += len(entries)
+                if len(inflight) > self.inflight_peak:
+                    self.inflight_peak = len(inflight)
+                if not inflight:
+                    if compacted:
+                        # route to the install path (same as the serial
+                        # probe did) instead of hard-spinning the outer
+                        # loop against a compacted log
+                        first = lm.first_log_index()
+                        self.next_index = first - 1 if first > 1 else 1
+                    return          # outer loop waits / installs
+                prev_index, count, term_at_send, task = inflight.popleft()
+                try:
+                    with node.metrics.timer("replicate-entries"):
+                        resp = await task
+                except RpcError:
+                    node.metrics.counter("replicate-error")
+                    self._roll_back_window(inflight)
+                    await asyncio.sleep(
+                        node.options.election_timeout_ms / 1000.0 / 10)
+                    return
+                if not self._running or node.current_term != term_at_send:
+                    self._roll_back_window(inflight)
+                    return
+                self.last_rpc_ack = time.monotonic()
+                node.on_peer_ack(self.peer, self.last_rpc_ack)
+                if resp.term > node.current_term:
+                    self._roll_back_window(inflight)
+                    await node.step_down_on_higher_term(
+                        resp.term,
+                        f"append_entries response from {self.peer}")
+                    return
+                if not resp.success:
+                    # conflict: back off with the follower's hints and
+                    # re-probe (same formula as the serial path)
+                    self._roll_back_window(inflight)
+                    self._matched = False
+                    candidates = [prev_index, resp.last_log_index + 1]
+                    if resp.conflict_index > 0:
+                        candidates.append(resp.conflict_index)
+                    self.next_index = max(1, min(candidates))
+                    return
+                new_match = prev_index + count
+                if new_match > self.match_index:
+                    self.match_index = new_match
+                    node.on_match_advanced(self.peer, self.match_index)
+                    self._check_catchup()
+                node.metrics.counter("replicate-entries-count", count)
+                await self._maybe_timeout_now()
+        finally:
+            # never leak in-flight RPC tasks (stop / cancellation paths);
+            # next_index is rolled back by the exits that need it
+            for *_, t in inflight:
+                _drop_task(t)
+            inflight.clear()
+
+    def _roll_back_window(self, inflight) -> None:
+        """Drop optimistic sends: cancel queued RPCs and return
+        next_index to just past the last CONFIRMED match."""
+        for *_, t in inflight:
+            _drop_task(t)
+        inflight.clear()
+        self.next_index = max(self.match_index + 1, 1)
 
     async def _send_entries(self) -> None:
         node = self._node
@@ -127,16 +259,11 @@ class Replicator:
             # prev entry gone (compacted concurrently) — snapshot path next loop
             self.next_index = lm.first_log_index() - 1 if lm.first_log_index() > 1 else 1
             return
-        ropts = node.options.raft_options
-        # until the first successful probe, send EMPTY AppendEntries
-        # (reference: sendEmptyEntries): reading payload batches for a
-        # follower whose match point is unknown wastes a disk batch per
-        # backoff step on a diverged log
-        if self._matched:
-            entries = lm.get_entries(self.next_index, ropts.max_entries_size,
-                                     ropts.max_body_size)
-        else:
-            entries = []
+        # EMPTY AppendEntries probe (reference: sendEmptyEntries):
+        # discovers the follower's match point / backs off next_index;
+        # data shipping happens exclusively in _pipeline_entries once
+        # matched
+        entries = []
         req = AppendEntriesRequest(
             group_id=node.group_id,
             server_id=str(node.server_id),
@@ -181,17 +308,15 @@ class Replicator:
                 await asyncio.sleep(
                     node.options.election_timeout_ms / 1000.0 / 20)
             return
-        # success: follower's log matches through prev + entries
+        # success: follower's log matches through prev
         # (reference: matchIndex = request.prevLogIndex + entriesCount)
         self._matched = True
-        new_match = prev_index + len(entries)
+        new_match = prev_index
         if new_match > self.match_index:
             self.match_index = new_match
             node.on_match_advanced(self.peer, self.match_index)
             self._check_catchup()
         self.next_index = max(self.next_index, new_match + 1)
-        if entries:
-            node.metrics.counter("replicate-entries-count", len(entries))
         await self._maybe_timeout_now()
 
     # -- heartbeats ----------------------------------------------------------
