@@ -1,0 +1,209 @@
+"""Chaos soak runner: boots a KV cluster in-process, drives paced
+client load under a nemesis fault schedule, then PROVES the recorded
+history linearizable.
+
+The reference's chaos tests assert convergence latches; this tool
+records real invoke/return windows and checks them against a register
+model (tpuraft.util.linearizability) — the strongest black-box verdict
+a consensus store can get.
+
+    python -m examples.soak --duration 60 --seed 7
+    python -m examples.soak --duration 120 --stores 5 --keys 8 \\
+        --data /tmp/soak --verbose
+
+Faults: rolling store kill/restart, one-way partitions, packet
+drops+delays.  Durable state dirs are required implicitly — a voter
+restarted without its disk is amnesiac, which Raft does not tolerate
+(the divergence detector would fail it loudly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import tempfile
+import time
+
+from tpuraft.rheakv.client import RheaKVStore
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+from tpuraft.util.linearizability import History, check_history
+from tpuraft.util.nemesis import NemesisAction, SkipFault, run_nemesis
+
+
+class SoakCluster:
+    def __init__(self, n_stores: int, data_path: str):
+        self.net = InProcNetwork()
+        self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
+        self.regions = [Region(id=1, peers=list(self.endpoints))]
+        self.data_path = data_path
+        self.stores: dict[str, StoreEngine] = {}
+
+    async def start_store(self, ep: str) -> None:
+        server = RpcServer(ep)
+        self.net.bind(server)
+        self.net.start_endpoint(ep)
+        transport = InProcTransport(self.net, ep)
+        opts = StoreEngineOptions(
+            server_id=ep,
+            initial_regions=[r.copy() for r in self.regions],
+            data_path=self.data_path,
+            election_timeout_ms=400)
+        store = StoreEngine(opts, server, transport)
+        await store.start()
+        self.stores[ep] = store
+
+    async def stop_store(self, ep: str) -> None:
+        self.net.stop_endpoint(ep)
+        store = self.stores.pop(ep, None)
+        if store:
+            self.net.unbind(ep)
+            await store.shutdown()
+
+    def leader_endpoint(self):
+        for ep, s in self.stores.items():
+            eng = s.get_region_engine(1)
+            if eng is not None and eng.is_leader():
+                return ep
+        return None
+
+
+async def run_soak(duration_s: float, n_stores: int, n_keys: int,
+                   seed: int, data_path: str, verbose: bool) -> dict:
+    rng = random.Random(seed)
+    c = SoakCluster(n_stores, data_path)
+    for ep in c.endpoints:
+        await c.start_store(ep)
+    pd = FakePlacementDriverClient([r.copy() for r in c.regions])
+    kv = RheaKVStore(pd, InProcTransport(c.net, "soak-client:0"),
+                     max_retries=1)
+    await kv.start()
+
+    def say(*a):
+        if verbose:
+            print(*a, flush=True)
+
+    h = History()
+    stop = asyncio.Event()
+    keys = [b"soak-%d" % i for i in range(n_keys)]
+
+    async def worker(cid: int):
+        n = 0
+        while not stop.is_set():
+            n += 1
+            key = rng.choice(keys)
+            if n % 2 == 0:
+                val = b"c%d-%d" % (cid, n)
+                tok = h.invoke(cid, "w", (key, val))
+                try:
+                    await asyncio.wait_for(kv.put(key, val), 4.0)
+                    h.complete(tok, True)
+                except Exception:
+                    pass            # pending: maybe applied
+            else:
+                tok = h.invoke(cid, "r", (key,))
+                try:
+                    v = await asyncio.wait_for(kv.get(key), 4.0)
+                    h.complete(tok, v)
+                except Exception:
+                    pass
+            await asyncio.sleep(0.005)
+
+    # -- nemesis menu -------------------------------------------------------
+    killed: list[str] = []
+
+    async def kill_leader():
+        ep = c.leader_endpoint()
+        if ep is None:
+            raise SkipFault
+        killed.append(ep)
+        await c.stop_store(ep)
+
+    async def restart_killed():
+        while killed:
+            await c.start_store(killed.pop())
+
+    async def one_way():
+        a, b = rng.sample(c.endpoints, 2)
+        c.net.partition_one_way({a}, {b})
+
+    async def heal_net():
+        c.net.heal()
+
+    async def noise_on():
+        c.net.set_drop_rate(0.05)
+        c.net.set_delay_ms(2)
+
+    async def noise_off():
+        c.net.set_drop_rate(0.0)
+        c.net.set_delay_ms(0)
+
+    actions = [
+        NemesisAction("leader-kill", kill_leader, restart_killed,
+                      dwell_s=0.7, weight=1.5),
+        NemesisAction("one-way-partition", one_way, heal_net, dwell_s=0.5),
+        NemesisAction("drops+delays", noise_on, noise_off, dwell_s=0.8),
+    ]
+
+    workers = [asyncio.ensure_future(worker(i)) for i in range(5)]
+    try:
+        await run_nemesis(actions, duration_s, rng,
+                          on_tick=lambda n: say("  nemesis:", n))
+        stop.set()
+        await asyncio.gather(*workers)
+        ops = h.ops()
+        completed = sum(1 for o in ops if o.ret is not None)
+        say(f"workload done: {len(ops)} ops ({completed} completed); "
+            f"checking linearizability…")
+        t0 = time.monotonic()
+        rep = check_history(h)
+        check_s = time.monotonic() - t0
+        result = {
+            "linearizable": rep.ok,
+            "ops": len(ops),
+            "completed": completed,
+            "maybe_applied": len(ops) - completed,
+            "faults": {a.name: a.applied for a in actions},
+            "checker_s": round(check_s, 1),
+        }
+        if not rep.ok:
+            result["violation"] = str(rep)
+        return result
+    finally:
+        # also on checker errors / cancellation: no leaked workers or
+        # still-running stores
+        stop.set()
+        for w in workers:
+            w.cancel()
+        await asyncio.gather(*workers, return_exceptions=True)
+        await kv.shutdown()
+        for ep in list(c.stores):
+            await c.stop_store(ep)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=30)
+    ap.add_argument("--stores", type=int, default=3)
+    ap.add_argument("--keys", type=int, default=6,
+                    help="distinct keys (fewer = more contention; "
+                         "checker cost grows with ops/key)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data", default="",
+                    help="durable state dir (default: a temp dir)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+    data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
+    result = asyncio.run(run_soak(args.duration, args.stores, args.keys,
+                                  args.seed, data, args.verbose))
+    import json
+
+    print(json.dumps(result))
+    raise SystemExit(0 if result["linearizable"] else 1)
+
+
+if __name__ == "__main__":
+    main()

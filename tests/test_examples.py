@@ -118,3 +118,19 @@ async def test_rheakv_bench_zipfian():
         run_bench(n_stores=3, n_regions=2, n_keys=60, n_ops=120,
                   concurrency=16, zipf_theta=0.99, verbose=False), 120)
     assert r["ops_per_s"] > 0 and r["zipf_theta"] == 0.99
+
+
+async def test_soak_runner_short():
+    """The chaos soak runner (examples/soak.py): 8s of nemesis faults
+    under load, history proven linearizable, faults actually fired."""
+    import tempfile
+
+    from examples.soak import run_soak
+
+    with tempfile.TemporaryDirectory() as d:
+        r = await asyncio.wait_for(
+            run_soak(duration_s=8, n_stores=3, n_keys=4, seed=3,
+                     data_path=d, verbose=False), 110)
+    assert r["linearizable"], r
+    assert r["ops"] > 50, r
+    assert sum(r["faults"].values()) >= 2, r

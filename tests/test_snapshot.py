@@ -386,7 +386,12 @@ async def test_install_under_write_load(tmp_path):
     assert all(counts[k] == 1 for k in acked)
     assert len(acked) > 100, len(acked)
     # the recovery path under test actually ran: at least one victim
-    # came back via InstallSnapshot, not plain log replay
-    installs = sum(f.snapshots_loaded for f in c.fsms.values())
+    # came back via a REMOTE InstallSnapshot (the node-side counter —
+    # fsm.snapshots_loaded would also count plain boot-time loads of a
+    # node's own local snapshot)
+    installs = sum(
+        n.metrics.snapshot().get("counters", {}).get(
+            "install-snapshot-received", 0)
+        for n in c.nodes.values())
     assert installs >= 1, "no InstallSnapshot occurred — vacuous run"
     await c.stop_all()
