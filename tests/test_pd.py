@@ -117,6 +117,15 @@ async def test_pd_balances_leaders():
                for i in range(6)]
     async with pd_cluster(regions=regions, balance_leaders=True,
                           transfer_cooldown_s=1.5) as c:
+        def leader_counts():
+            counts = {ep: 0 for ep in c.endpoints}
+            for rid in range(1, 7):
+                for ep, s in c.stores.items():
+                    eng = s.get_region_engine(rid)
+                    if eng is not None and eng.is_leader():
+                        counts[ep] += 1
+            return counts
+
         await c.wait_pd_leader()
         for rid in range(1, 7):
             await c.wait_region_leader(rid)
@@ -147,12 +156,7 @@ async def test_pd_balances_leaders():
         spread = None
         trajectory = []
         while time.monotonic() < deadline:
-            counts = {ep: 0 for ep in c.endpoints}
-            for rid in range(1, 7):
-                for ep, s in c.stores.items():
-                    eng = s.get_region_engine(rid)
-                    if eng is not None and eng.is_leader():
-                        counts[ep] += 1
+            counts = leader_counts()
             spread = max(counts.values()) - min(counts.values())
             if not trajectory or trajectory[-1][1] != counts:
                 trajectory.append((round(time.monotonic() - deadline + 45, 1),
@@ -162,3 +166,17 @@ async def test_pd_balances_leaders():
             await asyncio.sleep(0.2)
         assert spread is not None and spread <= 2, \
             f"final={counts} trajectory={trajectory}"
+        # stability: pending-move overlay must prevent the rebalance
+        # from overshooting into oscillation (regression: wholesale
+        # leadership rotation every cooldown period)
+        worst = 0
+        samples = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5:
+            counts = leader_counts()
+            if sum(counts.values()) == 6:
+                samples += 1
+                worst = max(worst, max(counts.values()) - min(counts.values()))
+            await asyncio.sleep(0.2)
+        assert samples > 0, "no fully-led sample in the stability window"
+        assert worst <= 2, f"balancer thrashing: worst spread {worst}"

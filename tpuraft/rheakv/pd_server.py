@@ -200,6 +200,9 @@ class ClusterStatsManager:
         self._keys: dict[int, int] = {}
         self._inflight_splits: dict[int, float] = {}  # region -> deadline
         self._transfer_cooldown: dict[int, float] = {}  # region -> deadline
+        # region -> (from_ep, to_ep, expiry): ordered but not yet
+        # observed in region_leaders (overlaid onto balancing counts)
+        self._pending_moves: dict[int, tuple[str, str, float]] = {}
 
     def record(self, region_id: int, approximate_keys: int) -> None:
         self._keys[region_id] = approximate_keys
@@ -230,15 +233,32 @@ class ClusterStatsManager:
         transfer target (with a per-region cooldown so one imbalance
         doesn't spray repeated transfers).  Ties between equally-loaded
         targets break on a per-region hash so concurrent decisions
-        spread across stores instead of herding onto the first one."""
+        spread across stores instead of herding onto the first one.
+
+        Decisions overlay the PENDING moves this manager already
+        ordered but has not yet observed in ``region_leaders`` —
+        without that, one heartbeat burst sees the same stale counts
+        for every region and orders the whole imbalance moved at once,
+        overshooting into a permanent oscillation (observed as
+        (6,0,0) → (0,2,4) → (2,4,0) → ... thrash every cooldown
+        period)."""
         now = time.monotonic()
         self._transfer_cooldown = {
             r: d for r, d in self._transfer_cooldown.items() if d > now}
+        self._pending_moves = {
+            r: m for r, m in self._pending_moves.items()
+            if m[2] > now and region_leaders.get(r) != m[1]}
         if region.id in self._transfer_cooldown:
             return None
         counts: dict[str, int] = {}
         for _, ep in region_leaders.items():
             counts[ep] = counts.get(ep, 0) + 1
+        # overlay in-flight moves: the source already "lost" the lease,
+        # the destination already "gained" it
+        for rid, (src, dst, _) in self._pending_moves.items():
+            if region_leaders.get(rid) == src:
+                counts[src] = counts.get(src, 0) - 1
+                counts[dst] = counts.get(dst, 0) + 1
         my = counts.get(leader_ep, 0)
         # learners are read-only replicas — never leadership targets
         candidates = [p for p in region.peers
@@ -251,6 +271,8 @@ class ClusterStatsManager:
         if my - counts.get(target, 0) < 2:
             return None
         self._transfer_cooldown[region.id] = now + cooldown_s
+        self._pending_moves[region.id] = (
+            leader_ep, target, now + 2 * cooldown_s)
         return target
 
 
