@@ -35,6 +35,8 @@ from tpuraft.util.nemesis import NemesisAction, SkipFault, run_nemesis
 
 
 class SoakCluster:
+    """In-proc fabric: InProcNetwork supplies partitions/drops/delays."""
+
     def __init__(self, n_stores: int, data_path: str):
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
@@ -70,16 +72,152 @@ class SoakCluster:
                 return ep
         return None
 
+    def client_transport(self):
+        self._client_t = InProcTransport(self.net, "soak-client:0")
+        return self._client_t
+
+    # fault surface (same verbs on both fabrics)
+    def one_way_partition(self, a: str, b: str) -> None:
+        self.net.partition_one_way({a}, {b})
+
+    def heal_partitions(self) -> None:
+        self.net.heal()
+
+    def set_noise(self, drop: float, delay_ms: float) -> None:
+        self.net.set_drop_rate(drop)
+        self.net.set_delay_ms(delay_ms)
+
+
+class NativeSoakCluster:
+    """Full native stack: C++ epoll sockets + C++ KV engines, faults
+    injected at each store's FaultInjectingTransport."""
+
+    def __init__(self, n_stores: int, data_path: str):
+        from tpuraft.rpc.native_tcp import ensure_built
+
+        ensure_built()
+        self.n = n_stores
+        self.data_path = data_path
+        self.endpoints: list[str] = []
+        self.regions: list[Region] = []
+        self.stores: dict[str, StoreEngine] = {}
+        self._servers: dict[str, object] = {}
+        self._faults: dict[str, object] = {}
+        # active fault state survives store restarts (the in-proc fabric
+        # gets this for free from its shared network object)
+        self._noise: tuple[float, float] = (0.0, 0.0)
+        self._blocks: set[tuple[str, str]] = set()
+
+    async def boot(self) -> None:
+        from tpuraft.rpc.native_tcp import NativeTcpRpcServer
+
+        servers = []
+        for _ in range(self.n):
+            srv = NativeTcpRpcServer("127.0.0.1:0")
+            await srv.start()
+            srv.endpoint = f"127.0.0.1:{srv.bound_port}"
+            servers.append(srv)
+        self.endpoints = [s.endpoint for s in servers]
+        self.regions = [Region(id=1, peers=list(self.endpoints))]
+        for srv in servers:
+            await self._start(srv.endpoint, srv)
+
+    async def _start(self, ep: str, server=None) -> None:
+        from tpuraft.rheakv.native_store import NativeRawKVStore
+        from tpuraft.rpc.fault import FaultInjectingTransport
+        from tpuraft.rpc.native_tcp import (
+            NativeTcpRpcServer,
+            NativeTcpTransport,
+        )
+
+        if server is None:
+            server = NativeTcpRpcServer(ep)
+            await server.start()
+        transport = FaultInjectingTransport(NativeTcpTransport(endpoint=ep))
+        opts = StoreEngineOptions(
+            server_id=ep,
+            initial_regions=[r.copy() for r in self.regions],
+            data_path=self.data_path,
+            election_timeout_ms=600,
+            raw_store_factory=lambda ep=ep: NativeRawKVStore(
+                f"{self.data_path}/nkv_{ep.replace(':', '_')}"),
+        )
+        store = StoreEngine(opts, server, transport)
+        await store.start()
+        self.stores[ep] = store
+        self._servers[ep] = server
+        self._faults[ep] = transport
+        # re-apply the fault state active at (re)start time
+        transport.set_drop_rate(self._noise[0])
+        transport.set_delay_ms(self._noise[1])
+        for src, dst in self._blocks:
+            if src == ep:
+                transport.block(dst)
+
+    async def start_store(self, ep: str) -> None:
+        await self._start(ep)
+
+    async def stop_store(self, ep: str) -> None:
+        store = self.stores.pop(ep, None)
+        server = self._servers.pop(ep, None)
+        ft = self._faults.pop(ep, None)
+        if store:
+            await store.shutdown()
+        if server:
+            await server.stop()
+        if ft:
+            await ft.close()
+
+    def leader_endpoint(self):
+        for ep, s in self.stores.items():
+            eng = s.get_region_engine(1)
+            if eng is not None and eng.is_leader():
+                return ep
+        return None
+
+    def client_transport(self):
+        from tpuraft.rpc.fault import FaultInjectingTransport
+        from tpuraft.rpc.native_tcp import NativeTcpTransport
+
+        # the client rides the SAME noise as the stores (in-proc mode
+        # gets this for free from InProcNetwork): maybe-applied client
+        # ops are exactly what the checker exists to exercise
+        self._client_t = FaultInjectingTransport(NativeTcpTransport())
+        self._faults["__client__"] = self._client_t
+        return self._client_t
+
+    def one_way_partition(self, a: str, b: str) -> None:
+        self._blocks.add((a, b))
+        ft = self._faults.get(a)
+        if ft is not None:
+            ft.block(b)
+
+    def heal_partitions(self) -> None:
+        self._blocks.clear()
+        for ft in self._faults.values():
+            ft.heal()
+
+    def set_noise(self, drop: float, delay_ms: float) -> None:
+        self._noise = (drop, delay_ms)
+        for ft in self._faults.values():
+            ft.set_drop_rate(drop)
+            ft.set_delay_ms(delay_ms)
+
 
 async def run_soak(duration_s: float, n_stores: int, n_keys: int,
-                   seed: int, data_path: str, verbose: bool) -> dict:
+                   seed: int, data_path: str, verbose: bool,
+                   transport: str = "inproc",
+                   dump_history: str = "") -> dict:
     rng = random.Random(seed)
-    c = SoakCluster(n_stores, data_path)
-    for ep in c.endpoints:
-        await c.start_store(ep)
+    if transport == "native":
+        c = NativeSoakCluster(n_stores, data_path)
+        await c.boot()
+    else:
+        c = SoakCluster(n_stores, data_path)
+        for ep in c.endpoints:
+            await c.start_store(ep)
     pd = FakePlacementDriverClient([r.copy() for r in c.regions])
-    kv = RheaKVStore(pd, InProcTransport(c.net, "soak-client:0"),
-                     max_retries=1)
+    kv = RheaKVStore(pd, c.client_transport(), max_retries=1)
     await kv.start()
 
     def say(*a):
@@ -128,18 +266,16 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 
     async def one_way():
         a, b = rng.sample(c.endpoints, 2)
-        c.net.partition_one_way({a}, {b})
+        c.one_way_partition(a, b)
 
     async def heal_net():
-        c.net.heal()
+        c.heal_partitions()
 
     async def noise_on():
-        c.net.set_drop_rate(0.05)
-        c.net.set_delay_ms(2)
+        c.set_noise(0.05, 2)
 
     async def noise_off():
-        c.net.set_drop_rate(0.0)
-        c.net.set_delay_ms(0)
+        c.set_noise(0.0, 0)
 
     actions = [
         NemesisAction("leader-kill", kill_leader, restart_killed,
@@ -171,6 +307,19 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         }
         if not rep.ok:
             result["violation"] = str(rep)
+        if dump_history and not rep.ok:
+            import json as _json
+            with open(dump_history, "w") as f:
+                for o in ops:
+                    f.write(_json.dumps({
+                        "id": o.op_id, "client": o.client, "kind": o.kind,
+                        "args": [a.hex() if isinstance(a, bytes) else a
+                                 for a in o.args],
+                        "invoke": o.invoke, "ret": o.ret,
+                        "result": (o.result.hex()
+                                   if isinstance(o.result, bytes)
+                                   else o.result)}) + "\n")
+            result["history_dump"] = dump_history
         return result
     finally:
         # also on checker errors / cancellation: no leaked workers or
@@ -182,6 +331,9 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         await kv.shutdown()
         for ep in list(c.stores):
             await c.stop_store(ep)
+        ct = getattr(c, "_client_t", None)
+        if ct is not None and hasattr(ct, "close"):
+            await ct.close()
 
 
 def main() -> None:
@@ -194,11 +346,20 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data", default="",
                     help="durable state dir (default: a temp dir)")
+    ap.add_argument("--transport", choices=["inproc", "native"],
+                    default="inproc",
+                    help="'native': C++ epoll sockets + C++ KV engines, "
+                         "faults injected per-store")
+    ap.add_argument("--dump-history", default="",
+                    help="on violation, write the full op history "
+                         "(JSON lines) here for offline analysis")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
     result = asyncio.run(run_soak(args.duration, args.stores, args.keys,
-                                  args.seed, data, args.verbose))
+                                  args.seed, data, args.verbose,
+                                  transport=args.transport,
+                                  dump_history=args.dump_history))
     import json
 
     print(json.dumps(result))

@@ -954,3 +954,56 @@ async def test_replication_pipelines_under_latency():
         assert logs[0] == logs[1] == logs[2]
     finally:
         await c.stop_all()
+
+
+async def test_read_index_refused_until_term_first_commit():
+    """A fresh leader must refuse readIndex until the first entry of
+    ITS OWN term commits: the carried-over commit marker may lag
+    entries the old leader committed and acked, and serving reads
+    against it loses acked writes (found by the linearizability soak;
+    reference: ReadOnlyServiceImpl rejects until current-term commit)."""
+    c = TestCluster(3)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        await c.apply_ok(leader, b"g1")
+        idx = await leader.read_index()
+        assert idx >= 1
+        # simulate the fresh-leader window: first-term entry not yet
+        # committed -> reads must fail closed, not serve the stale index
+        leader._term_first_index = leader.log_manager.last_log_index() + 5
+        with pytest.raises(ReadIndexError):
+            await asyncio.wait_for(leader.read_index(), 5)
+        # once the term's first entry is committed, reads resume
+        leader._term_first_index = 0
+        assert await leader.read_index() >= idx
+    finally:
+        await c.stop_all()
+
+
+async def test_read_after_leader_kill_sees_acked_write():
+    """Kill the leader immediately after an acked write (followers'
+    commit markers typically lag it); a linearizable read through the
+    new leader must include the acked write — the safety gate makes the
+    read wait for the new term's no-op commit instead of serving the
+    stale carried-over index."""
+    for round_i in range(3):
+        c = TestCluster(3, election_timeout_ms=200)
+        await c.start_all()
+        try:
+            leader = await c.wait_leader()
+            st = await c.apply_ok(leader, b"pre-%d" % round_i)
+            assert st.is_ok()
+            st = await c.apply_ok(leader, b"acked-%d" % round_i)
+            assert st.is_ok(), str(st)
+            # kill within the heartbeat gap: commit-marker propagation
+            # to followers likely hasn't happened yet
+            await c.stop(leader.server_id)
+            new_leader = await c.wait_leader()
+            idx = await asyncio.wait_for(new_leader.read_index(), 10)
+            applied = c.fsms[new_leader.server_id].logs
+            assert b"acked-%d" % round_i in applied, (
+                f"round {round_i}: acked write missing after "
+                f"read_index={idx}: {applied}")
+        finally:
+            await c.stop_all()

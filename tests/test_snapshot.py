@@ -395,3 +395,42 @@ async def test_install_under_write_load(tmp_path):
         for n in c.nodes.values())
     assert installs >= 1, "no InstallSnapshot occurred — vacuous run"
     await c.stop_all()
+
+
+async def test_add_peer_behind_compacted_log_installs_snapshot(tmp_path):
+    """Adding a FRESH voter after the leader compacted its log: the
+    joint-consensus catch-up phase must bootstrap the joiner via
+    InstallSnapshot (its next_index is below the leader's first log
+    index), then the change commits and the joiner serves as a voter."""
+    c = TestCluster(3, tmp_path=tmp_path, snapshot=True)
+    await c.start_all()
+    leader = await c.wait_leader()
+    for i in range(12):
+        await c.apply_ok(leader, b"a%d" % i)
+    await c.wait_applied(12)
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    assert leader.log_manager.first_log_index() > 1  # compacted
+    # boot an empty 4th node, then add it as a voter
+    new_peer = PeerId.parse("127.0.0.1:5003")
+    c.peers.append(new_peer)
+    from tpuraft.conf import Configuration
+    save_conf = c.conf
+    c.conf = Configuration()
+    await c.start(new_peer)
+    c.conf = save_conf
+    st = await asyncio.wait_for(leader.add_peer(new_peer), 15)
+    assert st.is_ok(), str(st)
+    assert new_peer in leader.list_peers()
+    await c.wait_applied(12, nodes=[c.nodes[new_peer]], timeout_s=10)
+    # it arrived via a REMOTE install, not log replay
+    got = c.nodes[new_peer].metrics.snapshot().get("counters", {}).get(
+        "install-snapshot-received", 0)
+    assert got >= 1, c.nodes[new_peer].metrics.snapshot()
+    # and it votes: kill one ORIGINAL voter, quorum (3 of 4) holds
+    victim = next(p for p in c.peers
+                  if p not in (leader.server_id, new_peer))
+    await c.stop(victim)
+    st = await c.apply_ok(await c.wait_leader(), b"post-join")
+    assert st.is_ok(), str(st)
+    await c.stop_all()

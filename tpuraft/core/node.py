@@ -123,6 +123,9 @@ class Node:
         self._snapshot_timer: Optional[RepeatedTimer] = None
         self._last_leader_timestamp = time.monotonic()
         self._peer_acks: dict[PeerId, float] = {}
+        # index of the first entry appended in THIS leadership term (the
+        # election no-op); reads are unsafe until it commits
+        self._term_first_index: int = 0
         self._conf_ctx: Optional["_ConfigurationCtx"] = None
         self._transfer_deadline: float = 0.0
         self._shutdown_event = asyncio.Event()
@@ -628,6 +631,14 @@ class Node:
         )
         term = self.current_term
         last_id = self.log_manager.stage_leader_entries([conf_entry], term)
+        # readIndex safety gate: a fresh leader's lastCommittedIndex is
+        # carried over from follower time and may LAG entries the old
+        # leader committed and acked — serving reads against it loses
+        # acked writes (found by the linearizability soak).  Reads are
+        # refused until this no-op (the first entry of OUR term) commits
+        # (reference: ReadOnlyServiceImpl's ERAFTTIMEDOUT until the
+        # leader commits in its current term).
+        self._term_first_index = last_id.index
         self.replicators.wake_all()
         self.fsm_caller.on_leader_start(term)
         self._stepdown_timer.start()

@@ -103,6 +103,16 @@ class ReadOnlyService:
                     fut.set_result(read_index)
 
     async def _leader_once(self) -> int:
+        # a fresh leader briefly cannot serve reads (safety gate below);
+        # WAIT for the term's no-op commit — normally single-digit ms —
+        # instead of bouncing every post-election read with an error
+        node = self._node
+        deadline = (asyncio.get_running_loop().time()
+                    + node.options.election_timeout_ms / 1000.0)
+        while (node.ballot_box.last_committed_index < node._term_first_index
+               and node.is_leader()
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.002)
         ok, read_index = await self._confirm_once()
         if not ok:
             raise _read_error(RaftError.ERAFTTIMEDOUT,
@@ -112,9 +122,16 @@ class ReadOnlyService:
     async def _confirm_once(self) -> tuple[bool, int]:
         node = self._node
         read_index = node.ballot_box.last_committed_index
-        # A commit index carried over from a prior term is still a valid
-        # read barrier — those entries were committed by prior leaders
-        # (reference: ReadOnlyServiceImpl's electing-state handling).
+        # SAFETY GATE: until this leader commits the first entry of its
+        # OWN term (the election no-op), its lastCommittedIndex is a
+        # follower-time carry-over that may LAG entries the previous
+        # leader committed and acked — serving reads against it returns
+        # state with acked writes missing (caught by the linearizability
+        # soak as a stale read after a leader kill).  Reference:
+        # ReadOnlyServiceImpl rejects reads until the current term has
+        # a committed entry.
+        if read_index < node._term_first_index:
+            return False, read_index
         opt = node.options.raft_options.read_only_option
         if opt == ReadOnlyOption.LEASE_BASED and node.leader_lease_is_valid():
             return True, read_index
