@@ -34,27 +34,53 @@ from tpuraft.util.linearizability import History, check_history
 from tpuraft.util.nemesis import NemesisAction, SkipFault, run_nemesis
 
 
-class SoakCluster:
+class _BaseSoakCluster:
+    """Shared cluster shape for both fabrics: a stores map, the region
+    layout, option plumbing, and leader lookup."""
+
+    read_only_option = None   # set by run_soak for lease-read mode
+
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        self.endpoints: list[str] = []
+        self.regions: list[Region] = []
+        self.stores: dict[str, StoreEngine] = {}
+
+    def _store_opts(self, ep: str, election_timeout_ms: int,
+                    **extra) -> StoreEngineOptions:
+        opts = StoreEngineOptions(
+            server_id=ep,
+            initial_regions=[r.copy() for r in self.regions],
+            data_path=self.data_path,
+            election_timeout_ms=election_timeout_ms,
+            **extra)
+        if self.read_only_option is not None:
+            opts.read_only_option = self.read_only_option
+        return opts
+
+    def leader_endpoint(self):
+        for ep, s in self.stores.items():
+            eng = s.get_region_engine(1)
+            if eng is not None and eng.is_leader():
+                return ep
+        return None
+
+
+class SoakCluster(_BaseSoakCluster):
     """In-proc fabric: InProcNetwork supplies partitions/drops/delays."""
 
     def __init__(self, n_stores: int, data_path: str):
+        super().__init__(data_path)
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
         self.regions = [Region(id=1, peers=list(self.endpoints))]
-        self.data_path = data_path
-        self.stores: dict[str, StoreEngine] = {}
 
     async def start_store(self, ep: str) -> None:
         server = RpcServer(ep)
         self.net.bind(server)
         self.net.start_endpoint(ep)
         transport = InProcTransport(self.net, ep)
-        opts = StoreEngineOptions(
-            server_id=ep,
-            initial_regions=[r.copy() for r in self.regions],
-            data_path=self.data_path,
-            election_timeout_ms=400)
-        store = StoreEngine(opts, server, transport)
+        store = StoreEngine(self._store_opts(ep, 400), server, transport)
         await store.start()
         self.stores[ep] = store
 
@@ -64,13 +90,6 @@ class SoakCluster:
         if store:
             self.net.unbind(ep)
             await store.shutdown()
-
-    def leader_endpoint(self):
-        for ep, s in self.stores.items():
-            eng = s.get_region_engine(1)
-            if eng is not None and eng.is_leader():
-                return ep
-        return None
 
     def client_transport(self):
         self._client_t = InProcTransport(self.net, "soak-client:0")
@@ -88,7 +107,7 @@ class SoakCluster:
         self.net.set_delay_ms(delay_ms)
 
 
-class NativeSoakCluster:
+class NativeSoakCluster(_BaseSoakCluster):
     """Full native stack: C++ epoll sockets + C++ KV engines, faults
     injected at each store's FaultInjectingTransport."""
 
@@ -96,11 +115,8 @@ class NativeSoakCluster:
         from tpuraft.rpc.native_tcp import ensure_built
 
         ensure_built()
+        super().__init__(data_path)
         self.n = n_stores
-        self.data_path = data_path
-        self.endpoints: list[str] = []
-        self.regions: list[Region] = []
-        self.stores: dict[str, StoreEngine] = {}
         self._servers: dict[str, object] = {}
         self._faults: dict[str, object] = {}
         # active fault state survives store restarts (the in-proc fabric
@@ -134,14 +150,10 @@ class NativeSoakCluster:
             server = NativeTcpRpcServer(ep)
             await server.start()
         transport = FaultInjectingTransport(NativeTcpTransport(endpoint=ep))
-        opts = StoreEngineOptions(
-            server_id=ep,
-            initial_regions=[r.copy() for r in self.regions],
-            data_path=self.data_path,
-            election_timeout_ms=600,
+        opts = self._store_opts(
+            ep, 600,
             raw_store_factory=lambda ep=ep: NativeRawKVStore(
-                f"{self.data_path}/nkv_{ep.replace(':', '_')}"),
-        )
+                f"{self.data_path}/nkv_{ep.replace(':', '_')}"))
         store = StoreEngine(opts, server, transport)
         await store.start()
         self.stores[ep] = store
@@ -167,13 +179,6 @@ class NativeSoakCluster:
             await server.stop()
         if ft:
             await ft.close()
-
-    def leader_endpoint(self):
-        for ep, s in self.stores.items():
-            eng = s.get_region_engine(1)
-            if eng is not None and eng.is_leader():
-                return ep
-        return None
 
     def client_transport(self):
         from tpuraft.rpc.fault import FaultInjectingTransport
@@ -207,13 +212,20 @@ class NativeSoakCluster:
 async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    seed: int, data_path: str, verbose: bool,
                    transport: str = "inproc",
-                   dump_history: str = "") -> dict:
+                   dump_history: str = "",
+                   lease_reads: bool = False) -> dict:
     rng = random.Random(seed)
     if transport == "native":
         c = NativeSoakCluster(n_stores, data_path)
-        await c.boot()
     else:
         c = SoakCluster(n_stores, data_path)
+    if lease_reads:
+        from tpuraft.options import ReadOnlyOption
+
+        c.read_only_option = ReadOnlyOption.LEASE_BASED
+    if transport == "native":
+        await c.boot()
+    else:
         for ep in c.endpoints:
             await c.start_store(ep)
     pd = FakePlacementDriverClient([r.copy() for r in c.regions])
@@ -350,6 +362,9 @@ def main() -> None:
                     default="inproc",
                     help="'native': C++ epoll sockets + C++ KV engines, "
                          "faults injected per-store")
+    ap.add_argument("--lease-reads", action="store_true",
+                    help="LEASE_BASED readIndex (no per-read quorum "
+                         "round; assumes bounded clock drift)")
     ap.add_argument("--dump-history", default="",
                     help="on violation, write the full op history "
                          "(JSON lines) here for offline analysis")
@@ -359,7 +374,8 @@ def main() -> None:
     result = asyncio.run(run_soak(args.duration, args.stores, args.keys,
                                   args.seed, data, args.verbose,
                                   transport=args.transport,
-                                  dump_history=args.dump_history))
+                                  dump_history=args.dump_history,
+                                  lease_reads=args.lease_reads))
     import json
 
     print(json.dumps(result))

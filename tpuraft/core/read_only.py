@@ -104,15 +104,19 @@ class ReadOnlyService:
 
     async def _leader_once(self) -> int:
         # a fresh leader briefly cannot serve reads (safety gate below);
-        # WAIT for the term's no-op commit — normally single-digit ms —
-        # instead of bouncing every post-election read with an error
+        # WAIT for the term's no-op to apply — normally single-digit ms
+        # — instead of bouncing every post-election read with an error.
+        # Budget: HALF the election timeout, so follower-FORWARDED reads
+        # (whose RPC timeout is one election timeout) still get the
+        # answer instead of timing out just as the leader resolves.
         node = self._node
-        deadline = (asyncio.get_running_loop().time()
-                    + node.options.election_timeout_ms / 1000.0)
-        while (node.ballot_box.last_committed_index < node._term_first_index
-               and node.is_leader()
-               and asyncio.get_running_loop().time() < deadline):
-            await asyncio.sleep(0.002)
+        if node.ballot_box.last_committed_index < node._term_first_index:
+            try:
+                await asyncio.wait_for(
+                    node.fsm_caller.wait_applied(node._term_first_index),
+                    node.options.election_timeout_ms / 2000.0)
+            except asyncio.TimeoutError:
+                pass   # fall through: _confirm_once fails closed
         ok, read_index = await self._confirm_once()
         if not ok:
             raise _read_error(RaftError.ERAFTTIMEDOUT,
