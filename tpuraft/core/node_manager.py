@@ -25,8 +25,9 @@ class NodeManager:
     def __init__(self, server: RpcServer):
         self.server = server
         self._nodes: dict[tuple[str, str], Node] = {}
-        # (group, leader) -> FIFO of in-order AppendEntries execution
-        self._append_lanes: dict[tuple[str, str], asyncio.Queue] = {}
+        # (group, leader) -> (FIFO, worker) of in-order AppendEntries execution
+        self._append_lanes: dict[
+            tuple[str, str], tuple[asyncio.Queue, asyncio.Task]] = {}
         for method in ("append_entries", "request_vote", "timeout_now",
                        "install_snapshot", "read_index"):
             server.register(method, self._make_handler(method))
@@ -184,8 +185,32 @@ class NodeManager:
         self._nodes.pop((node.group_id, str(node.server_id)), None)
         # tear down this group's append lanes: no worker may linger to
         # execute a queued append against a stopped node, and test
-        # teardowns must not see pending-task warnings
-        for key in [k for k in self._append_lanes if k[0] == node.group_id]:
+        # teardowns must not see pending-task warnings.  Lanes are keyed
+        # by (group, LEADER) and serve every co-hosted node of the
+        # group, so only reap once the LAST node of the group leaves —
+        # else removing one follower cancels queued appends for its
+        # siblings (in-proc topologies host several nodes per server).
+        # While siblings remain, still purge THIS node's queued appends:
+        # they'd otherwise head-of-line-delay siblings with per-entry
+        # EHOSTDOWN rejections and pin the dead node in the queue.
+        group_lane_keys = [k for k in self._append_lanes
+                           if k[0] == node.group_id]
+        if any(g == node.group_id for g, _ in self._nodes):
+            for key in group_lane_keys:
+                lane, _worker = self._append_lanes[key]
+                keep = []
+                while not lane.empty():
+                    item = lane.get_nowait()
+                    if item[0] is node:
+                        if not item[2].done():
+                            item[2].set_exception(RpcError(Status.error(
+                                RaftError.ENODESHUTTING, "node removed")))
+                    else:
+                        keep.append(item)
+                for item in keep:
+                    lane.put_nowait(item)
+            return
+        for key in group_lane_keys:
             lane, worker = self._append_lanes.pop(key)
             worker.cancel()
             while not lane.empty():

@@ -40,6 +40,12 @@ class ReadOnlyService:
                     _read_error(RaftError.ENODESHUTTING, "shutting down"))
         self._pending.clear()
         self._fwd_pending.clear()
+        # cancel in-flight confirmation rounds: a round surviving
+        # shutdown keeps issuing heartbeat/forward RPCs from a dead node
+        for task in (self._round_task, self._fwd_task):
+            if task is not None and not task.done():
+                task.cancel()
+        self._round_task = self._fwd_task = None
 
     async def read_index(self) -> int:
         """Public entry: returns an index I such that (a) I >= commit index
@@ -86,6 +92,15 @@ class ReadOnlyService:
             setattr(self, pending_attr, [])
             try:
                 read_index = await once()
+            except asyncio.CancelledError:
+                # shutdown cancelled the round mid-flight: the batch was
+                # already popped from pending, so shutdown()'s sweep
+                # can't reach it — fail it here or its readers hang
+                for fut in batch:
+                    if not fut.done():
+                        fut.set_exception(_read_error(
+                            RaftError.ENODESHUTTING, "shutting down"))
+                raise
             except ReadIndexError as e:
                 for fut in batch:
                     if not fut.done():

@@ -216,7 +216,12 @@ def check_register(ops: list[Op], initial=None,
     memoization.  All completed ops must be linearized; pending ops may
     be linearized (never before their invoke) or simply left unplaced —
     an op that never took effect.  Real-time order: op A must precede
-    op B iff A.ret < B.invoke.
+    op B iff A.ret < B.invoke.  (Strict: exact timestamp ties are
+    treated as concurrency.  Tie-as-precedence is NOT an order — two
+    zero-duration ops at one instant would mutually precede each other
+    and deadlock the search, failing valid histories; and monotonic-ns
+    clocks make ties between genuinely ordered calls effectively
+    impossible, so nothing real is lost.)
     """
     key = ops[0].key if ops else b""
     ops = [o for o in ops if not _prunable_pending(o, ops)]
@@ -232,7 +237,15 @@ def check_register(ops: list[Op], initial=None,
     def _candidates(done_mask: int):
         """Ops placeable next: not yet placed, and invoked no later than
         every unplaced completed op's return (an op whose return
-        precedes another's invoke must be linearized first)."""
+        strictly precedes another's invoke must be linearized first).
+
+        Ties (A.ret == B.invoke) are CONCURRENCY, not precedence: the
+        strict `A.ret < B.invoke` order is what keeps precedence an
+        interval order — treating ties as precedence makes two
+        zero-duration ops at the same instant mutually precede each
+        other (a cycle: neither is ever placeable) and falsely fails
+        linearizable histories.  Hence `<=` below, matching the
+        docstring's A.ret < B.invoke definition exactly."""
         min_ret = float("inf")
         for i in range(n):
             if not done_mask >> i & 1 and completed[i] and rets[i] < min_ret:
