@@ -1,23 +1,51 @@
 """MultiRaftEngine: one device tick advances ALL raft groups in a process.
 
-The north-star component (BASELINE.json): the per-group ``BallotBox``
-quorum counting becomes rows of a ``[G, P]`` tensor; one jitted
-``raft_tick`` per engine tick computes every group's commit advancement
-on device.  Host Nodes keep the protocol envelope; their ballot boxes are
-swapped for :class:`TpuBallotBox` via the ``ballot_box_factory`` seam
+The north-star component (BASELINE.json): the per-group consensus
+bookkeeping becomes rows of ``[G, P]`` tensors and ONE jitted
+``raft_tick`` (tpuraft.ops.tick) per engine tick computes every group's
+commit advancement, election-timeout firing, vote quorums, leader-lease
+validity / dead-quorum step-down, and heartbeat scheduling on device —
+the full SURVEY §8.1 device plane, not just the commit reduce.
+
+Wiring: host Nodes get their ballot boxes from :meth:`ballot_box_factory`
 (the analog of plugging TpuBallotBox through the reference's
-``JRaftServiceLoader`` SPI, leaving NodeImpl/FSMCaller/LogStorage
-untouched).
+``JRaftServiceLoader`` SPI).  With ``TickOptions.drive_protocol`` (the
+default), the box also hands the node an :class:`EngineControl` — the
+device-plane replacement for the reference's per-group RepeatedTimers
+(``electionTimer``/``voteTimer``/``stepDownTimer``), the ``_peer_acks``
+map behind ``NodeImpl#checkDeadNodes``, and the per-round vote tally of
+``NodeImpl#handleRequestVoteResponse``.  The engine's numpy mirrors are
+then the single source of truth for deadlines / acks / votes; the tick's
+output masks schedule the slow-path protocol handlers, which re-verify
+under the node lock (the host stays the single writer of protocol state,
+mirroring NodeImpl's writeLock discipline).
+
+Division of labor per event:
+  election_due  -> Node._on_election_due (pre-vote / vote-timeout retry)
+  elected       -> Node._on_engine_elected (becomeLeader)
+  step_down     -> Node._on_engine_quorum_dead (checkDeadNodes)
+  hb_due        -> batched empty-AppendEntries via HeartbeatHub.pulse
+  commit        -> TpuBallotBox._advance -> FSMCaller.on_committed
+
+The tick loop is ADAPTIVE: a dirty mark (new ack / vote / deadline
+change) fires a tick immediately — commit acks are not quantized to a
+fixed cadence — while consecutive ticks self-pace by the previous tick's
+cost (slow tunneled devices batch more per dispatch).  Idle engines
+sleep until the next election/heartbeat deadline, capped at
+``tick_interval_ms``.
 
 Index-domain note: the device works in int32 *relative* indexes
 (``abs - base[g]``); the engine re-bases a group whenever its relative
 window approaches 2^28, so unbounded absolute indexes never overflow.
+Times are int32 ms since engine start, epoch-shifted before they near
+2^30 (multi-week uptimes never overflow).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import Callable, Optional
 
@@ -26,11 +54,18 @@ import numpy as np
 from tpuraft.conf import Configuration
 from tpuraft.entity import PeerId
 from tpuraft.options import TickOptions
-from tpuraft.ops.tick import GroupState, TickParams
+from tpuraft.ops.tick import (
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_INACTIVE,
+    ROLE_LEADER,
+)
 
 LOG = logging.getLogger(__name__)
 
 _REBASE_LIMIT = 1 << 28
+_TIME_REBASE_MS = 1 << 30        # epoch-shift threshold (int32 headroom)
+_NEG_I32 = -(2 ** 30)            # matches tpuraft.ops.ballot.NEG_INF_I32
 
 
 class TpuBallotBox:
@@ -48,6 +83,15 @@ class TpuBallotBox:
         self.last_committed_index = 0
         self.pending_index = 0
 
+    # -- control-plane seam --------------------------------------------------
+
+    def make_control(self, node) -> Optional["EngineControl"]:
+        """Hand the node the engine's device control plane (or None to
+        keep host timers, when drive_protocol is off)."""
+        if not self._engine.opts.drive_protocol:
+            return None
+        return EngineControl(self._engine, node, self)
+
     # -- leader side ---------------------------------------------------------
 
     def reset_pending_index(self, new_pending_index: int) -> None:
@@ -56,16 +100,20 @@ class TpuBallotBox:
         e.base[self.slot] = new_pending_index - 1
         e.pending_rel[self.slot] = 1
         e.match_abs[self.slot, :] = 0
-        # commit baseline for the gate `q > commit_now`: nothing of THIS
-        # leadership is committed yet (slot may be reused from a prior node)
+        # commit baseline for the device gate `q > commit_now`: nothing of
+        # THIS leadership is committed yet (slot may be reused from a
+        # prior node)
         e.commit_abs[self.slot] = new_pending_index - 1
-        e.leader_mask[self.slot] = True
+        e.role[self.slot] = ROLE_LEADER
         e.mark_dirty()
 
     def clear_pending(self) -> None:
         self.pending_index = 0
         e = self._engine
-        e.leader_mask[self.slot] = False
+        # a controlled slot stays an engine-scheduled follower; a bare
+        # box (commit plane only) goes inactive
+        e.role[self.slot] = (
+            ROLE_FOLLOWER if e.has_ctrl[self.slot] else ROLE_INACTIVE)
         e.match_abs[self.slot, :] = 0
 
     def commit_at(self, peer: PeerId, match_index: int, conf: Configuration,
@@ -108,31 +156,292 @@ class TpuBallotBox:
             self._on_committed(new_commit)
 
 
+class EngineControl:
+    """Per-node handle to the engine's device control plane.
+
+    Replaces, for engine-backed nodes, the reference's per-group timers
+    and scalar tallies (SURVEY §3.1 "Timers & queues", §4.3):
+
+      electionTimer/voteTimer  -> elect_deadline[g] + election_due mask
+      vote tally (_VoteCtx)    -> granted[g,:] + elected mask
+      stepDownTimer/_peer_acks -> last_ack[g,:] + step_down/lease masks
+      heartbeat timers/hub tick-> hb_deadline[g] + hb_due mask
+
+    Pre-vote tallies stay host-side scalars by design: the device role
+    encoding has no pre-vote state (tpuraft.ops.tick) — pre-vote is a
+    rare, transient probe that never mutates durable terms.
+
+    One-off scalar queries (lease_valid for a single read, dead-quorum
+    re-verification under the node lock) compute host-side from the SAME
+    engine rows the device reduces — one [P] row, not a second copy of
+    the state.
+    """
+
+    drives_heartbeats = True
+
+    def __init__(self, engine: "MultiRaftEngine", node, box: TpuBallotBox):
+        self.engine = engine
+        self.node = node
+        self.slot = box.slot
+        opts = node.options
+        self._eto_ms = opts.election_timeout_ms
+        # the lease is per-NODE (eto x ratio): the engine-wide lease_ms
+        # param only feeds the device lease_valid mask, and a node whose
+        # eto is shorter than the engine's must not inherit a lease
+        # longer than its own election timeout (stale LEASE_BASED reads)
+        self._lease_ms = int(self._eto_ms
+                             * opts.raft_options.leader_lease_time_ratio)
+        self._jitter_range = max(1, min(opts.raft_options.max_election_delay_ms,
+                                        self._eto_ms))
+        self._jitter = random.randrange(self._jitter_range)
+        self._scheduled: set = set()
+        engine.register_ctrl(self, node.server_id,
+                             eto_ms=self._eto_ms,
+                             hb_ms=max(1, self._eto_ms
+                                       // opts.raft_options.election_heartbeat_factor),
+                             lease_ms=int(self._eto_ms
+                                          * opts.raft_options.leader_lease_time_ratio))
+
+    # -- scheduling plumbing (engine tick -> node slow path) -----------------
+
+    def schedule(self, name: str, handler) -> None:
+        """Fire-and-dedupe: at most one outstanding handler per event
+        kind — the tick may re-emit a mask for several ticks before the
+        async handler flips the role."""
+        if name in self._scheduled:
+            return
+        self._scheduled.add(name)
+
+        async def run():
+            try:
+                await handler()
+            except Exception:  # noqa: BLE001 — one group's handler only
+                LOG.exception("engine event %s for %s failed",
+                              name, self.node)
+            finally:
+                self._scheduled.discard(name)
+
+        asyncio.ensure_future(run())
+
+    def push_election_deadline(self, now_ms: Optional[int] = None,
+                               new_jitter: bool = True) -> None:
+        if now_ms is None:
+            now_ms = self.engine.now_ms()
+        if new_jitter:
+            self._jitter = random.randrange(self._jitter_range)
+        self.engine.elect_deadline[self.slot] = (
+            now_ms + self._eto_ms + self._jitter)
+
+    # -- node-facing API (mirrors TimerControl in tpuraft.core.node) ---------
+
+    def start_follower(self) -> None:
+        e = self.engine
+        e.role[self.slot] = ROLE_FOLLOWER
+        self.push_election_deadline()
+        e.mark_dirty()
+
+    def note_leader_contact(self) -> None:
+        """Hot path (every AppendEntries): push the election deadline.
+        Reuses the cached jitter — no RNG per append."""
+        self.engine.elect_deadline[self.slot] = (
+            self.engine.now_ms() + self._eto_ms + self._jitter)
+
+    def on_candidate(self) -> None:
+        e = self.engine
+        e.role[self.slot] = ROLE_CANDIDATE
+        self.push_election_deadline()   # vote-round timeout
+        e.mark_dirty()
+
+    def stop_vote_wait(self) -> None:
+        pass  # deadline is inert once the role leaves CANDIDATE
+
+    def start_vote_round(self) -> bool:
+        """Clear the vote row, grant self.  Returns True when self alone
+        is a quorum (single-voter group) — the engine's elected mask
+        handles the multi-voter async case."""
+        e = self.engine
+        e.granted[self.slot, :] = False
+        col = e.peer_col(self.slot, self.node.server_id)
+        if col is not None:
+            e.granted[self.slot, col] = True
+        e.mark_dirty()
+        return self.vote_quorum_now()
+
+    def grant_vote(self, peer: PeerId) -> bool:
+        """Record a granted vote.  Always returns False: the tally is the
+        device tick's elected mask (-> Node._on_engine_elected)."""
+        e = self.engine
+        col = e.peer_col(self.slot, peer)
+        if col is not None:
+            e.granted[self.slot, col] = True
+            e.mark_dirty()
+        return False
+
+    def vote_quorum_now(self) -> bool:
+        """Host-side row check of the SAME granted/voter rows the device
+        reduces — used to confirm `elected` under the node lock."""
+        e, s = self.engine, self.slot
+        g, vm, ovm = e.granted[s], e.voter_mask[s], e.old_voter_mask[s]
+
+        def ok(mask):
+            n = int(mask.sum())
+            return n > 0 and int((g & mask).sum()) >= n // 2 + 1
+
+        return ok(vm) and (not ovm.any() or ok(ovm))
+
+    def on_leader(self) -> None:
+        e, s = self.engine, self.slot
+        now = e.now_ms()
+        e.role[s] = ROLE_LEADER
+        # grace period (reference: becomeLeader resets the replicators'
+        # lastRpcSendTimestamp): every peer counts as freshly acked, so
+        # dead-quorum step-down fires one full election timeout later,
+        # not instantly on a fresh leader with silent followers
+        e.last_ack[s, :] = now
+        e.hb_deadline[s] = now       # beat on the next tick
+        e.granted[s, :] = False
+        e.mark_dirty()
+
+    def on_step_down(self, was_candidate: bool, was_leader: bool) -> None:
+        self.engine.granted[self.slot, :] = False
+
+    def on_follower(self) -> None:
+        self.start_follower()
+
+    # -- ack bookkeeping (replaces Node._peer_acks) --------------------------
+
+    def record_ack(self, peer: PeerId, when: float) -> None:
+        e = self.engine
+        col = e.peer_col(self.slot, peer)
+        if col is not None:
+            ms = e.to_ms(when)
+            if ms > e.last_ack[self.slot, col]:
+                e.last_ack[self.slot, col] = ms
+
+    def _quorum_ack_ms(self) -> int:
+        """q-th newest voter ack (joint-consensus aware), host-side from
+        the engine row.  Counts self as acked now."""
+        e, s = self.engine, self.slot
+        now = e.now_ms()
+        col = e.peer_col(s, self.node.server_id)
+        row = e.last_ack[s].copy()
+        if col is not None:
+            row[col] = now
+
+        def q_ack(mask):
+            vals = np.sort(row[mask])[::-1]
+            n = vals.size
+            return int(vals[n // 2]) if n else _NEG_I32
+
+        q = q_ack(e.voter_mask[s])
+        if e.old_voter_mask[s].any():
+            q = min(q, q_ack(e.old_voter_mask[s]))
+        return q
+
+    def quorum_ack_age_s(self) -> float:
+        q = self._quorum_ack_ms()
+        if q <= _NEG_I32:
+            return float("inf")
+        return max(0.0, (self.engine.now_ms() - q) / 1000.0)
+
+    def lease_valid(self) -> bool:
+        return (self.engine.now_ms() - self._quorum_ack_ms()
+                < self._lease_ms)
+
+    def alive_peers(self) -> list[PeerId]:
+        e, s = self.engine, self.slot
+        horizon = e.now_ms() - self._eto_ms
+        out = []
+        for peer in self.node.list_peers():
+            if peer == self.node.server_id:
+                out.append(peer)
+                continue
+            col = e.peer_col(s, peer)
+            if col is not None and e.last_ack[s, col] > horizon:
+                out.append(peer)
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def deactivate(self) -> None:
+        self.engine.role[self.slot] = ROLE_INACTIVE
+
+    def shutdown(self) -> None:
+        self.deactivate()
+        self.engine.unregister_ctrl(self.slot)
+
+
+class _NpOutputs:
+    """numpy TickOutputs twin (backend="numpy" fallback)."""
+
+    __slots__ = ("commit_rel", "commit_advanced", "elected", "election_due",
+                 "step_down", "hb_due", "lease_valid")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
 class MultiRaftEngine:
-    """Per-process batched commit plane.  Start once, register each node's
-    ballot box through :meth:`ballot_box_factory`."""
+    """Per-process batched consensus plane.  Start once, register each
+    node's ballot box through :meth:`ballot_box_factory`."""
 
     def __init__(self, opts: Optional[TickOptions] = None):
         self.opts = opts or TickOptions()
         g, p = self.opts.max_groups, self.opts.max_peers
         self.G, self.P = g, p
-        # numpy mirrors (host-owned truth between ticks)
+        # numpy mirrors (host-owned truth between ticks) — commit plane
         self.match_abs = np.zeros((g, p), np.int64)
         self.base = np.zeros(g, np.int64)
         self.pending_rel = np.ones(g, np.int32)
         self.voter_mask = np.zeros((g, p), bool)
         self.old_voter_mask = np.zeros((g, p), bool)
-        self.leader_mask = np.zeros(g, bool)
         self.commit_abs = np.zeros(g, np.int64)
+        # protocol plane (SURVEY §8.1): roles, deadlines, acks, votes
+        self.role = np.full(g, ROLE_INACTIVE, np.int32)
+        self.elect_deadline = np.zeros(g, np.int64)
+        self.hb_deadline = np.zeros(g, np.int64)
+        self.last_ack = np.full((g, p), _NEG_I32, np.int64)
+        self.granted = np.zeros((g, p), bool)
+        self.self_col = np.full(g, -1, np.int32)
+        self.has_ctrl = np.zeros(g, bool)
         self._peer_cols: list[dict[PeerId, int]] = [dict() for _ in range(g)]
         self._boxes: list[Optional[TpuBallotBox]] = [None] * g
+        self._ctrls: list[Optional[EngineControl]] = [None] * g
+        self._ctrl_server: list[Optional[PeerId]] = [None] * g
         self._free = list(range(g - 1, -1, -1))
         self._dirty = False
+        self._dirty_event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
-        self._tick_fn = None  # jitted quorum reduce (None => numpy path)
+        self._tick_fn = None  # jitted raft_tick outputs (None => numpy path)
+        self._params_dev = None
         self.ticks = 0
         self.commit_advances = 0
+        # protocol params (engine-wide; first registered node fixes them)
+        self.eto_ms = 1000
+        self.hb_ms = 100
+        self.lease_ms = 900
+        self._params_locked = False
+        self._t0 = time.monotonic()
+
+    # -- time ----------------------------------------------------------------
+
+    def now_ms(self) -> int:
+        return int((time.monotonic() - self._t0) * 1000)
+
+    def to_ms(self, monotonic_time: float) -> int:
+        return int((monotonic_time - self._t0) * 1000)
+
+    def _maybe_time_rebase(self, now: int) -> None:
+        """Shift the time epoch before int32 ms overflows (~12 days)."""
+        if now < _TIME_REBASE_MS:
+            return
+        shift = now - (self.eto_ms * 4)
+        self._t0 += shift / 1000.0
+        self.elect_deadline -= shift
+        self.hb_deadline -= shift
+        np.maximum(self.last_ack - shift, _NEG_I32, out=self.last_ack)
 
     # -- registry ------------------------------------------------------------
 
@@ -146,6 +455,33 @@ class MultiRaftEngine:
             return box
 
         return make
+
+    def register_ctrl(self, ctrl: EngineControl, server_id: PeerId,
+                      eto_ms: int, hb_ms: int, lease_ms: int) -> None:
+        s = ctrl.slot
+        self._ctrls[s] = ctrl
+        self._ctrl_server[s] = server_id
+        self.has_ctrl[s] = True
+        col = self._peer_cols[s].get(server_id)
+        self.self_col[s] = -1 if col is None else col
+        if not self._params_locked:
+            self.eto_ms, self.hb_ms, self.lease_ms = eto_ms, hb_ms, lease_ms
+            self._params_dev = None  # (re)built at next device tick
+            self._params_locked = True
+        elif (eto_ms, hb_ms, lease_ms) != (self.eto_ms, self.hb_ms,
+                                           self.lease_ms):
+            LOG.warning(
+                "engine protocol params are engine-wide: slot %d wants "
+                "(eto=%d hb=%d lease=%d) but engine runs (%d %d %d) — "
+                "the first registered node's timeouts apply to all",
+                s, eto_ms, hb_ms, lease_ms,
+                self.eto_ms, self.hb_ms, self.lease_ms)
+
+    def unregister_ctrl(self, slot: int) -> None:
+        self._ctrls[slot] = None
+        self._ctrl_server[slot] = None
+        self.has_ctrl[slot] = False
+        self.self_col[slot] = -1
 
     def alloc_slot(self) -> int:
         if not self._free:
@@ -170,10 +506,18 @@ class MultiRaftEngine:
         self.pending_rel = pad(self.pending_rel, 1)
         self.voter_mask = pad(self.voter_mask)
         self.old_voter_mask = pad(self.old_voter_mask)
-        self.leader_mask = pad(self.leader_mask)
         self.commit_abs = pad(self.commit_abs)
+        self.role = pad(self.role, ROLE_INACTIVE)
+        self.elect_deadline = pad(self.elect_deadline)
+        self.hb_deadline = pad(self.hb_deadline)
+        self.last_ack = pad(self.last_ack, _NEG_I32)
+        self.granted = pad(self.granted)
+        self.self_col = pad(self.self_col, -1)
+        self.has_ctrl = pad(self.has_ctrl)
         self._peer_cols.extend(dict() for _ in range(old_g))
         self._boxes.extend([None] * old_g)
+        self._ctrls.extend([None] * old_g)
+        self._ctrl_server.extend([None] * old_g)
         self._free = list(range(new_g - 1, old_g - 1, -1))
         self.G = new_g
         LOG.info("engine grew: %d -> %d group slots", old_g, new_g)
@@ -181,13 +525,18 @@ class MultiRaftEngine:
     def release(self, box: TpuBallotBox) -> None:
         s = box.slot
         self._boxes[s] = None
+        self.unregister_ctrl(s)
         self.voter_mask[s] = False
         self.old_voter_mask[s] = False
-        self.leader_mask[s] = False
         self.match_abs[s] = 0
         self.commit_abs[s] = 0
         self.base[s] = 0
         self.pending_rel[s] = 1
+        self.role[s] = ROLE_INACTIVE
+        self.elect_deadline[s] = 0
+        self.hb_deadline[s] = 0
+        self.last_ack[s] = _NEG_I32
+        self.granted[s] = False
         self._peer_cols[s].clear()
         self._free.append(s)
 
@@ -211,6 +560,8 @@ class MultiRaftEngine:
         # drop stale peers
         for peer in [p for p in cols if p not in all_peers]:
             self.match_abs[slot, cols[peer]] = 0
+            self.last_ack[slot, cols[peer]] = _NEG_I32
+            self.granted[slot, cols[peer]] = False
             del cols[peer]
         vm = np.zeros(self.P, bool)
         ovm = np.zeros(self.P, bool)
@@ -220,6 +571,10 @@ class MultiRaftEngine:
             ovm[cols[peer]] = True
         self.voter_mask[slot] = vm
         self.old_voter_mask[slot] = ovm
+        server = self._ctrl_server[slot]
+        if server is not None:
+            col = cols.get(server)
+            self.self_col[slot] = -1 if col is None else col
         self.mark_dirty()
 
     def peer_col(self, slot: int, peer: PeerId) -> Optional[int]:
@@ -227,16 +582,18 @@ class MultiRaftEngine:
 
     def mark_dirty(self) -> None:
         self._dirty = True
+        self._dirty_event.set()
 
     def describe(self) -> str:
         """Live engine state for operators (the device-plane counterpart
         of Node#describe)."""
         used = sum(1 for b in self._boxes if b is not None)
         return (f"MultiRaftEngine<G={self.G} P={self.P} used={used} "
+                f"ctrl={int(self.has_ctrl.sum())} "
                 f"backend={self.opts.backend} "
                 f"mesh={self.opts.mesh_devices or 1} "
                 f"ticks={self.ticks} commit_advances={self.commit_advances} "
-                f"leaders={int(self.leader_mask.sum())}>")
+                f"leaders={int((self.role == ROLE_LEADER).sum())}>")
 
     # -- tick loop -----------------------------------------------------------
 
@@ -244,12 +601,17 @@ class MultiRaftEngine:
         if self.opts.backend != "numpy":
             import jax
 
-            from tpuraft.ops.ballot import joint_quorum_match_index
+            from tpuraft.ops.tick import (raft_tick_outputs,
+                                          raft_tick_outputs_jit)
+            outputs_only = raft_tick_outputs
 
             if self.opts.mesh_devices and self.opts.mesh_devices > 1:
-                # SPMD over the group axis: each chip reduces its own
+                # SPMD over the group axis: each chip advances its own
                 # group rows; upload scatters, download gathers (the
                 # "vote-matrix over ICI" configuration in BASELINE.md)
+                from jax.sharding import NamedSharding, PartitionSpec
+                from tpuraft.ops.tick import (GroupState, TickOutputs,
+                                              TickParams)
                 from tpuraft.parallel.mesh import group_shardings, make_mesh
 
                 n = self.opts.mesh_devices
@@ -258,15 +620,31 @@ class MultiRaftEngine:
                         f"max_groups={self.G} not divisible by "
                         f"mesh_devices={n}")
                 mesh = make_mesh(n)  # raises if fewer devices exist
-                out, row = group_shardings(mesh)
+                row, mat = group_shardings(mesh)
+                scalar = NamedSharding(mesh, PartitionSpec())
+                state_sh = GroupState(
+                    role=row, commit_rel=row, pending_rel=row,
+                    match_rel=mat, granted=mat, voter_mask=mat,
+                    old_voter_mask=mat, elect_deadline=row,
+                    hb_deadline=row, last_ack=mat)
+                out_sh = TickOutputs(
+                    commit_rel=row, commit_advanced=row, elected=row,
+                    election_due=row, step_down=row, hb_due=row,
+                    lease_valid=row)
                 self._tick_fn = jax.jit(
-                    joint_quorum_match_index,
-                    in_shardings=(row, row, row),
-                    out_shardings=out)
+                    outputs_only,
+                    in_shardings=(state_sh, scalar,
+                                  TickParams(scalar, scalar, scalar)),
+                    out_shardings=out_sh)
             else:
-                # jitted once: eager per-tick dispatch would cost ~100ms
-                # over a tunneled device and starve the asyncio loop
-                self._tick_fn = jax.jit(joint_quorum_match_index)
+                # the PROCESS-WIDE jitted instance: all engines share one
+                # trace cache, so only the first engine (per [G, P]
+                # shape) pays a compile
+                self._tick_fn = raft_tick_outputs_jit
+            # warm the compile NOW, before any node registers: a first
+            # tick mid-protocol would block the event loop for the
+            # compile and miss every group's heartbeat window at once
+            self.tick_once()
         if self.opts.profile_dir:
             if self.opts.backend == "numpy":
                 LOG.warning("profile_dir set but backend is numpy: the "
@@ -309,17 +687,50 @@ class MultiRaftEngine:
                 pass
             self._task = None
 
+    def _next_deadline(self) -> int:
+        """Earliest engine-scheduled deadline (election or heartbeat)
+        over controlled slots; a huge sentinel when none."""
+        hc = self.has_ctrl
+        ec = hc & ((self.role == ROLE_FOLLOWER) | (self.role == ROLE_CANDIDATE))
+        ld = hc & (self.role == ROLE_LEADER)
+        nxt = 1 << 60
+        if ec.any():
+            nxt = min(nxt, int(self.elect_deadline[ec].min()))
+        if ld.any():
+            nxt = min(nxt, int(self.hb_deadline[ld].min()))
+        return nxt
+
     async def _loop(self) -> None:
-        interval = self.opts.tick_interval_ms / 1000.0
+        """Adaptive cadence: dirty -> tick now (sub-ms commit ack at low
+        load); consecutive ticks pace by the previous tick's cost (a
+        tunneled device batches more per dispatch); idle -> sleep to the
+        next deadline, capped at tick_interval_ms."""
+        max_idle_s = self.opts.tick_interval_ms / 1000.0
+        min_pace_s = self.opts.min_tick_interval_ms / 1000.0
         while not self._stopped:
-            await asyncio.sleep(interval)
-            if self._dirty:
+            now = self.now_ms()
+            due = self._next_deadline() <= now
+            if self._dirty or due:
+                self._dirty_event.clear()
                 self._dirty = False
+                t0 = time.perf_counter()
                 try:
                     self.tick_once()
                 except Exception:
                     LOG.exception("engine tick failed")
                     self._dirty = True  # re-process pending acks next tick
+                dur = time.perf_counter() - t0
+                pace = max(min_pace_s, dur * self.opts.pace_factor)
+                await asyncio.sleep(pace)
+                continue
+            wait = min(max_idle_s,
+                       max(0.0, (self._next_deadline() - now) / 1000.0))
+            if self._dirty:
+                continue
+            try:
+                await asyncio.wait_for(self._dirty_event.wait(), wait)
+            except asyncio.TimeoutError:
+                pass
 
     # -- the tick ------------------------------------------------------------
 
@@ -333,54 +744,187 @@ class MultiRaftEngine:
                 self.base[s] = new_base
 
     def tick_once(self) -> int:
-        """One batched commit computation for all leader groups.  Returns
+        """One batched device tick for all groups: commit advancement,
+        election/heartbeat scheduling, lease & step-down.  Returns the
         number of groups whose commit advanced."""
-        import jax.numpy as jnp
-
+        now = self.now_ms()
+        self._maybe_time_rebase(now)
+        now = self.now_ms()
         self._rebase()
+        # the leader's own slot counts as acked *now* (tick.py contract)
+        lead_rows = np.nonzero((self.role == ROLE_LEADER)
+                               & (self.self_col >= 0))[0]
+        if lead_rows.size:
+            self.last_ack[lead_rows, self.self_col[lead_rows]] = now
         rel = np.clip(self.match_abs - self.base[:, None], 0, None
                       ).astype(np.int32)
         commit_rel_now = np.clip(self.commit_abs - self.base, 0, None
                                  ).astype(np.int32)
 
         if self._tick_fn is not None:
-            import jax
-
-            with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
-                q = np.asarray(self._tick_fn(
-                    jnp.asarray(rel), jnp.asarray(self.voter_mask),
-                    jnp.asarray(self.old_voter_mask)))
+            out = self._device_tick(rel, commit_rel_now, now)
         else:  # numpy fallback (tiny deployments / no jax)
-            q = _np_joint_quorum(rel, self.voter_mask, self.old_voter_mask)
+            out = self._np_tick(rel, commit_rel_now, now)
 
-        can = (self.leader_mask & (q >= self.pending_rel)
-               & (q > commit_rel_now))
-        advanced = 0
         self.ticks += 1
-        for s in np.nonzero(can)[0]:
+        advanced = self._apply_commits(out)
+        self._apply_protocol(out, now)
+        return advanced
+
+    def _device_tick(self, rel, commit_rel_now, now):
+        import jax
+        import jax.numpy as jnp
+
+        from tpuraft.ops.tick import GroupState, TickParams
+
+        if self._params_dev is None:
+            self._params_dev = TickParams.make(self.eto_ms, self.hb_ms,
+                                               self.lease_ms)
+        state = GroupState(
+            role=jnp.asarray(self.role),
+            commit_rel=jnp.asarray(commit_rel_now),
+            pending_rel=jnp.asarray(self.pending_rel),
+            match_rel=jnp.asarray(rel),
+            granted=jnp.asarray(self.granted),
+            voter_mask=jnp.asarray(self.voter_mask),
+            old_voter_mask=jnp.asarray(self.old_voter_mask),
+            elect_deadline=jnp.asarray(
+                self.elect_deadline.astype(np.int32)),
+            hb_deadline=jnp.asarray(self.hb_deadline.astype(np.int32)),
+            last_ack=jnp.asarray(self.last_ack.astype(np.int32)),
+        )
+        with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
+            out = self._tick_fn(state, jnp.int32(now), self._params_dev)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    def _np_tick(self, rel, commit_rel_now, now) -> _NpOutputs:
+        """Bit-exact numpy twin of tpuraft.ops.tick.raft_tick (the
+        engine's no-jax fallback; also the oracle in engine tests)."""
+        vm, ovm = self.voter_mask, self.old_voter_mask
+        is_leader = self.role == ROLE_LEADER
+        is_follower = self.role == ROLE_FOLLOWER
+        is_candidate = self.role == ROLE_CANDIDATE
+
+        q = _np_joint_quorum(rel, vm, ovm)
+        can_commit = is_leader & (q >= self.pending_rel)
+        new_commit = np.where(can_commit, np.maximum(commit_rel_now, q),
+                              commit_rel_now)
+
+        def vote_ok(mask):
+            n = mask.sum(axis=1)
+            votes = (self.granted & mask).sum(axis=1)
+            return (n > 0) & (votes >= n // 2 + 1)
+
+        el = vote_ok(vm)
+        in_joint = ovm.any(axis=1)
+        elected_q = np.where(in_joint, el & vote_ok(ovm), el)
+        q_ack = _np_order_stat(
+            np.clip(self.last_ack, _NEG_I32, None).astype(np.int64), vm)
+        have_ack = q_ack > _NEG_I32
+        return _NpOutputs(
+            commit_rel=new_commit,
+            commit_advanced=new_commit > commit_rel_now,
+            elected=is_candidate & elected_q,
+            election_due=(is_follower | is_candidate)
+            & (now >= self.elect_deadline),
+            step_down=is_leader & have_ack & (now - q_ack >= self.eto_ms),
+            hb_due=is_leader & (now >= self.hb_deadline),
+            lease_valid=is_leader & have_ack & (now - q_ack < self.lease_ms),
+        )
+
+    def _apply_commits(self, out) -> int:
+        advanced = 0
+        for s in np.nonzero(np.asarray(out.commit_advanced))[0]:
             box = self._boxes[s]
             if box is None:
                 continue
-            new_commit = int(self.base[s] + q[s])
-            self.commit_abs[s] = new_commit
-            advanced += 1
-            box._advance(new_commit)
+            new_commit = int(self.base[s] + out.commit_rel[s])
+            if new_commit > self.commit_abs[s]:
+                self.commit_abs[s] = new_commit
+                advanced += 1
+                box._advance(new_commit)
         self.commit_advances += advanced
         return advanced
+
+    def _apply_protocol(self, out, now: int) -> None:
+        """Schedule slow-path handlers from the tick's event masks
+        (controlled slots only); handlers re-verify under the node lock."""
+        hc = self.has_ctrl
+        for s in np.nonzero(np.asarray(out.election_due) & hc)[0]:
+            ctrl = self._ctrls[s]
+            if ctrl is None:
+                continue
+            # push the deadline NOW: the handler runs async, and a
+            # same-deadline refire every tick until it runs would storm
+            ctrl.push_election_deadline(now)
+            ctrl.schedule("election_due", ctrl.node._on_election_due)
+        for s in np.nonzero(np.asarray(out.elected) & hc)[0]:
+            ctrl = self._ctrls[s]
+            if ctrl is not None:
+                ctrl.schedule("elected", ctrl.node._on_engine_elected)
+        for s in np.nonzero(np.asarray(out.step_down) & hc)[0]:
+            ctrl = self._ctrls[s]
+            if ctrl is not None:
+                ctrl.schedule("quorum_dead",
+                              ctrl.node._on_engine_quorum_dead)
+        hb_slots = np.nonzero(np.asarray(out.hb_due) & hc)[0]
+        if hb_slots.size:
+            self._flush_heartbeats(hb_slots, now)
+
+    def _flush_heartbeats(self, slots, now: int) -> None:
+        """Batched heartbeat fan-out for all due leader groups: ONE
+        HeartbeatHub.pulse per hub covering every due group this tick
+        (the send-matrix plane — O(endpoints) RPCs, not O(groups))."""
+        by_hub: dict[int, tuple[object, list]] = {}
+        direct: list = []
+        # phase-align the next beat to the engine-wide hb_ms grid: all
+        # leader groups then fall due on the SAME tick, so one pulse per
+        # interval carries every group's beat (max hub batching — the
+        # staggered per-group alternative degrades to ~1 beat per RPC)
+        aligned_next = (now // self.hb_ms + 1) * self.hb_ms
+        for s in slots:
+            # mirror the device's deadline advance so the mask doesn't
+            # refire every tick
+            self.hb_deadline[s] = aligned_next
+            ctrl = self._ctrls[s]
+            if ctrl is None:
+                continue
+            node = ctrl.node
+            if not node.is_leader():
+                continue
+            reps = node.replicators.all()
+            if not reps:
+                continue
+            nm = node.node_manager
+            if nm is not None and node.options.raft_options.coalesce_heartbeats:
+                # opt-in, as on the timer path: the receiver must run a
+                # NodeManager-style server with a multi_heartbeat handler
+                hub = nm.heartbeat_hub
+                by_hub.setdefault(id(hub), (hub, []))[1].extend(reps)
+            else:
+                direct.extend(reps)
+        for hub, reps in by_hub.values():
+            hub.pulse(reps)
+        for r in direct:
+            t = asyncio.ensure_future(r.send_heartbeat())
+            t.add_done_callback(
+                lambda tt: tt.cancelled() or tt.exception())
+
+
+def _np_order_stat(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Per-row q-th largest among masked slots (q = n//2 + 1), NEG for
+    empty masks — the numpy oracle of ops.ballot.quorum_match_index."""
+    NEG = np.int64(_NEG_I32)
+    v = np.where(mask, values, NEG)
+    sd = -np.sort(-v, axis=1)
+    n = mask.sum(axis=1)
+    qi = np.clip(n // 2, 0, values.shape[1] - 1)
+    picked = np.take_along_axis(sd, qi[:, None], axis=1)[:, 0]
+    return np.where(n > 0, picked, NEG)
 
 
 def _np_joint_quorum(rel: np.ndarray, vm: np.ndarray, ovm: np.ndarray
                      ) -> np.ndarray:
-    NEG = np.int32(-(2 ** 30))
-
-    def order_stat(mask):
-        v = np.where(mask, rel, NEG)
-        sd = -np.sort(-v, axis=1)
-        n = mask.sum(axis=1)
-        qi = np.clip(n // 2, 0, rel.shape[1] - 1)
-        picked = np.take_along_axis(sd, qi[:, None], axis=1)[:, 0]
-        return np.where(n > 0, picked, NEG)
-
-    new_q = order_stat(vm)
-    old_q = order_stat(ovm)
+    new_q = _np_order_stat(rel.astype(np.int64), vm).astype(np.int32)
+    old_q = _np_order_stat(rel.astype(np.int64), ovm).astype(np.int32)
     return np.where(ovm.any(axis=1), np.minimum(new_q, old_q), new_q)

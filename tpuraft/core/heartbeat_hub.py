@@ -115,14 +115,23 @@ class HeartbeatHub:
             return
 
     async def tick_once(self) -> None:
-        # Frames MUST be built here, synchronously: between the
-        # is_leader() check and an await, a step-down + re-election can
-        # change the node's term, and a beat built late would claim
-        # leadership of the NEW term from a node that is now a follower
-        # (observed as spurious "two leaders in one term" conflicts on
-        # receivers).  No awaits may separate the check from the build.
+        self.pulse(list(self._members.values()))
+
+    def pulse(self, replicators: list["Replicator"]) -> None:
+        """Beat the given replicators NOW, batched per destination
+        endpoint.  Two callers: the hub's own clock (tick_once) and the
+        engine's hb_due mask (MultiRaftEngine._flush_heartbeats), which
+        passes every due group's replicators in one call so idle beats
+        stay O(endpoints) per tick.
+
+        Frames MUST be built here, synchronously: between the
+        is_leader() check and an await, a step-down + re-election can
+        change the node's term, and a beat built late would claim
+        leadership of the NEW term from a node that is now a follower
+        (observed as spurious "two leaders in one term" conflicts on
+        receivers).  No awaits may separate the check from the build."""
         by_dst: dict[str, list[tuple["Replicator", bytes]]] = {}
-        for r in list(self._members.values()):
+        for r in replicators:
             node = r._node
             if not node.is_leader() or not r._running:
                 continue

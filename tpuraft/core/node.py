@@ -88,6 +88,133 @@ class _VoteCtx:
         return new_ok and old_ok
 
 
+class TimerControl:
+    """Reference-parity control plane: per-group RepeatedTimers + scalar
+    tallies (``NodeImpl``'s electionTimer / voteTimer / stepDownTimer and
+    the Replicator lastRpcSendTimestamp map behind ``checkDeadNodes``).
+
+    Engine-backed nodes swap this for ``tpuraft.core.engine.
+    EngineControl`` (via ``TpuBallotBox.make_control``): the same call
+    surface, but deadlines/acks/votes live in the engine's ``[G, P]``
+    mirrors and fire from the fused device tick's masks instead of
+    O(groups) asyncio timers — the SURVEY §8.1 device plane.
+    """
+
+    drives_heartbeats = False   # per-replicator loops / hub clock beat
+
+    def __init__(self, node: "Node"):
+        self._node = node
+        opts = node.options
+        self._acks: dict[PeerId, float] = {}
+        self._vote_ctx: Optional[_VoteCtx] = None
+        self._election_timer = RepeatedTimer(
+            f"election-{node.server_id}", opts.election_timeout_ms,
+            node._handle_election_timeout, adjust=RepeatedTimer.random_adjust)
+        self._vote_timer = RepeatedTimer(
+            f"vote-{node.server_id}", opts.election_timeout_ms,
+            node._handle_vote_timeout, adjust=RepeatedTimer.random_adjust)
+        self._stepdown_timer = RepeatedTimer(
+            f"stepdown-{node.server_id}", opts.election_timeout_ms // 2 or 1,
+            node._check_dead_nodes)
+
+    # -- role transitions ----------------------------------------------------
+
+    def start_follower(self) -> None:
+        self._election_timer.start()
+
+    def note_leader_contact(self) -> None:
+        pass  # the election handler's lease check covers timer mode
+
+    def on_candidate(self) -> None:
+        self._election_timer.stop()
+        self._vote_timer.start()
+
+    def stop_vote_wait(self) -> None:
+        self._vote_timer.stop()
+
+    def on_leader(self) -> None:
+        self._vote_timer.stop()
+        self._acks = {self._node.server_id: time.monotonic()}
+        self._stepdown_timer.start()
+
+    def on_step_down(self, was_candidate: bool, was_leader: bool) -> None:
+        if was_candidate:
+            self._vote_timer.stop()
+        if was_leader:
+            self._stepdown_timer.stop()
+        self._vote_ctx = None
+
+    def on_follower(self) -> None:
+        self._election_timer.restart()
+
+    # -- vote tally ----------------------------------------------------------
+
+    def start_vote_round(self) -> bool:
+        """Open a vote round granted by self; True = already a quorum."""
+        node = self._node
+        ctx = _VoteCtx(node.conf_entry.conf, node.conf_entry.old_conf)
+        ctx.grant(node.server_id)
+        self._vote_ctx = ctx
+        return ctx.is_granted()
+
+    def grant_vote(self, peer: PeerId) -> bool:
+        ctx = self._vote_ctx
+        if ctx is None:
+            return False
+        ctx.grant(peer)
+        return ctx.is_granted()
+
+    # -- ack bookkeeping (leader lease / dead-quorum / alive peers) ----------
+
+    def record_ack(self, peer: PeerId, when: float) -> None:
+        if when > self._acks.get(peer, 0.0):
+            self._acks[peer] = when
+
+    def quorum_ack_age_s(self) -> float:
+        """Age of the q-th newest voter ack (joint-consensus aware);
+        self counts as acked now (NodeImpl#checkDeadNodes)."""
+        node = self._node
+        now = time.monotonic()
+        self._acks[node.server_id] = now
+        conf, old_conf = node.conf_entry.conf, node.conf_entry.old_conf
+
+        def q_ack(peers: list[PeerId]) -> float:
+            acks = sorted((self._acks.get(p, 0.0) for p in peers),
+                          reverse=True)
+            return acks[len(peers) // 2] if peers else 0.0
+
+        qa = q_ack(conf.peers)
+        if not old_conf.is_empty():
+            qa = min(qa, q_ack(old_conf.peers))
+        return now - qa
+
+    def lease_valid(self) -> bool:
+        node = self._node
+        lease_s = (node.options.election_timeout_ms
+                   * node.options.raft_options.leader_lease_time_ratio
+                   / 1000.0)
+        return self.quorum_ack_age_s() < lease_s
+
+    def alive_peers(self) -> list[PeerId]:
+        node = self._node
+        horizon = time.monotonic() - node.options.election_timeout_ms / 1000.0
+        return [p for p in node.list_peers()
+                if p == node.server_id or self._acks.get(p, 0.0) > horizon]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def deactivate(self) -> None:
+        self._stop_timers()
+
+    def shutdown(self) -> None:
+        self._stop_timers()
+
+    def _stop_timers(self) -> None:
+        for t in (self._election_timer, self._vote_timer,
+                  self._stepdown_timer):
+            t.stop()
+
+
 class Node:
     def __init__(self, group_id: str, server_id: PeerId, options: NodeOptions,
                  transport, ballot_box_factory=None):
@@ -117,12 +244,11 @@ class Node:
 
         self._meta: RaftMetaStorage = None  # type: ignore[assignment]
         self._lock = asyncio.Lock()
-        self._election_timer: Optional[RepeatedTimer] = None
-        self._vote_timer: Optional[RepeatedTimer] = None
-        self._stepdown_timer: Optional[RepeatedTimer] = None
+        # control plane: TimerControl (per-group timers, reference
+        # parity) or EngineControl (device-tick masks) — set in init()
+        self._ctrl = None
         self._snapshot_timer: Optional[RepeatedTimer] = None
         self._last_leader_timestamp = time.monotonic()
-        self._peer_acks: dict[PeerId, float] = {}
         # index of the first entry appended in THIS leadership term (the
         # election no-op); reads are unsafe until it commits
         self._term_first_index: int = 0
@@ -206,16 +332,13 @@ class Node:
 
         self.read_only_service = ReadOnlyService(self)
 
-        # timers
-        self._election_timer = RepeatedTimer(
-            f"election-{self.server_id}", opts.election_timeout_ms,
-            self._handle_election_timeout, adjust=RepeatedTimer.random_adjust)
-        self._vote_timer = RepeatedTimer(
-            f"vote-{self.server_id}", opts.election_timeout_ms,
-            self._handle_vote_timeout, adjust=RepeatedTimer.random_adjust)
-        self._stepdown_timer = RepeatedTimer(
-            f"stepdown-{self.server_id}", opts.election_timeout_ms // 2 or 1,
-            self._check_dead_nodes)
+        # control plane: the engine's ballot box hands out an
+        # EngineControl (device-tick deadlines/votes/acks); every other
+        # box type falls back to per-group timers
+        make_ctrl = getattr(self.ballot_box, "make_control", None)
+        self._ctrl = make_ctrl(self) if make_ctrl is not None else None
+        if self._ctrl is None:
+            self._ctrl = TimerControl(self)
         if self.snapshot_executor and opts.snapshot.interval_secs > 0:
             self._snapshot_timer = RepeatedTimer(
                 f"snapshot-{self.server_id}", opts.snapshot.interval_secs * 1000,
@@ -224,7 +347,7 @@ class Node:
 
         self.state = State.FOLLOWER
         self._last_leader_timestamp = time.monotonic()
-        self._election_timer.start()
+        self._ctrl.start_follower()
         LOG.info("%s initialized: term=%d conf=%s", self, self.current_term,
                  self.conf_entry.conf)
 
@@ -243,10 +366,10 @@ class Node:
                 return
             prev_state = self.state
             self.state = State.SHUTTING
-            for t in (self._election_timer, self._vote_timer,
-                      self._stepdown_timer, self._snapshot_timer):
-                if t:
-                    t.stop()
+            if self._ctrl is not None:
+                self._ctrl.shutdown()
+            if self._snapshot_timer:
+                self._snapshot_timer.stop()
             self.replicators.stop_all()
             if prev_state in (State.LEADER, State.TRANSFERRING):
                 self.fsm_caller.fail_pending_closures(
@@ -424,14 +547,12 @@ class Node:
             peer, match_index, self.conf_entry.conf, self.conf_entry.old_conf)
 
     def on_peer_ack(self, peer: PeerId, when: float) -> None:
-        self._peer_acks[peer] = when
+        self._ctrl.record_ack(peer, when)
 
     def list_alive_peers(self) -> list[PeerId]:
         """Peers heard from within one election timeout (leader only;
         reference: CliServiceImpl#getAlivePeers via Replicator lastRpcSendTimestamp)."""
-        horizon = time.monotonic() - self.options.election_timeout_ms / 1000.0
-        return [p for p in self.list_peers()
-                if p == self.server_id or self._peer_acks.get(p, 0.0) > horizon]
+        return self._ctrl.alive_peers()
 
     # ======================================================================
     # election machinery
@@ -548,21 +669,21 @@ class Node:
         if not self.conf_entry.contains(self.server_id):
             return
         LOG.info("%s starting election at term %d", self, self.current_term + 1)
-        self._election_timer.stop()
         self.state = State.CANDIDATE
+        self._ctrl.on_candidate()
         self.current_term += 1
         self.voted_for = self.server_id
         self.leader_id = EMPTY_PEER
         await asyncio.get_running_loop().run_in_executor(
             None, self._meta.set_term_and_voted_for, self.current_term,
             self.server_id)
-        ctx = _VoteCtx(conf, old_conf)
-        ctx.grant(self.server_id)
-        self._vote_ctx = ctx
         term = self.current_term
         last_id = self.log_manager.last_log_id()
-        self._vote_timer.start()
-        if ctx.is_granted():
+        # tally: TimerControl checks quorum inline per grant; the
+        # engine's device tick tallies the granted row and fires
+        # _on_engine_elected (start_vote_round only short-circuits the
+        # single-voter case)
+        if self._ctrl.start_vote_round():
             await self._become_leader()
             return
 
@@ -585,10 +706,8 @@ class Node:
                     await self._step_down(resp.term, Status.error(
                         RaftError.EHIGHERTERMRESPONSE, "vote response"))
                     return
-                if resp.granted:
-                    ctx.grant(peer)
-                    if ctx.is_granted():
-                        await self._become_leader()
+                if resp.granted and self._ctrl.grant_vote(peer):
+                    await self._become_leader()
 
         for p in set(conf.peers) | set(old_conf.peers):
             if p != self.server_id:
@@ -599,18 +718,40 @@ class Node:
             if self.state != State.CANDIDATE:
                 return
             if self.options.raft_options.step_down_when_vote_timedout:
-                self._vote_timer.stop()
+                self._ctrl.stop_vote_wait()
                 await self._step_down(self.current_term, Status.error(
                     RaftError.ERAFTTIMEDOUT, "vote timed out"))
             else:
                 await self._elect_self()  # retry
 
+    # -- engine-scheduled slow paths (EngineControl event masks) -----------
+
+    async def _on_election_due(self) -> None:
+        """Engine path: one deadline serves both the follower election
+        timeout and the candidate vote-round timeout; each handler
+        re-checks state under the lock, so at most one acts."""
+        await self._handle_election_timeout()
+        await self._handle_vote_timeout()
+
+    async def _on_engine_elected(self) -> None:
+        """Device tick saw a vote quorum in the granted row."""
+        async with self._lock:
+            if self.state != State.CANDIDATE:
+                return
+            if not self._ctrl.vote_quorum_now():
+                return  # conf changed under the round; let it time out
+            await self._become_leader()
+
+    async def _on_engine_quorum_dead(self) -> None:
+        """Device tick saw the quorum-ack age exceed the election
+        timeout (the stepDownTimer analog)."""
+        await self._check_dead_nodes()
+
     async def _become_leader(self) -> None:
         """Caller holds the lock; we are CANDIDATE with a vote quorum."""
-        self._vote_timer.stop()
         self.state = State.LEADER
         self.leader_id = self.server_id
-        self._peer_acks = {self.server_id: time.monotonic()}
+        self._ctrl.on_leader()
         LOG.info("%s became LEADER at term %d", self, self.current_term)
         for peer in self.conf_entry.list_peers():
             if peer != self.server_id:
@@ -641,7 +782,6 @@ class Node:
         self._term_first_index = last_id.index
         self.replicators.wake_all()
         self.fsm_caller.on_leader_start(term)
-        self._stepdown_timer.start()
         asyncio.ensure_future(self._flush_and_self_commit(term, last_id.index))
 
     async def _flush_and_self_commit(self, term: int, index: int) -> None:
@@ -661,10 +801,8 @@ class Node:
         LOG.info("%s step down at term %d -> %d: %s", self, self.current_term,
                  term, status)
         was_leader = self.state in (State.LEADER, State.TRANSFERRING)
-        if self.state == State.CANDIDATE:
-            self._vote_timer.stop()
+        self._ctrl.on_step_down(self.state == State.CANDIDATE, was_leader)
         if was_leader:
-            self._stepdown_timer.stop()
             self.replicators.stop_all()
             self.ballot_box.clear_pending()
             self.fsm_caller.fail_pending_closures(
@@ -684,7 +822,7 @@ class Node:
             self._conf_ctx.fail(Status.error(
                 RaftError.ENEWLEADER, "leader stepped down"))
             self._conf_ctx = None
-        self._election_timer.restart()
+        self._ctrl.on_follower()
 
     async def step_down_on_higher_term(self, term: int, reason: str) -> None:
         async with self._lock:
@@ -694,23 +832,14 @@ class Node:
 
     async def _check_dead_nodes(self) -> None:
         """Leader: step down if a quorum hasn't acked within the election
-        timeout (asymmetric-partition tolerance — NodeImpl#checkDeadNodes)."""
+        timeout (asymmetric-partition tolerance — NodeImpl#checkDeadNodes).
+        Scheduling: TimerControl's stepdown timer, or the engine tick's
+        step_down mask; the age itself is re-verified here in both."""
         async with self._lock:
             if not self.is_leader():
                 return
-            now = time.monotonic()
-            self._peer_acks[self.server_id] = now
-            conf, old_conf = self.conf_entry.conf, self.conf_entry.old_conf
-
-            def quorum_ack(peers: list[PeerId]) -> float:
-                acks = sorted((self._peer_acks.get(p, 0.0) for p in peers),
-                              reverse=True)
-                return acks[len(peers) // 2] if peers else 0.0
-
-            q_ack = quorum_ack(conf.peers)
-            if not old_conf.is_empty():
-                q_ack = min(q_ack, quorum_ack(old_conf.peers))
-            if now - q_ack >= self.options.election_timeout_ms / 1000.0:
+            if (self._ctrl.quorum_ack_age_s()
+                    >= self.options.election_timeout_ms / 1000.0):
                 await self._step_down(
                     self.current_term,
                     Status.error(RaftError.ERAFTTIMEDOUT,
@@ -720,17 +849,7 @@ class Node:
         """For LEASE_BASED reads: a quorum acked within lease window."""
         if not self.is_leader():
             return False
-        now = time.monotonic()
-        self._peer_acks[self.server_id] = now
-        conf = self.conf_entry.conf
-        acks = sorted((self._peer_acks.get(p, 0.0) for p in conf.peers),
-                      reverse=True)
-        if not acks:
-            return False
-        q_ack = acks[len(conf.peers) // 2]
-        lease_s = (self.options.election_timeout_ms
-                   * self.options.raft_options.leader_lease_time_ratio / 1000.0)
-        return now - q_ack < lease_s
+        return self._ctrl.lease_valid()
 
     # ======================================================================
     # RPC handlers (server side)
@@ -760,6 +879,7 @@ class Node:
                     None, self._meta.set_term_and_voted_for, self.current_term,
                     candidate)
                 self._last_leader_timestamp = time.monotonic()  # grant => reset
+                self._ctrl.note_leader_contact()
                 return RequestVoteResponse(term=self.current_term, granted=True)
             granted = log_ok and self.voted_for == candidate
             return RequestVoteResponse(term=self.current_term, granted=granted)
@@ -813,6 +933,7 @@ class Node:
                     term=self.current_term, success=False,
                     last_log_index=self.log_manager.last_log_index())
             self._last_leader_timestamp = time.monotonic()
+            self._ctrl.note_leader_contact()
 
             lm = self.log_manager
             if not req.entries:
@@ -1026,10 +1147,9 @@ class Node:
             self.replicators.stop_all()
             self.fsm_caller.fail_pending_closures(status)
         self.state = State.ERROR
-        for t in (self._election_timer, self._vote_timer,
-                  self._stepdown_timer, self._snapshot_timer):
-            if t:
-                t.stop()
+        self._ctrl.deactivate()
+        if self._snapshot_timer:
+            self._snapshot_timer.stop()
 
     def __str__(self) -> str:
         return f"Node<{self.group_id}/{self.server_id}>"

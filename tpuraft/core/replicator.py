@@ -71,6 +71,11 @@ class Replicator:
         self._running = True
         self._task = asyncio.ensure_future(self._run())
         node = self._node
+        if getattr(node._ctrl, "drives_heartbeats", False):
+            # engine control plane: the device tick's hb_due mask beats
+            # this replicator (batched via HeartbeatHub.pulse) — no
+            # per-replicator clock, no hub clock registration
+            return
         hub = None
         if (node.options.raft_options.coalesce_heartbeats
                 and node.node_manager is not None):
@@ -485,6 +490,9 @@ class ReplicatorGroup:
 
     def peers(self) -> list[PeerId]:
         return list(self._replicators)
+
+    def all(self) -> list[Replicator]:
+        return list(self._replicators.values())
 
     async def heartbeat_round(self) -> int:
         """Concurrent heartbeat to all peers; returns ack count (for SAFE

@@ -178,3 +178,18 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
 
 raft_tick_jit = jax.jit(raft_tick, donate_argnums=(0,),
                         static_argnames=("quorum_impl",))
+
+
+def raft_tick_outputs(state: GroupState, now_ms: jnp.ndarray,
+                      params: TickParams) -> TickOutputs:
+    """Outputs-only tick — what the engine consumes (its numpy mirrors
+    are the state of record between ticks, so the new GroupState is
+    never fetched)."""
+    return raft_tick(state, now_ms, params)[1]
+
+
+# ONE process-wide jitted instance: every MultiRaftEngine in the process
+# shares this trace cache, so the N-th engine's first tick does not
+# re-trace/re-compile (a ~0.5s event-loop stall per engine that round-1
+# style multi-engine tests turned into election storms).
+raft_tick_outputs_jit = jax.jit(raft_tick_outputs)
