@@ -157,12 +157,35 @@ def main():
     commits_per_sec = med["cps"]
     p50, p99 = med["p50"], med["p99"]
 
+    # the END-TO-END number (real store processes: native TCP + shared
+    # multilog fsync + engine plane) rides along from the last
+    # bench_e2e.py run, so the driver's record carries both planes
+    e2e = None
+    try:
+        import os
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_E2E.json")) as f:
+            d = json.load(f)
+        e2e = {
+            "commits_per_sec": d["value"],
+            "per_core_commits_per_sec":
+                d["extra"].get("per_core_commits_per_sec"),
+            "host_cores": d["extra"].get("host_cores"),
+            "lowload_single_group_ack_ms":
+                d["extra"].get("lowload_single_group_ack"),
+            "stack": d["extra"].get("stack"),
+        }
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "multiraft_batched_commits_per_sec_16k_groups",
         "value": round(commits_per_sec, 1),
         "unit": "commits/s",
         "vs_baseline": round(commits_per_sec / 1e6, 3),
         "extra": {
+            "e2e": e2e,
             "groups": G, "peer_slots": P, "voters": VOTERS,
             "pipeline_depth": DEPTH,
             "dispatch_ms": round(dispatch_s * 1000, 2),

@@ -40,9 +40,12 @@ class MultiRaftCluster:
             manager = NodeManager(server)
             self.net.bind(server)
             transport = InProcTransport(self.net, ep.endpoint)
+            # backend pinned to jax: conftest forces a CPU default
+            # backend, where "auto" resolves to numpy — these tests
+            # exist to cover the jax tick path
             engine = MultiRaftEngine(TickOptions(
                 max_groups=len(self.groups) + 4, max_peers=8,
-                tick_interval_ms=self.tick_ms))
+                tick_interval_ms=self.tick_ms, backend="jax"))
             await engine.start()
             self.engines[ep.endpoint] = engine
             factory = engine.ballot_box_factory()

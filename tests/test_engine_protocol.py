@@ -216,6 +216,42 @@ async def test_adaptive_tick_commit_ack_not_quantized():
         await c.stop_all()
 
 
+async def test_apply_batch_semantics():
+    """apply_batch (NodeImpl#executeApplyingTasks parity): one lock/flush
+    round stages N entries; every task acks individually; stale
+    expected_term tasks are rejected without poisoning the batch."""
+    from tpuraft.errors import RaftError
+
+    c = MultiRaftCluster(3, 1, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader(c.groups[0])
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in range(40)]
+        stale = loop.create_future()
+        tasks = [Task(data=b"b%d" % i, done=futs[i].set_result)
+                 for i in range(40)]
+        tasks.insert(20, Task(data=b"stale", expected_term=999,
+                              done=stale.set_result))
+        await leader.apply_batch(tasks)
+        sts = await asyncio.wait_for(asyncio.gather(*futs), 10)
+        assert all(st.is_ok() for st in sts)
+        st = await asyncio.wait_for(stale, 5)
+        assert st.raft_error == RaftError.EPERM
+        # replicas converge on the same 40 entries (stale one excluded)
+        deadline = asyncio.get_running_loop().time() + 10
+        while asyncio.get_running_loop().time() < deadline:
+            logs = [f.logs for f in c.fsms.values()]
+            if all(len(lg) >= 40 for lg in logs):
+                break
+            await asyncio.sleep(0.05)
+        logs = [f.logs for f in c.fsms.values()]
+        assert all(lg == logs[0] for lg in logs)
+        assert len(logs[0]) == 40 and b"stale" not in logs[0]
+    finally:
+        await c.stop_all()
+
+
 async def test_timer_mode_unchanged_without_engine():
     """Nodes without an engine box still run the reference-parity
     TimerControl (per-group timers)."""

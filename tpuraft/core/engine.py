@@ -597,8 +597,26 @@ class MultiRaftEngine:
 
     # -- tick loop -----------------------------------------------------------
 
+    def _resolve_backend(self) -> str:
+        """backend="auto": the jax device plane exists FOR accelerators —
+        on a CPU-only host the vectorized numpy twin of the tick beats
+        XLA-CPU dispatch overhead at any G that fits one box (profiled:
+        per-tick jit call overhead dominated small-G CPU ticks).  A mesh
+        request always means jax."""
+        b = self.opts.backend
+        if b != "auto":
+            return b
+        if self.opts.mesh_devices and self.opts.mesh_devices > 1:
+            return "jax"
+        try:
+            import jax
+
+            return "jax" if jax.default_backend() != "cpu" else "numpy"
+        except Exception:  # noqa: BLE001 — no jax at all
+            return "numpy"
+
     async def start(self) -> None:
-        if self.opts.backend != "numpy":
+        if self._resolve_backend() != "numpy":
             import jax
 
             from tpuraft.ops.tick import (raft_tick_outputs,
@@ -646,7 +664,7 @@ class MultiRaftEngine:
             # compile and miss every group's heartbeat window at once
             self.tick_once()
         if self.opts.profile_dir:
-            if self.opts.backend == "numpy":
+            if self._resolve_backend() == "numpy":
                 LOG.warning("profile_dir set but backend is numpy: the "
                             "XLA profiler only traces the jax tick path")
             else:
@@ -773,28 +791,30 @@ class MultiRaftEngine:
 
     def _device_tick(self, rel, commit_rel_now, now):
         import jax
-        import jax.numpy as jnp
 
         from tpuraft.ops.tick import GroupState, TickParams
 
         if self._params_dev is None:
             self._params_dev = TickParams.make(self.eto_ms, self.hb_ms,
                                                self.lease_ms)
+        # numpy mirrors go STRAIGHT into the jitted call — jit commits
+        # them to the device itself, and an explicit jnp.asarray per
+        # field doubles the per-tick host overhead (profiled: the
+        # asarray+device_put pair dominated small-G tick cost)
         state = GroupState(
-            role=jnp.asarray(self.role),
-            commit_rel=jnp.asarray(commit_rel_now),
-            pending_rel=jnp.asarray(self.pending_rel),
-            match_rel=jnp.asarray(rel),
-            granted=jnp.asarray(self.granted),
-            voter_mask=jnp.asarray(self.voter_mask),
-            old_voter_mask=jnp.asarray(self.old_voter_mask),
-            elect_deadline=jnp.asarray(
-                self.elect_deadline.astype(np.int32)),
-            hb_deadline=jnp.asarray(self.hb_deadline.astype(np.int32)),
-            last_ack=jnp.asarray(self.last_ack.astype(np.int32)),
+            role=self.role,
+            commit_rel=commit_rel_now,
+            pending_rel=self.pending_rel,
+            match_rel=rel,
+            granted=self.granted,
+            voter_mask=self.voter_mask,
+            old_voter_mask=self.old_voter_mask,
+            elect_deadline=self.elect_deadline.astype(np.int32),
+            hb_deadline=self.hb_deadline.astype(np.int32),
+            last_ack=self.last_ack.astype(np.int32),
         )
         with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
-            out = self._tick_fn(state, jnp.int32(now), self._params_dev)
+            out = self._tick_fn(state, np.int32(now), self._params_dev)
         return jax.tree_util.tree_map(np.asarray, out)
 
     def _np_tick(self, rel, commit_rel_now, now) -> _NpOutputs:

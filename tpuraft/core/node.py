@@ -435,26 +435,47 @@ class Node:
 
     async def apply(self, task: Task) -> None:
         """Replicate task.data; task.done(status) fires on commit/failure."""
+        await self.apply_batch([task])
+
+    async def apply_batch(self, tasks: list[Task]) -> None:
+        """Stage a BATCH of tasks as consecutive log entries under ONE
+        lock acquisition / flush wait (reference:
+        ``NodeImpl#executeApplyingTasks`` — the apply Disruptor drains up
+        to ``applyBatch=32`` tasks per event).  Each task still becomes
+        its own entry with its own completion closure."""
+        if not tasks:
+            return
         async with self._lock:
             if self.state != State.LEADER:
                 st = (Status.error(RaftError.EBUSY, "leadership transferring")
                       if self.state == State.TRANSFERRING
                       else Status.error(RaftError.EPERM,
                                         f"not leader (state={self.state.value})"))
-                if task.done:
-                    task.done(st)
+                for task in tasks:
+                    if task.done:
+                        task.done(st)
                 return
-            if task.expected_term not in (-1, self.current_term):
-                if task.done:
-                    task.done(Status.error(
-                        RaftError.EPERM,
-                        f"expected term {task.expected_term} != {self.current_term}"))
+            good: list[Task] = []
+            for task in tasks:
+                if task.expected_term not in (-1, self.current_term):
+                    if task.done:
+                        task.done(Status.error(
+                            RaftError.EPERM,
+                            f"expected term {task.expected_term} != "
+                            f"{self.current_term}"))
+                    continue
+                good.append(task)
+            if not good:
                 return
-            entry = LogEntry(type=EntryType.DATA, data=task.data)
+            entries = [LogEntry(type=EntryType.DATA, data=t.data)
+                       for t in good]
             term = self.current_term
-            last_id = self.log_manager.stage_leader_entries([entry], term)
-            if task.done:
-                self.fsm_caller.append_pending_closure(last_id.index, task.done)
+            last_id = self.log_manager.stage_leader_entries(entries, term)
+            first_index = last_id.index - len(good) + 1
+            for i, task in enumerate(good):
+                if task.done:
+                    self.fsm_caller.append_pending_closure(
+                        first_index + i, task.done)
             self.replicators.wake_all()
         # fsync outside the lock; batched with concurrent appliers
         await self.log_manager.flush_staged(last_id.index)

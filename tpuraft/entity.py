@@ -127,6 +127,14 @@ class LogEntry:
     # -- codec ---------------------------------------------------------------
 
     def encode(self) -> bytes:
+        # Entries are encoded several times on the hot path (log flush +
+        # once per follower); the blob is cached per LogId — staging
+        # assigns the id once, after which the entry is logically
+        # immutable (mirrors the reference encoding entries once into
+        # pooled buffers via ByteBufferCollector).
+        cached = self.__dict__.get("_enc")
+        if cached is not None and cached[0] == self.id:
+            return cached[1]
         peers_blob = _encode_peer_lists(
             self.peers, self.old_peers, self.learners, self.old_learners
         )
@@ -143,7 +151,9 @@ class LogEntry:
             len(self.data),
             crc,
         )
-        return hdr + peers_blob + self.data
+        blob = hdr + peers_blob + self.data
+        self._enc = (self.id, blob)
+        return blob
 
     @staticmethod
     def decode(buf: bytes | memoryview) -> "LogEntry":

@@ -318,9 +318,17 @@ class LogManager:
             entries = [e for r in batch for e in r.entries]
             try:
                 if entries:
-                    await loop.run_in_executor(
-                        None, self._storage.append_entries, entries, self._sync
-                    )
+                    # shared-engine storages expose an async hook whose
+                    # fsync joins a cross-GROUP commit round (multilog);
+                    # classic storages block an executor thread
+                    append_async = getattr(
+                        self._storage, "append_entries_async", None)
+                    if append_async is not None:
+                        await append_async(entries, self._sync)
+                    else:
+                        await loop.run_in_executor(
+                            None, self._storage.append_entries, entries,
+                            self._sync)
                     self._stable_index = max(self._stable_index, entries[-1].id.index)
                 for r in batch:
                     if not r.future.done():

@@ -473,4 +473,16 @@ def create_log_storage(uri: str) -> LogStorage:
                 f"not automatic: {exc}"
             ) from exc
         return NativeLogStorage(uri[len("native://"):])
+    if uri.startswith("multilog://"):
+        # shared multi-group journal engine: multilog://<dir>#<group_id>
+        # — every group of a process shares one engine and one fsync per
+        # flush round (tpuraft.storage.multilog)
+        rest = uri[len("multilog://"):]
+        if "#" not in rest:
+            raise ValueError(
+                "multilog:// needs a group fragment: multilog://<dir>#<group>")
+        dir_path, group = rest.rsplit("#", 1)
+        from tpuraft.storage.multilog import MultiLogStorage
+
+        return MultiLogStorage(dir_path, group)
     raise ValueError(f"unknown log storage uri: {uri}")

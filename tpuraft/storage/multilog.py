@@ -1,0 +1,325 @@
+"""Shared multi-group log engine bindings (native/multilog.cc).
+
+Reference parity: RocksDB as ONE log engine per process — all raft
+groups share a write stream and a flush round covers every group with a
+single fsync (``core:storage/impl/RocksDBLogStorage`` + RocksDB
+WriteBatch; SURVEY.md §3.1 log-storage row, §8.3 "group-sharded column
+spaces; batched group-fsync").  Round-1 gap (VERDICT #3): every group
+opened its own segment directory, so a process hosting 1K regions held
+thousands of fds and issued uncoalesced fsyncs.
+
+Wiring:
+  log_uri = "multilog://<dir>#<group_id>"
+One :class:`MultiLogEngine` per directory per process (registry below);
+each node's :class:`MultiLogStorage` is a per-group view.  Durability:
+``append_entries`` stages bytes; the engine's :class:`_GroupCommit`
+coalesces every concurrently-flushing group into ONE ``tlm_sync``
+(observable via ``sync_count``/``append_count``).  The LogManager uses
+the async ``append_entries_async`` hook when present, so flush waiters
+are futures, not blocked executor threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import os
+import struct
+import threading
+from typing import Optional
+
+from tpuraft.entity import LogEntry
+from tpuraft.storage.log_storage import LogStorage
+
+_FRAME = struct.Struct("<I")
+_LIB_NAME = "libtpuraft_multilog.so"
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+
+
+def ensure_built(timeout: float = 120.0) -> str:
+    path = os.path.join(_native_dir(), _LIB_NAME)
+    if not os.path.exists(path):
+        import fcntl
+        import subprocess
+
+        # cross-PROCESS build guard: concurrently-spawned stores on a
+        # fresh checkout must not race three `make`s onto one .so (a
+        # loser can dlopen a half-written file)
+        lock_path = os.path.join(_native_dir(), ".build.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(path):
+                subprocess.run(["make", "-C", _native_dir(), _LIB_NAME],
+                               check=True, timeout=timeout,
+                               capture_output=True)
+    return path
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(ensure_built())
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.tlm_open.restype = ctypes.c_void_p
+            lib.tlm_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_char_p, ctypes.c_int]
+            lib.tlm_close.argtypes = [ctypes.c_void_p]
+            lib.tlm_register_group.restype = ctypes.c_uint32
+            lib.tlm_register_group.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int]
+            for name in ("tlm_first", "tlm_last"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+            lib.tlm_append.restype = ctypes.c_int64
+            lib.tlm_append.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                       ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_char_p, ctypes.c_int]
+            lib.tlm_sync.restype = ctypes.c_int
+            lib.tlm_sync.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+            for name in ("tlm_sync_count", "tlm_append_count",
+                         "tlm_file_count", "tlm_gc"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p]
+            lib.tlm_get.restype = ctypes.c_int64
+            lib.tlm_get.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.c_int64, ctypes.POINTER(u8p)]
+            lib.tlm_free.argtypes = [u8p]
+            for name in ("tlm_truncate_prefix", "tlm_truncate_suffix",
+                         "tlm_reset"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_int64]
+            lib.tlm_conf_count.restype = ctypes.c_int64
+            lib.tlm_conf_count.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+            lib.tlm_conf_indexes.restype = ctypes.c_int64
+            lib.tlm_conf_indexes.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            _lib = lib
+        return _lib
+
+
+class _GroupCommit:
+    """Coalesces concurrent flush() calls into one tlm_sync round
+    (RocksDB group commit): callers that arrive while a round's fsync is
+    in flight wait for the NEXT round, which covers their staged bytes."""
+
+    def __init__(self, engine: "MultiLogEngine"):
+        self._engine = engine
+        self._waiters: list[asyncio.Future] = []
+        self._task: Optional[asyncio.Task] = None
+
+    async def flush(self) -> None:
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._run())
+        await fut
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._waiters:
+            batch, self._waiters = self._waiters, []
+            try:
+                await loop.run_in_executor(None, self._engine.sync)
+            except Exception as e:  # noqa: BLE001 — fail THIS round only
+                for f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+            else:
+                for f in batch:
+                    if not f.done():
+                        f.set_result(None)
+
+
+class MultiLogEngine:
+    """One shared journal engine (ctypes handle) + its group-commit."""
+
+    def __init__(self, dir_path: str, segment_max_bytes: int = 0):
+        self._lib = _load()
+        parent = os.path.dirname(dir_path.rstrip("/"))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        err = ctypes.create_string_buffer(256)
+        self._h = self._lib.tlm_open(dir_path.encode(), segment_max_bytes,
+                                     err, 256)
+        if not self._h:
+            raise IOError(f"multilog open failed: {err.value.decode()}")
+        self.dir = dir_path
+        self.group_commit = _GroupCommit(self)
+        self._refs = 0
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tlm_close(self._h)
+            self._h = None
+
+    def register_group(self, name: str) -> int:
+        err = ctypes.create_string_buffer(256)
+        gid = self._lib.tlm_register_group(self._h, name.encode(), err, 256)
+        if gid == 0:
+            raise IOError(f"multilog register failed: {err.value.decode()}")
+        return gid
+
+    def sync(self) -> None:
+        err = ctypes.create_string_buffer(256)
+        if self._lib.tlm_sync(self._h, err, 256) != 0:
+            raise IOError(f"multilog sync failed: {err.value.decode()}")
+
+    @property
+    def sync_count(self) -> int:
+        return self._lib.tlm_sync_count(self._h)
+
+    @property
+    def append_count(self) -> int:
+        return self._lib.tlm_append_count(self._h)
+
+    @property
+    def file_count(self) -> int:
+        return self._lib.tlm_file_count(self._h)
+
+    def gc(self) -> int:
+        return self._lib.tlm_gc(self._h)
+
+
+# -- process-level engine registry (one engine per directory) ----------------
+
+_engines_lock = threading.Lock()
+_engines: dict[str, MultiLogEngine] = {}
+
+
+def get_engine(dir_path: str, segment_max_bytes: int = 0) -> MultiLogEngine:
+    key = os.path.realpath(dir_path)
+    with _engines_lock:
+        eng = _engines.get(key)
+        if eng is None or eng._h is None:
+            eng = MultiLogEngine(dir_path, segment_max_bytes)
+            _engines[key] = eng
+        eng._refs += 1
+        return eng
+
+
+def _release_engine(eng: MultiLogEngine) -> None:
+    key = os.path.realpath(eng.dir)
+    with _engines_lock:
+        eng._refs -= 1
+        if eng._refs <= 0:
+            _engines.pop(key, None)
+            eng.close()
+
+
+class MultiLogStorage(LogStorage):
+    """Per-group view over the shared engine; selected by
+    ``multilog://<dir>#<group_id>``."""
+
+    def __init__(self, dir_path: str, group: str):
+        self._dir = dir_path
+        self._group = group
+        self._eng: Optional[MultiLogEngine] = None
+        self._gid = 0
+        self._lib = _load()
+
+    @property
+    def engine(self) -> MultiLogEngine:
+        assert self._eng is not None, "init() first"
+        return self._eng
+
+    def init(self) -> None:
+        self._eng = get_engine(self._dir)
+        self._gid = self._eng.register_group(self._group)
+
+    def shutdown(self) -> None:
+        if self._eng is not None:
+            _release_engine(self._eng)
+            self._eng = None
+
+    def first_log_index(self) -> int:
+        return self._lib.tlm_first(self._eng._h, self._gid)
+
+    def last_log_index(self) -> int:
+        return self._lib.tlm_last(self._eng._h, self._gid)
+
+    def get_entry(self, index: int) -> Optional[LogEntry]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.tlm_get(self._eng._h, self._gid, index,
+                              ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            blob = ctypes.string_at(out, n)
+        finally:
+            self._lib.tlm_free(out)
+        return LogEntry.decode(blob)
+
+    def _stage(self, entries: list[LogEntry]) -> int:
+        parts = []
+        for e in entries:
+            blob = e.encode()
+            parts.append(_FRAME.pack(len(blob)))
+            parts.append(blob)
+        frames = b"".join(parts)
+        err = ctypes.create_string_buffer(256)
+        n = self._lib.tlm_append(self._eng._h, self._gid, frames,
+                                 len(frames), err, 256)
+        if n < 0:
+            raise ValueError(f"multilog append failed: {err.value.decode()}")
+        return n
+
+    def append_entries(self, entries: list[LogEntry], sync: bool = True) -> int:
+        """Synchronous path (executor callers): per-call fsync, no
+        cross-group coalescing — prefer append_entries_async."""
+        if not entries:
+            return 0
+        n = self._stage(entries)
+        if sync:
+            self._eng.sync()
+        return n
+
+    async def append_entries_async(self, entries: list[LogEntry],
+                                   sync: bool = True) -> int:
+        """LogManager hook: stage inline (ctypes releases the GIL for
+        the buffered write — no executor hop), then join the engine-wide
+        group commit — N groups flushing concurrently cost ONE fsync."""
+        if not entries:
+            return 0
+        n = self._stage(entries)
+        if sync:
+            await self._eng.group_commit.flush()
+        return n
+
+    def truncate_prefix(self, first_index_kept: int) -> None:
+        if self._lib.tlm_truncate_prefix(self._eng._h, self._gid,
+                                         first_index_kept) != 0:
+            raise IOError("multilog truncate_prefix failed")
+        self._eng.gc()  # opportunistic: drop fully-dead journal files
+
+    def truncate_suffix(self, last_index_kept: int) -> None:
+        if self._lib.tlm_truncate_suffix(self._eng._h, self._gid,
+                                         last_index_kept) != 0:
+            raise IOError("multilog truncate_suffix failed")
+
+    def reset(self, next_log_index: int) -> None:
+        if self._lib.tlm_reset(self._eng._h, self._gid, next_log_index) != 0:
+            raise IOError("multilog reset failed")
+
+    def configuration_indexes(self) -> list[int]:
+        n = self._lib.tlm_conf_count(self._eng._h, self._gid)
+        if n == 0:
+            return []
+        buf = (ctypes.c_int64 * n)()
+        got = self._lib.tlm_conf_indexes(self._eng._h, self._gid, buf, n)
+        return list(buf[:got])
