@@ -314,14 +314,26 @@ def main() -> None:
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env))
 
         def expect(p, prefix, timeout_s=180.0):
+            import select
+
             t0 = time.monotonic()
-            while time.monotonic() - t0 < timeout_s:
+            while True:
+                left = timeout_s - (time.monotonic() - t0)
+                if left <= 0:
+                    raise TimeoutError(f"no {prefix!r} from store")
+                # readline() alone would block past the deadline on a
+                # silent-but-alive store; gate it on pipe readability
+                ready, _, _ = select.select([p.stdout], [], [],
+                                            min(left, 1.0))
+                if not ready:
+                    if p.poll() is not None:
+                        raise RuntimeError("store process died")
+                    continue
                 line = p.stdout.readline().decode().strip()
                 if line.startswith(prefix):
                     return line
                 if not line and p.poll() is not None:
                     raise RuntimeError("store process died")
-            raise TimeoutError(f"no {prefix!r} from store")
 
         for p in procs:
             expect(p, "BOOTED")

@@ -640,28 +640,43 @@ int64_t tlm_file_count(tlm_handle* h) {
 }
 
 // Returns blob length and sets *out (caller frees with tlm_free), or -1.
+// The preads run OUTSIDE the engine mutex (a cold read must not stall
+// every group's appends); the fd is dup'd under the lock so a racing
+// GC unlink/close cannot invalidate it mid-read.
 int64_t tlm_get(tlm_handle* h, uint32_t gid, int64_t index, uint8_t** out) {
-  std::lock_guard<std::mutex> g(h->mu);
-  auto it = h->groups.find(gid);
-  if (it == h->groups.end()) return -1;
-  GroupLog& gl = it->second;
-  if (index < gl.first || !gl.has(index)) return -1;
-  Loc loc = gl.positions[(size_t)(index - gl.base)];
-  JournalFile* f = h->file_by_seq(loc.file);
-  if (!f) return -1;
-  uint8_t hdr[kRecHdr];
-  if (::pread(f->fd, hdr, kRecHdr, loc.off) != (ssize_t)kRecHdr) return -1;
-  uint32_t len = load_u32(hdr);
-  if (len < 9) return -1;
-  uint32_t blen = len - 9;
-  uint8_t* blob = (uint8_t*)malloc(blen ? blen : 1);
-  if (!blob) return -1;
-  if (::pread(f->fd, blob, blen, loc.off + kRecHdr) != (ssize_t)blen) {
-    free(blob);
-    return -1;
+  int fd = -1;
+  Loc loc{0, 0};
+  {
+    std::lock_guard<std::mutex> g(h->mu);
+    auto it = h->groups.find(gid);
+    if (it == h->groups.end()) return -1;
+    GroupLog& gl = it->second;
+    if (index < gl.first || !gl.has(index)) return -1;
+    loc = gl.positions[(size_t)(index - gl.base)];
+    JournalFile* f = h->file_by_seq(loc.file);
+    if (!f) return -1;
+    fd = ::dup(f->fd);
+    if (fd < 0) return -1;
   }
-  *out = blob;
-  return (int64_t)blen;
+  int64_t result = -1;
+  uint8_t hdr[kRecHdr];
+  if (::pread(fd, hdr, kRecHdr, loc.off) == (ssize_t)kRecHdr) {
+    uint32_t len = load_u32(hdr);
+    if (len >= 9) {
+      uint32_t blen = len - 9;
+      uint8_t* blob = (uint8_t*)malloc(blen ? blen : 1);
+      if (blob) {
+        if (::pread(fd, blob, blen, loc.off + kRecHdr) == (ssize_t)blen) {
+          *out = blob;
+          result = (int64_t)blen;
+        } else {
+          free(blob);
+        }
+      }
+    }
+  }
+  ::close(fd);
+  return result;
 }
 
 void tlm_free(uint8_t* buf) { free(buf); }

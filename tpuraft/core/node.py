@@ -247,6 +247,8 @@ class Node:
         # control plane: TimerControl (per-group timers, reference
         # parity) or EngineControl (device-tick masks) — set in init()
         self._ctrl = None
+        self._note_append_start = None  # replica-plane hooks (init())
+        self._note_attested = None
         self._snapshot_timer: Optional[RepeatedTimer] = None
         self._last_leader_timestamp = time.monotonic()
         # index of the first entry appended in THIS leadership term (the
@@ -294,6 +296,16 @@ class Node:
 
         # fsm pipeline
         self.ballot_box = self._ballot_box_factory(self._on_committed)
+        # replica-plane boxes tap the log's durable-advance stream (their
+        # row of the [R, G] collective commit plane IS this node's
+        # stable index — no ack echo needed for co-located replicas) and
+        # the attestation hooks that term-scope the row (plane SAFETY)
+        attach = getattr(self.ballot_box, "attach_log_manager", None)
+        if attach is not None:
+            attach(self.log_manager)
+        self._note_append_start = getattr(
+            self.ballot_box, "note_append_start", None)
+        self._note_attested = getattr(self.ballot_box, "note_attested", None)
         self.fsm_caller = FSMCaller(
             opts.fsm, self.log_manager,
             apply_batch=opts.raft_options.apply_batch,
@@ -780,6 +792,9 @@ class Node:
         for learner in set(self.conf_entry.conf.learners) | set(
                 self.conf_entry.old_conf.learners):
             self.replicators.add(learner)
+        if self._note_attested is not None:
+            # the leader's log is trivially consistent with itself
+            self._note_attested(self.current_term)
         self.ballot_box.reset_pending_index(
             self.log_manager.last_log_index() + 1)
         # commit a CONFIGURATION entry for the current conf: safely commits
@@ -977,10 +992,17 @@ class Node:
                         conflict_index=hint)
                 self.ballot_box.set_last_committed_index(
                     min(req.committed_index, req.prev_log_index))
+                if self._note_attested is not None and \
+                        req.prev_log_index >= lm.last_log_index():
+                    # heartbeat AT our tail: whole log prefix-matches
+                    # the leader's (replica-plane attestation)
+                    self._note_attested(req.term)
                 return AppendEntriesResponse(
                     term=self.current_term, success=True,
                     last_log_index=lm.last_log_index())
 
+            if self._note_append_start is not None:
+                self._note_append_start(req.term)
             try:
                 ok = await lm.append_entries_follower(
                     req.prev_log_index, req.prev_log_term, list(req.entries))
@@ -1007,6 +1029,11 @@ class Node:
             self.ballot_box.set_last_committed_index(
                 min(req.committed_index,
                     req.prev_log_index + len(req.entries)))
+            if self._note_attested is not None and \
+                    lm.last_log_index() == req.prev_log_index + len(req.entries):
+                # the append covered our tail: log is a verified prefix
+                # of the leader's (replica-plane attestation)
+                self._note_attested(req.term)
             return AppendEntriesResponse(
                 term=self.current_term, success=True,
                 last_log_index=lm.last_log_index())

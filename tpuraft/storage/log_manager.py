@@ -71,6 +71,12 @@ class LogManager:
         self._flusher: Optional[asyncio.Task] = None
         self._waiters: list[tuple[int, asyncio.Future]] = []
         self._stopped = False
+        # durable-advance hook: called with the new stable index after
+        # every storage flush — the bridge that ships this replica's
+        # (group, lastDurableIndex) into a replica-axis commit plane
+        # (tpuraft.parallel.replica_plane; SURVEY §6 "ships (groupId,
+        # peerId, lastLogIndex) tick-tensors ... into the JAX process")
+        self.on_stable = None  # Optional[Callable[[int], None]]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -330,6 +336,8 @@ class LogManager:
                             None, self._storage.append_entries, entries,
                             self._sync)
                     self._stable_index = max(self._stable_index, entries[-1].id.index)
+                    if self.on_stable is not None:
+                        self.on_stable(self._stable_index)
                 for r in batch:
                     if not r.future.done():
                         r.future.set_result(True)
@@ -372,6 +380,11 @@ class LogManager:
         self._last_index = last_index_kept
         self._stable_index = min(self._stable_index, last_index_kept)
         self.conf_manager.truncate_suffix(last_index_kept)
+        if self.on_stable is not None:
+            # the durable tip MOVED DOWN: replica-plane rows must follow
+            # (a stale-high row would count truncated entries toward a
+            # quorum — the divergent-suffix hazard)
+            self.on_stable(self._stable_index)
 
     # -- snapshot interaction ------------------------------------------------
 
@@ -401,6 +414,8 @@ class LogManager:
             self._last_index = snapshot_id.index
             self._stable_index = snapshot_id.index
             self.conf_manager.truncate_prefix(self._first_index)
+            if self.on_stable is not None:
+                self.on_stable(self._stable_index)  # tip moved (reset)
             return
         first_kept = max(self._first_index, first_kept)
         if first_kept > self._first_index:

@@ -176,8 +176,11 @@ class MultiLogEngine:
         return gid
 
     def sync(self) -> None:
+        h = self._h
+        if not h:
+            raise IOError("multilog engine closed")
         err = ctypes.create_string_buffer(256)
-        if self._lib.tlm_sync(self._h, err, 256) != 0:
+        if self._lib.tlm_sync(h, err, 256) != 0:
             raise IOError(f"multilog sync failed: {err.value.decode()}")
 
     @property
@@ -217,9 +220,17 @@ def _release_engine(eng: MultiLogEngine) -> None:
     key = os.path.realpath(eng.dir)
     with _engines_lock:
         eng._refs -= 1
-        if eng._refs <= 0:
-            _engines.pop(key, None)
-            eng.close()
+        if eng._refs > 0:
+            return
+        _engines.pop(key, None)
+    # a group-commit fsync may still be running in an executor thread;
+    # tlm_close deletes the handle, so closing under it is a
+    # use-after-free — defer until the flusher task drains
+    task = eng.group_commit._task
+    if task is not None and not task.done():
+        task.add_done_callback(lambda _t: eng.close())
+    else:
+        eng.close()
 
 
 class MultiLogStorage(LogStorage):
