@@ -157,6 +157,37 @@ def main():
     commits_per_sec = med["cps"]
     p50, p99 = med["p50"], med["p99"]
 
+    # quorum kernel auto-selection on THIS device (VERDICT r1 #4): try
+    # the Pallas kernel, A/B it against XLA when it compiles, record
+    # the failure reason when it can't (tunneled TPUs: Mosaic
+    # remote-compile 500 — direct-attach hardware required)
+    from tpuraft.ops.quorum_pallas import (_fused_quorum_pallas,
+                                           _fused_quorum_xla, select_impl)
+
+    impl, impl_reason = select_impl()
+    quorum_impl = {"impl": impl, "reason": impl_reason}
+    if impl == "pallas":
+        gq, pq = G, P
+        rngq = np.random.default_rng(1)
+        m = jnp.asarray(rngq.integers(0, 1000, (gq, pq)).astype(np.int32))
+        gr = jnp.asarray(rngq.random((gq, pq)) < 0.5)
+        ak = jnp.asarray(rngq.integers(0, 10**6, (gq, pq)).astype(np.int32))
+        vmq = np.zeros((gq, pq), bool)
+        vmq[:, :VOTERS] = True
+        vmq = jnp.asarray(vmq)
+        ovq = jnp.zeros((gq, pq), bool)
+        times = {}
+        for name, fn in (("xla", _fused_quorum_xla),
+                         ("pallas", _fused_quorum_pallas)):
+            jax.block_until_ready(fn(m, gr, ak, vmq, ovq))  # warm
+            t0 = time.perf_counter()
+            for _ in range(20):
+                r = fn(m, gr, ak, vmq, ovq)
+            jax.block_until_ready(r)
+            times[name] = (time.perf_counter() - t0) / 20
+        quorum_impl["pallas_speedup"] = round(
+            times["xla"] / times["pallas"], 3)
+
     # the END-TO-END number (real store processes: native TCP + shared
     # multilog fsync + engine plane) rides along from the last
     # bench_e2e.py run, so the driver's record carries both planes
@@ -186,6 +217,7 @@ def main():
         "vs_baseline": round(commits_per_sec / 1e6, 3),
         "extra": {
             "e2e": e2e,
+            "quorum_impl": quorum_impl,
             "groups": G, "peer_slots": P, "voters": VOTERS,
             "pipeline_depth": DEPTH,
             "dispatch_ms": round(dispatch_s * 1000, 2),

@@ -94,6 +94,84 @@ async def test_pd_ordered_auto_split():
         assert len(regions) >= 2
 
 
+async def test_split_decision_survives_pd_failover():
+    """VERDICT r1 #8: the split DECISION is replicated PD state.  Order
+    a split, kill the PD leader before the store reports completion —
+    the new leader must re-issue the SAME child region id, never
+    allocate a duplicate."""
+
+    from tpuraft.rheakv.pd_messages import (Instruction,
+                                            RegionHeartbeatRequest)
+    from tpuraft.rheakv.metadata import Region, RegionEpoch
+
+    async with pd_cluster(split_threshold_keys=1000) as c:
+        leader = await c.wait_pd_leader()
+        region = Region(id=7, start_key=b"", end_key=b"",
+                        peers=list(c.endpoints),
+                        epoch=RegionEpoch(1, 1))
+        pd = c.pd_client()
+
+        async def beat(keys: int) -> list[Instruction]:
+            # route to whoever currently leads the PD group
+            for srv in list(c.pd_servers.values()):
+                node = srv.node
+                if node is not None and node.is_leader():
+                    resp = await srv._region_heartbeat(
+                        RegionHeartbeatRequest(
+                            region=region.encode(),
+                            leader=c.endpoints[0],
+                            approximate_keys=keys))
+                    return [Instruction.decode(b)
+                            for b in resp.instructions]
+            return []
+
+        # oversize region -> exactly one split instruction
+        ins = await beat(5000)
+        assert len(ins) == 1 and ins[0].kind == Instruction.KIND_SPLIT
+        child_id = ins[0].new_region_id
+        assert child_id >= 1024
+
+        # the decision must be durable in the FSM before the kill
+        assert leader.fsm.pending_splits.get(7) == child_id
+
+        # PD leader dies before the store executes the split
+        await c.stop_pd(leader.server_id.endpoint)
+        new_leader = await c.wait_pd_leader()
+        # replicated decision survived the failover
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                new_leader.fsm.pending_splits.get(7) != child_id:
+            await asyncio.sleep(0.05)
+        assert new_leader.fsm.pending_splits.get(7) == child_id
+
+        # still-oversize heartbeats at the NEW leader re-issue the SAME
+        # child id — no duplicate allocation, ever
+        ids = set()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and not ids:
+            for i in await beat(5000):
+                if i.kind == Instruction.KIND_SPLIT:
+                    ids.add(i.new_region_id)
+            await asyncio.sleep(0.2)
+        assert ids == {child_id}, ids
+
+        # the split completing clears the decision; future splits allowed
+        parent_done = Region(id=7, start_key=b"", end_key=b"m",
+                             peers=list(c.endpoints),
+                             epoch=RegionEpoch(1, 2))
+        child_done = Region(id=child_id, start_key=b"m", end_key=b"",
+                            peers=list(c.endpoints),
+                            epoch=RegionEpoch(1, 2))
+        from tpuraft.rheakv.pd_messages import ReportSplitRequest
+
+        for srv in list(c.pd_servers.values()):
+            node = srv.node
+            if node is not None and node.is_leader():
+                await srv._report_split(ReportSplitRequest(
+                    parent=parent_done.encode(), child=child_done.encode()))
+        assert new_leader.fsm.pending_splits.get(7) is None
+
+
 async def test_client_with_remote_pd():
     async with pd_cluster() as c:
         await c.wait_pd_leader()

@@ -97,6 +97,15 @@ def _load() -> ctypes.CDLL:
             lib.tkv_wal_bytes.argtypes = [ctypes.c_void_p]
             lib.tkv_count.restype = ctypes.c_int64
             lib.tkv_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.tkv_open2.restype = ctypes.c_void_p
+            lib.tkv_open2.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_char_p,
+                                      ctypes.c_int]
+            lib.tkv_run_count.restype = ctypes.c_int64
+            lib.tkv_run_count.argtypes = [ctypes.c_void_p]
+            lib.tkv_mem_bytes.restype = ctypes.c_int64
+            lib.tkv_mem_bytes.argtypes = [ctypes.c_void_p]
             _lib = lib
         return _lib
 
@@ -116,15 +125,30 @@ class NativeRawKVStore(RawKVStore):
     """RawKVStore over the C++ engine; selected by ``native://<dir>``."""
 
     def __init__(self, dir_path: str, sync: bool = True,
-                 checkpoint_wal_bytes: int = 0):
+                 checkpoint_wal_bytes: int = 0,
+                 memtable_budget_bytes: int = 0, max_runs: int = 0):
+        """memtable_budget_bytes > 0 enables the LSM tier (the RocksDB
+        >RAM role): the memtable spills to immutable sorted runs at the
+        budget, background compaction merges runs past ``max_runs``, and
+        recovery replays at most one memtable of WAL.  0 keeps the
+        bounded-by-RAM memtable+checkpoint engine."""
         self._dir = dir_path
         self._lib = _load()
         err = ctypes.create_string_buffer(256)
-        h = self._lib.tkv_open(dir_path.encode(), 1 if sync else 0,
-                               checkpoint_wal_bytes, err, 256)
+        h = self._lib.tkv_open2(dir_path.encode(), 1 if sync else 0,
+                                checkpoint_wal_bytes, memtable_budget_bytes,
+                                max_runs, err, 256)
         if not h:
             raise IOError(f"native kv open failed: {err.value.decode()}")
         self._h = h
+
+    @property
+    def run_count(self) -> int:
+        return self._lib.tkv_run_count(self._handle())
+
+    @property
+    def mem_bytes(self) -> int:
+        return self._lib.tkv_mem_bytes(self._handle())
 
     def close(self) -> None:
         if self._h is not None:

@@ -56,6 +56,7 @@ _CMD_STORE_UPSERT = 1
 _CMD_REGION_UPSERT = 2
 _CMD_SPLIT = 3
 _CMD_ALLOC_ID = 4
+_CMD_SPLIT_ISSUED = 5   # alloc child id + record the pending decision
 
 
 def _cmd(kind: int, payload: bytes = b"") -> bytes:
@@ -76,6 +77,12 @@ class PDMetadataFSM(StateMachine):
         self.regions: dict[int, Region] = {}
         self.region_leaders: dict[int, str] = {}
         self.next_region_id: int = 1024  # user regions allocate upward
+        # REPLICATED split decisions (VERDICT r1 #8): parent region ->
+        # allocated child id.  A PD failover must not re-decide a split
+        # that was already ordered — the new leader re-issues the SAME
+        # child id (idempotent at the store) instead of allocating a
+        # duplicate.  Cleared when the split is reported done.
+        self.pending_splits: dict[int, int] = {}
 
     async def on_apply(self, it: Iterator) -> None:
         while it.valid():
@@ -114,10 +121,23 @@ class PDMetadataFSM(StateMachine):
                 if leader:
                     self.region_leaders[region.id] = leader
             return True
+        if kind == _CMD_SPLIT_ISSUED:
+            (parent_id,) = struct.unpack_from("<q", payload, 0)
+            already = self.pending_splits.get(parent_id)
+            if already is not None:
+                return already  # idempotent: same child id re-issued
+            rid = self.next_region_id
+            self.next_region_id += 1
+            self.pending_splits[parent_id] = rid
+            return rid
         if kind == _CMD_SPLIT:
             (pn,) = struct.unpack_from("<I", payload, 0)
             parent = Region.decode(payload[4:4 + pn])
             child = Region.decode(payload[4 + pn:])
+            # clear only the MATCHING decision: a stale replayed report
+            # (client retry) must not erase a newer pending split
+            if self.pending_splits.get(parent.id) == child.id:
+                self.pending_splits.pop(parent.id, None)
             # epoch-guarded like _CMD_REGION_UPSERT: a replayed
             # report_split (client retry after a lost response) must not
             # stomp fresher metadata from heartbeats or a later split
@@ -148,6 +168,9 @@ class PDMetadataFSM(StateMachine):
             leader = self.region_leaders.get(rid, "").encode()
             out += struct.pack("<I", len(blob)) + blob
             out += struct.pack("<H", len(leader)) + leader
+        out += struct.pack("<I", len(self.pending_splits))
+        for parent_id, child_id in self.pending_splits.items():
+            out += struct.pack("<qq", parent_id, child_id)
         writer.write_file("pd_meta", bytes(out))
         done(Status.OK())
 
@@ -185,6 +208,14 @@ class PDMetadataFSM(StateMachine):
             self.regions[region.id] = region
             if leader:
                 self.region_leaders[region.id] = leader
+        self.pending_splits = {}
+        if off + 4 <= len(buf):  # absent in pre-pending-split snapshots
+            (np_,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            for _ in range(np_):
+                parent_id, child_id = struct.unpack_from("<qq", buf, off)
+                off += 16
+                self.pending_splits[parent_id] = child_id
         return True
 
 
@@ -452,8 +483,20 @@ class PlacementDriverServer:
             await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
         self.stats.record(region.id, req.approximate_keys)
         instructions: list[Instruction] = []
-        if self.stats.should_split(region.id):
-            new_id = await self._apply(_cmd(_CMD_ALLOC_ID))
+        pending_child = self.fsm.pending_splits.get(region.id)
+        if pending_child is not None:
+            # a split was already ORDERED (possibly by a previous PD
+            # leader — the decision is replicated): re-issue the SAME
+            # child id while the region still reports oversize, paced by
+            # the leader-local cooldown.  Never allocate a duplicate.
+            if self.stats.should_split(region.id):
+                self.stats.mark_split_issued(region.id)
+                instructions.append(Instruction(
+                    kind=Instruction.KIND_SPLIT, region_id=region.id,
+                    new_region_id=pending_child))
+        elif self.stats.should_split(region.id):
+            new_id = await self._apply(_cmd(
+                _CMD_SPLIT_ISSUED, struct.pack("<q", region.id)))
             self.stats.mark_split_issued(region.id)
             instructions.append(Instruction(
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
