@@ -106,7 +106,8 @@ class CounterServer:
     (reference: CounterServer boots RaftGroupService and registers the
     counter processors on the shared RpcServer)."""
 
-    def __init__(self, me: PeerId, conf: Configuration, data_dir: str | None):
+    def __init__(self, me: PeerId, conf: Configuration, data_dir: str | None,
+                 config_yaml: str | None = None):
         self.me = me
         self.conf = conf
         self.fsm = CounterStateMachine()
@@ -115,11 +116,34 @@ class CounterServer:
         self.transport = TcpTransport(endpoint=me.endpoint)
         self.node: Node | None = None
         self.data_dir = data_dir
+        self.config_yaml = config_yaml
 
     async def start(self) -> None:
         await self.server.start()
         CliProcessors(self.manager)
-        opts = NodeOptions(initial_conf=self.conf.copy(), fsm=self.fsm)
+        if self.config_yaml:
+            # tunables from YAML (SURVEY §6 config layer); topology and
+            # storage placement come from the CLI here, so a YAML that
+            # also sets them is a CONFLICT, not a silent override
+            from tpuraft.config import load_node_options
+
+            opts = load_node_options(self.config_yaml)
+            conflicts = [name for name, dflt in [
+                ("initial_conf", Configuration()),
+                ("fsm", None)] if getattr(opts, name) != dflt]
+            if self.data_dir:
+                conflicts += [n for n in ("log_uri", "raft_meta_uri",
+                                          "snapshot_uri")
+                              if getattr(opts, n)]
+            if conflicts:
+                raise SystemExit(
+                    f"--config sets {conflicts}, which --peers/--data "
+                    f"control on the counter CLI — remove them from "
+                    f"the YAML or drop the flags")
+        else:
+            opts = NodeOptions()
+        opts.initial_conf = self.conf.copy()
+        opts.fsm = self.fsm
         if self.data_dir:
             opts.log_uri = f"file://{self.data_dir}/log"
             opts.raft_meta_uri = f"file://{self.data_dir}/meta"
@@ -282,7 +306,8 @@ async def demo(n: int = 3, increments: int = 10, data_root: str | None = None,
 
 async def _serve(args) -> None:
     conf = Configuration.parse(args.peers)
-    server = CounterServer(PeerId.parse(args.serve), conf, args.data)
+    server = CounterServer(PeerId.parse(args.serve), conf, args.data,
+                           config_yaml=args.config)
     await server.start()
     print(f"counter member {args.serve} up (group={GROUP})")
     try:
@@ -309,6 +334,7 @@ def main() -> None:
     ap.add_argument("--serve", help="ip:port to serve as a cluster member")
     ap.add_argument("--peers", help="comma-separated cluster conf")
     ap.add_argument("--data", help="data dir (omit for in-memory)")
+    ap.add_argument("--config", help="YAML options file (tpuraft.config)")
     ap.add_argument("--incr", type=int, help="client: increment by N")
     ap.add_argument("--get", action="store_true", help="client: read value")
     args = ap.parse_args()

@@ -1,0 +1,80 @@
+"""YAML config layer (SURVEY §6 "dataclass tree + YAML"; closes the
+round-1 partial on the config/flag row)."""
+
+import pytest
+
+from tpuraft.config import hydrate, load_node_options, node_options_from_dict
+from tpuraft.options import NodeOptions, RaftOptions, ReadOnlyOption
+
+
+def test_nested_hydration_and_enums(tmp_path):
+    p = tmp_path / "cluster.yaml"
+    p.write_text("""
+node:
+  election_timeout_ms: 1500
+  log_uri: multilog:///data/mlog#g1
+  initial_conf: "127.0.0.1:8001,127.0.0.1:8002,127.0.0.1:8003"
+  raft_options:
+    max_inflight_msgs: 128
+    coalesce_heartbeats: true
+    read_only_option: lease_based
+  tick:
+    max_groups: 4096
+    backend: auto
+    pace_factor: 1
+  snapshot:
+    interval_secs: 600
+""")
+    opts = load_node_options(str(p))
+    assert isinstance(opts, NodeOptions)
+    assert opts.election_timeout_ms == 1500
+    assert opts.log_uri == "multilog:///data/mlog#g1"
+    assert len(opts.initial_conf.peers) == 3
+    assert opts.raft_options.max_inflight_msgs == 128
+    assert opts.raft_options.coalesce_heartbeats is True
+    assert opts.raft_options.read_only_option is ReadOnlyOption.LEASE_BASED
+    assert opts.tick.max_groups == 4096
+    assert opts.tick.pace_factor == 1.0  # int -> float coercion
+    assert opts.snapshot.interval_secs == 600
+    # untouched fields keep dataclass defaults
+    assert opts.raft_options.max_entries_size == \
+        RaftOptions().max_entries_size
+
+
+def test_unknown_key_raises():
+    with pytest.raises(KeyError, match="election_timeout_msX"):
+        node_options_from_dict({"election_timeout_msX": 5})
+    with pytest.raises(KeyError, match="raft_options.max_inflightX"):
+        node_options_from_dict(
+            {"raft_options": {"max_inflightX": 1}})
+
+
+def test_type_and_enum_errors():
+    with pytest.raises(TypeError, match="election_timeout_ms"):
+        node_options_from_dict({"election_timeout_ms": "soon"})
+    with pytest.raises(ValueError, match="read_only_option"):
+        node_options_from_dict(
+            {"raft_options": {"read_only_option": "psychic"}})
+    # YAML 1.1 'on'/'yes' -> True; booleans must not hydrate int/float
+    with pytest.raises(TypeError, match="max_inflight_msgs"):
+        node_options_from_dict(
+            {"raft_options": {"max_inflight_msgs": True}})
+
+
+def test_sibling_toplevel_keys_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("node:\n  election_timeout_ms: 500\ntick:\n"
+                 "  max_groups: 64\n")
+    with pytest.raises(KeyError, match="misindented"):
+        load_node_options(str(p))
+
+
+def test_hydrate_arbitrary_dataclass():
+    from tpuraft.rheakv.pd_server import PlacementDriverOptions
+
+    opts = hydrate(PlacementDriverOptions, {
+        "endpoints": ["127.0.0.1:7001"],
+        "split_threshold_keys": 5000,
+        "balance_leaders": True,
+    })
+    assert opts.split_threshold_keys == 5000 and opts.balance_leaders
