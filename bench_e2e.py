@@ -162,6 +162,10 @@ async def run_store(args) -> None:
             i = 0
             while time.monotonic() < stop_at:
                 await sem.acquire()
+                if errs[0] > ok[0] + 1000:
+                    # cluster unhealthy (election churn): back off
+                    # instead of spinning failed applies at CPU speed
+                    await asyncio.sleep(0.1)
                 i += 1
                 t0 = time.perf_counter()
                 cb = batch_cb(t0, i % 8 == 0)

@@ -128,13 +128,15 @@ class CounterServer:
             from tpuraft.config import load_node_options
 
             opts = load_node_options(self.config_yaml)
+            # storage placement and topology always come from the CLI
+            # here (--data / --peers), so YAML settings for them would
+            # be silently clobbered below — reject them loudly instead
             conflicts = [name for name, dflt in [
                 ("initial_conf", Configuration()),
                 ("fsm", None)] if getattr(opts, name) != dflt]
-            if self.data_dir:
-                conflicts += [n for n in ("log_uri", "raft_meta_uri",
-                                          "snapshot_uri")
-                              if getattr(opts, n)]
+            conflicts += [n for n in ("log_uri", "raft_meta_uri",
+                                      "snapshot_uri")
+                          if getattr(opts, n)]
             if conflicts:
                 raise SystemExit(
                     f"--config sets {conflicts}, which --peers/--data "

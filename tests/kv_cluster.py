@@ -31,7 +31,8 @@ class KVTestCluster:
                  election_timeout_ms: int = 300,
                  multi_raft_engine_factory=None,
                  raw_store_factory=None,
-                 read_only_option=None):
+                 read_only_option=None,
+                 log_scheme: str = "file"):
         # raw_store_factory: Callable[[endpoint], RawKVStore] — lets tests
         # swap the memory store for the native C++ engine per store
         self.net = InProcNetwork()
@@ -49,6 +50,9 @@ class KVTestCluster:
         self.engine_factory = multi_raft_engine_factory
         self.raw_store_factory = raw_store_factory
         self.read_only_option = read_only_option
+        self.log_scheme = log_scheme  # "file" | "multilog" (needs tmp_path)
+        if log_scheme != "file" and tmp_path is None:
+            raise ValueError(f"log_scheme={log_scheme!r} needs a tmp_path")
         self.stores: dict[str, StoreEngine] = {}
 
     async def start_all(self) -> None:
@@ -65,6 +69,7 @@ class KVTestCluster:
             initial_regions=[r.copy() for r in self.region_template],
             data_path=str(self.tmp_path) if self.tmp_path else "",
             election_timeout_ms=self.election_timeout_ms,
+            log_scheme=self.log_scheme,
         )
         if self.read_only_option is not None:
             opts.read_only_option = self.read_only_option

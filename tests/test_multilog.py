@@ -284,6 +284,42 @@ def test_kill9_recovery_per_group(tmp_path):
             s.shutdown()
 
 
+async def test_kv_store_regions_share_one_log_engine(tmp_path):
+    """RheaKV production integration: StoreEngineOptions(log_scheme=
+    "multilog") puts every region of a store on ONE shared journal
+    engine — writes across regions coalesce into shared fsync rounds
+    and survive a store restart."""
+    from tests.kv_cluster import KVTestCluster
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.storage import multilog
+
+    regions = [Region(id=1, start_key=b"", end_key=b"m"),
+               Region(id=2, start_key=b"m", end_key=b"")]
+    c = KVTestCluster(3, tmp_path=tmp_path, regions=regions,
+                      log_scheme="multilog")
+    await c.start_all()
+    try:
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        for i in range(10):
+            assert await l1.raft_store.put(b"a%03d" % i, b"v%d" % i)
+            assert await l2.raft_store.put(b"z%03d" % i, b"v%d" % i)
+        # both regions' logs live in each store's ONE engine
+        engines = list(multilog._engines.values())
+        assert engines, "no shared engines registered"
+        assert len(engines) == 3  # one per store, not one per region
+        # restart a store: both its region logs recover from the engine
+        victim = c.endpoints[0]
+        await c.stop_store(victim)
+        await c.start_store(victim)
+        l1 = await c.wait_region_leader(1)
+        assert await l1.raft_store.get(b"a005") == b"v5"
+        l2 = await c.wait_region_leader(2)
+        assert await l2.raft_store.get(b"z007") == b"v7"
+    finally:
+        await c.stop_all()
+
+
 async def test_cluster_on_shared_log_engine(tmp_path):
     """End-to-end: 3 endpoints x 8 groups, every endpoint's groups on
     ONE shared log engine, electing and committing through the device

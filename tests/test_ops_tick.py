@@ -131,3 +131,59 @@ def test_jit_and_large_g():
     ns, out = tick(s, jnp.int32(123), PARAMS)
     assert out.commit_rel.shape == (G,)
     assert ns.match_rel.shape == (G, 8)
+
+
+def test_numpy_twin_matches_device_tick_randomized():
+    """The engine's no-jax fallback (MultiRaftEngine._np_tick) must stay
+    BIT-IDENTICAL to ops.tick.raft_tick — quorum semantics now live in
+    several formulations (jnp kernel, numpy twin, scalar BallotBox) and
+    this differential test is the drift tripwire for the first two."""
+    import numpy as np
+
+    from tpuraft.core.engine import MultiRaftEngine, _NEG_I32
+    from tpuraft.options import TickOptions
+    from tpuraft.ops.tick import GroupState, TickParams, raft_tick
+
+    rng = np.random.default_rng(42)
+    G, P = 64, 5
+    for trial in range(10):
+        eng = MultiRaftEngine(TickOptions(
+            max_groups=G, max_peers=P, backend="numpy"))
+        eng.eto_ms, eng.hb_ms, eng.lease_ms = 1000, 100, 900
+        eng.role = rng.integers(0, 4, G).astype(np.int32)
+        eng.pending_rel = rng.integers(1, 20, G).astype(np.int32)
+        eng.voter_mask = rng.random((G, P)) < 0.7
+        eng.old_voter_mask = np.where(
+            (rng.random(G) < 0.2)[:, None], rng.random((G, P)) < 0.5, False)
+        eng.granted = rng.random((G, P)) < 0.4
+        eng.elect_deadline = rng.integers(0, 2000, G)
+        eng.hb_deadline = rng.integers(0, 2000, G)
+        eng.last_ack = np.where(rng.random((G, P)) < 0.8,
+                                rng.integers(0, 1500, (G, P)), _NEG_I32)
+        rel = rng.integers(0, 100, (G, P)).astype(np.int32)
+        commit_now = rng.integers(0, 40, G).astype(np.int32)
+        now = int(rng.integers(500, 1500))
+
+        np_out = eng._np_tick(rel, commit_now, now)
+
+        state = GroupState(
+            role=eng.role.copy(),
+            commit_rel=commit_now.copy(),
+            pending_rel=eng.pending_rel.copy(),
+            match_rel=rel.copy(),
+            granted=eng.granted.copy(),
+            voter_mask=eng.voter_mask.copy(),
+            old_voter_mask=eng.old_voter_mask.copy(),
+            elect_deadline=eng.elect_deadline.astype(np.int32),
+            hb_deadline=eng.hb_deadline.astype(np.int32),
+            last_ack=eng.last_ack.astype(np.int32),
+        )
+        _, dev_out = raft_tick(state, np.int32(now),
+                               TickParams.make(1000, 100, 900))
+        for field in ("commit_rel", "commit_advanced", "elected",
+                      "election_due", "step_down", "hb_due",
+                      "lease_valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dev_out, field)),
+                np.asarray(getattr(np_out, field)),
+                err_msg=f"trial {trial}: {field} diverged")

@@ -77,8 +77,10 @@ def _hints(cls: type) -> dict:
 
 
 def _convert(ftype: Any, value: Any, path: str) -> Any:
+    import types
+
     origin = get_origin(ftype)
-    if origin is typing.Union:
+    if origin is typing.Union or origin is types.UnionType:  # X | None too
         args = [a for a in get_args(ftype) if a is not type(None)]
         if value is None:
             return None

@@ -25,15 +25,24 @@ Correctness notes:
 Opt in with ``RaftOptions.coalesce_heartbeats = True`` (the node must
 be wired to a NodeManager, which owns the hub).
 
-Operating envelope: the hub is one shared clock per process, so a late
-loop wakeup delays EVERY group's beat at once — a correlation that
-independent per-group timers don't have.  Size election timeouts with
-headroom over worst-case event-loop latency at your group count
-(measured here: 64 groups x 3 replicas churning in one CPython process
-needs ~2s timeouts to ride out boot-storm scheduling lag; production
-multi-raft deployments at region scale conventionally run multi-second
-election timeouts for the same reason).  The hub beats at HALF the
-per-group heartbeat interval for margin.
+Two drivers share :meth:`pulse`:
+- TIMER mode (nodes without an engine): the hub's own clock beats all
+  registered replicators each interval.
+- ENGINE mode: replicators never register a clock; the device tick's
+  ``hb_due`` mask collects every due group and calls ``pulse`` once per
+  tick (``MultiRaftEngine._flush_heartbeats``), with deadlines
+  phase-aligned to the hb interval so beats batch maximally.
+
+Operating envelope (timer mode): the hub is one shared clock per
+process, so a late loop wakeup delays EVERY group's beat at once — a
+correlation that independent per-group timers don't have.  Size
+election timeouts with headroom over worst-case event-loop latency at
+your group count (round 1 measured 64 groups x 3 replicas in one
+CPython process needing ~2s timeouts to ride out boot-storm lag; the
+engine control plane has since removed the per-group timers — 4096
+groups elect in one process at 300ms timeouts through the device
+tick — so at scale prefer engine mode).  The timer-mode hub beats at
+HALF the per-group heartbeat interval for margin.
 """
 
 from __future__ import annotations

@@ -59,6 +59,12 @@ class StoreEngineOptions:
     # wrap the raw store in the op-latency decorator (reference:
     # MetricsRawKVStore, enabled by RheaKVStoreOptions metrics flags)
     enable_kv_metrics: bool = False
+    # "file" = one segment dir per region (round-1 layout);
+    # "multilog" = ALL regions of this store share ONE C++ journal
+    # engine — group-keyed records, one fsync per flush round across
+    # regions, O(bytes/segment) fds (the reference's single-RocksDB
+    # role; storage/multilog.py).  Only used when data_path is set.
+    log_scheme: str = "file"
 
 
 class StoreEngine:
@@ -185,9 +191,15 @@ class StoreEngine:
         )
         opts.raft_options.read_only_option = self.opts.read_only_option
         if self.opts.data_path:
-            base = (f"{self.opts.data_path}/"
-                    f"{self.server_id.ip}_{self.server_id.port}/r{region.id}")
-            opts.log_uri = f"file://{base}/log"
+            store_base = (f"{self.opts.data_path}/"
+                          f"{self.server_id.ip}_{self.server_id.port}")
+            base = f"{store_base}/r{region.id}"
+            if self.opts.log_scheme == "multilog":
+                # one shared journal engine for every region of this
+                # store: cross-region group-commit fsync
+                opts.log_uri = f"multilog://{store_base}/mlog#r{region.id}"
+            else:
+                opts.log_uri = f"{self.opts.log_scheme}://{base}/log"
             opts.raft_meta_uri = f"file://{base}/meta"
             opts.snapshot_uri = f"file://{base}/snapshot"
         else:
