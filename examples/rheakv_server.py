@@ -36,7 +36,8 @@ def derive_regions(stores: list[str], n_regions: int):
 async def serve(endpoint: str, stores: list[str], n_regions: int,
                 data_path: str, transport_kind: str = "tcp",
                 store_kind: str = "memory",
-                pd_endpoints: list[str] | None = None) -> None:
+                pd_endpoints: list[str] | None = None,
+                log_scheme: str = "file") -> None:
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -52,6 +53,7 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         initial_regions=derive_regions(stores, n_regions),
         data_path=data_path,
         election_timeout_ms=1000,
+        log_scheme=log_scheme,
     )
     if store_kind == "native":
         import os
@@ -96,6 +98,9 @@ def main() -> None:
     ap.add_argument("--regions", type=int, default=2)
     ap.add_argument("--data", required=True, help="durable state dir")
     ap.add_argument("--transport", choices=["tcp", "native"], default="tcp")
+    ap.add_argument("--log-scheme", choices=["file", "multilog"],
+                    default="file",
+                    help="per-region segment dirs, or ONE shared C++ journal engine per store (group-commit fsync)")
     ap.add_argument("--store", choices=["memory", "native"],
                     default="memory")
     ap.add_argument("--pd", default="",
@@ -110,7 +115,8 @@ def main() -> None:
     try:
         asyncio.run(serve(args.serve, stores, args.regions, args.data,
                           args.transport, args.store,
-                          [e for e in args.pd.split(",") if e] or None))
+                          [e for e in args.pd.split(",") if e] or None,
+                          log_scheme=args.log_scheme))
     except KeyboardInterrupt:
         pass
 

@@ -31,7 +31,18 @@ def _free_ports(n: int) -> list[int]:
 
 
 @pytest.mark.asyncio
-async def test_three_process_kv_cluster_kill9_leader(tmp_path):
+@pytest.mark.parametrize("stack", [
+    [],                                           # tcp + memory KV + file log
+    ["--transport", "native", "--store", "native",
+     "--log-scheme", "multilog"],                 # FULL native + shared journal
+], ids=["default", "native-multilog"])
+async def test_three_process_kv_cluster_kill9_leader(tmp_path, stack):
+    if stack:
+        from tpuraft.rpc.native_tcp import ensure_built as build_t
+        from tpuraft.rheakv.native_store import ensure_built as build_kv
+        from tpuraft.storage.multilog import ensure_built as build_ml
+
+        build_t(); build_kv(); build_ml()
     ports = _free_ports(3)
     stores = [f"127.0.0.1:{p}" for p in ports]
     procs: dict[int, subprocess.Popen] = {}
@@ -41,7 +52,8 @@ async def test_three_process_kv_cluster_kill9_leader(tmp_path):
             procs[p] = subprocess.Popen(
                 [sys.executable, "-m", "examples.rheakv_server",
                  "--serve", ep, "--stores", ",".join(stores),
-                 "--regions", "2", "--data", str(tmp_path / str(p))],
+                 "--regions", "2", "--data", str(tmp_path / str(p))]
+                + stack,
                 cwd=REPO, env=env,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
