@@ -28,19 +28,51 @@ class KVStoreError(Exception):
 
 
 class RaftRawKVStore:
-    def __init__(self, node: Node, store: RawKVStore):
+    def __init__(self, node: Node, store: RawKVStore,
+                 apply_batch: int = 32):
         self.node = node
         self.store = store
+        # server-side apply micro-batching (reference: the apply
+        # Disruptor drains up to applyBatch=32 tasks per event):
+        # concurrent RPC handlers coalesce into ONE Node.apply_batch —
+        # one node-lock acquisition and one flush wait per drain round
+        # instead of per op
+        self._apply_batch = max(1, apply_batch)
+        self._pending: list[tuple[bytes, asyncio.Future]] = []
+        self._drainer: Optional[asyncio.Task] = None
 
     # -- write path (through the log) ---------------------------------------
 
     async def _apply(self, op: KVOperation):
         fut = asyncio.get_running_loop().create_future()
-        await self.node.apply(Task(data=op.encode(), done=KVClosure(fut)))
+        # encode HERE, not in the drainer: a malformed op (bad key
+        # type) must fail its own caller, not kill the drain task and
+        # hang every op coalesced into the same batch
+        blob = op.encode()
+        self._pending.append((blob, fut))
+        if self._drainer is None or self._drainer.done():
+            self._drainer = asyncio.ensure_future(self._drain())
         status, result = await fut
         if not status.is_ok():
             raise KVStoreError(status)
         return result
+
+    async def _drain(self) -> None:
+        # same drain-until-empty invariant as ReadOnlyService's rounds:
+        # ops queued while a batch is in flight are picked up by the
+        # next loop iteration, never orphaned
+        while self._pending:
+            batch = self._pending[:self._apply_batch]
+            del self._pending[:len(batch)]
+            tasks = [Task(data=blob, done=KVClosure(fut))
+                     for blob, fut in batch]
+            try:
+                await self.node.apply_batch(tasks)
+            except Exception as e:  # noqa: BLE001 — fail THIS batch only
+                st = Status.error(RaftError.EINTERNAL, f"apply: {e!r}")
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result((st, None))
 
     async def put(self, key: bytes, value: bytes) -> bool:
         return await self._apply(KVOperation(KVOp.PUT, key, value))
