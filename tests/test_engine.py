@@ -34,18 +34,21 @@ class MultiRaftCluster:
         self.election_timeout_ms = election_timeout_ms
         self.tick_ms = tick_ms
 
+    def _tick_options(self) -> TickOptions:
+        # backend pinned to jax: conftest forces a CPU default backend,
+        # where "auto" resolves to numpy — these tests exist to cover
+        # the jax tick path.  Subclasses override for mesh sharding etc.
+        return TickOptions(
+            max_groups=len(self.groups) + 4, max_peers=8,
+            tick_interval_ms=self.tick_ms, backend="jax")
+
     async def start_all(self):
         for ep in self.endpoints:
             server = RpcServer(ep.endpoint)
             manager = NodeManager(server)
             self.net.bind(server)
             transport = InProcTransport(self.net, ep.endpoint)
-            # backend pinned to jax: conftest forces a CPU default
-            # backend, where "auto" resolves to numpy — these tests
-            # exist to cover the jax tick path
-            engine = MultiRaftEngine(TickOptions(
-                max_groups=len(self.groups) + 4, max_peers=8,
-                tick_interval_ms=self.tick_ms, backend="jax"))
+            engine = MultiRaftEngine(self._tick_options())
             await engine.start()
             self.engines[ep.endpoint] = engine
             factory = engine.ballot_box_factory()
