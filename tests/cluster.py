@@ -96,7 +96,7 @@ class TestCluster:
         if log_scheme != "file" and tmp_path is None:
             raise ValueError(f"log_scheme={log_scheme!r} needs a tmp_path "
                              "(memory:// would silently be used instead)")
-        self.log_scheme = log_scheme  # "file" | "native" (needs tmp_path)
+        self.log_scheme = log_scheme  # "file" | "native" | "multilog" (needs tmp_path)
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
@@ -109,7 +109,12 @@ class TestCluster:
         )
         if self.tmp_path is not None:
             base = f"{self.tmp_path}/{peer.ip}_{peer.port}"
-            opts.log_uri = f"{self.log_scheme}://{base}/log"
+            if self.log_scheme == "multilog":
+                # shared journal engine (one per endpoint dir here; the
+                # scheme needs a group fragment)
+                opts.log_uri = f"multilog://{base}/mlog#{self.group_id}"
+            else:
+                opts.log_uri = f"{self.log_scheme}://{base}/log"
             opts.raft_meta_uri = f"file://{base}/meta"
             if self.snapshot:
                 opts.snapshot_uri = f"file://{base}/snapshot"
