@@ -825,10 +825,25 @@ bool spill(Store* s, std::string* err) {
     s->range_dead[c].clear();
   }
   s->mem_bytes = 0;
+  // Durable-op order matters: the legacy checkpoint must be durably gone
+  // BEFORE the WAL is truncated.  If we truncated first and crashed before
+  // the unlink hit disk, reopen would ckpt_load the stale checkpoint into
+  // the memtable (newest rank), shadowing newer values in the runs.  With
+  // this order every crash window is consistent: ckpt+full-WAL replay
+  // reproduces exactly the content just spilled to the run.
+  if (unlink(s->ckpt_path().c_str()) == 0) {
+    if (!fsync_dir(s->dir)) {
+      *err = "spill: fsync dir after ckpt unlink";
+      return false;
+    }
+  } else if (errno != ENOENT) {
+    // an unremovable stale checkpoint would shadow the runs on reopen;
+    // failing the spill keeps WAL + checkpoint consistent instead
+    *err = std::string("spill: ckpt unlink: ") + strerror(errno);
+    return false;
+  }
   // memtable content is durable in the run: restart the WAL
   if (!wal_restart(s, err)) return false;
-  // a post-spill legacy checkpoint would shadow the runs on reopen
-  unlink(s->ckpt_path().c_str());
   s->compact_cv.notify_all();
   return true;
 }
@@ -1225,6 +1240,19 @@ void* tkv_open2(const char* dir, int sync, int64_t ckpt_wal_bytes,
     return nullptr;
   }
   std::string msg;
+  if (!s->lsm()) {
+    // Guard against opening an LSM-tiered directory without LSM params
+    // (legacy tkv_open or a config downgrade): the manifest's runs would
+    // be silently invisible — reads miss most of the dataset and the next
+    // checkpoint durably excludes it.  Fail loudly instead.
+    struct stat st;
+    if (stat(s->manifest_path().c_str(), &st) == 0) {
+      set_err(err, errlen,
+              "LSM directory (manifest present) opened without LSM params; "
+              "reopen with memtable_budget_bytes > 0 (tkv_open2)");
+      return nullptr;
+    }
+  }
   if (s->lsm() && !manifest_load(s.get(), &msg)) {
     set_err(err, errlen, msg);
     return nullptr;

@@ -615,3 +615,28 @@ async def test_engine_1k_groups_5_replicas():
     finally:
         await eng_jax.shutdown()
         await eng_np.shutdown()
+
+
+def test_set_conf_grace_window_for_added_peers():
+    """A peer added mid-leadership gets a grace ack stamp: a NEG column
+    would pin the joint q_ack reduce at NEG_INF ("no data"), so a dead
+    NEW config could never fire step_down (r3 review finding)."""
+    from tpuraft.core.engine import _NEG_I32
+
+    eng = MultiRaftEngine(TickOptions(
+        max_groups=4, max_peers=4, backend="numpy"))
+    slot = eng.alloc_slot()
+    a, b, c = (PeerId.parse(f"127.0.0.1:{p}") for p in (9001, 9002, 9003))
+    eng.set_conf(slot, Configuration([a, b]), Configuration())
+    from tpuraft.ops.tick import ROLE_LEADER
+
+    eng.role[slot] = ROLE_LEADER
+    eng.last_ack[slot, :2] = 5000  # established leadership acks
+    # joint change adds c: its fresh column must be stamped, not NEG
+    eng.set_conf(slot, Configuration([a, b, c]), Configuration([a, b]))
+    col = eng.peer_col(slot, c)
+    assert eng.last_ack[slot, col] > _NEG_I32
+    # a follower slot's columns are untouched (grace is leader-only)
+    slot2 = eng.alloc_slot()
+    eng.set_conf(slot2, Configuration([a, b]), Configuration())
+    assert (eng.last_ack[slot2, :2] <= _NEG_I32).all()

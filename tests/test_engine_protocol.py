@@ -140,6 +140,70 @@ async def test_engine_elects_4k_groups_one_process(tmp_path):
         await engine.shutdown()
 
 
+async def test_per_group_timeouts_one_engine():
+    """VERDICT r2 #5: protocol params are [G] rows on the device plane —
+    two nodes with different election_timeout_ms in ONE engine each
+    honor their own timeouts (a PD group + region groups in one process
+    no longer run the first registrant's constants)."""
+    net = InProcNetwork()
+    ep = PeerId.parse("127.0.0.1:7100")
+    server = RpcServer(ep.endpoint)
+    manager = NodeManager(server)
+    net.bind(server)
+    transport = InProcTransport(net, ep.endpoint)
+    engine = MultiRaftEngine(TickOptions(
+        max_groups=4, max_peers=4, tick_interval_ms=20))
+    await engine.start()
+    factory = engine.ballot_box_factory()
+    nodes: dict[str, Node] = {}
+    try:
+        for gid, eto in (("fast", 500), ("slow", 30_000)):
+            opts = NodeOptions(
+                election_timeout_ms=eto,
+                initial_conf=Configuration([ep]),
+                fsm=MockStateMachine(), log_uri="memory://",
+                raft_meta_uri="memory://")
+            node = Node(gid, ep, opts, transport,
+                        ballot_box_factory=factory)
+            node.node_manager = manager
+            manager.add(node)
+            assert await node.init()
+            nodes[gid] = node
+        fast, slow = nodes["fast"], nodes["slow"]
+        assert isinstance(fast._ctrl, EngineControl)
+        # the engine's [G] param rows carry each node's own constants
+        assert int(engine.eto_ms[fast._ctrl.slot]) == 500
+        assert int(engine.eto_ms[slow._ctrl.slot]) == 30_000
+
+        for n in (fast, slow):
+            deadline = time.monotonic() + 20
+            while n.state != State.LEADER and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert n.state == State.LEADER
+
+        # step both down; only the fast group's election_due mask may
+        # fire within its (short) timeout window — the slow group must
+        # still be a follower when the fast one is back in charge
+        from tpuraft.errors import RaftError, Status
+        for n in (fast, slow):
+            async with n._lock:
+                await n._step_down(n.current_term, Status.error(
+                    RaftError.ERAFTTIMEDOUT, "test: step-down"))
+        assert fast.state == State.FOLLOWER
+        assert slow.state == State.FOLLOWER
+        deadline = time.monotonic() + 20
+        while fast.state != State.LEADER and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert fast.state == State.LEADER, \
+            "fast group never re-elected from its 500ms timeout"
+        assert slow.state == State.FOLLOWER, \
+            "slow group elected way before its 30s election timeout"
+    finally:
+        for n in nodes.values():
+            await n.shutdown()
+        await engine.shutdown()
+
+
 async def test_engine_mask_driven_failover():
     """3 endpoints x 8 groups: kill the leader endpoint's node of one
     group; the remaining replicas re-elect purely via engine masks

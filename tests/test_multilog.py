@@ -169,6 +169,50 @@ async def test_group_fsync_coalescing(tmp_path):
             s.shutdown()
 
 
+def test_group_commit_across_event_loops(tmp_path):
+    """The engine is shared process-wide by directory, so stores on
+    DIFFERENT event loops (threads) may join the same group-commit; each
+    waiter must resolve on its own loop (ADVICE r2: futures were set
+    from whichever loop ran the round — not thread-safe)."""
+    import threading
+
+    from tests.test_storage import mk_entries
+
+    T, ROUNDS = 4, 25
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(T)
+
+    def worker(k: int) -> None:
+        async def run():
+            s = mk_storage(tmp_path, f"loop{k}")
+            s.init()
+            try:
+                for i in range(ROUNDS):
+                    await s.append_entries_async(
+                        mk_entries(3 * i + 1, 3, term=1), sync=True)
+                    # stagger so rounds interleave across loops
+                    await asyncio.sleep(0.001 * (k % 3))
+                assert s.last_log_index() == 3 * ROUNDS
+            finally:
+                s.shutdown()
+
+        barrier.wait(timeout=30)
+        try:
+            asyncio.run(run())
+        except BaseException as e:  # noqa: BLE001 — surfaced to the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # a stranded waiter hangs its worker inside asyncio.run — join()
+    # returning on timeout must fail the test, not pass it silently
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    assert not errors, errors
+
+
 def test_journal_gc_after_prefix_truncation(tmp_path):
     s = mk_storage(tmp_path, "g1", seg_max=4096)
     s.init()

@@ -201,6 +201,31 @@ def test_kill9_during_spills_and_compactions(tmp_path):
             s.close()
 
 
+def test_lsm_dir_refuses_legacy_open(tmp_path):
+    """Opening an LSM-tiered directory without LSM params must fail
+    loudly (ADVICE r2): a legacy open would silently ignore the manifest
+    and every run — reads miss the dataset and the next checkpoint
+    durably excludes it."""
+    s = mk(tmp_path)
+    try:
+        val = b"v" * 200
+        for i in range(2048):  # several spills past the 64KB budget
+            s.put(b"k%06d" % i, val)
+        assert s.run_count >= 1
+    finally:
+        s.close()
+    with pytest.raises(IOError, match="LSM"):
+        NativeRawKVStore(str(tmp_path / "lsm"), sync=False,
+                         memtable_budget_bytes=0)
+    # reopening WITH LSM params still works and sees the data
+    s2 = mk(tmp_path)
+    try:
+        assert s2.get(b"k000000") == val
+        assert s2.get(b"k002047") == val
+    finally:
+        s2.close()
+
+
 def test_legacy_mode_untouched(tmp_path):
     """memtable_budget=0 keeps the original engine (no manifest, no
     runs, checkpoint file semantics)."""

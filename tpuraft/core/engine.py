@@ -66,6 +66,8 @@ LOG = logging.getLogger(__name__)
 _REBASE_LIMIT = 1 << 28
 _TIME_REBASE_MS = 1 << 30        # epoch-shift threshold (int32 headroom)
 _NEG_I32 = -(2 ** 30)            # matches tpuraft.ops.ballot.NEG_INF_I32
+# protocol-param defaults for slots no node has registered yet
+_DEF_ETO_MS, _DEF_HB_MS, _DEF_LEASE_MS = 1000, 100, 900
 
 
 class TpuBallotBox:
@@ -418,11 +420,13 @@ class MultiRaftEngine:
         self._params_dev = None
         self.ticks = 0
         self.commit_advances = 0
-        # protocol params (engine-wide; first registered node fixes them)
-        self.eto_ms = 1000
-        self.hb_ms = 100
-        self.lease_ms = 900
-        self._params_locked = False
+        # protocol params: [G] rows — each registered node's NodeOptions
+        # timeouts apply to ITS groups only (mixed-timeout engines, e.g.
+        # a PD group + region groups in one process, run correct
+        # per-group constants; was engine-wide first-node-wins pre-r3)
+        self.eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
+        self.hb_ms = np.full(g, _DEF_HB_MS, np.int64)
+        self.lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
         self._t0 = time.monotonic()
 
     # -- time ----------------------------------------------------------------
@@ -437,7 +441,7 @@ class MultiRaftEngine:
         """Shift the time epoch before int32 ms overflows (~12 days)."""
         if now < _TIME_REBASE_MS:
             return
-        shift = now - (self.eto_ms * 4)
+        shift = now - int(self.eto_ms.max()) * 4
         self._t0 += shift / 1000.0
         self.elect_deadline -= shift
         self.hb_deadline -= shift
@@ -464,18 +468,9 @@ class MultiRaftEngine:
         self.has_ctrl[s] = True
         col = self._peer_cols[s].get(server_id)
         self.self_col[s] = -1 if col is None else col
-        if not self._params_locked:
-            self.eto_ms, self.hb_ms, self.lease_ms = eto_ms, hb_ms, lease_ms
-            self._params_dev = None  # (re)built at next device tick
-            self._params_locked = True
-        elif (eto_ms, hb_ms, lease_ms) != (self.eto_ms, self.hb_ms,
-                                           self.lease_ms):
-            LOG.warning(
-                "engine protocol params are engine-wide: slot %d wants "
-                "(eto=%d hb=%d lease=%d) but engine runs (%d %d %d) — "
-                "the first registered node's timeouts apply to all",
-                s, eto_ms, hb_ms, lease_ms,
-                self.eto_ms, self.hb_ms, self.lease_ms)
+        self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
+            eto_ms, hb_ms, lease_ms
+        self._params_dev = None  # (re)built at next device tick
 
     def unregister_ctrl(self, slot: int) -> None:
         self._ctrls[slot] = None
@@ -514,6 +509,10 @@ class MultiRaftEngine:
         self.granted = pad(self.granted)
         self.self_col = pad(self.self_col, -1)
         self.has_ctrl = pad(self.has_ctrl)
+        self.eto_ms = pad(self.eto_ms, _DEF_ETO_MS)
+        self.hb_ms = pad(self.hb_ms, _DEF_HB_MS)
+        self.lease_ms = pad(self.lease_ms, _DEF_LEASE_MS)
+        self._params_dev = None  # [G] rows must match the grown shape
         self._peer_cols.extend(dict() for _ in range(old_g))
         self._boxes.extend([None] * old_g)
         self._ctrls.extend([None] * old_g)
@@ -537,6 +536,9 @@ class MultiRaftEngine:
         self.hb_deadline[s] = 0
         self.last_ack[s] = _NEG_I32
         self.granted[s] = False
+        self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
+            _DEF_ETO_MS, _DEF_HB_MS, _DEF_LEASE_MS
+        self._params_dev = None
         self._peer_cols[s].clear()
         self._free.append(s)
 
@@ -571,6 +573,17 @@ class MultiRaftEngine:
             ovm[cols[peer]] = True
         self.voter_mask[slot] = vm
         self.old_voter_mask[slot] = ovm
+        if self.role[slot] == ROLE_LEADER:
+            # grace window for peers ADDED mid-leadership (reference:
+            # addReplicator stamps lastRpcSendTimestamp at start): a
+            # never-acked NEG column would otherwise pin the joint q_ack
+            # reduce at NEG_INF, which the have-ack gate reads as "no
+            # data" — so a dead new config could never fire step_down.
+            # Invariant: a leader's (old_)voter columns are never NEG.
+            row = self.last_ack[slot]
+            fresh = (vm | ovm) & (row <= _NEG_I32)
+            if fresh.any():
+                row[fresh] = self.now_ms()
         server = self._ctrl_server[slot]
         if server is not None:
             col = cols.get(server)
@@ -838,8 +851,10 @@ class MultiRaftEngine:
         el = vote_ok(vm)
         in_joint = ovm.any(axis=1)
         elected_q = np.where(in_joint, el & vote_ok(ovm), el)
-        q_ack = _np_order_stat(
-            np.clip(self.last_ack, _NEG_I32, None).astype(np.int64), vm)
+        # joint consensus: the lease needs BOTH configs responsive
+        # (NodeImpl#checkDeadNodes walks conf and oldConf)
+        ack64 = np.clip(self.last_ack, _NEG_I32, None).astype(np.int64)
+        q_ack = _np_joint_order_stat(ack64, vm, ovm)
         have_ack = q_ack > _NEG_I32
         return _NpOutputs(
             commit_rel=new_commit,
@@ -897,15 +912,14 @@ class MultiRaftEngine:
         (the send-matrix plane — O(endpoints) RPCs, not O(groups))."""
         by_hub: dict[int, tuple[object, list]] = {}
         direct: list = []
-        # phase-align the next beat to the engine-wide hb_ms grid: all
-        # leader groups then fall due on the SAME tick, so one pulse per
-        # interval carries every group's beat (max hub batching — the
-        # staggered per-group alternative degrades to ~1 beat per RPC)
-        aligned_next = (now // self.hb_ms + 1) * self.hb_ms
+        # phase-align each next beat to its group's hb_ms grid: groups
+        # sharing an interval then fall due on the SAME tick, so one
+        # pulse per interval carries every such group's beat (max hub
+        # batching — staggered per-group beats degrade to ~1 per RPC).
+        # Mirrors the device's deadline advance so masks don't refire.
+        hbs = self.hb_ms[slots]
+        self.hb_deadline[slots] = (now // hbs + 1) * hbs
         for s in slots:
-            # mirror the device's deadline advance so the mask doesn't
-            # refire every tick
-            self.hb_deadline[s] = aligned_next
             ctrl = self._ctrls[s]
             if ctrl is None:
                 continue
@@ -916,13 +930,18 @@ class MultiRaftEngine:
             if not reps:
                 continue
             nm = node.node_manager
-            if nm is not None and node.options.raft_options.coalesce_heartbeats:
-                # opt-in, as on the timer path: the receiver must run a
-                # NodeManager-style server with a multi_heartbeat handler
-                hub = nm.heartbeat_hub
-                by_hub.setdefault(id(hub), (hub, []))[1].extend(reps)
-            else:
+            opt = node.options.raft_options.coalesce_heartbeats
+            if nm is None or opt is False:
                 direct.extend(reps)
+                continue
+            # AUTO (None): coalesce per peer once its responses advertise
+            # multi_heartbeat — idle beats become O(endpoints) by default
+            hub = nm.heartbeat_hub
+            for r in reps:
+                if opt is True or r.peer_multi_hb:
+                    by_hub.setdefault(id(hub), (hub, []))[1].append(r)
+                else:
+                    direct.append(r)
         for hub, reps in by_hub.values():
             hub.pulse(reps)
         for r in direct:
@@ -943,8 +962,17 @@ def _np_order_stat(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
     return np.where(n > 0, picked, NEG)
 
 
+def _np_joint_order_stat(values: np.ndarray, vm: np.ndarray,
+                         ovm: np.ndarray) -> np.ndarray:
+    """Joint-consensus order statistic: min of both configs' q-th
+    largest where a row is in joint mode — the shared shape of
+    ballot.joint_quorum_match_index AND joint_quorum_ack_time."""
+    new_q = _np_order_stat(values, vm)
+    old_q = _np_order_stat(values, ovm)
+    return np.where(ovm.any(axis=1), np.minimum(new_q, old_q), new_q)
+
+
 def _np_joint_quorum(rel: np.ndarray, vm: np.ndarray, ovm: np.ndarray
                      ) -> np.ndarray:
-    new_q = _np_order_stat(rel.astype(np.int64), vm).astype(np.int32)
-    old_q = _np_order_stat(rel.astype(np.int64), ovm).astype(np.int32)
-    return np.where(ovm.any(axis=1), np.minimum(new_q, old_q), new_q)
+    return _np_joint_order_stat(rel.astype(np.int64), vm, ovm
+                                ).astype(np.int32)

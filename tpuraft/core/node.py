@@ -938,6 +938,9 @@ class Node:
     async def handle_append_entries(self, req: AppendEntriesRequest
                                     ) -> AppendEntriesResponse:
         server = PeerId.parse(req.server_id)
+        # capability advertisement (VERDICT r2 #6): this endpoint serves
+        # multi_heartbeat iff it runs a NodeManager
+        mh = self.node_manager is not None
         async with self._lock:
             if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
                               State.UNINITIALIZED):
@@ -950,6 +953,7 @@ class Node:
                     f"{self.state.value}"))
             if req.term < self.current_term:
                 return AppendEntriesResponse(
+                    multi_hb=mh,
                     term=self.current_term, success=False,
                     last_log_index=self.log_manager.last_log_index())
             if req.term > self.current_term or self.state != State.FOLLOWER:
@@ -966,6 +970,7 @@ class Node:
                 await self._step_down(req.term + 1, Status.error(
                     RaftError.ELEADERCONFLICT, "two leaders in one term"))
                 return AppendEntriesResponse(
+                    multi_hb=mh,
                     term=self.current_term, success=False,
                     last_log_index=self.log_manager.last_log_index())
             self._last_leader_timestamp = time.monotonic()
@@ -987,6 +992,7 @@ class Node:
                         hint = lm.conflict_hint(req.prev_log_index,
                                                 local_prev_term)
                     return AppendEntriesResponse(
+                        multi_hb=mh,
                         term=self.current_term, success=False,
                         last_log_index=lm.last_log_index(),
                         conflict_index=hint)
@@ -998,6 +1004,7 @@ class Node:
                     # the leader's (replica-plane attestation)
                     self._note_attested(req.term)
                 return AppendEntriesResponse(
+                    multi_hb=mh,
                     term=self.current_term, success=True,
                     last_log_index=lm.last_log_index())
 
@@ -1023,6 +1030,7 @@ class Node:
                     f"node failed: {e.status}")) from e
             if not ok:
                 return AppendEntriesResponse(
+                    multi_hb=mh,
                     term=self.current_term, success=False,
                     last_log_index=lm.last_log_index())
             self._refresh_conf_from_log()
@@ -1035,6 +1043,7 @@ class Node:
                 # of the leader's (replica-plane attestation)
                 self._note_attested(req.term)
             return AppendEntriesResponse(
+                multi_hb=mh,
                 term=self.current_term, success=True,
                 last_log_index=lm.last_log_index())
 

@@ -61,6 +61,10 @@ class Replicator:
         self._hb_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._hub = None  # HeartbeatHub when coalescing is enabled
+        # does the peer's endpoint serve multi_heartbeat?  Learned from
+        # every AppendEntries response (probe/ack/beat); drives AUTO
+        # coalescing (RaftOptions.coalesce_heartbeats=None)
+        self.peer_multi_hb = False
         self._transfer_target_index: Optional[int] = None
         self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
         self.inflight_peak = 0  # high-water mark of the pipeline window
@@ -77,8 +81,12 @@ class Replicator:
             # per-replicator clock, no hub clock registration
             return
         hub = None
-        if (node.options.raft_options.coalesce_heartbeats
-                and node.node_manager is not None):
+        opt = node.options.raft_options.coalesce_heartbeats
+        if node.node_manager is not None and (
+                opt is True or (opt is None and self.peer_multi_hb)):
+            # auto mode joins the hub once the peer's capability is
+            # known (probe responses advertise it; _note_peer_caps
+            # migrates mid-leadership when it is learned later)
             hub = node.node_manager.heartbeat_hub
         self._hub = hub
         if hub is not None:
@@ -215,6 +223,7 @@ class Replicator:
                 if not self._running or node.current_term != term_at_send:
                     self._roll_back_window(inflight)
                     return
+                self._note_peer_caps(resp)
                 self.last_rpc_ack = time.monotonic()
                 node.on_peer_ack(self.peer, self.last_rpc_ack)
                 if resp.term > node.current_term:
@@ -291,6 +300,7 @@ class Replicator:
             return
         if not self._running or node.current_term != term_at_send:
             return
+        self._note_peer_caps(resp)
         self.last_rpc_ack = time.monotonic()
         node.on_peer_ack(self.peer, self.last_rpc_ack)
         if resp.term > node.current_term:
@@ -355,6 +365,31 @@ class Replicator:
             entries=[],
         )
 
+    def _note_peer_caps(self, resp) -> None:
+        """Track the peer endpoint's multi_heartbeat capability; in AUTO
+        mode (coalesce_heartbeats=None) migrate this replicator's beat
+        source between the direct loop and the hub to match it."""
+        mh = bool(getattr(resp, "multi_hb", False))
+        if mh == self.peer_multi_hb:
+            return
+        self.peer_multi_hb = mh
+        node = self._node
+        if (not self._running
+                or getattr(node._ctrl, "drives_heartbeats", False)
+                or node.options.raft_options.coalesce_heartbeats is not None
+                or node.node_manager is None):
+            return  # engine beats handle this per-tick; or mode is fixed
+        if mh and self._hub is None:
+            if self._hb_task is not None:
+                self._hb_task.cancel()
+                self._hb_task = None
+            self._hub = node.node_manager.heartbeat_hub
+            self._hub.register(self)
+        elif not mh and self._hub is not None:
+            self._hub.deregister(self)
+            self._hub = None
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
     async def process_heartbeat_response(self, resp) -> bool:
         """Ack bookkeeping shared by both heartbeat paths: lease acks,
         step-down on higher term, re-probe on lost match."""
@@ -370,6 +405,11 @@ class Replicator:
             self._matched = False
             self.next_index = min(self.next_index, resp.last_log_index + 1) or 1
             self.wake()
+        # LAST, with no awaits after: an AUTO-mode migration may cancel
+        # the very _hb_task running this coroutine, and a pending
+        # CancelledError would abort any later await (observed hazard:
+        # swallowing a mandated step-down)
+        self._note_peer_caps(resp)
         return True
 
     async def send_heartbeat(self) -> bool:

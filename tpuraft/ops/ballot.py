@@ -92,3 +92,16 @@ def quorum_ack_time(last_ack: jnp.ndarray, voter_mask: jnp.ndarray) -> jnp.ndarr
     name because timestamps and log indexes are different host quantities.
     """
     return quorum_match_index(last_ack, voter_mask)
+
+
+def joint_quorum_ack_time(
+    last_ack: jnp.ndarray, voter_mask: jnp.ndarray, old_voter_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Lease/step-down ack point under joint consensus: the leader holds
+    its lease only while a quorum of BOTH configurations is responsive
+    (reference: ``NodeImpl#checkDeadNodes`` iterates conf and oldConf), so
+    take the older (min) of the two configs' quorum ack times."""
+    new_q = quorum_ack_time(last_ack, voter_mask)
+    old_q = quorum_ack_time(last_ack, old_voter_mask)
+    in_joint = old_voter_mask.any(axis=-1)
+    return jnp.where(in_joint, jnp.minimum(new_q, old_q), new_q)

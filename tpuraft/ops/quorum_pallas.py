@@ -6,7 +6,8 @@ vote tally + ``NodeImpl#checkDeadNodes``):
 
   quorum_idx  — q-th largest voter matchIndex (joint-consensus aware)
   elected     — vote quorum reached (joint-consensus aware)
-  q_ack       — q-th newest voter ack timestamp (lease / step-down)
+  q_ack       — q-th newest voter ack timestamp (joint-consensus aware;
+                lease / step-down)
 
 Design notes:
   - Arrays enter transposed as [P, G] so the large G axis lies on the
@@ -35,9 +36,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from tpuraft.ops.ballot import (
+    joint_quorum_ack_time,
     joint_quorum_match_index,
     joint_vote_quorum,
-    quorum_ack_time,
 )
 
 TILE_G = 512
@@ -80,7 +81,9 @@ def _fused_quorum_kernel(match_ref, granted_ref, ack_ref, vm_ref, ovm_ref,
     elected_ref[:] = jnp.where(in_joint, el_new & el_old,
                                el_new).astype(jnp.int32)
 
-    qack_ref[:] = _qth_largest(ack_ref[:], vm, p)
+    qa_new = _qth_largest(ack_ref[:], vm, p)
+    qa_old = _qth_largest(ack_ref[:], ovm, p)
+    qack_ref[:] = jnp.where(in_joint, jnp.minimum(qa_new, qa_old), qa_new)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -116,7 +119,7 @@ def _fused_quorum_pallas(match, granted, last_ack, voter_mask, old_voter_mask,
 def _fused_quorum_xla(match, granted, last_ack, voter_mask, old_voter_mask):
     qidx = joint_quorum_match_index(match, voter_mask, old_voter_mask)
     elected = joint_vote_quorum(granted, voter_mask, old_voter_mask)
-    qack = quorum_ack_time(last_ack, voter_mask)
+    qack = joint_quorum_ack_time(last_ack, voter_mask, old_voter_mask)
     return qidx, elected, qack
 
 

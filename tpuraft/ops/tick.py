@@ -77,18 +77,21 @@ class GroupState:
 @jax.tree_util.register_dataclass
 @dataclass
 class TickParams:
-    """Scalar protocol parameters (prefetched once, not retraced)."""
+    """Protocol parameters: int32 scalars (engine-wide) or [G] rows
+    (per-group — the reference's per-node NodeOptions timeouts; a PD
+    group and region groups in one engine each honor their own).  Either
+    shape broadcasts through the tick; prefetched once, not retraced."""
 
-    election_timeout_ms: jnp.ndarray  # int32 scalar
-    heartbeat_ms: jnp.ndarray         # int32 scalar
-    lease_ms: jnp.ndarray             # int32 scalar
+    election_timeout_ms: jnp.ndarray  # int32 scalar or [G]
+    heartbeat_ms: jnp.ndarray         # int32 scalar or [G]
+    lease_ms: jnp.ndarray             # int32 scalar or [G]
 
     @staticmethod
-    def make(election_timeout_ms: int, heartbeat_ms: int, lease_ms: int) -> "TickParams":
+    def make(election_timeout_ms, heartbeat_ms, lease_ms) -> "TickParams":
         return TickParams(
-            jnp.int32(election_timeout_ms),
-            jnp.int32(heartbeat_ms),
-            jnp.int32(lease_ms),
+            jnp.asarray(election_timeout_ms, jnp.int32),
+            jnp.asarray(heartbeat_ms, jnp.int32),
+            jnp.asarray(lease_ms, jnp.int32),
         )
 
 
@@ -142,6 +145,10 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     # --- leader lease / step-down (NodeImpl#checkDeadNodes) ----------------
     # Count the leader itself as acked "now" via its self slot: the host
     # keeps last_ack[g, self] == now. Quorum ack time = q-th newest response.
+    # The NEG gate below means "no data", not "dead quorum"; the host
+    # upholds the invariant that a LEADER's voter columns are never NEG
+    # (grace stamps at on_leader and for set_conf-added peers), so a
+    # config that stops responding always reaches step_down via staleness.
     have_quorum_ack = q_ack > NEG_INF_I32
     lease_valid = is_leader & have_quorum_ack & (now_ms - q_ack < params.lease_ms)
     step_down = is_leader & have_quorum_ack & (

@@ -51,7 +51,12 @@ class RaftOptions:
     # multi_heartbeat RPC per destination endpoint per interval (the
     # batched send-matrix plane — O(endpoints) instead of O(groups x
     # peers) idle RPCs).  Needs the node wired to a NodeManager.
-    coalesce_heartbeats: bool = False
+    # None = AUTO (default): coalesce per peer once its AppendEntries
+    # responses advertise the multi_heartbeat capability (the receiver
+    # runs a NodeManager), direct beats otherwise — so a 1K-group idle
+    # cluster's RPC rate is O(endpoints) out of the box.  True = always
+    # (peers must serve multi_heartbeat), False = never.
+    coalesce_heartbeats: Optional[bool] = None
     read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
     max_replicator_retry_times: int = 3
     step_down_when_vote_timedout: bool = True

@@ -106,6 +106,10 @@ class AppendEntriesResponse:
     # step instead of one-entry-per-RTT linear backoff (classic Raft §5.3
     # fast-backoff optimization; 0 = no hint)
     conflict_index: int = 0
+    # capability advertisement: the responder's endpoint runs a
+    # NodeManager serving ``multi_heartbeat``, so the leader may
+    # auto-coalesce its beats to this endpoint (VERDICT r2 #6)
+    multi_hb: bool = False
 
 
 @dataclass

@@ -113,37 +113,92 @@ def _load() -> ctypes.CDLL:
         return _lib
 
 
+def _deliver(f: asyncio.Future, exc: Optional[BaseException]) -> None:
+    """Resolve one group-commit waiter; must run on f's own loop."""
+    if f.done():
+        return
+    if exc is not None:
+        f.set_exception(exc)
+    else:
+        f.set_result(None)
+
+
 class _GroupCommit:
     """Coalesces concurrent flush() calls into one tlm_sync round
     (RocksDB group commit): callers that arrive while a round's fsync is
-    in flight wait for the NEXT round, which covers their staged bytes."""
+    in flight wait for the NEXT round, which covers their staged bytes.
+
+    The engine is shared process-wide by directory, so flushers may live
+    on DIFFERENT event loops (multi-store processes): the waiter list is
+    lock-guarded and each future resolves on its OWN loop — setting a
+    future from a foreign loop's thread is not thread-safe."""
 
     def __init__(self, engine: "MultiLogEngine"):
         self._engine = engine
+        self._lock = threading.Lock()
         self._waiters: list[asyncio.Future] = []
         self._task: Optional[asyncio.Task] = None
 
     async def flush(self) -> None:
         fut = asyncio.get_running_loop().create_future()
-        self._waiters.append(fut)
-        if self._task is None or self._task.done():
-            self._task = asyncio.ensure_future(self._run())
+        with self._lock:
+            self._waiters.append(fut)
+            # done() covers a round task that died without its locked
+            # handoff (its loop closed with the task pending): the next
+            # flusher — on any loop — revives the group commit
+            if self._task is None or self._task.done():
+                self._task = asyncio.ensure_future(self._run())
         await fut
+
+    def _revive(self) -> None:
+        """Restart the round on THIS loop — scheduled via
+        call_soon_threadsafe when a foreign host loop died mid-round."""
+        with self._lock:
+            if self._waiters and (self._task is None or self._task.done()):
+                self._task = asyncio.ensure_future(self._run())
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
-        while self._waiters:
-            batch, self._waiters = self._waiters, []
+        while True:
+            with self._lock:
+                if not self._waiters:
+                    # hand off INSIDE the lock: a flusher on another loop
+                    # that observed a still-pending task must not strand
+                    # its waiter on a round that already decided to exit
+                    self._task = None
+                    return
+                batch, self._waiters = self._waiters, []
+            exc: Optional[BaseException] = None
             try:
                 await loop.run_in_executor(None, self._engine.sync)
+            except asyncio.CancelledError:
+                # this round's HOST loop is tearing down (asyncio.run
+                # cancels pending tasks at exit) — that is not an fsync
+                # failure, and waiters on OTHER loops must not see it:
+                # requeue the batch, hand the round to every surviving
+                # waiter loop (idempotent under the lock), and let the
+                # cancellation proceed on this loop
+                with self._lock:
+                    self._waiters = batch + self._waiters
+                    self._task = None
+                    for fl in {f.get_loop() for f in self._waiters}:
+                        if fl is loop:
+                            continue
+                        try:
+                            fl.call_soon_threadsafe(self._revive)
+                        except RuntimeError:
+                            pass  # that loop is gone too
+                raise
             except Exception as e:  # noqa: BLE001 — fail THIS round only
-                for f in batch:
-                    if not f.done():
-                        f.set_exception(e)
-            else:
-                for f in batch:
-                    if not f.done():
-                        f.set_result(None)
+                exc = e
+            for f in batch:
+                if f.get_loop() is loop:
+                    _deliver(f, exc)
+                else:
+                    try:
+                        f.get_loop().call_soon_threadsafe(_deliver, f, exc)
+                    except RuntimeError:
+                        pass  # waiter's loop already closed
 
 
 class MultiLogEngine:
@@ -162,11 +217,18 @@ class MultiLogEngine:
         self.dir = dir_path
         self.group_commit = _GroupCommit(self)
         self._refs = 0
+        # serializes sync vs close: tlm_close deletes the native Store,
+        # so closing while an fsync round is mid-flight in any thread
+        # (executor, or a foreign loop's cancelled round whose job keeps
+        # running) would be a use-after-free.  close() blocks the few ms
+        # an in-flight fsync needs; later syncs fail cleanly.
+        self._sync_lock = threading.Lock()
 
     def close(self) -> None:
-        if self._h:
-            self._lib.tlm_close(self._h)
-            self._h = None
+        with self._sync_lock:
+            if self._h:
+                self._lib.tlm_close(self._h)
+                self._h = None
 
     def register_group(self, name: str) -> int:
         err = ctypes.create_string_buffer(256)
@@ -176,12 +238,13 @@ class MultiLogEngine:
         return gid
 
     def sync(self) -> None:
-        h = self._h
-        if not h:
-            raise IOError("multilog engine closed")
-        err = ctypes.create_string_buffer(256)
-        if self._lib.tlm_sync(h, err, 256) != 0:
-            raise IOError(f"multilog sync failed: {err.value.decode()}")
+        with self._sync_lock:
+            h = self._h
+            if not h:
+                raise IOError("multilog engine closed")
+            err = ctypes.create_string_buffer(256)
+            if self._lib.tlm_sync(h, err, 256) != 0:
+                raise IOError(f"multilog sync failed: {err.value.decode()}")
 
     @property
     def sync_count(self) -> int:
@@ -223,14 +286,12 @@ def _release_engine(eng: MultiLogEngine) -> None:
         if eng._refs > 0:
             return
         _engines.pop(key, None)
-    # a group-commit fsync may still be running in an executor thread;
-    # tlm_close deletes the handle, so closing under it is a
-    # use-after-free — defer until the flusher task drains
-    task = eng.group_commit._task
-    if task is not None and not task.done():
-        task.add_done_callback(lambda _t: eng.close())
-    else:
-        eng.close()
+    # close() serializes against any in-flight fsync via the engine's
+    # sync lock (blocks the few ms it needs), so closing here is safe
+    # even while a round's executor job is still running; that round's
+    # waiters — all belonging to already-shutdown stores — get a clean
+    # "engine closed" failure if they sync after this point
+    eng.close()
 
 
 class MultiLogStorage(LogStorage):
