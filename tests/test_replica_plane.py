@@ -8,71 +8,9 @@ import asyncio
 import numpy as np
 import pytest
 
-from tests.cluster import MockStateMachine
-from tpuraft.conf import Configuration
-from tpuraft.core.node import Node, State
-from tpuraft.core.node_manager import NodeManager
-from tpuraft.entity import PeerId, Task
-from tpuraft.options import NodeOptions
+from tpuraft.entity import Task
+from tpuraft.parallel.replica_cluster import ReplicaPlaneCluster
 from tpuraft.parallel.replica_plane import ReplicatedClusterPlane
-from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
-
-
-class ReplicaPlaneCluster:
-    """R endpoints x G groups, ONE ReplicatedClusterPlane: every node's
-    ballot box is a row-view of the [R, G] collective commit plane."""
-
-    def __init__(self, n_replicas: int, n_groups: int, mesh=None,
-                 election_timeout_ms: int = 400):
-        self.net = InProcNetwork()
-        self.R = n_replicas
-        self.endpoints = [PeerId.parse(f"127.0.0.1:{7700 + i}")
-                          for i in range(n_replicas)]
-        self.conf = Configuration(list(self.endpoints))
-        self.groups = [f"g{k}" for k in range(n_groups)]
-        self.plane = ReplicatedClusterPlane(
-            n_replicas, n_groups, mesh=mesh, tick_interval_ms=5)
-        self.nodes: dict[tuple[str, PeerId], Node] = {}
-        self.fsms: dict[tuple[str, PeerId], MockStateMachine] = {}
-        self.election_timeout_ms = election_timeout_ms
-
-    async def start_all(self):
-        await self.plane.start()
-        for r, ep in enumerate(self.endpoints):
-            server = RpcServer(ep.endpoint)
-            manager = NodeManager(server)
-            self.net.bind(server)
-            transport = InProcTransport(self.net, ep.endpoint)
-            for gid in self.groups:
-                fsm = MockStateMachine()
-                self.fsms[(gid, ep)] = fsm
-                opts = NodeOptions(
-                    election_timeout_ms=self.election_timeout_ms,
-                    initial_conf=self.conf.copy(),
-                    fsm=fsm, log_uri="memory://", raft_meta_uri="memory://")
-                node = Node(gid, ep, opts, transport,
-                            ballot_box_factory=self.plane.ballot_box_factory(
-                                gid, r))
-                node.node_manager = manager
-                manager.add(node)
-                assert await node.init()
-                self.nodes[(gid, ep)] = node
-
-    async def stop_all(self):
-        for node in self.nodes.values():
-            await node.shutdown()
-        await self.plane.shutdown()
-
-    async def wait_leader(self, gid: str, timeout_s: float = 10.0) -> Node:
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + timeout_s
-        while loop.time() < deadline:
-            leaders = [n for (g, ep), n in self.nodes.items()
-                       if g == gid and n.state == State.LEADER]
-            if len(leaders) == 1:
-                return leaders[0]
-            await asyncio.sleep(0.02)
-        raise TimeoutError(f"no leader for {gid}")
 
 
 async def _apply_ok(node, data, t=10.0):

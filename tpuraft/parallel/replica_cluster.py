@@ -1,0 +1,144 @@
+"""Bootable replica-plane cluster (VERDICT r2 #2): the deployment mode
+that wires R co-located replica endpoints x G raft groups onto ONE
+:class:`tpuraft.parallel.replica_plane.ReplicatedClusterPlane` — every
+node's ballot box is a row-view of the [R, G] collective commit plane,
+so the quorum commit point for ALL groups is one replica-axis
+all_gather + order statistic per tick (reference role: the NCCL/MPI
+"math plane" of ``core:ReplicatorGroup`` ack aggregation, redesigned as
+an XLA collective over the device mesh — SURVEY.md §6 comms backend).
+
+This is package code an operator can boot (``examples/replica_plane.py``
+is the runnable main); the test suite and the driver's multi-chip dry
+run consume THIS class rather than a test-only harness.
+
+Topology: each replica endpoint hosts one replica of every group behind
+one RpcServer/NodeManager; entries still travel the protocol plane
+(AppendEntries RPC), while commit advancement comes from each replica's
+own DURABLE log state via the plane's ``on_stable`` hook — see
+replica_plane.py's term-scoped-attestation safety note.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from tpuraft.conf import Configuration
+from tpuraft.core.node import Node, State
+from tpuraft.core.node_manager import NodeManager
+from tpuraft.core.state_machine import Iterator, StateMachine
+from tpuraft.entity import PeerId, Task
+from tpuraft.options import NodeOptions
+from tpuraft.parallel.replica_plane import ReplicatedClusterPlane
+from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+
+class RecordingStateMachine(StateMachine):
+    """Minimal FSM for examples/bring-up: records applied payloads."""
+
+    def __init__(self):
+        self.logs: list[bytes] = []
+
+    async def on_apply(self, it: Iterator) -> None:
+        while it.valid():
+            self.logs.append(it.data())
+            it.next()
+
+
+class ReplicaPlaneCluster:
+    """R replica endpoints x G groups over ONE ReplicatedClusterPlane.
+
+    Parameters
+    ----------
+    fsm_factory: called as ``fsm_factory()`` per (group, replica) node;
+        defaults to :class:`RecordingStateMachine`.
+    log_uri / meta_uri: per-node storage URIs; ``{group}`` and
+        ``{replica}`` placeholders are substituted, so
+        ``multilog:///data/r{replica}#{group}`` gives each replica one
+        shared journal engine across its groups.
+    mesh: optional 2D ``jax.sharding.Mesh`` with ("replica", "groups")
+        axes; None runs the plane's numpy twin (tiny deployments).
+    net: optional shared InProcNetwork (tests inject one to partition
+        endpoints); by default a fresh loopback network is created.
+    """
+
+    def __init__(self, n_replicas: int, n_groups: int, mesh=None,
+                 election_timeout_ms: int = 400,
+                 fsm_factory: Optional[Callable[[], StateMachine]] = None,
+                 log_uri: str = "memory://", meta_uri: str = "memory://",
+                 base_port: int = 7700, tick_interval_ms: int = 5,
+                 net: Optional[InProcNetwork] = None):
+        self.net = net or InProcNetwork()
+        self.R = n_replicas
+        self.endpoints = [PeerId.parse(f"127.0.0.1:{base_port + i}")
+                          for i in range(n_replicas)]
+        self.conf = Configuration(list(self.endpoints))
+        self.groups = [f"g{k}" for k in range(n_groups)]
+        self.plane = ReplicatedClusterPlane(
+            n_replicas, n_groups, mesh=mesh,
+            tick_interval_ms=tick_interval_ms)
+        self.nodes: dict[tuple[str, PeerId], Node] = {}
+        self.fsms: dict[tuple[str, PeerId], StateMachine] = {}
+        self.election_timeout_ms = election_timeout_ms
+        self._fsm_factory = fsm_factory or RecordingStateMachine
+        self._log_uri = log_uri
+        self._meta_uri = meta_uri
+
+    def _uri(self, template: str, gid: str, replica: int) -> str:
+        return template.format(group=gid, replica=replica)
+
+    async def start_all(self) -> None:
+        await self.plane.start()
+        for r, ep in enumerate(self.endpoints):
+            server = RpcServer(ep.endpoint)
+            manager = NodeManager(server)
+            self.net.bind(server)
+            transport = InProcTransport(self.net, ep.endpoint)
+            for gid in self.groups:
+                fsm = self._fsm_factory()
+                self.fsms[(gid, ep)] = fsm
+                opts = NodeOptions(
+                    election_timeout_ms=self.election_timeout_ms,
+                    initial_conf=self.conf.copy(), fsm=fsm,
+                    log_uri=self._uri(self._log_uri, gid, r),
+                    raft_meta_uri=self._uri(self._meta_uri, gid, r))
+                node = Node(gid, ep, opts, transport,
+                            ballot_box_factory=self.plane.ballot_box_factory(
+                                gid, r))
+                node.node_manager = manager
+                manager.add(node)
+                if not await node.init():
+                    raise RuntimeError(f"node init failed: {gid}@{ep}")
+                self.nodes[(gid, ep)] = node
+
+    async def stop_all(self) -> None:
+        for node in self.nodes.values():
+            await node.shutdown()
+        await self.plane.shutdown()
+
+    async def stop_replica(self, ep: PeerId) -> None:
+        """Crash one replica endpoint: silence its network and shut its
+        nodes down (chaos hook for examples/tests)."""
+        self.net.stop_endpoint(ep.endpoint)
+        for key in [k for k in self.nodes if k[1] == ep]:
+            await self.nodes.pop(key).shutdown()
+
+    async def wait_leader(self, gid: str, timeout_s: float = 10.0) -> Node:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while loop.time() < deadline:
+            leaders = [n for (g, ep), n in self.nodes.items()
+                       if g == gid and n.state == State.LEADER]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"no leader for {gid}")
+
+    async def apply_ok(self, node: Node, data: bytes,
+                       timeout_s: float = 10.0):
+        fut = asyncio.get_running_loop().create_future()
+        await node.apply(Task(data=data, done=fut.set_result))
+        st = await asyncio.wait_for(fut, timeout_s)
+        if not st.is_ok():
+            raise RuntimeError(f"apply failed: {st}")
+        return st
