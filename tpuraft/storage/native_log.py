@@ -38,9 +38,15 @@ def lib_path() -> str:
 
 
 def ensure_built(timeout: float = 120.0) -> str:
-    """Builds the .so via make if missing; returns its path or raises."""
+    """ALWAYS runs make (mtime-aware, ~no-op when current): an
+    existence-only check would dlopen a stale prebuilt .so missing
+    newly added symbols; flock serializes concurrent spawns."""
     path = lib_path()
-    if not os.path.exists(path):
+    import fcntl
+
+    lock_path = os.path.join(_native_dir(), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
         subprocess.run(
             ["make", "-C", _native_dir()], check=True, timeout=timeout,
             capture_output=True)
