@@ -186,12 +186,19 @@ async def run_store(args) -> None:
         await asyncio.gather(*(drive(n) for n in led))
         elapsed = time.monotonic() - t_start
         lats.sort()
+        import resource
+
         return {
             "ok": ok[0], "errs": errs[0], "elapsed": elapsed,
             "applied": CountFSM.applied,
             "lat_p50_ms": round(lats[len(lats) // 2] * 1e3, 3) if lats else None,
             "lat_p99_ms": round(lats[int(len(lats) * 0.99)] * 1e3, 3)
             if lats else None,
+            # scale accounting (VERDICT r2 #1): memory + event-loop task
+            # population at this G, per store process
+            "rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+            "asyncio_tasks": len(asyncio.all_tasks()),
         }
 
     async def latency_probe(n_ops: int):
@@ -219,6 +226,91 @@ async def run_store(args) -> None:
             "min_ms": round(lats[0] * 1e3, 3),
         }
 
+    async def latency_breakdown(n_ops: int):
+        """Per-stage timestamps along ONE group's low-load commit-ack
+        path (VERDICT r2 #3): apply -> stage -> leader fsync -> RPC
+        (follower fsync inside) -> quorum tick -> commit advance -> FSM
+        ack, via transient wrappers — production code stays clean."""
+        if not led:
+            return {"n": 0}
+        node = led[0]
+        lm = node.log_manager
+        box = node.ballot_box
+        marks: dict = {}
+
+        orig_flush = lm.flush_staged
+
+        async def flush_wrap(upto=None):
+            marks.setdefault("flush_s", time.perf_counter())
+            r = await orig_flush(upto)
+            marks.setdefault("flush_e", time.perf_counter())
+            return r
+
+        orig_call = transport.append_entries
+
+        async def ae_wrap(dst, req, timeout_ms=None):
+            if req.entries:  # ignore idle probes/heartbeats
+                marks.setdefault("rpc_s", time.perf_counter())
+            r = await orig_call(dst, req, timeout_ms=timeout_ms)
+            if req.entries:
+                marks.setdefault("rpc_e", time.perf_counter())
+            return r
+
+        orig_tick = engine.tick_once
+
+        def tick_wrap():
+            t = time.perf_counter()
+            r = orig_tick()
+            if "adv" in marks:
+                marks.setdefault("tick_s", t)
+                marks.setdefault("tick_e", time.perf_counter())
+            return r
+
+        orig_adv = box._advance
+
+        def adv_wrap(idx):
+            marks.setdefault("adv", time.perf_counter())
+            return orig_adv(idx)
+
+        lm.flush_staged = flush_wrap
+        transport.append_entries = ae_wrap
+        engine.tick_once = tick_wrap
+        box._advance = adv_wrap
+        stages: dict[str, list] = {}
+        total = []
+        try:
+            for _ in range(n_ops):
+                marks.clear()
+                fut = loop.create_future()
+                t0 = time.perf_counter()
+                await node.apply(Task(data=b"brk", done=fut.set_result))
+                st = await fut
+                t_ack = time.perf_counter()
+                if not st.is_ok():
+                    continue
+                rel = {k: (v - t0) * 1e3 for k, v in marks.items()}
+                rel["ack"] = (t_ack - t0) * 1e3
+                for k, v in rel.items():
+                    stages.setdefault(k, []).append(v)
+                total.append(rel["ack"])
+                await asyncio.sleep(0.002)
+        finally:
+            lm.flush_staged = orig_flush
+            transport.append_entries = orig_call
+            engine.tick_once = orig_tick
+            box._advance = orig_adv
+
+        def p50(xs):
+            return round(sorted(xs)[len(xs) // 2], 3) if xs else None
+
+        return {
+            "n": len(total),
+            "note": "relative ms marks, p50 across ops; rpc includes "
+                    "follower fsync; adv = quorum commit advanced on "
+                    "the engine; tick = the advancing tick's span",
+            "stage_p50_ms": {k: p50(v) for k, v in sorted(stages.items())},
+        }
+
     while True:
         line = (await reader.readline()).decode().strip()
         if not line or line == "QUIT":
@@ -243,6 +335,9 @@ async def run_store(args) -> None:
             print("RESULT " + json.dumps(res), flush=True)
         elif cmd[0] == "LAT":
             res = await latency_probe(int(cmd[1]))
+            print("RESULT " + json.dumps(res), flush=True)
+        elif cmd[0] == "BRK":
+            res = await latency_breakdown(int(cmd[1]))
             print("RESULT " + json.dumps(res), flush=True)
 
     for n in nodes:
@@ -281,6 +376,10 @@ def main() -> None:
                     help="entries per apply_batch (reference applyBatch)")
     ap.add_argument("--payload", type=int, default=16)
     ap.add_argument("--election-timeout-ms", type=int, default=1500)
+    ap.add_argument("--json-out", default="BENCH_E2E.json",
+                    help="result file (relative to the repo root)")
+    ap.add_argument("--skip-brk", action="store_true",
+                    help="skip the per-stage breakdown round")
     ap.add_argument("--dir", default="")
     ap.add_argument("--store", action="store_true",
                     help="internal: run as a store process")
@@ -351,9 +450,19 @@ def main() -> None:
             return [json.loads(expect(p, "RESULT")[len("RESULT "):])
                     for p in procs]
 
+        def round_one(p, cmd):
+            # low-load probes run on ONE store while the others idle —
+            # probing all three concurrently triples the CPU in every
+            # "low-load" sample on a 1-core host
+            p.stdin.write((cmd + "\n").encode())
+            p.stdin.flush()
+            return json.loads(expect(p, "RESULT")[len("RESULT "):])
+
         round_all(f"GO {args.warmup}")          # warmup
         results = round_all(f"GO {args.duration}")
-        lat = round_all("LAT 200")[0]           # low-load single-group acks
+        lat = round_one(procs[0], "LAT 200")    # low-load single-group acks
+        brk = (None if args.skip_brk
+               else round_one(procs[0], "BRK 150"))  # per-stage breakdown
         for p in procs:
             p.stdin.write(b"QUIT\n")
             p.stdin.flush()
@@ -378,6 +487,10 @@ def main() -> None:
                 "underload_ack_p50_ms": [r["lat_p50_ms"] for r in results],
                 "underload_ack_p99_ms": [r["lat_p99_ms"] for r in results],
                 "lowload_single_group_ack": lat,
+                "ack_breakdown": brk,
+                "rss_mb_per_store": [r.get("rss_mb") for r in results],
+                "asyncio_tasks_per_store": [r.get("asyncio_tasks")
+                                            for r in results],
                 "host_cores": os.cpu_count(),
                 "per_core_commits_per_sec": round(
                     cps / max(1, os.cpu_count()), 1),
@@ -390,7 +503,7 @@ def main() -> None:
             },
         }
         print(json.dumps(out))
-        with open(os.path.join(REPO, "BENCH_E2E.json"), "w") as f:
+        with open(os.path.join(REPO, args.json_out), "w") as f:
             json.dump(out, f, indent=1)
     finally:
         for p in procs:

@@ -339,7 +339,10 @@ def decode_message(buf: bytes | memoryview):
             entries = []
             for _ in range(n):
                 blob, off = _unpack_bytes(buf, off)
-                entries.append(LogEntry.decode(blob))
+                # wire path: TCP is already checksummed and the journal
+                # CRCs records at write time — skip the per-entry CRC
+                # (storage reads keep verify=True)
+                entries.append(LogEntry.decode(blob, verify=False))
             kwargs[name] = entries
         else:
             raise TypeError(f"cannot decode field {name}: {ann}")

@@ -26,6 +26,7 @@ import ctypes
 import os
 import struct
 import threading
+import time
 from typing import Optional
 
 from tpuraft.entity import LogEntry
@@ -133,21 +134,67 @@ class _GroupCommit:
     lock-guarded and each future resolves on its OWN loop — setting a
     future from a foreign loop's thread is not thread-safe."""
 
+    # An inline fsync blocks the event loop, so the fast path self-bans
+    # the moment a sync exceeds this (slow/contended disk): stalling the
+    # loop stalls heartbeats for EVERY group in the process.
+    INLINE_MAX_S = 0.001
+    # Gap below which another flush is considered "hot on our heels":
+    # take the coalescing round so N concurrent flushers cost one fsync.
+    INLINE_IDLE_GAP_S = 0.002
+
     def __init__(self, engine: "MultiLogEngine"):
         self._engine = engine
         self._lock = threading.Lock()
         self._waiters: list[asyncio.Future] = []
         self._task: Optional[asyncio.Task] = None
+        self._last_sync = 0.0
+        self._cost_ewma = 0.0  # smoothed inline-sync cost (seconds)
 
     async def flush(self) -> None:
-        fut = asyncio.get_running_loop().create_future()
+        # LOW-LOAD fast path (VERDICT r2 #3): the executor round costs
+        # ~2ms end-to-end on a busy single-core loop (the completion
+        # callback queues behind tick + replicator work) while the fsync
+        # itself is ~0.1ms on this disk class.  When no round is running
+        # and no flush landed within the idle gap, fsync INLINE — the
+        # commit-ack path shortens by the round-trip on both the leader
+        # and the follower.  Sustained load (back-to-back flushes) keeps
+        # the coalescing round: N concurrent flushers -> one fsync.
         with self._lock:
-            self._waiters.append(fut)
-            # done() covers a round task that died without its locked
-            # handoff (its loop closed with the task pending): the next
-            # flusher — on any loop — revives the group commit
-            if self._task is None or self._task.done():
-                self._task = asyncio.ensure_future(self._run())
+            idle = (self._task is None or self._task.done()) and \
+                (time.monotonic() - self._last_sync
+                 > self.INLINE_IDLE_GAP_S)
+            if idle and self._cost_ewma >= self.INLINE_MAX_S:
+                # decay the ban while idle: a past writeback spike must
+                # not disable the fast path for the process lifetime —
+                # after a stretch of idle flushes an inline retry
+                # re-measures the disk
+                self._cost_ewma *= 0.9
+            if idle and self._cost_ewma < self.INLINE_MAX_S \
+                    and not self._waiters:
+                self._last_sync = time.monotonic()  # claim the window
+                inline = True
+            else:
+                inline = False
+                fut = asyncio.get_running_loop().create_future()
+                self._waiters.append(fut)
+                # done() covers a round task that died without its
+                # locked handoff (its loop closed with the task
+                # pending): the next flusher revives the group commit
+                if self._task is None or self._task.done():
+                    self._task = asyncio.ensure_future(self._run())
+        if inline:
+            t0 = time.perf_counter()
+            try:
+                self._engine.sync()
+            finally:
+                dur = time.perf_counter() - t0
+                with self._lock:
+                    self._last_sync = time.monotonic()
+                    # smoothed: one writeback spike doesn't ban the fast
+                    # path, a genuinely slow disk does (and keeps it
+                    # banned while the ewma stays above the ceiling)
+                    self._cost_ewma = 0.7 * self._cost_ewma + 0.3 * dur
+            return
         await fut
 
     def _revive(self) -> None:
@@ -171,6 +218,8 @@ class _GroupCommit:
             exc: Optional[BaseException] = None
             try:
                 await loop.run_in_executor(None, self._engine.sync)
+                with self._lock:
+                    self._last_sync = time.monotonic()
             except asyncio.CancelledError:
                 # this round's HOST loop is tearing down (asyncio.run
                 # cancels pending tasks at exit) — that is not an fsync
