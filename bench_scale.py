@@ -110,7 +110,8 @@ async def run_rung(args) -> dict:
     for i in range(R):
         eng = engines[i]
         now = eng.now_ms()
-        jit = rng.integers(0, 4 * args.election_timeout_ms, eng.G)
+        spread_ms = int(args.elect_spread_s * 1000) or             4 * args.election_timeout_ms
+        jit = rng.integers(0, spread_ms, eng.G)
         eng.elect_deadline[:] = now + args.election_timeout_ms // 4 + jit
         eng.mark_dirty()
     boot_s = time.monotonic() - t_boot
@@ -206,6 +207,11 @@ def main() -> None:
     ap.add_argument("--groups", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--pace-ms", type=float, default=0.0)
+    ap.add_argument("--elect-spread-s", type=float, default=0.0,
+                    help="window over which the boot-deferred elections "
+                         "release (0 = 4x election timeout); widen at "
+                         "high GxR so the election herd stays under the "
+                         "host's per-second election capacity")
     ap.add_argument("--dir", default="")
     args = ap.parse_args()
 
@@ -230,6 +236,7 @@ def main() -> None:
         cmd = [sys.executable, os.path.join(REPO, "bench_scale.py"),
                "--rung", "--groups", str(g), "--dir", workdir,
                "--replicas", str(args.replicas),
+               "--elect-spread-s", str(args.elect_spread_s),
                "--duration", str(rung_duration), "--batch", str(args.batch),
                "--pace-ms", str(pace_ms),
                "--election-timeout-ms", str(args.election_timeout_ms)]
