@@ -110,7 +110,8 @@ async def run_rung(args) -> dict:
     for i in range(R):
         eng = engines[i]
         now = eng.now_ms()
-        spread_ms = int(args.elect_spread_s * 1000) or             4 * args.election_timeout_ms
+        spread_ms = (int(args.elect_spread_s * 1000)
+                     or 4 * args.election_timeout_ms)
         jit = rng.integers(0, spread_ms, eng.G)
         eng.elect_deadline[:] = now + args.election_timeout_ms // 4 + jit
         eng.mark_dirty()
