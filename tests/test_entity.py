@@ -74,6 +74,24 @@ class TestLogEntryCodec:
         with pytest.raises(ValueError, match="crc"):
             LogEntry.decode(bytes(raw))
 
+    def test_wire_decode_defers_crc_to_verify_crc(self):
+        raw = bytearray(LogEntry(type=EntryType.DATA, id=LogId(1, 1),
+                                 data=b"x" * 100).encode())
+        raw[-3] ^= 0xFF
+        # wire path skips the CRC — corruption decodes "successfully"...
+        e = LogEntry.decode(bytes(raw), verify=False)
+        # ...but the deferred staging-time check catches it
+        with pytest.raises(ValueError, match="crc"):
+            e.verify_crc()
+        # a clean blob verifies once, then becomes a no-op
+        good = LogEntry.decode(
+            LogEntry(type=EntryType.DATA, id=LogId(2, 1), data=b"y").encode(),
+            verify=False)
+        good.verify_crc()
+        good.verify_crc()
+        # locally-built entries (fresh CRC at encode) are no-ops too
+        LogEntry(type=EntryType.DATA, id=LogId(3, 1), data=b"z").verify_crc()
+
     def test_encoded_size(self):
         e = LogEntry(type=EntryType.DATA, id=LogId(1, 1), data=b"abc")
         assert e.encoded_size() == len(e.encode())

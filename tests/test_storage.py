@@ -110,7 +110,11 @@ class TestFileLogStorage(_BaseLogStorageSuite):
         s.init()
         s.append_entries(mk_entries(1, 3, size=40))
         s.shutdown()
-        # corrupt: chop bytes off the tail of the (only) segment
+        # corrupt: chop bytes off the tail of the (only) segment.  A torn
+        # write only happens on a CRASH — clean shutdown advances the
+        # durability watermark over the whole file, which would (rightly)
+        # make this loud corruption instead; drop it to simulate the crash.
+        (tmp_path / "log" / "synced").unlink()
         seg = sorted((tmp_path / "log").glob("seg_*.log"))[0]
         data = seg.read_bytes()
         seg.write_bytes(data[:-10])
@@ -127,6 +131,118 @@ class TestFileLogStorage(_BaseLogStorageSuite):
         with pytest.raises(ValueError):
             s.append_entries(mk_entries(7, 1))
         s.shutdown()
+
+    def test_tail_corruption_after_clean_shutdown_is_loud(self, tmp_path):
+        """Clean shutdown leaves no torn-write window: the watermark
+        covers the file, so even LAST-entry corruption fails loudly."""
+        from tpuraft.storage.log_storage import CorruptLogError
+
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 3, size=40))
+        s.shutdown()
+        seg = sorted((tmp_path / "log").glob("seg_*.log"))[0]
+        data = bytearray(seg.read_bytes())
+        data[-5] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        s2 = self.mk(tmp_path)
+        with pytest.raises(CorruptLogError):
+            s2.init()
+
+    def test_crash_window_failures_stay_truncatable(self, tmp_path):
+        """Length-prefix corruption BEYOND the watermark (the unsynced
+        crash window) must stay a truncatable torn tail even when
+        valid-looking frames follow — unordered page writeback can
+        legitimately persist later blocks while losing earlier ones."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 3, size=40))
+        s.shutdown()
+        (tmp_path / "log" / "synced").unlink()  # simulate crash
+        seg = sorted((tmp_path / "log").glob("seg_*.log"))[0]
+        data = bytearray(seg.read_bytes())
+        frame = 4 + 32 + 40
+        data[frame] ^= 0xFF  # second entry's length prefix
+        seg.write_bytes(bytes(data))
+        s2 = self.mk(tmp_path)
+        s2.init()  # no exception: entries 2-3 were never provably durable
+        assert s2.last_log_index() == 1
+        s2.shutdown()
+
+    def test_truncate_suffix_crash_window_not_bricked(self, tmp_path, monkeypatch):
+        """Crash between the suffix shrink and the final watermark save
+        must NOT brick startup: the floored watermark (written fsynced
+        BEFORE the shrink) makes the stale value LOW, never HIGH."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5, size=40))
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # watermark now covers all 5 entries
+        orig = FileLogStorage._save_watermark
+
+        def drop_final_save(self_, sync=False):
+            if sync:
+                orig(self_, sync)  # the pre-shrink floor still lands
+
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", drop_final_save)
+        s2.truncate_suffix(3)
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", orig)
+        # simulate crash: no shutdown; reopen from disk state
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.last_log_index() == 3
+        s3.shutdown()
+
+    def test_missing_durable_segment_fails_loudly(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 20, size=40))  # spans segments
+        s.shutdown()
+        from tpuraft.storage.log_storage import CorruptLogError
+
+        segs = sorted((tmp_path / "log").glob("seg_*.log"),
+                      key=lambda p: int(p.name[4:-4]))
+        assert len(segs) >= 3
+        segs[1].unlink()  # a fully-durable mid-chain segment vanishes
+        s2 = self.mk(tmp_path)
+        with pytest.raises(CorruptLogError):
+            s2.init()
+
+    def test_missing_watermark_segment_fails_loudly(self, tmp_path):
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 20, size=40))
+        s.shutdown()
+        from tpuraft.storage.log_storage import CorruptLogError
+
+        segs = sorted((tmp_path / "log").glob("seg_*.log"),
+                      key=lambda p: int(p.name[4:-4]))
+        segs[-1].unlink()  # the watermark segment itself vanishes
+        s2 = self.mk(tmp_path)
+        with pytest.raises(CorruptLogError):
+            s2.init()
+
+    def test_midlog_corruption_fails_loudly(self, tmp_path):
+        """CRC failure with valid entries AFTER it is corruption, not a
+        torn tail: truncating there would silently drop acked suffix
+        entries, so startup must refuse instead."""
+        from tpuraft.storage.log_storage import CorruptLogError
+
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 3, size=40))
+        s.shutdown()
+        seg = sorted((tmp_path / "log").glob("seg_*.log"))[0]
+        data = bytearray(seg.read_bytes())
+        # flip one payload byte in the MIDDLE entry (frames are
+        # 4B len + 32B header + 40B data each)
+        frame = 4 + 32 + 40
+        data[frame + frame - 5] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        s2 = self.mk(tmp_path)
+        with pytest.raises(CorruptLogError):
+            s2.init()
 
 
 def _native_available():
@@ -292,6 +408,23 @@ class TestLogManager:
         assert not await lm.append_entries_follower(99, 1, mk_entries(100, 1))
         # mismatched prev term rejected
         assert not await lm.append_entries_follower(4, 1, mk_entries(5, 1, term=2))
+        await lm.shutdown()
+
+    async def test_follower_rejects_wire_corrupted_entry(self):
+        """A blob corrupted past TCP's checksum must NOT reach storage:
+        the append is refused (leader backs off + retransmits), instead
+        of staging bytes whose embedded CRC mismatches — which a later
+        recovery scan would mistake for a torn tail."""
+        lm = await self.mk()
+        raw = bytearray(mk_entries(1, 1, term=1, size=64)[0].encode())
+        raw[-3] ^= 0xFF
+        bad = LogEntry.decode(bytes(raw), verify=False)  # wire path
+        ok = await lm.append_entries_follower(0, 0, [bad])
+        assert not ok
+        assert lm.last_log_index() == 0  # nothing staged
+        # a clean retransmission then succeeds
+        ok = await lm.append_entries_follower(0, 0, mk_entries(1, 1, term=1))
+        assert ok and lm.last_log_index() == 1
         await lm.shutdown()
 
     async def test_duplicate_append_idempotent(self):

@@ -217,7 +217,36 @@ class LogEntry:
         # entry re-encodes bit-identically (follower staging to the
         # journal, leader fan-out) without paying the codec again
         e._enc = (eid, raw)
+        if not verify:
+            # mark for the one deferred check at storage-staging time:
+            # TCP's 16-bit checksum is weak, and a corrupt blob staged
+            # bit-identically would only surface at the NEXT recovery
+            # scan — as a spurious "torn tail" truncating acked entries
+            e._crc_unverified = True
         return e
+
+    def verify_crc(self) -> None:
+        """One deferred CRC check against the cached wire blob.
+
+        Raises ValueError on mismatch.  No-op for locally-built entries
+        (``encode`` computes a fresh CRC) and for already-verified ones.
+        """
+        if not self.__dict__.get("_crc_unverified"):
+            return
+        cached = self.__dict__.get("_enc")
+        if cached is None or cached[0] != self.id:
+            self._crc_unverified = False
+            return  # will re-encode with a fresh CRC anyway
+        raw = cached[1]
+        (_m, _t, _r, _term, _idx, peers_len, _n2, _dlen, crc) = \
+            _HDR.unpack_from(raw)
+        computed = zlib.crc32(raw[_HDR.size + peers_len:])
+        if peers_len:
+            computed = zlib.crc32(raw[_HDR.size:_HDR.size + peers_len], computed)
+        if computed != crc:
+            raise ValueError(
+                f"log entry crc mismatch at index {self.id.index} (wire)")
+        self._crc_unverified = False
 
     def encoded_size(self) -> int:
         return _HDR.size + len(

@@ -274,6 +274,19 @@ class LogManager:
         new_entries = entries[keep_from:]
         if not new_entries:
             return True
+        # Deferred wire-CRC check, once per entry actually staged (the
+        # wire decode skips it for speed): a blob corrupted past TCP's
+        # 16-bit checksum must NOT reach the journal — recovery scans
+        # would later mistake it for a torn tail and silently truncate
+        # acked suffix entries.  Rejecting here makes the leader back
+        # off and retransmit, turning corruption into a transient.
+        try:
+            for e in new_entries:
+                e.verify_crc()
+        except ValueError:
+            LOG.warning("rejecting AppendEntries batch: wire CRC mismatch "
+                        "at index %d", e.id.index)
+            return False
         for e in new_entries:
             self._mem_put(e)
             self._last_index = e.id.index

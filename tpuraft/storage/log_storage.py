@@ -28,6 +28,16 @@ from typing import Optional
 from tpuraft.entity import EntryType, LogEntry
 
 _FRAME = struct.Struct("<I")
+# durable_end sentinel: "this whole segment was complete at watermark time"
+_DURABLE_ALL = 1 << 62
+
+
+class CorruptLogError(Exception):
+    """Mid-log corruption (valid entries beyond a bad frame).
+
+    Distinct from a torn tail: truncating here would silently drop
+    acked suffix entries, so startup fails loudly instead.
+    """
 
 
 def _fsync_dir(path: str) -> None:
@@ -150,14 +160,27 @@ class _Segment:
     def last_index(self) -> int:
         return self.first_index + len(self.offsets) - 1
 
-    def open(self) -> None:
+    def open(self, durable_end: int = 0) -> None:
         exists = os.path.exists(self.path)
         self._f = open(self.path, "r+b" if exists else "w+b")
         if exists:
-            self._scan()
+            self._scan(durable_end)
 
-    def _scan(self) -> None:
-        """Rebuild the offset index; truncate a torn tail write if found."""
+    def _scan(self, durable_end: int) -> None:
+        """Rebuild the offset index; truncate a torn tail write if found.
+
+        ``durable_end``: bytes below it were verified present at an
+        earlier startup (the store's ``synced`` watermark).  A failure
+        BELOW it can't be a torn in-flight write — it is corruption of
+        previously-durable (acked, possibly committed) data, and
+        truncating there would silently drop the log suffix, so fail
+        loudly and let the operator rebuild the replica from a
+        snapshot.  At/above the watermark nothing was acked against a
+        completed fsync, and unordered page writeback can legitimately
+        persist a LATER entry's blocks while losing an earlier one's —
+        so any failure there is a truncatable torn tail, valid-looking
+        bytes after it notwithstanding.
+        """
         f = self._f
         f.seek(0, os.SEEK_END)
         end = f.tell()
@@ -168,15 +191,40 @@ class _Segment:
             f.seek(off)
             (flen,) = _FRAME.unpack(f.read(_FRAME.size))
             if off + _FRAME.size + flen > end:
+                if off < durable_end:
+                    raise CorruptLogError(
+                        f"{self.path}: frame at offset {off} overruns the "
+                        f"file inside the durable region (<{durable_end}) — "
+                        f"refusing to truncate acked suffix")
                 break  # torn write
             blob = f.read(flen)
             try:
                 LogEntry.decode(blob)  # CRC + framing check
             except (ValueError, struct.error):
+                if off < durable_end:
+                    raise CorruptLogError(
+                        f"{self.path}: CRC/framing failure at offset {off} "
+                        f"inside the durable region (<{durable_end}) — "
+                        f"refusing to truncate acked suffix")
                 break
             self.offsets.append(off)
             off += _FRAME.size + flen
             good_end = off
+        if durable_end >= _DURABLE_ALL:
+            # fully-durable segment (strictly below the watermark
+            # segment): its exact size wasn't recorded, so the most we
+            # can demand is that every byte present scans clean
+            bad = good_end < end
+        else:
+            # watermark segment: at least the recorded size must scan
+            # clean — catches clean-at-a-frame-boundary shrinkage too
+            # (no bad frame to trip on, the file just ends early)
+            bad = good_end < durable_end
+        if bad:
+            raise CorruptLogError(
+                f"{self.path}: durable region ran to "
+                f"{min(durable_end, end)} bytes but only {good_end} scan "
+                f"clean — acked entries lost")
         if good_end < end:
             f.truncate(good_end)
         self.size = good_end
@@ -237,6 +285,12 @@ class FileLogStorage(LogStorage):
         self._first = 1
         self._seg_max = segment_max_bytes or self.SEGMENT_MAX_BYTES
         self._conf_indexes: list[int] = []
+        # synced frontier (active_segment_first_index, size): the bytes
+        # PROVEN on disk by a completed fsync.  The persisted watermark
+        # (`synced` file) only ever records this value, so it can never
+        # run ahead of durability (stale-HIGH), which would turn a
+        # legitimate torn tail into a false CorruptLogError.
+        self._synced = (-1, 0)
         # guards _segments and file handles: the event loop reads (get_entry)
         # while the LogManager flusher appends/truncates in executor threads
         self._lock = threading.RLock()
@@ -246,14 +300,26 @@ class FileLogStorage(LogStorage):
     def init(self) -> None:
         os.makedirs(self._dir, exist_ok=True)
         self._load_meta()
+        wm_first, wm_size = self._load_watermark()
         names = sorted(
             (n for n in os.listdir(self._dir) if n.startswith("seg_") and n.endswith(".log")),
             key=lambda n: int(n[4:-4]),
         )
         drop_rest = False
         for n in names:
-            seg = _Segment(os.path.join(self._dir, n), int(n[4:-4]))
-            seg.open()
+            first_index = int(n[4:-4])
+            # durable region (see _Segment._scan): segments strictly
+            # below the watermark segment were complete when the
+            # watermark was recorded; the watermark segment is durable
+            # up to the recorded size; later segments not at all
+            if first_index < wm_first:
+                durable_end = _DURABLE_ALL
+            elif first_index == wm_first:
+                durable_end = wm_size
+            else:
+                durable_end = 0
+            seg = _Segment(os.path.join(self._dir, n), first_index)
+            seg.open(durable_end)
             # stale: fully below first_log_index — crash mid truncate_prefix
             # (meta saved, file not yet deleted)
             stale = seg.first_index < self._first and (
@@ -267,17 +333,99 @@ class FileLogStorage(LogStorage):
                 and seg.first_index != self._segments[-1].last_index + 1
             ):
                 # empty (torn) segment or a hole from a torn multi-segment
-                # batch append: everything from here on is unreachable
+                # batch append: everything from here on is unreachable.
+                # But a hole or vanished bytes in the DURABLE region is
+                # the fail-loud case — deleting would silently drop the
+                # acked suffix just like a silent truncation would.
+                expected = (self._segments[-1].last_index + 1
+                            if self._segments else self._first)
+                if durable_end > 0 or expected < wm_first:
+                    raise CorruptLogError(
+                        f"{self._dir}: durable segment(s) missing or empty "
+                        f"around index {expected} (watermark segment "
+                        f"{wm_first}) — refusing to drop acked suffix")
                 seg.delete()
                 drop_rest = True
                 continue
             self._segments.append(seg)
+        if wm_size > 0 and not any(s.first_index == wm_first
+                                   for s in self._segments):
+            # the watermark segment itself vanished with recorded bytes
+            # in it — destructive ops floor the watermark (fsynced)
+            # before deleting, so this can only be external loss
+            raise CorruptLogError(
+                f"{self._dir}: watermark segment seg_{wm_first}.log "
+                f"({wm_size} durable bytes) is missing — acked entries "
+                f"lost")
         self._load_conf_indexes()
+        # Bytes at/above the loaded watermark are readable but possibly
+        # still dirty in the page cache (crash-restart case): fsync them
+        # before advancing the watermark over them, or a power loss in
+        # the writeback window would turn the watermark into a false
+        # corruption alarm at the NEXT boot.  Bytes below it were
+        # fsynced before that watermark was recorded — skip (O(1)
+        # fsyncs at boot, not O(#segments)).
+        for seg in self._segments:
+            if seg.first_index >= wm_first:
+                seg.sync()
+        if self._segments:
+            last = self._segments[-1]
+            self._synced = (last.first_index, last.size)
+        else:
+            self._synced = (-1, 0)
+        self._save_watermark()
 
     def shutdown(self) -> None:
+        # clean shutdown: fsync + advance the watermark over everything
+        # written this run, so the next scan treats it all as durable.
+        # Everything at/above the synced frontier may be dirty (rolled
+        # segments in a sync=False run included) — flush it all.
+        if self._segments:
+            for s in self._segments:
+                if s.first_index >= self._synced[0]:
+                    s.sync()
+            last = self._segments[-1]
+            self._synced = (last.first_index, last.size)
+            self._save_watermark()
         for s in self._segments:
             s.close()
         self._segments.clear()
+
+    # -- durability watermark ------------------------------------------------
+    # Persists the synced frontier (active_segment_first_index, size) —
+    # recorded at init (after scan + fsync), clean shutdown, and around
+    # destructive ops; never on the append hot path.  Stale-LOW is
+    # always safe (falls back to torn-tail truncation semantics), so the
+    # ordinary save is not fsynced.  Destructive ops (suffix truncation,
+    # reset) FIRST persist a lowered floor WITH fsync: the reverse order
+    # would leave a stale-HIGH watermark if the shrink hit disk and the
+    # lowered watermark didn't, bricking startup with a false
+    # CorruptLogError.
+
+    def _watermark_path(self) -> str:
+        return os.path.join(self._dir, "synced")
+
+    def _load_watermark(self) -> tuple[int, int]:
+        try:
+            with open(self._watermark_path(), "rb") as f:
+                first, size = struct.unpack("<qq", f.read(16))
+                return first, size
+        except (FileNotFoundError, struct.error):
+            # no watermark: nothing provably durable (-1 sorts below
+            # every segment first_index, so every durable_end is 0)
+            return (-1, 0)
+
+    def _save_watermark(self, sync: bool = False) -> None:
+        blob = struct.pack("<qq", *self._synced)
+        tmp = self._watermark_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, self._watermark_path())
+        if sync:
+            _fsync_dir(self._dir)
 
     def _meta_path(self) -> str:
         return os.path.join(self._dir, "meta")
@@ -376,9 +524,17 @@ class FileLogStorage(LogStorage):
         # readers never stall behind a disk flush.
         with self._lock:
             touched = self._append_entries_locked(entries, sync)
+            if touched:
+                # frontier candidate captured under the lock: only bytes
+                # written BEFORE our fsync below may be claimed synced
+                frontier = (touched[-1].first_index, touched[-1].size)
         # fsync oldest-first so a crash leaves a prefix, never a hole
         for seg in touched:
             seg.sync()
+        if touched:
+            with self._lock:
+                if frontier > self._synced:
+                    self._synced = frontier
         return len(entries)
 
     def _append_entries_locked(self, entries: list[LogEntry],
@@ -434,10 +590,27 @@ class FileLogStorage(LogStorage):
             self._truncate_suffix_locked(last_index_kept)
 
     def _truncate_suffix_locked(self, last_index_kept: int) -> None:
+        # find the segment that will remain active and FLOOR the
+        # watermark to (its start, 0) — fsynced — BEFORE shrinking any
+        # file: if the shrink hits disk and a later watermark write
+        # doesn't, a stale-HIGH watermark would turn this legitimate
+        # truncation into a false CorruptLogError at the next boot.
+        target = next((s for s in reversed(self._segments)
+                       if s.first_index <= last_index_kept), None)
+        floor = (target.first_index, 0) if target else (-1, 0)
+        if floor < self._synced:
+            self._synced = floor
+            self._save_watermark(sync=True)
         while self._segments and self._segments[-1].first_index > last_index_kept:
             self._segments.pop().delete()
         if self._segments:
             self._segments[-1].truncate_to(last_index_kept)
+            # fsync even when truncate_to was a no-op (boundary case):
+            # the watermark below claims this segment's bytes durable
+            self._segments[-1].sync()
+            self._synced = (self._segments[-1].first_index,
+                            self._segments[-1].size)
+        self._save_watermark()
         if self._conf_indexes and self._conf_indexes[-1] > last_index_kept:
             self._conf_indexes = [i for i in self._conf_indexes if i <= last_index_kept]
             self._rewrite_conf_indexes()
@@ -447,6 +620,11 @@ class FileLogStorage(LogStorage):
             self._reset_locked(next_log_index)
 
     def _reset_locked(self, next_log_index: int) -> None:
+        # clear the watermark (fsynced) BEFORE deleting files: a crash
+        # mid-delete must not leave a watermark pointing into a
+        # partially-removed chain (false corruption alarm on reopen)
+        self._synced = (-1, 0)
+        self._save_watermark(sync=True)
         for s in self._segments:
             s.delete()
         self._segments.clear()

@@ -42,22 +42,9 @@ def _native_dir() -> str:
 
 
 def ensure_built(timeout: float = 120.0) -> str:
-    path = os.path.join(_native_dir(), _LIB_NAME)
-    import fcntl
-    import subprocess
-
-    # ALWAYS run make (mtime-aware, ~no-op when current): an
-    # existence-only check would dlopen a stale prebuilt .so missing
-    # newly added symbols.  The flock is the cross-PROCESS build guard:
-    # concurrently-spawned stores must not race `make`s onto one .so (a
-    # loser could dlopen a half-written file).
-    lock_path = os.path.join(_native_dir(), ".build.lock")
-    with open(lock_path, "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
-        subprocess.run(["make", "-C", _native_dir(), _LIB_NAME],
-                       check=True, timeout=timeout,
-                       capture_output=True)
-    return path
+    from tpuraft.util.native_build import ensure_built as _eb
+    return _eb(_native_dir(), os.path.join(_native_dir(), _LIB_NAME),
+               target=_LIB_NAME, timeout=timeout)
 
 
 _lib_lock = threading.Lock()
@@ -163,12 +150,13 @@ class _GroupCommit:
             idle = (self._task is None or self._task.done()) and \
                 (time.monotonic() - self._last_sync
                  > self.INLINE_IDLE_GAP_S)
-            if idle and self._cost_ewma >= self.INLINE_MAX_S:
-                # decay the ban while idle: a past writeback spike must
-                # not disable the fast path for the process lifetime —
-                # after a stretch of idle flushes an inline retry
-                # re-measures the disk
-                self._cost_ewma *= 0.9
+            # NOTE: while banned (ewma >= INLINE_MAX_S) there is no
+            # inline re-probe — a probe blocks the loop for the full,
+            # unbounded fsync (seconds under writeback stalls), for
+            # every group in the process.  The executor round measures
+            # each sync instead (in _run) and the same EWMA recovers
+            # there, so the fast path re-enables only after the DISK
+            # proves fast again, off-loop.
             if idle and self._cost_ewma < self.INLINE_MAX_S \
                     and not self._waiters:
                 self._last_sync = time.monotonic()  # claim the window
@@ -197,6 +185,12 @@ class _GroupCommit:
             return
         await fut
 
+    def _timed_sync(self) -> float:
+        """engine.sync() + pure in-thread duration (seconds)."""
+        t0 = time.perf_counter()
+        self._engine.sync()
+        return time.perf_counter() - t0
+
     def _revive(self) -> None:
         """Restart the round on THIS loop — scheduled via
         call_soon_threadsafe when a foreign host loop died mid-round."""
@@ -217,9 +211,16 @@ class _GroupCommit:
                 batch, self._waiters = self._waiters, []
             exc: Optional[BaseException] = None
             try:
-                await loop.run_in_executor(None, self._engine.sync)
+                # time the fsync IN the executor thread: timing around
+                # the await would fold in the loop round-trip (~2ms) and
+                # permanently ban the inline path on any busy process
+                dur = await loop.run_in_executor(None, self._timed_sync)
                 with self._lock:
                     self._last_sync = time.monotonic()
+                    # keep the inline-ban EWMA fed from the executor
+                    # path too: this is how a banned fast path recovers
+                    # (re-probing inline would block the loop)
+                    self._cost_ewma = 0.7 * self._cost_ewma + 0.3 * dur
             except asyncio.CancelledError:
                 # this round's HOST loop is tearing down (asyncio.run
                 # cancels pending tasks at exit) — that is not an fsync

@@ -16,7 +16,6 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 from typing import Optional
 
@@ -38,19 +37,8 @@ def lib_path() -> str:
 
 
 def ensure_built(timeout: float = 120.0) -> str:
-    """ALWAYS runs make (mtime-aware, ~no-op when current): an
-    existence-only check would dlopen a stale prebuilt .so missing
-    newly added symbols; flock serializes concurrent spawns."""
-    path = lib_path()
-    import fcntl
-
-    lock_path = os.path.join(_native_dir(), ".build.lock")
-    with open(lock_path, "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
-        subprocess.run(
-            ["make", "-C", _native_dir()], check=True, timeout=timeout,
-            capture_output=True)
-    return path
+    from tpuraft.util.native_build import ensure_built as _eb
+    return _eb(_native_dir(), lib_path(), timeout=timeout)
 
 
 _lib_lock = threading.Lock()
