@@ -1,0 +1,252 @@
+"""RheaKV at region density (VERDICT r3 #5): >= 1K regions on a
+3-store cluster through the FULL KV stack — region engines + KV state
+machines + native C++ data engine + multilog shared journal + engine
+protocol plane + the batching RheaKV client — under mixed load, with PD
+heartbeat volume counted.
+
+rhea:StoreEngine's whole point is thousands of regions per process
+(SURVEY.md §3.2); until r4 the densest recorded KV run was 64 regions
+(BENCH_E2E.json).  Writes BENCH_REGIONS.json; bench.py embeds it as
+extra.regions.
+
+Topology: ONE process hosts all three stores over in-proc RPC (the
+loopback-TCP e2e variant at its own G lives in bench_e2e.py), each
+store with its own MultiRaftEngine, its own native:// KV engine and
+its own multilog journal.  Regions split a 4-hex-digit keyspace evenly.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+async def run_config(args) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import random
+    import resource
+
+    import numpy as np
+
+    from tpuraft.core.engine import MultiRaftEngine
+    from tpuraft.options import TickOptions
+    from tpuraft.rheakv.client import BatchingOptions, RheaKVStore
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.native_store import NativeRawKVStore
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+    from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+    from tpuraft.rpc.transport import (InProcNetwork, InProcTransport,
+                                       RpcServer)
+
+    R, S = args.regions, args.stores
+    net = InProcNetwork()
+    endpoints = [f"127.0.0.1:{6600 + i}" for i in range(S)]
+
+    # R regions split a 4-hex keyspace: region k owns [hex(k), hex(k+1))
+    def bkey(k: int) -> bytes:
+        return b"%06x" % k
+
+    regions = [Region(id=k + 1, start_key=bkey(k) if k else b"",
+                      end_key=bkey(k + 1) if k + 1 < R else b"",
+                      peers=list(endpoints))
+               for k in range(R)]
+
+    class CountingPD(FakePlacementDriverClient):
+        store_hbs = 0
+        region_hbs = 0
+
+        async def store_heartbeat(self, meta) -> None:
+            CountingPD.store_hbs += 1
+            await super().store_heartbeat(meta)
+
+        async def region_heartbeat(self, region, leader, *a, **kw):
+            CountingPD.region_hbs += 1
+            return await super().region_heartbeat(region, leader, *a, **kw)
+
+    t0 = time.monotonic()
+    engines, stores = [], []
+    cap = 1 << max(4, (R + 3).bit_length())
+    for i, ep in enumerate(endpoints):
+        server = RpcServer(ep)
+        net.bind(server)
+        transport = InProcTransport(net, ep)
+        engine = MultiRaftEngine(TickOptions(
+            max_groups=cap, max_peers=4, tick_interval_ms=20))
+        engines.append(engine)
+        opts = StoreEngineOptions(
+            server_id=ep,
+            initial_regions=[r.copy() for r in regions],
+            data_path=f"{args.dir}/store{i}",
+            election_timeout_ms=args.election_timeout_ms,
+            log_scheme="multilog",
+            raw_store_factory=lambda i=i: NativeRawKVStore(
+                f"{args.dir}/store{i}/kv", sync=False),
+            heartbeat_interval_ms=1000,
+        )
+        store = StoreEngine(opts, server, transport,
+                            multi_raft_engine=engine,
+                            pd_client=CountingPD(
+                                [r.copy() for r in regions]))
+        # defer elections past boot (the bench_scale pattern): engine
+        # deadlines move en masse after every store is up
+        orig_start_region = store._start_region
+
+        async def deferred(region, store=store, engine=engine,
+                           orig=orig_start_region):
+            eng_region = await orig(region)
+            node = eng_region.node
+            engine.elect_deadline[node._ctrl.slot] = \
+                engine.now_ms() + 3_600_000
+            return eng_region
+
+        store._start_region = deferred
+        await store.start()
+        stores.append(store)
+    # release elections jittered over ~4 timeouts
+    rng = np.random.default_rng(0)
+    for engine in engines:
+        now = engine.now_ms()
+        jit = rng.integers(0, 4 * args.election_timeout_ms, engine.G)
+        engine.elect_deadline[:] = now + args.election_timeout_ms // 4 + jit
+        engine.mark_dirty()
+    boot_s = time.monotonic() - t0
+
+    # leadership convergence
+    t1 = time.monotonic()
+    deadline = time.monotonic() + 120 + R * 0.05
+    led = 0
+    while time.monotonic() < deadline:
+        led = sum(1 for s in stores for re in s._regions.values()
+                  if re.is_leader())
+        if led >= int(R * 0.98):
+            break
+        await asyncio.sleep(0.5)
+    elect_s = time.monotonic() - t1
+
+    pd = FakePlacementDriverClient([r.copy() for r in regions])
+    client = RheaKVStore(pd, InProcTransport(net, "kvclient:0"),
+                         batching=BatchingOptions())
+    hb0 = (CountingPD.store_hbs, CountingPD.region_hbs)
+
+    ok = [0]
+    errs = [0]
+    lats: list[float] = []
+    stop_at = time.monotonic() + args.duration
+    payload = b"v" * 32
+
+    async def worker(wid: int) -> None:
+        r = random.Random(wid)
+        while time.monotonic() < stop_at:
+            k = b"%06x" % r.randrange(R)
+            key = k + b"/%04d" % r.randrange(100)
+            t = time.perf_counter()
+            try:
+                if r.random() < 0.75:
+                    await client.put(key, payload)
+                else:
+                    await client.get(key)
+                ok[0] += 1
+                lats.append(time.perf_counter() - t)
+            except Exception:
+                errs[0] += 1
+            await asyncio.sleep(args.pace_ms / 1e3)
+
+    t2 = time.monotonic()
+    await asyncio.gather(*(worker(i) for i in range(args.workers)))
+    elapsed = time.monotonic() - t2
+    hb1 = (CountingPD.store_hbs, CountingPD.region_hbs)
+    lats.sort()
+
+    rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    res = {
+        "regions": R,
+        "stores": S,
+        "leaders": led,
+        "boot_s": round(boot_s, 1),
+        "elect_s": round(elect_s, 1),
+        "ops_per_sec": round(ok[0] / elapsed, 1),
+        "ok": ok[0],
+        "errors": errs[0],
+        "ack_p50_ms": round(lats[len(lats) // 2] * 1e3, 2) if lats else None,
+        "ack_p99_ms": round(lats[int(len(lats) * 0.99)] * 1e3, 2)
+        if lats else None,
+        "rss_mb": round(rss_mb, 1),
+        "rss_kb_per_region": round(rss_mb * 1024 / (R * S), 1),
+        "pd_store_hb_per_s": round((hb1[0] - hb0[0]) / elapsed, 2),
+        "pd_region_hb_per_s": round((hb1[1] - hb0[1]) / elapsed, 2),
+        "asyncio_tasks": len(asyncio.all_tasks()),
+        "workers": args.workers,
+        "pace_ms": args.pace_ms,
+    }
+    print("RESULT " + json.dumps(res), flush=True)
+    os._exit(0)  # 3R region engines: teardown is not the measurement
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--regions", type=int, default=1024)
+    ap.add_argument("--stores", type=int, default=3)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--workers", type=int, default=24)
+    ap.add_argument("--pace-ms", type=float, default=2.0)
+    ap.add_argument("--election-timeout-ms", type=int, default=10000)
+    ap.add_argument("--json-out", default="BENCH_REGIONS.json")
+    ap.add_argument("--config", action="store_true",
+                    help="internal: run one config in this process")
+    ap.add_argument("--dir", default="")
+    args = ap.parse_args()
+
+    if args.config:
+        asyncio.run(run_config(args))
+        return
+
+    import tempfile
+
+    from tpuraft.storage.multilog import ensure_built
+    from tpuraft.rheakv.native_store import ensure_built as kv_built
+
+    ensure_built()
+    kv_built()
+    workdir = tempfile.mkdtemp(prefix=f"tpuraft_regions_{args.regions}_")
+    cmd = [sys.executable, os.path.join(REPO, "bench_region_density.py"),
+           "--config", "--regions", str(args.regions),
+           "--stores", str(args.stores), "--dir", workdir,
+           "--duration", str(args.duration),
+           "--workers", str(args.workers),
+           "--pace-ms", str(args.pace_ms),
+           "--election-timeout-ms", str(args.election_timeout_ms)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    t0 = time.monotonic()
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
+    row = None
+    for line in p.stdout:
+        line = line.decode().strip()
+        if line.startswith("RESULT "):
+            row = json.loads(line[len("RESULT "):])
+    p.wait()
+    if row is None:
+        row = {"regions": args.regions, "error": "no result"}
+    row["wall_s"] = round(time.monotonic() - t0, 1)
+    out = {
+        "metric": "rheakv_region_density",
+        "row": row,
+        "stack": "3 StoreEngines in-proc, native C++ KV engine per "
+                 "store, multilog shared journal, engine protocol "
+                 "plane, batching RheaKV client, counting PD",
+    }
+    with open(os.path.join(REPO, args.json_out), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(row), flush=True)
+    subprocess.run(["rm", "-rf", workdir])
+
+
+if __name__ == "__main__":
+    main()

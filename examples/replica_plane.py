@@ -47,7 +47,9 @@ async def main(args) -> None:
     if args.mesh:
         mesh = build_mesh(args.replicas, args.mesh_groups_axis)
     c = ReplicaPlaneCluster(args.replicas, args.groups, mesh=mesh,
-                            election_timeout_ms=args.election_timeout_ms)
+                            election_timeout_ms=args.election_timeout_ms,
+                            transport=args.transport,
+                            base_port=args.base_port)
     await c.start_all()
     acked = 0
     try:
@@ -90,6 +92,7 @@ async def main(args) -> None:
                 f"group {g} diverged"
         print(json.dumps({
             "replicas": args.replicas, "groups": args.groups,
+            "transport": args.transport,
             "mesh": bool(mesh), "acked": acked,
             "plane_ticks": c.plane.ticks,
             "commit_advances": c.plane.commit_advances,
@@ -108,6 +111,11 @@ if __name__ == "__main__":
     ap.add_argument("--mesh", action="store_true",
                     help="shard the plane over a 2D device mesh")
     ap.add_argument("--mesh-groups-axis", type=int, default=4)
+    ap.add_argument("--transport", default="inproc",
+                    choices=["inproc", "tcp", "native"],
+                    help="protocol-plane transport: in-proc loopback, "
+                         "asyncio TCP sockets, or the C++ epoll engine")
+    ap.add_argument("--base-port", type=int, default=7700)
     ap.add_argument("--chaos", action="store_true",
                     help="crash one replica mid-run")
     asyncio.run(main(ap.parse_args()))

@@ -58,29 +58,59 @@ class _BaseSoakCluster:
             opts.read_only_option = self.read_only_option
         return opts
 
-    def leader_endpoint(self):
+    def leader_endpoint(self, region_id: int = 1):
         for ep, s in self.stores.items():
-            eng = s.get_region_engine(1)
+            eng = s.get_region_engine(region_id)
             if eng is not None and eng.is_leader():
                 return ep
         return None
 
 
 class SoakCluster(_BaseSoakCluster):
-    """In-proc fabric: InProcNetwork supplies partitions/drops/delays."""
+    """In-proc fabric: InProcNetwork supplies partitions/drops/delays.
 
-    def __init__(self, n_stores: int, data_path: str):
+    n_regions > 1 splits the keyspace into that many raft groups per
+    store (region k owns [k%06d, (k+1)%06d)); engine=True gives every
+    store a MultiRaftEngine protocol plane + multilog shared journal —
+    the configuration the G>=1K chaos soak (VERDICT r3 #6) runs."""
+
+    def __init__(self, n_stores: int, data_path: str, n_regions: int = 1,
+                 engine: bool = False, election_timeout_ms: int = 400):
         super().__init__(data_path)
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
-        self.regions = [Region(id=1, peers=list(self.endpoints))]
+        self.election_timeout_ms = election_timeout_ms
+        self.engine = engine
+        if n_regions <= 1:
+            self.regions = [Region(id=1, peers=list(self.endpoints))]
+        else:
+            def bkey(k):
+                return b"k%06d" % k
+
+            self.regions = [
+                Region(id=k + 1, start_key=bkey(k) if k else b"",
+                       end_key=bkey(k + 1) if k + 1 < n_regions else b"",
+                       peers=list(self.endpoints))
+                for k in range(n_regions)]
 
     async def start_store(self, ep: str) -> None:
         server = RpcServer(ep)
         self.net.bind(server)
         self.net.start_endpoint(ep)
         transport = InProcTransport(self.net, ep)
-        store = StoreEngine(self._store_opts(ep, 400), server, transport)
+        extra = {}
+        raft_engine = None
+        if self.engine:
+            from tpuraft.core.engine import MultiRaftEngine
+            from tpuraft.options import TickOptions
+
+            cap = 1 << max(4, (len(self.regions) + 3).bit_length())
+            raft_engine = MultiRaftEngine(TickOptions(
+                max_groups=cap, max_peers=4, tick_interval_ms=20))
+            extra["log_scheme"] = "multilog"
+        store = StoreEngine(
+            self._store_opts(ep, self.election_timeout_ms, **extra),
+            server, transport, multi_raft_engine=raft_engine)
         await store.start()
         self.stores[ep] = store
 
@@ -213,12 +243,20 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    seed: int, data_path: str, verbose: bool,
                    transport: str = "inproc",
                    dump_history: str = "",
-                   lease_reads: bool = False) -> dict:
+                   lease_reads: bool = False,
+                   n_regions: int = 1,
+                   engine: bool = False,
+                   election_timeout_ms: int = 400) -> dict:
     rng = random.Random(seed)
     if transport == "native":
+        if n_regions > 1 or engine:
+            raise ValueError("region-density soak runs on the in-proc "
+                             "fabric (--transport inproc)")
         c = NativeSoakCluster(n_stores, data_path)
     else:
-        c = SoakCluster(n_stores, data_path)
+        c = SoakCluster(n_stores, data_path, n_regions=n_regions,
+                        engine=engine,
+                        election_timeout_ms=election_timeout_ms)
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -238,7 +276,17 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 
     h = History()
     stop = asyncio.Event()
-    keys = [b"soak-%d" % i for i in range(n_keys)]
+    if n_regions > 1:
+        # sample keys from n_keys DISTINCT regions spread over the
+        # range: linearizability is checked per key, so each sampled
+        # key exercises its own raft group under the shared faults
+        step = max(1, n_regions // n_keys)
+        sampled = [min(i * step, n_regions - 1) for i in range(n_keys)]
+        keys = [b"k%06d/s" % j for j in sampled]
+        sampled_regions = [j + 1 for j in sampled]
+    else:
+        keys = [b"soak-%d" % i for i in range(n_keys)]
+        sampled_regions = [1]
 
     async def worker(cid: int):
         n = 0
@@ -266,7 +314,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
     killed: list[str] = []
 
     async def kill_leader():
-        ep = c.leader_endpoint()
+        ep = c.leader_endpoint(rng.choice(sampled_regions))
         if ep is None:
             raise SkipFault
         killed.append(ep)
@@ -368,6 +416,15 @@ def main() -> None:
     ap.add_argument("--dump-history", default="",
                     help="on violation, write the full op history "
                          "(JSON lines) here for offline analysis")
+    ap.add_argument("--regions", type=int, default=1,
+                    help=">1: split the keyspace into this many raft "
+                         "groups per store (in-proc fabric only) — the "
+                         "G>=1K chaos configuration")
+    ap.add_argument("--engine", action="store_true",
+                    help="MultiRaftEngine protocol plane + multilog "
+                         "journal per store (required reading at "
+                         "region density)")
+    ap.add_argument("--election-timeout-ms", type=int, default=400)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -375,7 +432,10 @@ def main() -> None:
                                   args.seed, data, args.verbose,
                                   transport=args.transport,
                                   dump_history=args.dump_history,
-                                  lease_reads=args.lease_reads))
+                                  lease_reads=args.lease_reads,
+                                  n_regions=args.regions,
+                                  engine=args.engine,
+                                  election_timeout_ms=args.election_timeout_ms))
     import json
 
     print(json.dumps(result))

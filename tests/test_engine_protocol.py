@@ -406,3 +406,70 @@ async def test_protocol_plane_on_mesh_sharded_engine():
             (g, str(ep)): len(f.logs) for (g, ep), f in c.fsms.items()}
     finally:
         await c.stop_all()
+
+
+async def test_engine_scheduled_snapshot_cadence(tmp_path):
+    """The reference's 4th timer (snapshotTimer) folded into the device
+    tick (VERDICT r3 #4): engine-backed nodes create NO per-group
+    RepeatedTimer; the [G] snap_deadline row fires snapshots staggered
+    by jitter, so G groups never snapshot as one herd."""
+    G = 6
+    net = InProcNetwork()
+    ep = PeerId.parse("127.0.0.1:6400")
+    server = RpcServer(ep.endpoint)
+    manager = NodeManager(server)
+    net.bind(server)
+    transport = InProcTransport(net, ep.endpoint)
+    engine = MultiRaftEngine(TickOptions(
+        max_groups=G + 2, max_peers=4, tick_interval_ms=5, backend="jax"))
+    await engine.start()
+    factory = engine.ballot_box_factory()
+    nodes, fsms = [], []
+    for k in range(G):
+        fsm = MockStateMachine()
+        opts = NodeOptions(
+            election_timeout_ms=300,
+            initial_conf=Configuration([ep]),
+            fsm=fsm, log_uri="memory://", raft_meta_uri="memory://",
+            snapshot_uri=f"file://{tmp_path}/snap_g{k}")
+        opts.snapshot.interval_secs = 1
+        node = Node(f"g{k}", ep, opts, transport,
+                    ballot_box_factory=factory)
+        node.node_manager = manager
+        manager.add(node)
+        assert await node.init()
+        nodes.append(node)
+        fsms.append(fsm)
+    try:
+        # NO host snapshot timers on engine-backed nodes
+        assert all(n._snapshot_timer is None for n in nodes)
+        # the deadline row is jitter-staggered at registration: the
+        # spread across groups must cover a meaningful slice of the
+        # interval (an unstaggered herd would all share one deadline)
+        slots = [n._ctrl.slot for n in nodes]
+        dl = engine.snap_deadline[slots]
+        assert (dl > 0).all()
+        assert dl.max() - dl.min() > 100, dl  # >10% of the 1s interval
+        for n in nodes:
+            while not n.is_leader():
+                await asyncio.sleep(0.02)
+        for i, n in enumerate(nodes):
+            fut = asyncio.get_running_loop().create_future()
+            await n.apply(Task(data=b"x%d" % i,
+                               done=lambda st, fut=fut:
+                               fut.done() or fut.set_result(st)))
+            assert (await asyncio.wait_for(fut, 5)).is_ok()
+        # within ~2.5 intervals every group's engine-driven snapshot fired
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            if all(f.snapshots_saved >= 1 for f in fsms):
+                break
+            await asyncio.sleep(0.1)
+        assert all(f.snapshots_saved >= 1 for f in fsms), \
+            [f.snapshots_saved for f in fsms]
+        assert all(n.log_manager.last_snapshot_id().index >= 1
+                   for n in nodes)
+    finally:
+        for n in nodes:
+            await n.shutdown()
+        await engine.shutdown()

@@ -203,13 +203,14 @@ def test_numpy_twin_matches_device_tick_randomized():
             elect_deadline=eng.elect_deadline.astype(np.int32),
             hb_deadline=eng.hb_deadline.astype(np.int32),
             last_ack=eng.last_ack.astype(np.int32),
+            snap_deadline=eng.snap_deadline.astype(np.int32),
         )
         _, dev_out = raft_tick(state, np.int32(now),
                                TickParams.make(eng.eto_ms, eng.hb_ms,
-                                               eng.lease_ms))
+                                               eng.lease_ms, eng.snap_ms))
         for field in ("commit_rel", "commit_advanced", "elected",
                       "election_due", "step_down", "hb_due",
-                      "lease_valid"):
+                      "lease_valid", "snap_due"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(dev_out, field)),
                 np.asarray(getattr(np_out, field)),

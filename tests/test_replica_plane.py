@@ -181,3 +181,28 @@ async def test_numpy_fallback_matches_mesh_path():
             p.tick_once()
             commits.append(p.commit_abs.copy())
         np.testing.assert_array_equal(commits[0], commits[1])
+
+
+async def test_transport_seam_tcp():
+    """The protocol plane above the replica-axis collective rides real
+    sockets (VERDICT r3 #8): same cluster, loopback TCP transport,
+    including a replica crash + failover."""
+    c = ReplicaPlaneCluster(3, 4, election_timeout_ms=600,
+                            transport="tcp", base_port=7750)
+    await c.start_all()
+    try:
+        leaders = {g: await c.wait_leader(g) for g in c.groups}
+        await asyncio.gather(*(
+            c.apply_ok(leaders[g], b"%s-tcp" % g.encode())
+            for g in c.groups))
+        # crash one replica endpoint; groups fail over over TCP
+        lead_count = {ep.endpoint: 0 for ep in c.endpoints}
+        for g in c.groups:
+            lead_count[leaders[g].server_id.endpoint] += 1
+        victim = min(c.endpoints, key=lambda ep: lead_count[ep.endpoint])
+        await c.stop_replica(victim)
+        for g in c.groups:
+            n = await c.wait_leader(g, timeout_s=20)
+            await c.apply_ok(n, b"%s-post" % g.encode())
+    finally:
+        await c.stop_all()

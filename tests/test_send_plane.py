@@ -1,0 +1,161 @@
+"""Send-plane tests (SURVEY.md §3.5 "batched per-tick (group, peer)
+send matrices"): batched vote + AppendEntries dispatch via one
+EndpointSender per endpoint pair, and the task-count collapse it exists
+for.  Reference comparison: ``core:Replicator`` posts sends to shared
+executors — here the batching is at the WIRE level too (one multi_append
+RPC carries many groups), which the reference never does.
+"""
+
+import asyncio
+
+import pytest
+
+from tests.test_engine import MultiRaftCluster
+from tpuraft.entity import Task
+
+pytestmark = pytest.mark.asyncio
+
+
+async def apply_ok(node, data: bytes, timeout=5.0):
+    fut = asyncio.get_running_loop().create_future()
+
+    def done(st):
+        if not fut.done():
+            fut.set_result(st)
+
+    await node.apply(Task(data=data, done=done))
+    st = await asyncio.wait_for(fut, timeout)
+    assert st.is_ok(), st
+    return st
+
+
+async def test_appends_ride_batched_rpcs():
+    """A burst across many groups coalesces into multi_append RPCs:
+    items per RPC must exceed 1 on average by a wide margin."""
+    c = MultiRaftCluster(3, 12, election_timeout_ms=1000)
+    await c.start_all()
+    try:
+        leaders = [await c.wait_leader(g) for g in c.groups]
+        planes = [m.send_plane for m in
+                  {id(n.node_manager): n.node_manager
+                   for n in c.nodes.values()}.values()]
+
+        def totals():
+            return (sum(p.stats()["rpcs_sent"] for p in planes),
+                    sum(p.stats()["items_sent"] for p in planes))
+
+        rpcs0, items0 = totals()  # election votes: staggered, ~1/RPC
+        # concurrent burst: every group applies at once
+        await asyncio.gather(*(apply_ok(n, b"x%d" % i)
+                               for i, n in enumerate(leaders)))
+        rpcs, items = (a - b for a, b in zip(totals(), (rpcs0, items0)))
+        assert items >= 24 and rpcs > 0, (items, rpcs)
+        # 12 groups x 2 peers apply concurrently; far fewer RPCs than
+        # items proves wire-level coalescing (exact ratio is timing-
+        # dependent; >1.5x is already impossible without batching)
+        assert items / rpcs > 1.5, (items, rpcs)
+    finally:
+        await c.stop_all()
+
+
+async def test_standing_tasks_are_o_endpoints_not_o_groups():
+    """The r4 contract: G groups on 3 endpoints must not hold standing
+    per-(group, peer) tasks (pre-r4: ~4 tasks per group at idle)."""
+    G = 24
+    c = MultiRaftCluster(3, G, election_timeout_ms=1000)
+    await c.start_all()
+    try:
+        leaders = [await c.wait_leader(g) for g in c.groups]
+        await asyncio.gather(*(apply_ok(n, b"w") for n in leaders))
+        # let transients (response fan-out, FSM drains) finish
+        await asyncio.sleep(1.0)
+        tasks = len(asyncio.all_tasks())
+        # engines (3) + test machinery + senders; generous bound that a
+        # per-group loop (24+ tasks minimum) cannot meet
+        assert tasks < 3 + G // 2, tasks
+    finally:
+        await c.stop_all()
+
+
+async def test_elections_use_vote_batching_and_converge():
+    """Kill a leader endpoint: every orphaned group re-elects through
+    multi_vote batches (not per-group RPC fanouts)."""
+    c = MultiRaftCluster(3, 8, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        leaders = [await c.wait_leader(g) for g in c.groups]
+        victim_ep = leaders[0].server_id
+        victims = [g for g, n in zip(c.groups, leaders)
+                   if n.server_id == victim_ep]
+        c.net.stop_endpoint(victim_ep.endpoint)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 15
+        from tpuraft.core.node import State
+
+        for g in victims:
+            while loop.time() < deadline:
+                live = [n for (gg, ep), n in c.nodes.items()
+                        if gg == g and ep != victim_ep
+                        and n.state == State.LEADER]
+                if live:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError(f"{g} did not re-elect")
+    finally:
+        await c.stop_all()
+
+
+async def test_window_pipelines_within_one_batch():
+    """max_inflight_msgs frames ride one batch: with 1-entry batches
+    forced, a backlog ships as multiple frames per submit (the
+    inflight_peak proof, plane edition)."""
+    c = MultiRaftCluster(3, 1, election_timeout_ms=1500)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader(c.groups[0])
+        await apply_ok(leader, b"warm")
+        for n in c.nodes.values():
+            n.options.raft_options.max_entries_size = 1
+        c.net.set_delay_ms(10)
+        futs = []
+        loop = asyncio.get_running_loop()
+        for i in range(40):
+            fut = loop.create_future()
+            await leader.apply(Task(
+                data=b"p%03d" % i,
+                done=lambda st, fut=fut: fut.done() or fut.set_result(st)))
+            futs.append(fut)
+        sts = await asyncio.wait_for(asyncio.gather(*futs), 30)
+        c.net.set_delay_ms(0)
+        assert all(st.is_ok() for st in sts)
+        peaks = [r.inflight_peak for r in leader.replicators.all()]
+        assert any(pk > 3 for pk in peaks), peaks
+    finally:
+        await c.stop_all()
+
+
+async def test_legacy_fallback_for_receiver_without_batch_handlers():
+    """An endpoint whose server predates the batch plane (no multi_*
+    handlers) gets single RPCs after one failed batch probe."""
+    from tests.cluster import TestCluster
+
+    c = TestCluster(3, election_timeout_ms=1000)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        # strip the batch handlers from one follower's server to
+        # simulate an old receiver
+        follower_ep = next(p for p in c.peers if p != leader.server_id)
+        server = c.managers[follower_ep].server
+        server._handlers.pop("multi_append", None)
+        server._handlers.pop("multi_vote", None)
+        await c.apply_ok(leader, b"via-legacy")
+        await c.wait_applied(1)
+        sender = leader.node_manager.send_plane.sender(follower_ep.endpoint)
+        assert sender._legacy is True
+        # and replication still flows
+        await c.apply_ok(leader, b"more")
+        await c.wait_applied(2)
+    finally:
+        await c.stop_all()

@@ -180,6 +180,7 @@ class EngineControl:
     """
 
     drives_heartbeats = True
+    drives_snapshots = True
 
     def __init__(self, engine: "MultiRaftEngine", node, box: TpuBallotBox):
         self.engine = engine
@@ -197,12 +198,16 @@ class EngineControl:
                                         self._eto_ms))
         self._jitter = random.randrange(self._jitter_range)
         self._scheduled: set = set()
+        snap_ms = 0
+        if opts.snapshot_uri and opts.snapshot.interval_secs > 0:
+            snap_ms = opts.snapshot.interval_secs * 1000
         engine.register_ctrl(self, node.server_id,
                              eto_ms=self._eto_ms,
                              hb_ms=max(1, self._eto_ms
                                        // opts.raft_options.election_heartbeat_factor),
                              lease_ms=int(self._eto_ms
-                                          * opts.raft_options.leader_lease_time_ratio))
+                                          * opts.raft_options.leader_lease_time_ratio),
+                             snapshot_ms=snap_ms)
 
     # -- scheduling plumbing (engine tick -> node slow path) -----------------
 
@@ -377,7 +382,7 @@ class _NpOutputs:
     """numpy TickOutputs twin (backend="numpy" fallback)."""
 
     __slots__ = ("commit_rel", "commit_advanced", "elected", "election_due",
-                 "step_down", "hb_due", "lease_valid")
+                 "step_down", "hb_due", "lease_valid", "snap_due")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -427,6 +432,11 @@ class MultiRaftEngine:
         self.eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
         self.hb_ms = np.full(g, _DEF_HB_MS, np.int64)
         self.lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
+        # engine-scheduled snapshot cadence (the reference's 4th timer,
+        # snapshotTimer): [G] interval row (0 = disabled) + deadline row
+        # replace G per-group RepeatedTimers; fires staggered by jitter
+        self.snap_ms = np.zeros(g, np.int64)
+        self.snap_deadline = np.zeros(g, np.int64)
         self._t0 = time.monotonic()
 
     # -- time ----------------------------------------------------------------
@@ -445,6 +455,7 @@ class MultiRaftEngine:
         self._t0 += shift / 1000.0
         self.elect_deadline -= shift
         self.hb_deadline -= shift
+        self.snap_deadline -= shift
         np.maximum(self.last_ack - shift, _NEG_I32, out=self.last_ack)
 
     # -- registry ------------------------------------------------------------
@@ -461,7 +472,8 @@ class MultiRaftEngine:
         return make
 
     def register_ctrl(self, ctrl: EngineControl, server_id: PeerId,
-                      eto_ms: int, hb_ms: int, lease_ms: int) -> None:
+                      eto_ms: int, hb_ms: int, lease_ms: int,
+                      snapshot_ms: int = 0) -> None:
         s = ctrl.slot
         self._ctrls[s] = ctrl
         self._ctrl_server[s] = server_id
@@ -470,6 +482,12 @@ class MultiRaftEngine:
         self.self_col[s] = -1 if col is None else col
         self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
             eto_ms, hb_ms, lease_ms
+        self.snap_ms[s] = snapshot_ms
+        if snapshot_ms > 0:
+            # first due staggered over [0.5, 1.5) intervals: groups
+            # registered together must not snapshot as one herd
+            self.snap_deadline[s] = self.now_ms() + int(
+                snapshot_ms * (0.5 + random.random()))
         self._params_dev = None  # (re)built at next device tick
 
     def unregister_ctrl(self, slot: int) -> None:
@@ -512,6 +530,8 @@ class MultiRaftEngine:
         self.eto_ms = pad(self.eto_ms, _DEF_ETO_MS)
         self.hb_ms = pad(self.hb_ms, _DEF_HB_MS)
         self.lease_ms = pad(self.lease_ms, _DEF_LEASE_MS)
+        self.snap_ms = pad(self.snap_ms)
+        self.snap_deadline = pad(self.snap_deadline)
         self._params_dev = None  # [G] rows must match the grown shape
         self._peer_cols.extend(dict() for _ in range(old_g))
         self._boxes.extend([None] * old_g)
@@ -538,6 +558,8 @@ class MultiRaftEngine:
         self.granted[s] = False
         self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
             _DEF_ETO_MS, _DEF_HB_MS, _DEF_LEASE_MS
+        self.snap_ms[s] = 0
+        self.snap_deadline[s] = 0
         self._params_dev = None
         self._peer_cols[s].clear()
         self._free.append(s)
@@ -657,15 +679,16 @@ class MultiRaftEngine:
                     role=row, commit_rel=row, pending_rel=row,
                     match_rel=mat, granted=mat, voter_mask=mat,
                     old_voter_mask=mat, elect_deadline=row,
-                    hb_deadline=row, last_ack=mat)
+                    hb_deadline=row, last_ack=mat, snap_deadline=row)
                 out_sh = TickOutputs(
                     commit_rel=row, commit_advanced=row, elected=row,
                     election_due=row, step_down=row, hb_due=row,
-                    lease_valid=row)
+                    lease_valid=row, snap_due=row)
                 self._tick_fn = jax.jit(
                     outputs_only,
                     in_shardings=(state_sh, scalar,
-                                  TickParams(scalar, scalar, scalar)),
+                                  TickParams(scalar, scalar, scalar,
+                                             scalar)),
                     out_shardings=out_sh)
             else:
                 # the PROCESS-WIDE jitted instance: all engines share one
@@ -824,7 +847,7 @@ class MultiRaftEngine:
 
         if self._params_dev is None:
             self._params_dev = TickParams.make(self.eto_ms, self.hb_ms,
-                                               self.lease_ms)
+                                               self.lease_ms, self.snap_ms)
         # numpy mirrors go STRAIGHT into the jitted call — jit commits
         # them to the device itself, and an explicit jnp.asarray per
         # field doubles the per-tick host overhead (profiled: the
@@ -840,6 +863,7 @@ class MultiRaftEngine:
             elect_deadline=self.elect_deadline.astype(np.int32),
             hb_deadline=self.hb_deadline.astype(np.int32),
             last_ack=self.last_ack.astype(np.int32),
+            snap_deadline=self.snap_deadline.astype(np.int32),
         )
         with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
             out = self._tick_fn(state, np.int32(now), self._params_dev)
@@ -880,6 +904,8 @@ class MultiRaftEngine:
             step_down=is_leader & have_ack & (now - q_ack >= self.eto_ms),
             hb_due=is_leader & (now >= self.hb_deadline),
             lease_valid=is_leader & have_ack & (now - q_ack < self.lease_ms),
+            snap_due=(self.role != ROLE_INACTIVE) & (self.snap_ms > 0)
+            & (now >= self.snap_deadline),
         )
 
     def _apply_commits(self, out) -> int:
@@ -920,6 +946,16 @@ class MultiRaftEngine:
         hb_slots = np.nonzero(np.asarray(out.hb_due) & hc)[0]
         if hb_slots.size:
             self._flush_heartbeats(hb_slots, now)
+        snap_slots = np.nonzero(np.asarray(out.snap_due) & hc)[0]
+        for s in snap_slots:
+            ctrl = self._ctrls[s]
+            if ctrl is None:
+                continue
+            # advance the host mirror NOW (the handler runs async; a
+            # same-deadline refire every tick would herd), keeping each
+            # group on its own staggered phase
+            self.snap_deadline[s] = now + int(self.snap_ms[s])
+            ctrl.schedule("snapshot_due", ctrl.node._on_snapshot_due)
 
     def _flush_heartbeats(self, slots, now: int) -> None:
         """Batched heartbeat fan-out for all due leader groups: ONE

@@ -9,6 +9,7 @@ run on it in submission order, so user code never sees concurrency.
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 import logging
 from typing import Awaitable, Callable, Optional
 
@@ -31,8 +32,14 @@ class FSMCaller:
         self.last_applied_term = 0
         self._committed_index = 0
         self._closures: dict[int, Callable[[Status], None]] = {}
-        self._queue: asyncio.Queue = asyncio.Queue()
+        # demand-spawned drain (r4): a standing task per FSMCaller was
+        # O(nodes) standing tasks per process — at 16K groups x 3
+        # replicas that alone is 48K idle tasks (the election-starvation
+        # regime BENCH_SCALE r3 measured).  Events queue here and one
+        # short-lived drain task runs only while events exist.
+        self._queue: deque = deque()
         self._task: Optional[asyncio.Task] = None
+        self._shut = False
         self._error: Optional[Status] = None
         self._applied_waiters: list[tuple[int, asyncio.Future]] = []
         # node hook: conf entry committed (drives membership-change stages)
@@ -43,13 +50,19 @@ class FSMCaller:
         self.last_applied_index = bootstrap_id.index
         self.last_applied_term = bootstrap_id.term
         self._committed_index = bootstrap_id.index
-        self._task = asyncio.ensure_future(self._run())
 
     async def shutdown(self) -> None:
-        if self._task:
-            await self._queue.put(("shutdown", None))
+        self._enqueue(("shutdown", None))
+        if self._task is not None:
             await self._task
             self._task = None
+
+    def _enqueue(self, item) -> None:
+        if self._shut:
+            return
+        self._queue.append(item)
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain())
 
     # -- producers (called from node / ballot box) ---------------------------
 
@@ -70,22 +83,22 @@ class FSMCaller:
         if index <= self._committed_index:
             return
         self._committed_index = index
-        self._queue.put_nowait(("committed", index))
+        self._enqueue(("committed", index))
 
     def on_leader_start(self, term: int) -> None:
-        self._queue.put_nowait(("leader_start", term))
+        self._enqueue(("leader_start", term))
 
     def on_leader_stop(self, status: Status) -> None:
-        self._queue.put_nowait(("leader_stop", status))
+        self._enqueue(("leader_stop", status))
 
     def on_start_following(self, leader: PeerId, term: int) -> None:
-        self._queue.put_nowait(("start_following", (leader, term)))
+        self._enqueue(("start_following", (leader, term)))
 
     def on_stop_following(self, leader: PeerId, term: int) -> None:
-        self._queue.put_nowait(("stop_following", (leader, term)))
+        self._enqueue(("stop_following", (leader, term)))
 
     def on_error(self, status: Status) -> None:
-        self._queue.put_nowait(("error", status))
+        self._enqueue(("error", status))
 
     def poison(self, status: Status) -> None:
         """Externally-detected fatal error (e.g. divergence below the
@@ -95,14 +108,14 @@ class FSMCaller:
         so the node can call it while holding its lock."""
         if self._error is None:
             self._error = status
-            self._queue.put_nowait(("error", status))
+            self._enqueue(("error", status))
 
     async def on_snapshot_save(self, writer, done: Callable[[Status], None]) -> None:
-        self._queue.put_nowait(("snapshot_save", (writer, done)))
+        self._enqueue(("snapshot_save", (writer, done)))
 
     async def on_snapshot_load(self, reader) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait(("snapshot_load", (reader, fut)))
+        self._enqueue(("snapshot_load", (reader, fut)))
         return fut
 
     # -- applied-index waiters (ReadOnlyService) -----------------------------
@@ -128,11 +141,12 @@ class FSMCaller:
 
     # -- consumer ------------------------------------------------------------
 
-    async def _run(self) -> None:
-        while True:
-            kind, arg = await self._queue.get()
+    async def _drain(self) -> None:
+        while self._queue:
+            kind, arg = self._queue.popleft()
             try:
                 if kind == "shutdown":
+                    self._shut = True
                     await self._fsm.on_shutdown()
                     return
                 if self._error is not None and kind not in ("error",):

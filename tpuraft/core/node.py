@@ -351,7 +351,12 @@ class Node:
         self._ctrl = make_ctrl(self) if make_ctrl is not None else None
         if self._ctrl is None:
             self._ctrl = TimerControl(self)
-        if self.snapshot_executor and opts.snapshot.interval_secs > 0:
+        if self.snapshot_executor and opts.snapshot.interval_secs > 0 \
+                and not getattr(self._ctrl, "drives_snapshots", False):
+            # host timer only for timer-mode nodes: engine-backed nodes
+            # get their cadence from the device tick's snap_due mask
+            # (one [G] deadline row, jitter-staggered — no per-group
+            # RepeatedTimer, no unstaggered snapshot herd at high G)
             self._snapshot_timer = RepeatedTimer(
                 f"snapshot-{self.server_id}", opts.snapshot.interval_secs * 1000,
                 self._handle_snapshot_timeout)
@@ -651,6 +656,43 @@ class Node:
                 self.fsm_caller.on_stop_following(prev_leader, self.current_term)
             await self._pre_vote()
 
+    async def _persist_meta(self, term: int, voted_for: PeerId) -> None:
+        """Durably record {term, votedFor}.  File-backed meta fsyncs in
+        an executor thread; volatile meta (memory://) writes two fields
+        — the executor hop for it was pure overhead, and at high group
+        counts an election herd paid tens of thousands of pointless
+        thread round-trips."""
+        if isinstance(self._meta, MemoryRaftMetaStorage):
+            self._meta.set_term_and_voted_for(term, voted_for)
+            return
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._meta.set_term_and_voted_for, term, voted_for)
+
+    def _send_vote(self, peer: PeerId, req: "RequestVoteRequest",
+                   on_resp) -> None:
+        """Dispatch one RequestVote through the batched send plane when
+        a NodeManager is wired (one ``multi_vote`` RPC per endpoint per
+        flush — election herds at high group counts coalesce instead of
+        spawning O(G x P) tasks), else a direct transient RPC task.
+        ``on_resp(resp, peer)`` runs only when a response arrives;
+        errors are silence, like a dropped packet."""
+        if self.node_manager is not None:
+            self.node_manager.send_plane.sender(peer.endpoint).submit_vote(
+                self, req, lambda resp, p=peer: on_resp(resp, p))
+            return
+
+        async def direct():
+            try:
+                resp = await self.transport.request_vote(
+                    peer.endpoint, req,
+                    timeout_ms=self.options.election_timeout_ms)
+            except RpcError:
+                return
+            await on_resp(resp, peer)
+
+        t = asyncio.ensure_future(direct())
+        t.add_done_callback(lambda tt: tt.cancelled() or tt.exception())
+
     async def _pre_vote(self) -> None:
         """Pre-vote: probe electability WITHOUT bumping term (symmetric-
         partition tolerance — reference: NodeImpl#preVote)."""
@@ -667,18 +709,7 @@ class Node:
             return
         req_term = term + 1  # NOT persisted
 
-        async def ask(peer: PeerId):
-            req = RequestVoteRequest(
-                group_id=self.group_id, server_id=str(self.server_id),
-                peer_id=str(peer), term=req_term,
-                last_log_index=last_id.index, last_log_term=last_id.term,
-                pre_vote=True)
-            try:
-                resp: RequestVoteResponse = await self.transport.request_vote(
-                    peer.endpoint, req,
-                    timeout_ms=self.options.election_timeout_ms)
-            except RpcError:
-                return
+        async def on_resp(resp: RequestVoteResponse, peer: PeerId):
             async with self._lock:
                 if (self.state != State.FOLLOWER or self.current_term != term):
                     return  # world moved on
@@ -693,7 +724,12 @@ class Node:
 
         for p in set(conf.peers) | set(old_conf.peers):
             if p != self.server_id:
-                asyncio.ensure_future(ask(p))
+                req = RequestVoteRequest(
+                    group_id=self.group_id, server_id=str(self.server_id),
+                    peer_id=str(p), term=req_term,
+                    last_log_index=last_id.index, last_log_term=last_id.term,
+                    pre_vote=True)
+                self._send_vote(p, req, on_resp)
 
     async def _elect_self(self) -> None:
         """Real election: term+1, vote for self, solicit votes.
@@ -707,9 +743,7 @@ class Node:
         self.current_term += 1
         self.voted_for = self.server_id
         self.leader_id = EMPTY_PEER
-        await asyncio.get_running_loop().run_in_executor(
-            None, self._meta.set_term_and_voted_for, self.current_term,
-            self.server_id)
+        await self._persist_meta(self.current_term, self.server_id)
         term = self.current_term
         last_id = self.log_manager.last_log_id()
         # tally: TimerControl checks quorum inline per grant; the
@@ -720,18 +754,7 @@ class Node:
             await self._become_leader()
             return
 
-        async def ask(peer: PeerId):
-            req = RequestVoteRequest(
-                group_id=self.group_id, server_id=str(self.server_id),
-                peer_id=str(peer), term=term,
-                last_log_index=last_id.index, last_log_term=last_id.term,
-                pre_vote=False)
-            try:
-                resp: RequestVoteResponse = await self.transport.request_vote(
-                    peer.endpoint, req,
-                    timeout_ms=self.options.election_timeout_ms)
-            except RpcError:
-                return
+        async def on_resp(resp: RequestVoteResponse, peer: PeerId):
             async with self._lock:
                 if self.state != State.CANDIDATE or self.current_term != term:
                     return
@@ -744,7 +767,12 @@ class Node:
 
         for p in set(conf.peers) | set(old_conf.peers):
             if p != self.server_id:
-                asyncio.ensure_future(ask(p))
+                req = RequestVoteRequest(
+                    group_id=self.group_id, server_id=str(self.server_id),
+                    peer_id=str(p), term=term,
+                    last_log_index=last_id.index, last_log_term=last_id.term,
+                    pre_vote=False)
+                self._send_vote(p, req, on_resp)
 
     async def _handle_vote_timeout(self) -> None:
         async with self._lock:
@@ -852,8 +880,7 @@ class Node:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = EMPTY_PEER
-            await asyncio.get_running_loop().run_in_executor(
-                None, self._meta.set_term_and_voted_for, term, EMPTY_PEER)
+            await self._persist_meta(term, EMPTY_PEER)
         if self._conf_ctx is not None:
             self._conf_ctx.fail(Status.error(
                 RaftError.ENEWLEADER, "leader stepped down"))
@@ -911,9 +938,7 @@ class Node:
             if (log_ok and self.voted_for.is_empty()
                     and self.state == State.FOLLOWER):
                 self.voted_for = candidate
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._meta.set_term_and_voted_for, self.current_term,
-                    candidate)
+                await self._persist_meta(self.current_term, candidate)
                 self._last_leader_timestamp = time.monotonic()  # grant => reset
                 self._ctrl.note_leader_contact()
                 return RequestVoteResponse(term=self.current_term, granted=True)
@@ -1190,6 +1215,11 @@ class Node:
     async def _handle_snapshot_timeout(self) -> None:
         if self.snapshot_executor:
             await self.snapshot_executor.do_snapshot()
+
+    async def _on_snapshot_due(self) -> None:
+        """Engine path: the device tick's snap_due mask fired for this
+        group (the snapshotTimer analog — SURVEY §3.1 Timers)."""
+        await self._handle_snapshot_timeout()
 
     async def _on_fsm_error(self, status: Status) -> None:
         async with self._lock:

@@ -37,11 +37,21 @@ class NodeManager:
         server.register("get_file", self._handle_get_file)
         # coalesced heartbeats (HeartbeatHub): one RPC per endpoint pair
         server.register("multi_heartbeat", self._handle_multi_heartbeat)
+        # batched send plane (SendPlane): votes + entry-bearing appends
+        # coalesced the same way — O(endpoints) RPCs, O(endpoints)
+        # standing sender tasks
+        server.register("multi_append", self._handle_multi_append)
+        server.register("multi_vote", self._handle_multi_vote)
+        self._send_plane = None
         self._heartbeat_hub = None  # created on first coalescing leader
         # at most ONE outstanding beat handler per (group, peer): beats
         # behind a busy node lock must answer EBUSY, not stack a new
         # lock waiter every round (queue flooding starves vote handling)
         self._beat_inflight: set[tuple[str, str]] = set()
+        # same guard for batched appends: a stuck node (long fsync /
+        # snapshot load) must not accumulate one shielded handler —
+        # each carrying a full entry window — per leader retry cycle
+        self._append_inflight: set[tuple[str, str]] = set()
 
     @property
     def heartbeat_hub(self):
@@ -50,6 +60,106 @@ class NodeManager:
 
             self._heartbeat_hub = HeartbeatHub()
         return self._heartbeat_hub
+
+    @property
+    def send_plane(self):
+        if self._send_plane is None:
+            from tpuraft.core.send_plane import SendPlane
+
+            self._send_plane = SendPlane()
+        return self._send_plane
+
+    async def _handle_multi_vote(self, request):
+        """Fan a vote BatchRequest out concurrently; vote handlers only
+        hold the node lock briefly (no disk waits)."""
+        from tpuraft.rpc.messages import BatchResponse, ErrorResponse
+
+        async def one(req):
+            try:
+                node = self._nodes.get((req.group_id, req.peer_id))
+                if node is None:
+                    return ErrorResponse(int(RaftError.ENOENT),
+                                         f"no node for {req.group_id}")
+                return await node.handle_request_vote(req)
+            except RpcError as e:
+                return ErrorResponse(e.status.code, e.status.error_msg)
+            except Exception as e:  # noqa: BLE001 — one bad item only
+                LOG.exception("multi_vote item failed")
+                return ErrorResponse(int(RaftError.EINTERNAL), repr(e))
+
+        acks = await asyncio.gather(*(one(r) for r in request.items))
+        return BatchResponse(items=list(acks))
+
+    async def _handle_multi_append(self, request):
+        """Fan an AppendEntries BatchRequest out: per TARGET NODE the
+        items execute sequentially in batch order (the in-order
+        execution contract pipelined replication needs — the sender
+        guarantees no cross-RPC races by keeping one RPC in flight per
+        endpoint); distinct nodes run concurrently, so their log
+        flushes coalesce into the same multilog group-commit round.
+
+        A node that cannot serve an item within half an election
+        timeout gets EBUSY for that item AND every later item of the
+        same node in this batch (executing later items while the stuck
+        one still holds the lane would reorder the group's log writes);
+        the shielded handler keeps running, the leader just rolls back
+        and re-probes, exactly like a dropped direct RPC."""
+        from tpuraft.rpc.messages import BatchResponse, ErrorResponse
+
+        out: list = [None] * len(request.items)
+        by_node: dict[tuple[str, str], list[int]] = {}
+        for i, req in enumerate(request.items):
+            by_node.setdefault((req.group_id, req.peer_id), []).append(i)
+
+        async def run_node(key, idxs):
+            node = self._nodes.get(key)
+            if node is None:
+                err = ErrorResponse(int(RaftError.ENOENT),
+                                    f"no node for {key[0]}")
+                for i in idxs:
+                    out[i] = err
+                return
+            if key in self._append_inflight:
+                # a previous window's handler is still stuck on this
+                # node: answering EBUSY NOW (without spawning) keeps
+                # leader retries from stacking one shielded handler —
+                # each holding a full entry window — per cycle
+                busy = ErrorResponse(int(RaftError.EBUSY),
+                                     f"{key[0]} busy")
+                for i in idxs:
+                    out[i] = busy
+                return
+            budget = node.options.election_timeout_ms / 1000.0 / 2
+            for pos, i in enumerate(idxs):
+                try:
+                    self._append_inflight.add(key)
+                    task = asyncio.ensure_future(
+                        node.handle_append_entries(request.items[i]))
+
+                    def _done(t, key=key):
+                        self._append_inflight.discard(key)
+                        if not t.cancelled():
+                            t.exception()
+
+                    task.add_done_callback(_done)
+                    out[i] = await asyncio.wait_for(
+                        asyncio.shield(task), budget)
+                except asyncio.TimeoutError:
+                    busy = ErrorResponse(int(RaftError.EBUSY),
+                                         f"{key[0]} busy")
+                    for j in idxs[pos:]:
+                        out[j] = busy
+                    return
+                except RpcError as e:
+                    out[i] = ErrorResponse(e.status.code,
+                                           e.status.error_msg)
+                except Exception as e:  # noqa: BLE001
+                    LOG.exception("multi_append item failed")
+                    out[i] = ErrorResponse(int(RaftError.EINTERNAL),
+                                           repr(e))
+
+        await asyncio.gather(*(run_node(k, v) for k, v in by_node.items()))
+        return BatchResponse(items=out)
 
     async def _handle_multi_heartbeat(self, request):
         """Fan a MultiHeartbeatRequest out to the local nodes; each beat

@@ -1,18 +1,30 @@
-"""Replicator: per-(group, follower) log shipping state machine.
+"""Replicator: per-(group, follower) log-shipping state machine.
 
 Reference parity: ``core:core/Replicator`` + ``ReplicatorGroupImpl``
-(SURVEY.md §3.1 north-star hot path, §4.2): probe → batched AppendEntries
-→ matchIndex advance → BallotBox#commitAt; separate heartbeat cadence;
-InstallSnapshot fallback when the follower is behind the compacted log;
-TimeoutNow for leadership transfer.
+(SURVEY.md §3.1 north-star hot path, §4.2): probe → batched
+AppendEntries → matchIndex advance → BallotBox#commitAt; separate
+heartbeat cadence; InstallSnapshot fallback when the follower is behind
+the compacted log; TimeoutNow for leadership transfer.
+
+Round-4 redesign (SURVEY §3.5 "batched per-tick (group, peer) send
+matrices", §8.2 "send-plans"): the replicator is a PASSIVE state
+machine — no standing task, no per-RPC task, no log-manager waiter.
+Events (log appends via :meth:`wake`, batch responses, engine masks)
+drive :meth:`pump`, which builds up to a window of AppendEntries and
+hands them to the shared per-endpoint :class:`~tpuraft.core.send_plane.
+EndpointSender`; the whole window rides ONE ``multi_append`` RPC
+together with every other group on the endpoint pair.  Standing tasks
+per process drop from O(groups x peers) (the reference's
+thread-per-replicator shape, and this file's own pre-r4 ``_run`` task)
+to O(endpoints).
 
 Pipelining (reference: inflight FIFO, ``maxReplicatorInflightMsgs``):
-up to ``RaftOptions.max_inflight_msgs`` AppendEntries ride per peer,
-resolved strictly in send order against the follower's per-(group,
-leader) ordered execution lane (NodeManager) — single-group throughput
-is batch*window per RTT instead of batch per RTT.  The asyncio loop
-additionally pipelines across groups/peers, and the multi-raft engine
-batches G x P quorum math per device tick.
+up to ``RaftOptions.max_inflight_msgs`` AppendEntries ride per batch,
+resolved strictly in send order (the sender preserves order, the
+receiver executes a node's items sequentially) — single-group
+throughput is window x batch per endpoint round trip.  A head failure
+rolls the window back to the confirmed ``match_index`` and re-probes,
+exactly like the old FIFO.
 """
 
 from __future__ import annotations
@@ -20,14 +32,13 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from collections import deque
 from typing import Optional
 
 from tpuraft.entity import PeerId
-from tpuraft.errors import RaftError, Status
+from tpuraft.errors import RaftError
 from tpuraft.rpc.messages import (
     AppendEntriesRequest,
-    AppendEntriesResponse,
+    ErrorResponse,
     TimeoutNowRequest,
 )
 from tpuraft.rpc.transport import RpcError
@@ -35,17 +46,9 @@ from tpuraft.rpc.transport import RpcError
 LOG = logging.getLogger(__name__)
 
 
-def _drop_task(t: "asyncio.Task") -> None:
-    """Cancel an in-flight RPC task and make sure a failure that
-    already completed is retrieved (else asyncio logs 'Task exception
-    was never retrieved' per dropped send during any outage)."""
-    t.cancel()
-
-    def _swallow(tt):
-        if not tt.cancelled():
-            tt.exception()
-
-    t.add_done_callback(_swallow)
+def _consume(t: "asyncio.Task") -> None:
+    if not t.cancelled():
+        t.exception()
 
 
 class Replicator:
@@ -57,24 +60,35 @@ class Replicator:
         self._matched = False  # True after the first successful probe/append
         self.last_rpc_ack = time.monotonic()
         self._running = False
-        self._task: Optional[asyncio.Task] = None
-        self._hb_task: Optional[asyncio.Task] = None
-        self._wake = asyncio.Event()
         self._hub = None  # HeartbeatHub when coalescing is enabled
+        self._hb_task: Optional[asyncio.Task] = None
         # does the peer's endpoint serve multi_heartbeat?  Learned from
         # every AppendEntries response (probe/ack/beat); drives AUTO
         # coalescing (RaftOptions.coalesce_heartbeats=None)
         self.peer_multi_hb = False
         self._transfer_target_index: Optional[int] = None
         self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
-        self.inflight_peak = 0  # high-water mark of the pipeline window
+        self.inflight_peak = 0  # high-water mark of the batch window
+        # send-plane state
+        self._sender = None          # EndpointSender (or None: direct mode)
+        self._pending = False        # a batch is submitted / in flight
+        self._inflight: list[tuple[int, int, int]] = []  # (prev, count, term)
+        self._installing = False
+        self._install_task: Optional[asyncio.Task] = None
+        self._wake_scheduled = False
+        self._delay_handle = None    # scheduled delayed pump (backoff)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self._running = True
-        self._task = asyncio.ensure_future(self._run())
         node = self._node
+        if node.node_manager is not None:
+            self._sender = node.node_manager.send_plane.sender(
+                self.peer.endpoint)
+        else:
+            self._sender = _DirectSender(self.peer.endpoint)
+        self.wake()  # initial probe
         if getattr(node._ctrl, "drives_heartbeats", False):
             # engine control plane: the device tick's hb_due mask beats
             # this replicator (batched via HeartbeatHub.pulse) — no
@@ -99,186 +113,117 @@ class Replicator:
         if self._hub is not None:
             self._hub.deregister(self)
             self._hub = None
-        for t in (self._task, self._hb_task):
-            if t:
-                t.cancel()
-        self._task = self._hb_task = None
+        if self._hb_task:
+            self._hb_task.cancel()
+            self._hb_task = None
+        if self._install_task:
+            self._install_task.cancel()
+            self._install_task = None
+        if self._delay_handle is not None:
+            self._delay_handle.cancel()
+            self._delay_handle = None
+        if isinstance(self._sender, _DirectSender):
+            self._sender.stop()
+        self._inflight.clear()
         for _, fut in self._catchup_waiters:
             if not fut.done():
                 fut.set_result(False)
         self._catchup_waiters.clear()
 
     def wake(self) -> None:
-        self._wake.set()
-
-    # -- main replication loop ----------------------------------------------
-
-    async def _run(self) -> None:
-        try:
-            while self._running and self._node.is_leader():
-                lm = self._node.log_manager
-                if self.next_index < lm.first_log_index():
-                    ok = await self._install_snapshot()
-                    if not ok:
-                        await asyncio.sleep(
-                            self._node.options.election_timeout_ms / 1000.0 / 2)
-                    continue
-                if not self._matched:
-                    # probe first (reference: sendEmptyEntries on start):
-                    # discovers the follower's log tail / backs off next_index
-                    await self._send_entries()
-                    continue
-                if self.next_index > lm.last_log_index():
-                    # nothing to send: wait for new entries (or stop)
-                    self._wake.clear()
-                    waiter = lm.wait_for(self.next_index)
-                    wake = asyncio.ensure_future(self._wake.wait())
-                    try:
-                        await asyncio.wait(
-                            [waiter, wake],
-                            return_when=asyncio.FIRST_COMPLETED)
-                    finally:
-                        # also on cancellation, or the Event.wait task
-                        # outlives the replicator ("destroyed pending")
-                        waiter.cancel()
-                        wake.cancel()
-                    continue
-                await self._pipeline_entries()
-        except asyncio.CancelledError:
+        """Schedule a pump on the next loop pass (coalesces N wakes per
+        pass into one batch build — e.g. a burst of appends)."""
+        if self._wake_scheduled or not self._running:
             return
-        except Exception:
-            LOG.exception("replicator %s crashed", self.peer)
+        self._wake_scheduled = True
+        asyncio.get_running_loop().call_soon(self._wake_run)
 
-    async def _pipeline_entries(self) -> None:
-        """Windowed pipelined replication (reference: the Replicator
-        inflight FIFO, ``maxReplicatorInflightMsgs``): keep up to W
-        AppendEntries RPCs in flight, advancing ``next_index``
-        optimistically as batches ship.  Responses resolve strictly in
-        send order — the head of the FIFO is awaited, so out-of-order
-        completions just wait their turn.  Any head failure rolls the
-        window back to the confirmed ``match_index`` and re-probes.
-        The follower executes in arrival order (NodeManager's
-        per-(group, leader) lanes), so in-window requests cannot race
-        each other to the log."""
-        node = self._node
-        lm = node.log_manager
-        ropts = node.options.raft_options
-        window = max(1, ropts.max_inflight_msgs)
-        inflight: deque = deque()
-        try:
-            while self._running and node.is_leader() and self._matched:
-                compacted = False
-                while (len(inflight) < window
-                       and self.next_index <= lm.last_log_index()):
-                    prev_index = self.next_index - 1
-                    prev_term = lm.get_term(prev_index)
-                    if prev_index > 0 and prev_term == 0 \
-                            and prev_index >= lm.first_log_index():
-                        compacted = True   # prev gone under us
-                        break
-                    if prev_index < lm.first_log_index() - 1:
-                        compacted = True   # behind the snapshot
-                        break
-                    entries = lm.get_entries(self.next_index,
-                                             ropts.max_entries_size,
-                                             ropts.max_body_size)
-                    if not entries:
-                        break
-                    req = AppendEntriesRequest(
-                        group_id=node.group_id,
-                        server_id=str(node.server_id),
-                        peer_id=str(self.peer),
-                        term=node.current_term,
-                        prev_log_index=prev_index,
-                        prev_log_term=prev_term,
-                        committed_index=node.ballot_box.last_committed_index,
-                        entries=entries)
-                    task = asyncio.ensure_future(
-                        node.transport.append_entries(
-                            self.peer.endpoint, req,
-                            timeout_ms=node.options.election_timeout_ms))
-                    inflight.append((prev_index, len(entries),
-                                     node.current_term, task))
-                    self.next_index += len(entries)
-                if len(inflight) > self.inflight_peak:
-                    self.inflight_peak = len(inflight)
-                if not inflight:
-                    if compacted:
-                        # route to the install path (same as the serial
-                        # probe did) instead of hard-spinning the outer
-                        # loop against a compacted log
-                        first = lm.first_log_index()
-                        self.next_index = first - 1 if first > 1 else 1
-                    return          # outer loop waits / installs
-                prev_index, count, term_at_send, task = inflight.popleft()
-                try:
-                    with node.metrics.timer("replicate-entries"):
-                        resp = await task
-                except RpcError:
-                    node.metrics.counter("replicate-error")
-                    self._roll_back_window(inflight)
-                    await asyncio.sleep(
-                        node.options.election_timeout_ms / 1000.0 / 10)
-                    return
-                if not self._running or node.current_term != term_at_send:
-                    self._roll_back_window(inflight)
-                    return
-                self._note_peer_caps(resp)
-                self.last_rpc_ack = time.monotonic()
-                node.on_peer_ack(self.peer, self.last_rpc_ack)
-                if resp.term > node.current_term:
-                    self._roll_back_window(inflight)
-                    await node.step_down_on_higher_term(
-                        resp.term,
-                        f"append_entries response from {self.peer}")
-                    return
-                if not resp.success:
-                    # conflict: back off with the follower's hints and
-                    # re-probe (same formula as the serial path)
-                    self._roll_back_window(inflight)
-                    self._matched = False
-                    candidates = [prev_index, resp.last_log_index + 1]
-                    if resp.conflict_index > 0:
-                        candidates.append(resp.conflict_index)
-                    self.next_index = max(1, min(candidates))
-                    return
-                new_match = prev_index + count
-                if new_match > self.match_index:
-                    self.match_index = new_match
-                    node.on_match_advanced(self.peer, self.match_index)
-                    self._check_catchup()
-                node.metrics.counter("replicate-entries-count", count)
-                await self._maybe_timeout_now()
-        finally:
-            # never leak in-flight RPC tasks (stop / cancellation paths);
-            # next_index is rolled back by the exits that need it
-            for *_, t in inflight:
-                _drop_task(t)
-            inflight.clear()
+    def _wake_run(self) -> None:
+        self._wake_scheduled = False
+        if self._running:
+            self.pump()
 
-    def _roll_back_window(self, inflight) -> None:
-        """Drop optimistic sends: cancel queued RPCs and return
-        next_index to just past the last CONFIRMED match."""
-        for *_, t in inflight:
-            _drop_task(t)
-        inflight.clear()
-        self.next_index = max(self.match_index + 1, 1)
-
-    async def _send_entries(self) -> None:
-        node = self._node
-        lm = node.log_manager
-        prev_index = self.next_index - 1
-        prev_term = lm.get_term(prev_index)
-        if prev_index > 0 and prev_term == 0 and prev_index >= lm.first_log_index():
-            # prev entry gone (compacted concurrently) — snapshot path next loop
-            self.next_index = lm.first_log_index() - 1 if lm.first_log_index() > 1 else 1
+    def _delayed_pump(self, delay_s: float) -> None:
+        if not self._running or self._delay_handle is not None:
             return
-        # EMPTY AppendEntries probe (reference: sendEmptyEntries):
-        # discovers the follower's match point / backs off next_index;
-        # data shipping happens exclusively in _pipeline_entries once
-        # matched
-        entries = []
-        req = AppendEntriesRequest(
+        loop = asyncio.get_running_loop()
+
+        def fire():
+            self._delay_handle = None
+            if self._running:
+                self.pump()
+
+        self._delay_handle = loop.call_later(delay_s, fire)
+
+    # -- the send plan -------------------------------------------------------
+
+    def pump(self) -> None:
+        """Build the next send plan for this (group, peer) and submit it
+        to the endpoint sender.  Synchronous: frames snapshot the term
+        NOW (a step-down between build and send is caught by the
+        receiver's term check + our term_at_send guard)."""
+        node = self._node
+        if (not self._running or not node.is_leader() or self._pending
+                or self._installing):
+            return
+        lm = node.log_manager
+        if self.next_index < lm.first_log_index():
+            self._start_install()
+            return
+        if not self._matched:
+            # EMPTY AppendEntries probe (reference: sendEmptyEntries):
+            # discovers the follower's match point / backs off
+            # next_index; data ships only once matched
+            prev_index = self.next_index - 1
+            prev_term = lm.get_term(prev_index)
+            if prev_index > 0 and prev_term == 0 \
+                    and prev_index >= lm.first_log_index():
+                # prev entry gone (compacted concurrently)
+                first = lm.first_log_index()
+                self.next_index = first - 1 if first > 1 else 1
+                self._start_install()
+                return
+            reqs = [self._build_request(prev_index, prev_term, [])]
+            self._inflight = [(prev_index, 0, node.current_term)]
+        else:
+            ropts = node.options.raft_options
+            window = max(1, ropts.max_inflight_msgs)
+            reqs = []
+            self._inflight = []
+            next_index = self.next_index
+            while (len(reqs) < window
+                   and next_index <= lm.last_log_index()):
+                prev_index = next_index - 1
+                prev_term = lm.get_term(prev_index)
+                if prev_index > 0 and prev_term == 0 \
+                        and prev_index >= lm.first_log_index():
+                    break  # prev compacted under us: probe/install next
+                if prev_index < lm.first_log_index() - 1:
+                    break  # behind the snapshot
+                entries = lm.get_entries(next_index,
+                                         ropts.max_entries_size,
+                                         ropts.max_body_size)
+                if not entries:
+                    break
+                reqs.append(self._build_request(prev_index, prev_term,
+                                                entries))
+                self._inflight.append((prev_index, len(entries),
+                                       node.current_term))
+                next_index += len(entries)
+            if not reqs:
+                if next_index < lm.first_log_index():
+                    self._start_install()
+                return  # idle: the next wake() re-pumps
+            self.next_index = next_index  # optimistic, like the old FIFO
+        if len(self._inflight) > self.inflight_peak:
+            self.inflight_peak = len(self._inflight)
+        self._pending = True
+        self._sender.submit_append(self, reqs)
+
+    def _build_request(self, prev_index: int, prev_term: int,
+                       entries: list) -> AppendEntriesRequest:
+        node = self._node
+        return AppendEntriesRequest(
             group_id=node.group_id,
             server_id=str(node.server_id),
             peer_id=str(self.peer),
@@ -286,53 +231,129 @@ class Replicator:
             prev_log_index=prev_index,
             prev_log_term=prev_term,
             committed_index=node.ballot_box.last_committed_index,
-            entries=entries,
-        )
-        term_at_send = node.current_term
+            entries=entries)
+
+    # -- batch resolution ----------------------------------------------------
+
+    async def on_batch_responses(self, acks: list) -> None:
+        """Resolve one submitted batch, strictly in send order (the old
+        inflight-FIFO head loop, one whole window at a time).
+
+        _pending stays True for the WHOLE resolution (cleared in the
+        finally): this coroutine awaits mid-loop (step-down, transfer),
+        and an external wake pumping a new batch against half-processed
+        state would race the rollback paths."""
+        inflight, self._inflight = self._inflight, []
         try:
-            with node.metrics.timer("replicate-entries"):
-                resp: AppendEntriesResponse = await node.transport.append_entries(
-                    self.peer.endpoint, req,
-                    timeout_ms=node.options.election_timeout_ms)
-        except RpcError:
-            node.metrics.counter("replicate-error")
-            await asyncio.sleep(node.options.election_timeout_ms / 1000.0 / 10)
+            await self._resolve_batch(inflight, acks)
+        finally:
+            self._pending = False
+
+    async def _resolve_batch(self, inflight: list, acks: list) -> None:
+        node = self._node
+        if not self._running:
             return
-        if not self._running or node.current_term != term_at_send:
-            return
-        self._note_peer_caps(resp)
-        self.last_rpc_ack = time.monotonic()
-        node.on_peer_ack(self.peer, self.last_rpc_ack)
-        if resp.term > node.current_term:
-            await node.step_down_on_higher_term(
-                resp.term, f"append_entries response from {self.peer}")
-            return
-        if not resp.success:
-            # log mismatch: back off using the follower's hints, re-probe.
-            # conflict_index (first index of the follower's conflicting
-            # term) skips a whole term run per round trip.
-            self._matched = False
-            before = self.next_index
-            candidates = [self.next_index - 1, resp.last_log_index + 1]
-            if resp.conflict_index > 0:
-                candidates.append(resp.conflict_index)
-            self.next_index = max(1, min(candidates))
-            if self.next_index == before:
-                # no progress (e.g. a follower that rejects everything):
-                # pace the probe loop instead of spinning at full speed
-                await asyncio.sleep(
-                    node.options.election_timeout_ms / 1000.0 / 20)
-            return
-        # success: follower's log matches through prev
-        # (reference: matchIndex = request.prevLogIndex + entriesCount)
-        self._matched = True
-        new_match = prev_index
-        if new_match > self.match_index:
-            self.match_index = new_match
-            node.on_match_advanced(self.peer, self.match_index)
-            self._check_catchup()
-        self.next_index = max(self.next_index, new_match + 1)
+        eto_s = node.options.election_timeout_ms / 1000.0
+        for (prev_index, count, term_at_send), ack in zip(inflight, acks):
+            if node.current_term != term_at_send or not node.is_leader():
+                self._rollback()
+                return
+            if isinstance(ack, (ErrorResponse, Exception)) or not hasattr(
+                    ack, "success"):
+                code = getattr(ack, "code", None)
+                if code == int(RaftError.ENOENT):
+                    # peer endpoint is up but doesn't host this node
+                    # (removed / not yet started): silence, not a storm
+                    self._rollback()
+                    self._delayed_pump(eto_s / 2)
+                else:
+                    node.metrics.counter("replicate-error")
+                    self._rollback()
+                    self._delayed_pump(eto_s / 10)
+                return
+            self._note_peer_caps(ack)
+            self.last_rpc_ack = time.monotonic()
+            node.on_peer_ack(self.peer, self.last_rpc_ack)
+            if ack.term > node.current_term:
+                self._rollback()
+                await node.step_down_on_higher_term(
+                    ack.term, f"append_entries response from {self.peer}")
+                return
+            if not ack.success:
+                # log mismatch: back off using the follower's hints and
+                # re-probe; conflict_index (first index of the
+                # follower's conflicting term) skips a whole term run
+                # per round trip (classic Raft §5.3 fast backoff)
+                was_probe = count == 0 and not self._matched
+                before = self.next_index
+                self._rollback()
+                self._matched = False
+                candidates = [prev_index, ack.last_log_index + 1]
+                if ack.conflict_index > 0:
+                    candidates.append(ack.conflict_index)
+                self.next_index = max(1, min(candidates))
+                if was_probe and self.next_index == before:
+                    # a follower that rejects everything: pace the probe
+                    # loop instead of spinning at full speed
+                    self._delayed_pump(eto_s / 20)
+                else:
+                    self.wake()
+                return
+            # success: follower's log matches through prev + entries
+            # (reference: matchIndex = prevLogIndex + entriesCount)
+            self._matched = True
+            new_match = prev_index + count
+            if new_match > self.match_index:
+                self.match_index = new_match
+                node.on_match_advanced(self.peer, self.match_index)
+                self._check_catchup()
+            if count:
+                node.metrics.counter("replicate-entries-count", count)
         await self._maybe_timeout_now()
+        self.wake()  # more entries may have queued while we were out
+
+    async def on_batch_error(self) -> None:
+        """The whole batch RPC failed (endpoint unreachable/timeout)."""
+        node = self._node
+        self._pending = False
+        self._rollback()
+        if not self._running or not node.is_leader():
+            return
+        node.metrics.counter("replicate-error")
+        self._delayed_pump(node.options.election_timeout_ms / 1000.0 / 10)
+
+    def _rollback(self) -> None:
+        """Drop optimistic sends: return next_index to just past the
+        last CONFIRMED match."""
+        self._inflight = []
+        if self._matched:
+            self.next_index = max(self.match_index + 1, 1)
+
+    # -- snapshot install ----------------------------------------------------
+
+    def _start_install(self) -> None:
+        if self._installing or not self._running:
+            return
+        self._installing = True
+
+        async def run():
+            node = self._node
+            try:
+                ok = await node.install_snapshot_on(self.peer, self)
+                if not ok:
+                    await asyncio.sleep(
+                        node.options.election_timeout_ms / 1000.0 / 2)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                LOG.exception("snapshot install to %s failed", self.peer)
+            finally:
+                self._installing = False
+                self._install_task = None
+                self.wake()
+
+        self._install_task = asyncio.ensure_future(run())
+        self._install_task.add_done_callback(_consume)
 
     # -- heartbeats ----------------------------------------------------------
 
@@ -463,7 +484,8 @@ class Replicator:
         """Send TimeoutNow once this peer's match reaches log_index."""
         self._transfer_target_index = log_index
         if self.match_index >= log_index:
-            asyncio.ensure_future(self._maybe_timeout_now())
+            t = asyncio.ensure_future(self._maybe_timeout_now())
+            t.add_done_callback(_consume)
         else:
             self.wake()
 
@@ -483,10 +505,28 @@ class Replicator:
             except RpcError:
                 LOG.warning("timeout_now to %s failed", self.peer)
 
-    # -- snapshot install ----------------------------------------------------
 
-    async def _install_snapshot(self) -> bool:
-        return await self._node.install_snapshot_on(self.peer, self)
+class _DirectSender:
+    """Degenerate per-(group, peer) sender for nodes WITHOUT a
+    NodeManager (bare unit-test nodes): same submit/response contract as
+    EndpointSender, but ships each frame as its own append_entries RPC
+    from one transient task per batch."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._task: Optional[asyncio.Task] = None
+
+    def submit_append(self, rep: Replicator, reqs: list) -> None:
+        from tpuraft.core.send_plane import sequential_appends
+
+        self._task = asyncio.ensure_future(
+            sequential_appends(rep, self.endpoint, reqs, timed=True))
+        self._task.add_done_callback(_consume)
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
 
 
 class ReplicatorGroup:

@@ -111,7 +111,7 @@ class SnapshotExecutor:
                                        node.fsm_caller.last_applied_term)
                 await node.options.fsm.on_snapshot_save(w, d)
 
-            node.fsm_caller._queue.put_nowait(
+            node.fsm_caller._enqueue(
                 ("snapshot_save_custom", (writer, done, save_wrapper)))
             st = await done_fut
             if not st.is_ok():

@@ -57,6 +57,8 @@ class GroupState:
     elect_deadline: jnp.ndarray  # int32 [G] ms: follower election-timeout deadline
     hb_deadline: jnp.ndarray   # int32 [G] ms: leader next-heartbeat time
     last_ack: jnp.ndarray      # int32 [G,P] ms: last response time per peer
+    snap_deadline: jnp.ndarray  # int32 [G] ms: next snapshot due (engine-
+    # scheduled snapshotTimer: one [G] row + mask replaces G RepeatedTimers)
 
     @staticmethod
     def zeros(g: int, p: int) -> "GroupState":
@@ -71,6 +73,7 @@ class GroupState:
             elect_deadline=jnp.zeros((g,), jnp.int32),
             hb_deadline=jnp.zeros((g,), jnp.int32),
             last_ack=jnp.zeros((g, p), jnp.int32),
+            snap_deadline=jnp.zeros((g,), jnp.int32),
         )
 
 
@@ -85,13 +88,16 @@ class TickParams:
     election_timeout_ms: jnp.ndarray  # int32 scalar or [G]
     heartbeat_ms: jnp.ndarray         # int32 scalar or [G]
     lease_ms: jnp.ndarray             # int32 scalar or [G]
+    snapshot_ms: jnp.ndarray          # int32 scalar or [G]; 0 = disabled
 
     @staticmethod
-    def make(election_timeout_ms, heartbeat_ms, lease_ms) -> "TickParams":
+    def make(election_timeout_ms, heartbeat_ms, lease_ms,
+             snapshot_ms=0) -> "TickParams":
         return TickParams(
             jnp.asarray(election_timeout_ms, jnp.int32),
             jnp.asarray(heartbeat_ms, jnp.int32),
             jnp.asarray(lease_ms, jnp.int32),
+            jnp.asarray(snapshot_ms, jnp.int32),
         )
 
 
@@ -107,6 +113,7 @@ class TickOutputs:
     step_down: jnp.ndarray      # bool [G] leader lost quorum within lease window
     hb_due: jnp.ndarray         # bool [G] leader heartbeat due this tick
     lease_valid: jnp.ndarray    # bool [G] leader lease currently valid (for reads)
+    snap_due: jnp.ndarray       # bool [G] snapshot interval elapsed (any role)
 
 
 def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
@@ -159,6 +166,16 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     hb_due = is_leader & (now_ms >= state.hb_deadline)
     new_hb_deadline = jnp.where(hb_due, now_ms + params.heartbeat_ms, state.hb_deadline)
 
+    # --- snapshot cadence (RepeatedTimer snapshotTimer, vectorized) --------
+    # Any ACTIVE role snapshots (followers compact their logs too, like
+    # the reference's per-node snapshotTimer); 0 disables.  The deadline
+    # row advances on device; the host re-mirrors + jitters on fire.
+    active = state.role != ROLE_INACTIVE
+    snap_due = active & (params.snapshot_ms > 0) & (
+        now_ms >= state.snap_deadline)
+    new_snap_deadline = jnp.where(
+        snap_due, now_ms + params.snapshot_ms, state.snap_deadline)
+
     new_state = GroupState(
         role=state.role,
         commit_rel=new_commit,
@@ -170,6 +187,7 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         elect_deadline=state.elect_deadline,
         hb_deadline=new_hb_deadline,
         last_ack=state.last_ack,
+        snap_deadline=new_snap_deadline,
     )
     outputs = TickOutputs(
         commit_rel=new_commit,
@@ -179,6 +197,7 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         step_down=step_down,
         hb_due=hb_due,
         lease_valid=lease_valid,
+        snap_due=snap_due,
     )
     return new_state, outputs
 

@@ -65,12 +65,13 @@ def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
         return GroupState(
             role=row, commit_rel=row, pending_rel=row, match_rel=mat,
             granted=mat, voter_mask=mat, old_voter_mask=mat,
-            elect_deadline=row, hb_deadline=row, last_ack=mat)
+            elect_deadline=row, hb_deadline=row, last_ack=mat,
+            snap_deadline=row)
 
     out_outputs = TickOutputs(
         commit_rel=row, commit_advanced=row, elected=row, election_due=row,
-        step_down=row, hb_due=row, lease_valid=row)
-    params_sharding = TickParams(scalar, scalar, scalar)
+        step_down=row, hb_due=row, lease_valid=row, snap_due=row)
+    params_sharding = TickParams(scalar, scalar, scalar, scalar)
     return jax.jit(
         raft_tick,
         in_shardings=(state_shardings(), scalar, params_sharding),

@@ -96,8 +96,22 @@ class StoreEngine:
     async def start(self) -> None:
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.start()
-        for region in self.opts.initial_regions:
-            await self._start_region(region)
+        # batched-concurrent region boot: one region at a time serializes
+        # every node.init's await points — at region density (rhea:
+        # StoreEngine's thousands-of-regions role) that alone dominates
+        # store restart time.  Bounded batches keep the task herd small.
+        BOOT_BATCH = 128
+        regions = list(self.opts.initial_regions)
+        for i in range(0, len(regions), BOOT_BATCH):
+            # settle the WHOLE batch before failing: a bare gather would
+            # abort on the first error while sibling boots keep running
+            # detached against a half-torn store
+            results = await asyncio.gather(
+                *(self._start_region(r) for r in regions[i:i + BOOT_BATCH]),
+                return_exceptions=True)
+            for res in results:
+                if isinstance(res, BaseException):
+                    raise res
         self._started = True
         if self.pd_client is not None:
             self._heartbeat_task = asyncio.ensure_future(

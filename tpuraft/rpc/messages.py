@@ -228,6 +228,29 @@ class MultiHeartbeatResponse:
     acks: list[bytes]
 
 
+@dataclass
+class BatchRequest:
+    """Generic batched RPC envelope (the send-plane wire unit —
+    SURVEY.md §3.5 "batched per-tick (group, peer) send matrices",
+    §8.2 "send-plans"): one RPC per (src, dst) endpoint pair carries
+    MANY groups' protocol messages.  ``items`` are full request
+    messages (AppendEntriesRequest / RequestVoteRequest); the method
+    name ("multi_append" / "multi_vote") selects the receiver's
+    dispatch.  In-proc transports pass the objects through untouched;
+    framed transports nest-encode them at the wire (``list[msg]``)."""
+
+    items: list[msg]  # noqa: F821 — codec annotation, not a type
+
+
+@dataclass
+class BatchResponse:
+    """One response message per request item, in order; an
+    ErrorResponse marks an item whose group was unroutable or
+    unserviceable on the receiver."""
+
+    items: list[msg]  # noqa: F821
+
+
 for _i, _t in enumerate([
     AppendEntriesRequest,
     AppendEntriesResponse,
@@ -244,6 +267,8 @@ for _i, _t in enumerate([
     ErrorResponse,
     MultiHeartbeatRequest,
     MultiHeartbeatResponse,
+    BatchRequest,
+    BatchResponse,
 ]):
     register_message(_i, _t)
 
@@ -290,6 +315,10 @@ def encode_message(msg) -> bytes:
             out += struct.pack("<I", len(v))
             for e in v:
                 out += _pack_bytes(e.encode())
+        elif ann.startswith("list[msg]"):
+            out += struct.pack("<I", len(v))
+            for m in v:
+                out += _pack_bytes(encode_message(m))
         else:
             raise TypeError(f"cannot encode field {name}={v!r} ({ann})")
     return bytes(out)
@@ -344,6 +373,14 @@ def decode_message(buf: bytes | memoryview):
                 # (storage reads keep verify=True)
                 entries.append(LogEntry.decode(blob, verify=False))
             kwargs[name] = entries
+        elif ann.startswith("list[msg]"):
+            (n,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            msgs = []
+            for _ in range(n):
+                blob, off = _unpack_bytes(buf, off)
+                msgs.append(decode_message(blob))
+            kwargs[name] = msgs
         else:
             raise TypeError(f"cannot decode field {name}: {ann}")
     return cls(**kwargs)

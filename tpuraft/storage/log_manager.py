@@ -15,6 +15,7 @@ node's event loop (the functional analog of LogManagerImpl's lock).
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 import logging
 from dataclasses import dataclass
 from typing import Optional
@@ -64,7 +65,12 @@ class LogManager:
 
         self._staged: list[LogEntry] = []
         self._stable_waiters: list[tuple[int, asyncio.Future]] = []
-        self._queue: asyncio.Queue[_FlushReq | None] = asyncio.Queue()
+        # demand-spawned flusher (r4): a standing flush task per node is
+        # O(nodes) idle tasks per process (48K at the 16Kx3 ladder rung);
+        # requests queue here and one short-lived drain runs while any
+        # exist.  Single-drainer + FIFO deque keeps flush order, which
+        # _stable_index and the on_stable hook rely on.
+        self._queue: deque = deque()
         self._inflight_flushes = 0
         self._flush_idle = asyncio.Event()
         self._flush_idle.set()
@@ -90,22 +96,26 @@ class LogManager:
         # floor of 0 would make the first trim walk the whole log range)
         self._trim_floor = self._last_index
         # rebuild configuration history from the stored log (sidecar index:
-        # O(#conf entries), not O(n) — see LogStorage#configuration_indexes)
-        loop = asyncio.get_running_loop()
-        conf_indexes = await loop.run_in_executor(
-            None, self._storage.configuration_indexes)
+        # O(#conf entries), not O(n) — see LogStorage#configuration_indexes).
+        # Storages whose sidecar is an in-memory/C-side lookup advertise
+        # CHEAP_CONF_INDEXES: the executor hop is pure overhead for them,
+        # and at high group counts one hop per node serializes into tens
+        # of seconds of boot (16K-groups ladder, VERDICT r3 #7).
+        if getattr(self._storage, "CHEAP_CONF_INDEXES", False):
+            conf_indexes = self._storage.configuration_indexes()
+        else:
+            loop = asyncio.get_running_loop()
+            conf_indexes = await loop.run_in_executor(
+                None, self._storage.configuration_indexes)
         for i in conf_indexes:
             e = self._storage.get_entry(i)
             if e and e.type == EntryType.CONFIGURATION:
                 self._track_conf(e)
-        self._flusher = asyncio.ensure_future(self._flush_loop())
 
     async def shutdown(self) -> None:
         self._stopped = True
-        if self._flusher:
-            await self._queue.put(None)
+        if self._flusher is not None and not self._flusher.done():
             await self._flusher
-            self._flusher = None
         self._wake_waiters(error=True)
         self._storage.shutdown()
 
@@ -313,7 +323,9 @@ class LogManager:
         self._inflight_flushes += 1
         self._flush_idle.clear()
         try:
-            await self._queue.put(_FlushReq(entries, fut))
+            self._queue.append(_FlushReq(entries, fut))
+            if self._flusher is None or self._flusher.done():
+                self._flusher = asyncio.ensure_future(self._flush_loop())
             await fut
         finally:
             self._inflight_flushes -= 1
@@ -322,18 +334,11 @@ class LogManager:
 
     async def _flush_loop(self) -> None:
         loop = asyncio.get_running_loop()
-        while True:
-            req = await self._queue.get()
-            if req is None:
-                return
-            batch = [req]
+        while self._queue:
+            batch = [self._queue.popleft()]
             # coalesce everything already queued (AppendBatcher)
-            while not self._queue.empty() and len(batch) < self._max_flush_batch:
-                nxt = self._queue.get_nowait()
-                if nxt is None:
-                    await self._queue.put(None)
-                    break
-                batch.append(nxt)
+            while self._queue and len(batch) < self._max_flush_batch:
+                batch.append(self._queue.popleft())
             entries = [e for r in batch for e in r.entries]
             try:
                 if entries:

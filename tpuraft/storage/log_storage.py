@@ -102,6 +102,8 @@ class LogStorage(ABC):
 class MemoryLogStorage(LogStorage):
     """Reference test double (``MemoryLogStorage`` exists upstream too)."""
 
+    CHEAP_CONF_INDEXES = True  # dict walk, no disk
+
     def __init__(self) -> None:
         self._entries: dict[int, LogEntry] = {}
         self._first = 1

@@ -345,6 +345,8 @@ def _release_engine(eng: MultiLogEngine) -> None:
 
 
 class MultiLogStorage(LogStorage):
+
+    CHEAP_CONF_INDEXES = True  # C-side sidecar lookup, no disk I/O
     """Per-group view over the shared engine; selected by
     ``multilog://<dir>#<group_id>``."""
 
