@@ -235,6 +235,9 @@ def main():
     # the scale ladder (bench_scale.py: 1K/4K/16K groups per process,
     # real appends -> fsync -> quorum -> apply) rides along the same way
     scale = load_sidecar("BENCH_SCALE.json")
+    # the KV region-density record (bench_region_density.py: >=1K
+    # regions through the full RheaKV stack)
+    regions = load_sidecar("BENCH_REGIONS.json")
 
     print(json.dumps({
         "metric": "multiraft_batched_commits_per_sec_16k_groups",
@@ -244,6 +247,7 @@ def main():
         "extra": {
             "e2e": e2e,
             "scale": scale,
+            "regions": regions,
             "quorum_impl": quorum_impl,
             "groups": G, "peer_slots": P, "voters": VOTERS,
             # PRIMARY regression signals (VERDICT r2 #8): both are

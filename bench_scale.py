@@ -118,7 +118,7 @@ async def run_rung(args) -> dict:
     for i in range(R):
         eng = engines[i]
         now = eng.now_ms()
-        spread_ms = (int(args.elect_spread_s * 1000)
+        spread_ms = (int(float(args.elect_spread_s) * 1000)
                      or 4 * args.election_timeout_ms)
         jit = rng.integers(0, spread_ms, eng.G)
         eng.elect_deadline[:] = now + args.election_timeout_ms // 4 + jit
@@ -210,6 +210,11 @@ async def run_rung(args) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rungs", default="1024,4096,16384")
+    ap.add_argument("--offered", default="3000",
+                    help="offered entries/s; one value or comma list "
+                         "matched to --rungs (capacity at high G is "
+                         "1-core bound — over-offering measures queue "
+                         "collapse, not protocol capacity)")
     # parent-side replicas passthrough (single-voter rungs measure the
     # engine+journal+FSM plane at G beyond the 3-replica election
     # capacity of one core)
@@ -222,9 +227,10 @@ def main() -> None:
     ap.add_argument("--groups", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--pace-ms", type=float, default=0.0)
-    ap.add_argument("--elect-spread-s", type=float, default=0.0,
+    ap.add_argument("--elect-spread-s", default="0",
                     help="window over which the boot-deferred elections "
-                         "release (0 = 4x election timeout); widen at "
+                         "release (0 = 4x election timeout); one value "
+                         "or comma list matched to --rungs; widen at "
                          "high GxR so the election herd stays under the "
                          "host's per-second election capacity")
     ap.add_argument("--dir", default="")
@@ -240,18 +246,29 @@ def main() -> None:
 
     ensure_built()
     rows = []
-    for g in [int(x) for x in args.rungs.split(",")]:
-        # offered load ~3K entries/s regardless of G (below the 1-core
-        # protocol capacity, so ack latency reflects service time, not
-        # queue growth): pace = G*batch/3K; the window stretches so
-        # every group gets >= ~2 turns even when pace > duration
-        pace_ms = max(200.0, g * args.batch / 3000.0 * 1000.0)
+    rung_list = [int(x) for x in args.rungs.split(",")]
+    offered_list = [float(x) for x in args.offered.split(",")]
+    if len(offered_list) == 1:
+        offered_list *= len(rung_list)
+    spread_list = [float(x) for x in str(args.elect_spread_s).split(",")]
+    if len(spread_list) == 1:
+        spread_list *= len(rung_list)
+    if len(offered_list) != len(rung_list) or \
+            len(spread_list) != len(rung_list):
+        raise SystemExit("--offered/--elect-spread-s list lengths must "
+                         "match --rungs (or be a single value)")
+    for g, offered, spread in zip(rung_list, offered_list, spread_list):
+        # offered load below the measured 1-core protocol capacity, so
+        # ack latency reflects service time, not queue growth:
+        # pace = G*batch/offered; the window stretches so every group
+        # gets >= ~2 turns even when pace > duration
+        pace_ms = max(200.0, g * args.batch / offered * 1000.0)
         rung_duration = max(args.duration, pace_ms * 2.0 / 1000.0)
         workdir = tempfile.mkdtemp(prefix=f"tpuraft_scale_{g}_")
         cmd = [sys.executable, os.path.join(REPO, "bench_scale.py"),
                "--rung", "--groups", str(g), "--dir", workdir,
                "--replicas", str(args.replicas),
-               "--elect-spread-s", str(args.elect_spread_s),
+               "--elect-spread-s", str(spread),
                "--duration", str(rung_duration), "--batch", str(args.batch),
                "--pace-ms", str(pace_ms),
                "--election-timeout-ms", str(args.election_timeout_ms)]
@@ -263,6 +280,8 @@ def main() -> None:
             line = line.decode().strip()
             if line.startswith("RESULT "):
                 row = json.loads(line[len("RESULT "):])
+            elif line.startswith("PROGRESS"):
+                print(line, flush=True)
         p.wait()
         if row is None:
             row = {"groups": g, "error": "rung produced no result"}

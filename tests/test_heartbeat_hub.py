@@ -92,10 +92,11 @@ async def test_multi_group_idle_rpc_reduction():
                 (c.nodes[(c.groups[0], ep)].node_manager
                  for ep in c.endpoints)]
         rpcs0 = sum(h.rpcs_sent for h in hubs)
-        beats0 = sum(h.beats_sent for h in hubs)
+        beats0 = sum(h.beats_sent + h.fast_beats_sent for h in hubs)
         calls.clear()
         await asyncio.sleep(1.0)
-        n_multi = calls.count("multi_heartbeat")
+        n_multi = calls.count("multi_heartbeat") + calls.count(
+            "multi_beat_fast")
         n_append = calls.count("append_entries")
         assert n_multi > 0
         # without coalescing, idle heartbeats would be ~16 groups x 2
@@ -103,10 +104,13 @@ async def test_multi_group_idle_rpc_reduction():
         # append_entries RPCs in a quiet window stay far below that
         assert n_append < n_multi * 4, (n_append, n_multi)
         # and the hub batched many beats per RPC while idle (deadlines
-        # phase-align to the hb grid, so due groups pulse together)
+        # phase-align to the hb grid, so due groups pulse together);
+        # steady state rides the beat-plane fast path almost entirely
         d_rpcs = sum(h.rpcs_sent for h in hubs) - rpcs0
-        d_beats = sum(h.beats_sent for h in hubs) - beats0
+        d_beats = sum(h.beats_sent + h.fast_beats_sent
+                      for h in hubs) - beats0
         assert d_beats > d_rpcs * 4, (d_beats, d_rpcs)
+        assert sum(h.fast_beats_sent for h in hubs) > 0
     finally:
         await c.stop_all()
 

@@ -49,9 +49,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING, Optional
 
 from tpuraft.rpc.messages import (
+    BatchRequest,
+    CompactBeat,
     MultiHeartbeatRequest,
     MultiHeartbeatResponse,
     decode_message,
@@ -77,8 +80,14 @@ class HeartbeatHub:
         # magnitude, small enough that a contended group's slow ack only
         # delays its own chunk
         self.max_beats_per_rpc = 16
+        # fast beats are data rows, not frames: a straggler answers
+        # needs_full instead of delaying its chunk, so chunks can be big
+        self.max_fast_beats_per_rpc = 1024
         self.rpcs_sent = 0      # multi_heartbeat RPCs (observability)
         self.beats_sent = 0     # individual group beats carried
+        self.fast_beats_sent = 0
+        self.fast_fallbacks = 0
+        self._fast_ok: dict[str, bool] = {}  # dst lacks multi_beat_fast
 
     def register(self, replicator: "Replicator") -> None:
         node = replicator._node
@@ -133,21 +142,57 @@ class HeartbeatHub:
         passes every due group's replicators in one call so idle beats
         stay O(endpoints) per tick.
 
-        Frames MUST be built here, synchronously: between the
+        Steady-state beats ride the beat-plane FAST path (CompactBeat
+        data, inline lock-free validation on the receiver — see
+        NodeManager._handle_multi_beat_fast): at region density the
+        classic per-beat handler fan-out is the dominant idle CPU burn.
+        A group whose fast beat answers needs-full (term moved,
+        committed behind, follower restarted) gets a classic
+        full-semantics beat as the follow-up; replicators not yet
+        matched, or whose endpoint hasn't advertised the capability,
+        take the classic path directly.
+
+        Frames/beats MUST be built here, synchronously: between the
         is_leader() check and an await, a step-down + re-election can
         change the node's term, and a beat built late would claim
         leadership of the NEW term from a node that is now a follower
         (observed as spurious "two leaders in one term" conflicts on
         receivers).  No awaits may separate the check from the build."""
-        by_dst: dict[str, list[tuple["Replicator", bytes]]] = {}
+        by_dst_fast: dict[str, list[tuple["Replicator", CompactBeat]]] = {}
+        classic: list["Replicator"] = []
         for r in replicators:
             node = r._node
             if not node.is_leader() or not r._running:
                 continue
-            frame = encode_message(r.build_heartbeat_request())
-            by_dst.setdefault(r.peer.endpoint, []).append((r, frame))
-        if not by_dst:
-            return
+            if (r.peer_multi_hb and r._matched
+                    and self._fast_ok.get(r.peer.endpoint, True)):
+                beat = CompactBeat(
+                    group_id=node.group_id,
+                    server_id=str(node.server_id),
+                    peer_id=str(r.peer),
+                    term=node.current_term,
+                    committed_index=min(
+                        node.ballot_box.last_committed_index,
+                        r.match_index))
+                by_dst_fast.setdefault(r.peer.endpoint, []).append((r, beat))
+                continue
+            classic.append(r)
+        for dst, pairs in by_dst_fast.items():
+            for ci in range(0, len(pairs), self.max_fast_beats_per_rpc):
+                chunk = pairs[ci:ci + self.max_fast_beats_per_rpc]
+                key = f"fast:{dst}#{ci // self.max_fast_beats_per_rpc}"
+                if key in self._inflight:
+                    continue
+                t = asyncio.ensure_future(self._beat_fast(dst, chunk))
+                self._inflight[key] = t
+                t.add_done_callback(
+                    lambda _t, k=key: self._inflight.pop(k, None))
+        if classic:
+            self._pulse_classic(classic)
+
+    def _dispatch_classic(
+            self, by_dst: dict[str, list[tuple["Replicator", bytes]]]
+    ) -> None:
         # fire-and-track per destination chunk: the tick cadence must NOT
         # wait for RPC round trips (a slow endpoint would stall
         # heartbeats to every other endpoint and trigger elections
@@ -165,6 +210,53 @@ class HeartbeatHub:
                 self._inflight[key] = t
                 t.add_done_callback(
                     lambda _t, k=key: self._inflight.pop(k, None))
+
+    async def _beat_fast(self, dst: str,
+                         pairs: list[tuple["Replicator", object]]) -> None:
+        reps = [r for r, _ in pairs]
+        items = [b for _, b in pairs]
+        node = reps[0]._node
+        self.rpcs_sent += 1
+        self.fast_beats_sent += len(items)
+        try:
+            resp = await node.transport.call(
+                dst, "multi_beat_fast", BatchRequest(items=items),
+                timeout_ms=node.options.election_timeout_ms // 2 or 1)
+        except RpcError as e:
+            if "no handler" in e.status.error_msg:
+                # receiver predates the beat plane: classic beats only
+                self._fast_ok[dst] = False
+                self.pulse(reps)
+            return  # else: silence — dead-node detection, as direct
+        now = time.monotonic()
+        fallback: list["Replicator"] = []
+        for r, ack in zip(reps, resp.items):
+            if not r._running or not r._node.is_leader():
+                continue
+            if getattr(ack, "ok", False):
+                # inline ack bookkeeping: the lease plane only needs the
+                # (peer, when) write — no per-ack task, no node lock
+                r.last_rpc_ack = now
+                r._node.on_peer_ack(r.peer, now)
+            else:
+                fallback.append(r)
+        if fallback:
+            # full-semantics follow-up for just the deviating groups
+            # (term moved / committed behind / follower restarted)
+            self.fast_fallbacks += len(fallback)
+            self._pulse_classic(fallback)
+
+    def _pulse_classic(self, replicators: list["Replicator"]) -> None:
+        """Classic framed beats only (no fast-path retry) — used for
+        fast-beat fallbacks to avoid ping-ponging."""
+        by_dst: dict[str, list[tuple["Replicator", bytes]]] = {}
+        for r in replicators:
+            node = r._node
+            if not node.is_leader() or not r._running:
+                continue
+            frame = encode_message(r.build_heartbeat_request())
+            by_dst.setdefault(r.peer.endpoint, []).append((r, frame))
+        self._dispatch_classic(by_dst)
 
     async def _beat_endpoint(self, dst: str,
                              pairs: list[tuple["Replicator", bytes]]

@@ -662,7 +662,7 @@ class Node:
         — the executor hop for it was pure overhead, and at high group
         counts an election herd paid tens of thousands of pointless
         thread round-trips."""
-        if isinstance(self._meta, MemoryRaftMetaStorage):
+        if getattr(self._meta, "SYNC_CHEAP", False):
             self._meta.set_term_and_voted_for(term, voted_for)
             return
         await asyncio.get_running_loop().run_in_executor(

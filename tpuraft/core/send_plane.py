@@ -226,7 +226,25 @@ class EndpointSender:
                 ack = acks[i]
                 i += 1
                 if not isinstance(ack, ErrorResponse):
-                    self._spawn(route[1](ack))
+                    # INLINE, not spawned, when the node's meta storage
+                    # is volatile: a 16K-group election herd's response
+                    # tasks otherwise pile up faster than the loop
+                    # drains them (measured: 35K stacked tasks, tick
+                    # rate collapsed 5x, zero groups converging).
+                    # Inline consumption is the backpressure — the next
+                    # vote chunk only ships once this chunk's responses
+                    # are processed.  With FILE-backed meta a winning
+                    # round fsyncs {term, votedFor} inside the handler,
+                    # which must not head-of-line-block up to 1023
+                    # sibling responses — those spawn as before.
+                    node = route[2]
+                    if getattr(node._meta, "SYNC_CHEAP", False):
+                        try:
+                            await route[1](ack)
+                        except Exception:  # noqa: BLE001 — one group's
+                            LOG.exception("vote response handler failed")
+                    else:
+                        self._spawn(route[1](ack))
             else:
                 _k, rep, count = route
                 self._spawn(rep.on_batch_responses(acks[i:i + count]))

@@ -229,6 +229,28 @@ class MultiHeartbeatResponse:
 
 
 @dataclass
+class CompactBeat:
+    """One steady-state heartbeat as data, not a frame (the beat-plane
+    fast path): the receiver validates (term, leader, committed) against
+    its row and touches the election deadline INLINE — no node lock, no
+    handler task.  Anything unusual (term moved, candidate, committed
+    behind, unknown node) answers needs_full and the sender follows up
+    with a classic empty-AppendEntries beat carrying full semantics."""
+
+    group_id: str
+    server_id: str  # the sending leader
+    peer_id: str    # the target node
+    term: int
+    committed_index: int
+
+
+@dataclass
+class BeatAck:
+    ok: bool            # False => send a full beat (slow path)
+    term: int           # receiver's current term (observability only)
+
+
+@dataclass
 class BatchRequest:
     """Generic batched RPC envelope (the send-plane wire unit —
     SURVEY.md §3.5 "batched per-tick (group, peer) send matrices",
@@ -269,6 +291,8 @@ for _i, _t in enumerate([
     MultiHeartbeatResponse,
     BatchRequest,
     BatchResponse,
+    CompactBeat,
+    BeatAck,
 ]):
     register_message(_i, _t)
 

@@ -77,6 +77,11 @@ class RaftMetaStorage:
 class MemoryRaftMetaStorage(RaftMetaStorage):
     """Volatile variant for tests/benchmarks."""
 
+    # _save is a no-op: callers may persist {term, votedFor} inline on
+    # the event loop (Node._persist_meta fast path, send-plane inline
+    # vote-response handling) instead of paying an executor round
+    SYNC_CHEAP = True
+
     def __init__(self) -> None:
         super().__init__("", sync=False)
 
