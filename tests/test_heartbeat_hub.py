@@ -146,6 +146,80 @@ async def test_coalesced_failover_and_recovery():
         await c.stop_all()
 
 
+# -- fast-beat failure-path unit tests (ADVICE r4) ---------------------------
+
+from types import SimpleNamespace  # noqa: E402
+
+from tpuraft.core.heartbeat_hub import HeartbeatHub  # noqa: E402
+
+
+def _fake_beat_rep(transport, peer_ep="dst:1"):
+    node = SimpleNamespace(
+        group_id="g",
+        server_id="srv:1",
+        current_term=3,
+        transport=transport,
+        options=SimpleNamespace(
+            election_timeout_ms=400,
+            raft_options=SimpleNamespace(election_heartbeat_factor=10)),
+        ballot_box=SimpleNamespace(last_committed_index=7),
+        is_leader=lambda: True,
+        on_peer_ack=lambda peer, when: None,
+    )
+    return SimpleNamespace(
+        _node=node,
+        _running=True,
+        _matched=True,
+        peer_multi_hb=True,
+        peer=SimpleNamespace(endpoint=peer_ep),
+        match_index=7,
+        last_rpc_ack=0.0,
+    )
+
+
+async def test_fast_beat_short_ack_list_falls_back_classic():
+    """A response with fewer acks than beats must NOT silently drop the
+    trailing replicators (zip truncation): the whole chunk deviates and
+    gets the classic-beat follow-up."""
+
+    class ShortTransport:
+        async def call(self, dst, method, request, timeout_ms=None):
+            from tpuraft.rpc.messages import BatchResponse
+            return BatchResponse(items=[SimpleNamespace(ok=True)])
+
+    hub = HeartbeatHub()
+    tr = ShortTransport()
+    reps = [_fake_beat_rep(tr) for _ in range(3)]
+    fell_back: list = []
+    hub._pulse_classic = lambda rs: fell_back.extend(rs)
+    hub.pulse(reps)
+    await asyncio.sleep(0.05)
+    assert len(fell_back) == 3
+    assert hub.fast_fallbacks == 3
+
+
+async def test_fast_beat_crash_is_reaped_and_falls_back_classic():
+    """A non-RpcError escaping _beat_fast must be retrieved by the done
+    callback (no 'exception was never retrieved' spam) AND fall back to
+    classic beats — a persistent codec failure must not silently starve
+    those groups of heartbeats until their followers elect."""
+
+    class ExplodingTransport:
+        async def call(self, dst, method, request, timeout_ms=None):
+            raise ValueError("codec blew up")
+
+    hub = HeartbeatHub()
+    tr = ExplodingTransport()
+    reps = [_fake_beat_rep(tr) for _ in range(2)]
+    fell_back: list = []
+    hub._pulse_classic = lambda rs: fell_back.extend(rs)
+    hub.pulse(reps)
+    await asyncio.sleep(0.05)
+    assert len(fell_back) == 2
+    assert hub.fast_fallbacks == 2
+    assert not hub._inflight  # chunk slot released for the next pulse
+
+
 class AutoMultiRaftCluster(MultiRaftCluster):
     coalesce_heartbeats = None  # the RaftOptions DEFAULT: auto
 

@@ -60,7 +60,7 @@ from tpuraft.rpc.messages import (
     decode_message,
     encode_message,
 )
-from tpuraft.rpc.transport import RpcError
+from tpuraft.rpc.transport import RpcError, is_no_method
 
 if TYPE_CHECKING:
     from tpuraft.core.replicator import Replicator
@@ -185,10 +185,30 @@ class HeartbeatHub:
                     continue
                 t = asyncio.ensure_future(self._beat_fast(dst, chunk))
                 self._inflight[key] = t
+                reps = [r for r, _ in chunk]
                 t.add_done_callback(
-                    lambda _t, k=key: self._inflight.pop(k, None))
+                    lambda _t, k=key, rs=reps: self._reap(k, _t, rs))
         if classic:
             self._pulse_classic(classic)
+
+    def _reap(self, key: str, t: asyncio.Task,
+              fallback: Optional[list["Replicator"]] = None) -> None:
+        """Done-callback for beat tasks: always retrieve the exception
+        (an unretrieved one is event-loop log spam AND a silently
+        missed beat), and give fast-path chunks that died on an
+        unexpected error their classic-beat fallback so a persistent
+        non-RpcError (e.g. codec failure) can't starve those groups of
+        heartbeats until their followers start elections."""
+        self._inflight.pop(key, None)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is None:
+            return
+        LOG.warning("heartbeat batch %s failed: %r", key, exc)
+        if fallback:
+            self.fast_fallbacks += len(fallback)
+            self._pulse_classic([r for r in fallback if r._running])
 
     def _dispatch_classic(
             self, by_dst: dict[str, list[tuple["Replicator", bytes]]]
@@ -209,7 +229,7 @@ class HeartbeatHub:
                 t = asyncio.ensure_future(self._beat_endpoint(dst, chunk))
                 self._inflight[key] = t
                 t.add_done_callback(
-                    lambda _t, k=key: self._inflight.pop(k, None))
+                    lambda _t, k=key: self._reap(k, _t))
 
     async def _beat_fast(self, dst: str,
                          pairs: list[tuple["Replicator", object]]) -> None:
@@ -223,11 +243,19 @@ class HeartbeatHub:
                 dst, "multi_beat_fast", BatchRequest(items=items),
                 timeout_ms=node.options.election_timeout_ms // 2 or 1)
         except RpcError as e:
-            if "no handler" in e.status.error_msg:
+            if is_no_method(e):
                 # receiver predates the beat plane: classic beats only
                 self._fast_ok[dst] = False
                 self.pulse(reps)
             return  # else: silence — dead-node detection, as direct
+        if len(resp.items) != len(items):
+            # short/overlong response: zip would silently drop trailing
+            # replicators' acks — treat the whole chunk as deviating
+            LOG.warning("multi_beat_fast %s: %d acks for %d beats",
+                        dst, len(resp.items), len(items))
+            self.fast_fallbacks += len(reps)
+            self._pulse_classic(reps)
+            return
         now = time.monotonic()
         fallback: list["Replicator"] = []
         for r, ack in zip(reps, resp.items):
@@ -278,6 +306,13 @@ class HeartbeatHub:
                 timeout_ms=node.options.election_timeout_ms // 2 or 1)
         except RpcError:
             return  # no acks: dead-node detection sees silence, as direct
+        if len(resp.acks) != len(frames):
+            # a short ack list must read as silence for the WHOLE chunk
+            # (dead-node detection semantics), not as acks for whichever
+            # prefix zip happens to pair up
+            LOG.warning("multi_heartbeat %s: %d acks for %d beats",
+                        dst, len(resp.acks), len(frames))
+            return
         for r, blob in zip(reps, resp.acks):
             try:
                 ack = decode_message(blob)

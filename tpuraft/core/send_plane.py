@@ -37,7 +37,7 @@ import logging
 from typing import Callable, Optional
 
 from tpuraft.rpc.messages import BatchRequest, ErrorResponse
-from tpuraft.rpc.transport import RpcError
+from tpuraft.rpc.transport import RpcError, is_no_method
 
 LOG = logging.getLogger(__name__)
 
@@ -77,12 +77,11 @@ class EndpointSender:
     def __init__(self, endpoint: str):
         self.endpoint = endpoint
         self._votes: list[tuple[object, object, Callable]] = []
-        self._appends: list[tuple[object, list]] = []
+        self._appends: list[tuple[object, list, float]] = []
         self._task: Optional[asyncio.Task] = None
-        self._round_pending: list[tuple[object, list]] = []
+        self._round_pending: list[tuple[object, list, float]] = []
         self._vote_tasks: set = set()
         self._transport = None
-        self._timeout_ms = 1000.0
         self._legacy = False  # receiver lacks multi_* handlers
         self.rpcs_sent = 0
         self.items_sent = 0
@@ -92,14 +91,13 @@ class EndpointSender:
     def submit_vote(self, node, req, cb) -> None:
         self._votes.append((node, req, cb))
         self._transport = node.transport
-        self._timeout_ms = node.options.election_timeout_ms
         self._kick_votes()
 
     def submit_append(self, replicator, reqs: list) -> None:
         node = replicator._node
-        self._appends.append((replicator, reqs))
+        self._appends.append(
+            (replicator, reqs, node.options.election_timeout_ms))
         self._transport = node.transport
-        self._timeout_ms = node.options.election_timeout_ms
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._drain())
             self._task.add_done_callback(_consume)
@@ -110,7 +108,14 @@ class EndpointSender:
             del self._votes[:self.MAX_VOTES_PER_RPC]
             items = [req for _n, req, _cb in chunk]
             routes = [("v", cb, node) for node, _req, cb in chunk]
-            t = asyncio.ensure_future(self._send_chunk(items, routes))
+            # groups with DIFFERENT election timeouts share the chunk:
+            # budget for the slowest, or a short-timeout group submitted
+            # last would expire every co-batched long-timeout group's
+            # round early (and vice versa starve retries)
+            timeout_ms = max(n.options.election_timeout_ms
+                             for _k, _cb, n in routes)
+            t = asyncio.ensure_future(
+                self._send_chunk(items, routes, timeout_ms))
             self._vote_tasks.add(t)
 
             def _done(tt, self=self):
@@ -121,7 +126,7 @@ class EndpointSender:
             t.add_done_callback(_done)
 
     def queued(self) -> int:
-        return len(self._votes) + sum(len(r) for _, r in self._appends)
+        return len(self._votes) + sum(len(r) for _, r, _t in self._appends)
 
     def stop(self) -> None:
         if self._task is not None:
@@ -139,7 +144,7 @@ class EndpointSender:
         # would leave their replicators _pending=True forever (pump
         # gated, replication silently stopped for the pair)
         pending, self._round_pending = self._round_pending, []
-        for rep, _reqs in pending + appends:
+        for rep, *_ in pending + appends:
             self._spawn(rep.on_batch_error())
         del votes  # silence, like a dropped RPC
 
@@ -172,27 +177,32 @@ class EndpointSender:
         self._round_pending = list(appends)
         chunk_items: list = []
         chunk_routes: list = []  # ("a", rep, count)
+        chunk_timeout = 0.0
 
         async def flush_chunk():
+            nonlocal chunk_timeout
             if not chunk_items:
                 return
             items, routes = list(chunk_items), list(chunk_routes)
+            timeout_ms, chunk_timeout = chunk_timeout, 0.0
             chunk_items.clear()
             chunk_routes.clear()
-            await self._send_chunk(items, routes)
+            await self._send_chunk(items, routes, timeout_ms)
             done = {id(r[1]) for r in routes}
             self._round_pending = [b for b in self._round_pending
                                    if id(b[0]) not in done]
 
-        for rep, reqs in appends:
+        for rep, reqs, tmo in appends:
             if chunk_items and (
                     len(chunk_items) + len(reqs) > self.MAX_ITEMS_PER_RPC):
                 await flush_chunk()
             chunk_items.extend(reqs)
             chunk_routes.append(("a", rep, len(reqs)))
+            chunk_timeout = max(chunk_timeout, tmo)  # budget for slowest
         await flush_chunk()
 
-    async def _send_chunk(self, items: list, routes: list) -> None:
+    async def _send_chunk(self, items: list, routes: list,
+                          timeout_ms: float) -> None:
         if self._legacy:
             await self._send_legacy(items, routes)
             return
@@ -202,10 +212,10 @@ class EndpointSender:
         try:
             resp = await self._transport.call(
                 self.endpoint, method, BatchRequest(items=items),
-                timeout_ms=self._timeout_ms)
+                timeout_ms=timeout_ms)
             acks = resp.items
         except RpcError as e:
-            if "no handler" in e.status.error_msg:
+            if is_no_method(e):
                 # receiver predates the batch plane: resend these as
                 # single RPCs and stay legacy for this endpoint
                 self._legacy = True
@@ -221,6 +231,7 @@ class EndpointSender:
             self._dispatch_error(routes)
             return
         i = 0
+        slow_votes = []
         for route in routes:
             if route[0] == "v":
                 ack = acks[i]
@@ -233,10 +244,10 @@ class EndpointSender:
                     # rate collapsed 5x, zero groups converging).
                     # Inline consumption is the backpressure — the next
                     # vote chunk only ships once this chunk's responses
-                    # are processed.  With FILE-backed meta a winning
-                    # round fsyncs {term, votedFor} inside the handler,
-                    # which must not head-of-line-block up to 1023
-                    # sibling responses — those spawn as before.
+                    # are processed.  With DURABLE meta a winning round
+                    # fsyncs {term, votedFor} inside the handler, which
+                    # must not head-of-line-block up to 1023 sibling
+                    # responses — those gather below instead.
                     node = route[2]
                     if getattr(node._meta, "SYNC_CHEAP", False):
                         try:
@@ -244,11 +255,23 @@ class EndpointSender:
                         except Exception:  # noqa: BLE001 — one group's
                             LOG.exception("vote response handler failed")
                     else:
-                        self._spawn(route[1](ack))
+                        slow_votes.append(route[1](ack))
             else:
                 _k, rep, count = route
                 self._spawn(rep.on_batch_responses(acks[i:i + count]))
                 i += count
+        if slow_votes:
+            # ONE awaited gather instead of len(slow_votes) spawned
+            # tasks: task count stays O(vote lanes), the handlers run
+            # concurrently — so their meta fsyncs coalesce into shared
+            # group-commit rounds (multimeta://) — and awaiting inline
+            # keeps the lane's backpressure: the next vote chunk ships
+            # only after this chunk's {term, votedFor} persists land.
+            for r in await asyncio.gather(*slow_votes,
+                                          return_exceptions=True):
+                if isinstance(r, BaseException) and not isinstance(
+                        r, asyncio.CancelledError):
+                    LOG.error("vote response handler failed: %r", r)
 
     def _dispatch_error(self, routes) -> None:
         for route in routes:

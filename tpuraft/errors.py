@@ -46,6 +46,11 @@ class RaftError(enum.IntEnum):
     ESHUTDOWN = 108
     ENOENT = 2
     EEXISTS = 17
+    # transport: no handler registered for the requested method.  A
+    # DEDICATED code so capability probes (send plane / heartbeat hub
+    # falling back to per-item RPCs against an older receiver) match on
+    # the code, not on the wording of an error message.
+    ENOMETHOD = 1010
 
 
 @dataclass(frozen=True)

@@ -25,6 +25,16 @@ class RpcError(Exception):
         self.status = status
 
 
+def is_no_method(e: RpcError) -> bool:
+    """True when the receiver has no handler for the requested method —
+    the capability probe the batch planes (send plane, heartbeat hub)
+    key their per-item fallback on.  The dedicated ENOMETHOD code is
+    authoritative; the substring is a compat net for receivers older
+    than the code itself."""
+    return (e.status.code == RaftError.ENOMETHOD
+            or "no handler" in e.status.error_msg)
+
+
 class RpcServer:
     """One per process endpoint; multiplexes all raft groups on it.
 
@@ -44,7 +54,7 @@ class RpcServer:
     async def dispatch(self, method: str, request: Any) -> Any:
         h = self._handlers.get(method)
         if h is None:
-            raise RpcError(Status.error(RaftError.EINTERNAL, f"no handler {method}"))
+            raise RpcError(Status.error(RaftError.ENOMETHOD, f"no handler {method}"))
         return await h(request)
 
     async def serve_framed_payload(self, seq: int, payload: bytes,
