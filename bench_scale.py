@@ -86,7 +86,9 @@ async def run_rung(args) -> dict:
                 initial_conf=Configuration(list(peers)),
                 fsm=CountFSM(),
                 log_uri=f"multilog://{args.dir}/store{i}/mlog#{gid}",
-                raft_meta_uri="memory://",
+                raft_meta_uri=(
+                    f"multimeta://{args.dir}/store{i}/meta#{gid}"
+                    if args.meta == "multimeta" else "memory://"),
                 enable_metrics=False)
             node = Node(gid, peers[i], opts, transports[i],
                         ballot_box_factory=factories[i])
@@ -199,6 +201,7 @@ async def run_rung(args) -> dict:
         "applied_total": CountFSM.applied,
         "pace_ms": args.pace_ms,
         "batch": args.batch,
+        "meta": args.meta,
         "engine_ticks": sum(e.ticks for e in engines),
     }
     print("RESULT " + json.dumps(res), flush=True)
@@ -233,6 +236,13 @@ def main() -> None:
                          "or comma list matched to --rungs; widen at "
                          "high GxR so the election herd stays under the "
                          "host's per-second election capacity")
+    ap.add_argument("--meta", default="memory",
+                    choices=["memory", "multimeta"],
+                    help="raft meta storage: memory:// (volatile, the "
+                         "r1-r4 ladder default) or multimeta:// (fsynced "
+                         "{term, votedFor} via the shared group-commit "
+                         "journal — the durable-meta election-herd "
+                         "measurement, VERDICT r4 #3)")
     ap.add_argument("--dir", default="")
     args = ap.parse_args()
 
@@ -270,7 +280,7 @@ def main() -> None:
                "--replicas", str(args.replicas),
                "--elect-spread-s", str(spread),
                "--duration", str(rung_duration), "--batch", str(args.batch),
-               "--pace-ms", str(pace_ms),
+               "--pace-ms", str(pace_ms), "--meta", args.meta,
                "--election-timeout-ms", str(args.election_timeout_ms)]
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
         t0 = time.monotonic()

@@ -77,7 +77,8 @@ class TestCluster:
                  snapshot: bool = False, group_id: str = "test_group",
                  snapshot_interval_secs: int = 0,
                  coalesce_heartbeats: bool = False,
-                 log_scheme: str = "file"):
+                 log_scheme: str = "file",
+                 meta_scheme: str = "file"):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
@@ -97,6 +98,9 @@ class TestCluster:
             raise ValueError(f"log_scheme={log_scheme!r} needs a tmp_path "
                              "(memory:// would silently be used instead)")
         self.log_scheme = log_scheme  # "file" | "native" | "multilog" (needs tmp_path)
+        if meta_scheme != "file" and tmp_path is None:
+            raise ValueError(f"meta_scheme={meta_scheme!r} needs a tmp_path")
+        self.meta_scheme = meta_scheme  # "file" | "multimeta"
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
@@ -115,7 +119,11 @@ class TestCluster:
                 opts.log_uri = f"multilog://{base}/mlog#{self.group_id}"
             else:
                 opts.log_uri = f"{self.log_scheme}://{base}/log"
-            opts.raft_meta_uri = f"file://{base}/meta"
+            if self.meta_scheme == "multimeta":
+                # shared fsynced {term, votedFor} journal (group-commit)
+                opts.raft_meta_uri = f"multimeta://{base}/meta#{self.group_id}"
+            else:
+                opts.raft_meta_uri = f"file://{base}/meta"
             if self.snapshot:
                 opts.snapshot_uri = f"file://{base}/snapshot"
         else:
@@ -205,6 +213,26 @@ class TestCluster:
                     max(0.1, deadline - time.monotonic()))
             except TimeoutError:
                 return st
+
+    @staticmethod
+    async def drain_sends_to(leader, endpoint: str,
+                             timeout_s: float = 5.0) -> None:
+        """Wait until the leader's send plane has no queued or in-flight
+        traffic to `endpoint`.  Used by install-snapshot tests before
+        restarting a crashed follower: a retry pump may legally build an
+        entry-bearing AppendEntries from the not-yet-compacted log
+        DURING the snapshot, and if that frame is still in flight when
+        the follower's new server comes up, the delayed delivery catches
+        the follower up via the log path — valid raft, but it bypasses
+        the InstallSnapshot the test wants to assert on (the r4
+        snapshots_loaded=0 flake)."""
+        sender = leader.node_manager.send_plane.sender(endpoint)
+        deadline = time.monotonic() + timeout_s
+        while (sender.queued() or (sender._task is not None
+                                   and not sender._task.done())):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"send plane to {endpoint} never drained")
+            await asyncio.sleep(0.02)
 
     async def wait_applied(self, count: int, timeout_s: float = 5.0,
                            nodes=None) -> None:

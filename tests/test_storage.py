@@ -223,6 +223,149 @@ class TestFileLogStorage(_BaseLogStorageSuite):
         with pytest.raises(CorruptLogError):
             s2.init()
 
+    def test_truncate_prefix_past_stale_watermark_then_crash(self, tmp_path):
+        """Compaction deleting the persisted-watermark segment must move
+        the watermark BEFORE deleting: a crash right after used to brick
+        the next init() with a false 'watermark segment missing'."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5, size=40))
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # persisted watermark now names the (only) segment
+        s2.append_entries(mk_entries(6, 20, size=40))  # rolls segments
+        s2.truncate_prefix(15)  # compacts the watermark segment away
+        # simulate crash: no shutdown; reopen from disk state
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.first_log_index() == 15
+        assert s3.last_log_index() == 25
+        assert s3.get_entry(20) is not None
+        s3.shutdown()
+
+    def test_unsynced_compaction_crash_does_not_brick(self, tmp_path,
+                                                      monkeypatch):
+        """sync=False run: the frontier never advances past boot, so a
+        compaction past it must CLEAR the watermark, not name a
+        survivor — else a crash mid-delete leaves a never-fsynced
+        below-survivor segment to be scanned as fully-durable, and its
+        legitimately torn tail bricks boot (r5 review finding)."""
+        from tpuraft.storage.log_storage import _Segment
+
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5, size=40))
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # frontier + persisted watermark at seg_1
+        s2.append_entries(mk_entries(6, 20, size=40), sync=False)  # rolls
+        deleted = []
+        orig_delete = _Segment.delete
+
+        def delete_once(seg):
+            if deleted:
+                raise RuntimeError("crash mid-delete")
+            deleted.append(seg)
+            orig_delete(seg)
+
+        monkeypatch.setattr(_Segment, "delete", delete_once)
+        with pytest.raises(RuntimeError):
+            s2.truncate_prefix(15)
+        monkeypatch.setattr(_Segment, "delete", orig_delete)
+        # deterministic crash image: page cache flushed (no fsync), so
+        # every byte except the chopped tail "survived" the crash
+        for seg in s2._segments:
+            seg._f.flush()
+        # the surviving doomed segment was never fsynced: its tail may
+        # legitimately vanish with the crash
+        seg8 = min((tmp_path / "log").glob("seg_*.log"),
+                   key=lambda p: int(p.name[4:-4]))
+        seg8.write_bytes(seg8.read_bytes()[:-10])
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.first_log_index() == 15
+        assert s3.last_log_index() == 25
+        s3.shutdown()
+
+    def test_rotted_garbage_below_first_does_not_brick(self, tmp_path,
+                                                       monkeypatch):
+        """A below-first segment NAMED BY the watermark whose range is
+        provably compacted (a successor starts at first_log_index) must
+        scan tolerantly even with a damaged tail: it is garbage awaiting
+        deletion, not acked data (r5 review finding)."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 14, size=40))  # seg_1 + seg_8
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # persisted watermark names seg_8 (the last segment)
+        s2.append_entries(mk_entries(15, 11, size=40))  # seg_15, seg_22
+        orig = FileLogStorage._save_watermark
+
+        def boom(self_, sync=False):
+            raise RuntimeError("crash mid-truncate")
+
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", boom)
+        with pytest.raises(RuntimeError):
+            s2.truncate_prefix(15)  # meta saved; nothing deleted yet
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", orig)
+        # the doomed watermark segment's tail rots before the next boot
+        seg8 = tmp_path / "log" / "seg_8.log"
+        seg8.write_bytes(seg8.read_bytes()[:-10])
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.first_log_index() == 15
+        assert s3.last_log_index() == 25
+        s3.shutdown()
+
+    def test_truncate_prefix_whole_log_then_reopen(self, tmp_path):
+        """Compacting the ENTIRE log (snapshot covers every entry, no
+        surviving segment, no appends after) must reopen cleanly — the
+        watermark is cleared before the deletes."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5, size=40))
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # persisted watermark names the (only) segment
+        s2.truncate_prefix(6)  # whole log compacted
+        # crash: no shutdown
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.first_log_index() == 6
+        assert s3.last_log_index() == 5
+        s3.append_entries(mk_entries(6, 3, term=2))
+        assert s3.get_term(7) == 2
+        s3.shutdown()
+
+    def test_truncate_prefix_crash_before_watermark_save(self, tmp_path,
+                                                         monkeypatch):
+        """Crash inside truncate_prefix after _save_meta but before the
+        watermark save + deletes: init's stale cleanup removes the
+        watermark segment itself — that provable-compaction case must be
+        forgiven, not reported as acked-entry loss."""
+        s = self.mk(tmp_path)
+        s.init()
+        s.append_entries(mk_entries(1, 5, size=40))
+        s.shutdown()
+        s2 = self.mk(tmp_path)
+        s2.init()  # persisted watermark names seg_1
+        s2.append_entries(mk_entries(6, 20, size=40))  # rolls segments
+        orig = FileLogStorage._save_watermark
+
+        def boom(self_, sync=False):
+            raise RuntimeError("crash mid-truncate")
+
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", boom)
+        with pytest.raises(RuntimeError):
+            s2.truncate_prefix(15)  # meta saved, nothing deleted yet
+        monkeypatch.setattr(FileLogStorage, "_save_watermark", orig)
+        s3 = self.mk(tmp_path)
+        s3.init()  # must not raise CorruptLogError
+        assert s3.first_log_index() == 15
+        assert s3.last_log_index() == 25
+        s3.shutdown()
+
     def test_midlog_corruption_fails_loudly(self, tmp_path):
         """CRC failure with valid entries AFTER it is corruption, not a
         torn tail: truncating there would silently drop acked suffix
@@ -375,6 +518,102 @@ class TestRaftMetaStorage:
         with pytest.raises(IOError):
             m2 = RaftMetaStorage(str(tmp_path))
             m2.init()
+
+
+class TestMultiMetaStorage:
+    """Shared {term, votedFor} journal with group-commit fsync
+    (storage/meta_multilog.py; reference: LocalRaftMetaStorage semantics
+    at multi-raft density — SURVEY.md §3.1 'synced on change')."""
+
+    def test_roundtrip_many_groups(self, tmp_path):
+        from tpuraft.storage.meta_multilog import MultiRaftMetaStorage
+
+        stores = [MultiRaftMetaStorage(str(tmp_path), f"g{i}")
+                  for i in range(8)]
+        for s in stores:
+            s.init()
+        for i, s in enumerate(stores):
+            s.set_term_and_voted_for(i + 1, PeerId.parse(f"1.2.3.4:{80 + i}"))
+        for s in stores:
+            s.shutdown()
+        back = [MultiRaftMetaStorage(str(tmp_path), f"g{i}")
+                for i in range(8)]
+        for i, s in enumerate(back):
+            s.init()
+            assert s.term == i + 1
+            assert s.voted_for == PeerId.parse(f"1.2.3.4:{80 + i}")
+        for s in back:
+            s.shutdown()
+
+    async def test_group_commit_coalesces_fsyncs(self, tmp_path):
+        """N groups persisting concurrently must share fsync rounds —
+        the whole point of the journal (r4 weak #5: durable-meta
+        election herds)."""
+        from tpuraft.storage.meta_multilog import MultiRaftMetaStorage
+
+        G = 64
+        stores = [MultiRaftMetaStorage(str(tmp_path), f"g{i}")
+                  for i in range(G)]
+        for s in stores:
+            s.init()
+        jnl = stores[0]._jnl
+        sync0 = jnl.sync_count
+        await asyncio.gather(*(
+            s.save_async(5, PeerId.parse("1.2.3.4:80")) for s in stores))
+        rounds = jnl.sync_count - sync0
+        assert rounds < G / 4, rounds  # far fewer fsyncs than groups
+        for s in stores:
+            s.shutdown()
+
+    def test_torn_tail_truncated_beyond_watermark(self, tmp_path):
+        from tpuraft.storage.meta_multilog import MetaJournal
+
+        j = MetaJournal(str(tmp_path))
+        j.stage("g1", 3, PeerId.parse("1.2.3.4:80"))
+        j.sync()
+        # watermark still covers only the fsynced prefix recorded at
+        # open; simulate crash AFTER an unsynced stage: chop bytes
+        j.stage("g1", 4, PeerId.parse("1.2.3.4:81"))
+        path = tmp_path / "meta.jnl"
+        j._f.flush()
+        j._f = None  # simulate crash (skip close's sync+watermark)
+        data = path.read_bytes()
+        path.write_bytes(data[:-3])
+        j2 = MetaJournal(str(tmp_path))
+        term, voted = j2.get("g1")
+        assert term == 3  # torn record dropped, synced one survives
+        j2.close()
+
+    def test_corruption_below_watermark_is_loud(self, tmp_path):
+        from tpuraft.storage.log_storage import CorruptLogError
+        from tpuraft.storage.meta_multilog import MetaJournal
+
+        j = MetaJournal(str(tmp_path))
+        j.stage("g1", 3, PeerId.parse("1.2.3.4:80"))
+        j.sync()
+        j.close()  # clean close advances the watermark
+        path = tmp_path / "meta.jnl"
+        data = bytearray(path.read_bytes())
+        data[5] ^= 0xFF
+        path.write_bytes(bytes(data))
+        with pytest.raises(CorruptLogError):
+            MetaJournal(str(tmp_path))
+
+    def test_compaction_keeps_latest_values(self, tmp_path):
+        from tpuraft.storage.meta_multilog import MetaJournal
+
+        j = MetaJournal(str(tmp_path))
+        j.COMPACT_MIN_BYTES = 512  # force compaction early
+        for term in range(1, 200):
+            j.stage("g1", term, PeerId.parse("1.2.3.4:80"))
+            j.stage("g2", term, PeerId.parse("1.2.3.4:81"))
+            j.sync()
+        assert (tmp_path / "meta.jnl").stat().st_size < 4096  # compacted
+        j.close()
+        j2 = MetaJournal(str(tmp_path))
+        assert j2.get("g1") == (199, PeerId.parse("1.2.3.4:80"))
+        assert j2.get("g2") == (199, PeerId.parse("1.2.3.4:81"))
+        j2.close()
 
 
 @pytest.mark.asyncio

@@ -276,8 +276,29 @@ class Node:
         if opts.raft_meta_uri.startswith("file://"):
             self._meta = RaftMetaStorage(opts.raft_meta_uri[len("file://"):],
                                          sync=opts.raft_options.sync_meta)
-        else:
+        elif opts.raft_meta_uri.startswith("multimeta://"):
+            # shared fsynced meta journal: multimeta://<dir>#<group> —
+            # every group of the process joins one group-commit round,
+            # so an election herd's {term, votedFor} persists cost one
+            # fsync, not G (storage/meta_multilog.py)
+            rest = opts.raft_meta_uri[len("multimeta://"):]
+            if "#" not in rest:
+                raise ValueError(
+                    "multimeta:// needs a group fragment: "
+                    "multimeta://<dir>#<group>")
+            mdir, mgroup = rest.rsplit("#", 1)
+            from tpuraft.storage.meta_multilog import MultiRaftMetaStorage
+
+            self._meta = MultiRaftMetaStorage(mdir, mgroup)
+        elif opts.raft_meta_uri in ("", "memory://"):
             self._meta = MemoryRaftMetaStorage()
+        else:
+            # NO silent fallthrough to volatile meta: a typo'd scheme
+            # silently dropping {term, votedFor} durability is a
+            # double-vote hazard, not a default
+            raise ValueError(
+                f"unknown raft_meta_uri scheme: {opts.raft_meta_uri!r} "
+                "(expected file://, multimeta://, memory:// or empty)")
         self._meta.init()
         self.current_term = self._meta.term
         self.voted_for = self._meta.voted_for
@@ -664,6 +685,12 @@ class Node:
         thread round-trips."""
         if getattr(self._meta, "SYNC_CHEAP", False):
             self._meta.set_term_and_voted_for(term, voted_for)
+            return
+        save_async = getattr(self._meta, "save_async", None)
+        if save_async is not None:
+            # shared meta journal: stage inline, join the engine-wide
+            # group-commit — concurrent groups' meta fsyncs coalesce
+            await save_async(term, voted_for)
             return
         await asyncio.get_running_loop().run_in_executor(
             None, self._meta.set_term_and_voted_for, term, voted_for)

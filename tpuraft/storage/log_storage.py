@@ -307,6 +307,19 @@ class FileLogStorage(LogStorage):
             (n for n in os.listdir(self._dir) if n.startswith("seg_") and n.endswith(".log")),
             key=lambda n: int(n[4:-4]),
         )
+        on_disk_firsts = [int(n[4:-4]) for n in names]
+        # Segments provably compacted garbage from names alone: a
+        # below-first segment ends strictly before the next on-disk
+        # segment's first index, so ANY on-disk successor starting in
+        # (its_first, first_log_index] proves its whole range is below
+        # first_log_index — leftovers of a crash mid truncate_prefix.
+        # Scan those tolerantly (durable_end=0): demanding durable-
+        # region cleanliness of about-to-be-deleted garbage would brick
+        # boot on a torn (or rotted) tail that never mattered.
+        stale_certain = {
+            fi for fi in on_disk_firsts if fi < self._first and any(
+                fi < nx <= self._first for nx in on_disk_firsts)}
+        wm_seg_verified_stale = False
         drop_rest = False
         for n in names:
             first_index = int(n[4:-4])
@@ -314,7 +327,9 @@ class FileLogStorage(LogStorage):
             # below the watermark segment were complete when the
             # watermark was recorded; the watermark segment is durable
             # up to the recorded size; later segments not at all
-            if first_index < wm_first:
+            if first_index in stale_certain:
+                durable_end = 0
+            elif first_index < wm_first:
                 durable_end = _DURABLE_ALL
             elif first_index == wm_first:
                 durable_end = wm_size
@@ -328,6 +343,12 @@ class FileLogStorage(LogStorage):
                 not seg.offsets or seg.last_index < self._first
             )
             if drop_rest or stale:
+                if stale and first_index == wm_first \
+                        and first_index not in stale_certain:
+                    # the watermark segment scanned clean at its recorded
+                    # durable size and is entirely below first_log_index:
+                    # provably compacted, nothing acked lost
+                    wm_seg_verified_stale = True
                 seg.delete()
                 continue
             if not seg.offsets or (
@@ -352,13 +373,25 @@ class FileLogStorage(LogStorage):
             self._segments.append(seg)
         if wm_size > 0 and not any(s.first_index == wm_first
                                    for s in self._segments):
-            # the watermark segment itself vanished with recorded bytes
-            # in it — destructive ops floor the watermark (fsynced)
-            # before deleting, so this can only be external loss
-            raise CorruptLogError(
-                f"{self._dir}: watermark segment seg_{wm_first}.log "
-                f"({wm_size} durable bytes) is missing — acked entries "
-                f"lost")
+            # The watermark segment itself vanished with recorded bytes
+            # in it.  One legitimate cause: prefix compaction deleted it
+            # (truncate_prefix only removes segments ENTIRELY below
+            # first_log_index, and a crash between _save_meta and the
+            # segment deletes leaves the same state via init's stale
+            # cleanup above).  That case is provable from what WAS on
+            # disk at boot: some segment started in (wm_first, _first],
+            # so the watermark segment ended strictly below _first —
+            # every index it held is compacted, nothing acked is lost.
+            # Anything else (segment straddling _first gone, or no
+            # bounding successor) is external loss: fail loudly.
+            compacted = wm_seg_verified_stale or (
+                wm_first < self._first and any(
+                    wm_first < fi <= self._first for fi in on_disk_firsts))
+            if not compacted:
+                raise CorruptLogError(
+                    f"{self._dir}: watermark segment seg_{wm_first}.log "
+                    f"({wm_size} durable bytes) is missing — acked entries "
+                    f"lost")
         self._load_conf_indexes()
         # Bytes at/above the loaded watermark are readable but possibly
         # still dirty in the page cache (crash-restart case): fsync them
@@ -580,6 +613,30 @@ class FileLogStorage(LogStorage):
             return
         self._first = first_index_kept
         self._save_meta()
+        if any(s.last_index < first_index_kept for s in self._segments):
+            # The persisted watermark is only rewritten at init/shutdown/
+            # destructive ops, so it can still name a segment this
+            # compaction is about to delete (arbitrarily stale-low).
+            # Persist the LIVE frontier — fsynced — BEFORE deleting:
+            # otherwise a crash after the deletes leaves a watermark
+            # pointing at a vanished segment, and the next init() raises
+            # a false "watermark segment missing / acked entries lost"
+            # on a perfectly healthy replica.  If the frontier segment
+            # ITSELF sits inside the doomed range, CLEAR the watermark:
+            # everything provably durable is being deleted, surviving
+            # segments carry no durable claims (the frontier never
+            # reached them — e.g. a sync=False run), so (-1, 0) loses
+            # nothing — while naming any survivor would claim the
+            # never-fsynced segments below it fully durable, and a crash
+            # mid-delete would leave one to fail the _DURABLE_ALL scan
+            # (the stale-HIGH false brick this function exists to avoid).
+            survivor = next((s for s in self._segments
+                             if s.last_index >= first_index_kept), None)
+            if self._synced != (-1, 0) and (
+                    survivor is None
+                    or self._synced[0] < survivor.first_index):
+                self._synced = (-1, 0)
+            self._save_watermark(sync=True)
         # background-safe: delete whole segments strictly below the kept index
         while self._segments and self._segments[0].last_index < first_index_kept:
             self._segments.pop(0).delete()
