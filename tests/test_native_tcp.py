@@ -236,6 +236,14 @@ class TestSnapshotInstallOverNativeTransport:
             st = await leader.snapshot()
             assert st.is_ok(), str(st)
             assert leader.log_manager.first_log_index() > 1
+            # Drain in-flight sends to the victim BEFORE restarting it
+            # (the r4 "snapshots_loaded 0" flake, root-caused by
+            # submit/restart trace: an entry-bearing frame built from
+            # the not-yet-compacted log during the snapshot was
+            # delivered to the RESTARTED server 9ms later, catching the
+            # victim up via the log path — see drain_sends_to)
+            from tests.cluster import TestCluster
+            await TestCluster.drain_sends_to(leader, victim.endpoint)
             await c.restart(victim)
             await c.wait_applied(15, timeout_s=15)
             assert c.fsms[victim].logs == [b"s%d" % i for i in range(15)]

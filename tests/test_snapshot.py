@@ -116,6 +116,9 @@ async def test_install_snapshot_to_lagging_follower(tmp_path):
     assert (leader.log_manager.first_log_index()
             == leader.fsm_caller.last_applied_index + 1)
     # follower comes back: too far behind the compacted log -> InstallSnapshot
+    # (drain first: a pre-compaction entry frame still in flight would
+    # legally catch the victim up via the log path — the r4 flake)
+    await c.drain_sends_to(leader, victim.endpoint)
     await c.start(victim)
     await c.wait_applied(15, timeout_s=10)
     assert c.fsms[victim].logs == [b"s%d" % i for i in range(15)]
@@ -316,6 +319,7 @@ async def test_install_recovers_from_stale_partial_temp(tmp_path):
         await c.apply_ok(leader, b"t%d" % i)
     st = await leader.snapshot()
     assert st.is_ok(), str(st)
+    await c.drain_sends_to(leader, victim.endpoint)  # r4 flake guard
     await c.start(victim)
     await c.wait_applied(15, timeout_s=10)
     assert c.fsms[victim].logs == [b"t%d" % i for i in range(15)]
@@ -465,6 +469,7 @@ async def test_install_snapshot_on_multilog_scheme(tmp_path):
         # snapshot + compact: the victim's catch-up point is gone
         st = await leader.snapshot()
         assert st.is_ok(), st
+        await c.drain_sends_to(leader, victim.endpoint)  # r4 flake guard
         node = await c.start(victim)
         # generous: re-init + snapshot transfer + FSM load on a loaded host
         await c.wait_applied(25, timeout_s=10)
