@@ -324,6 +324,13 @@ async def run_store(args) -> None:
             s = sorted(xs)
             return round(s[min(len(s) - 1, int(len(s) * q))], 3)
 
+        p99 = {k: pct(v, 0.99) for k, v in sorted(stages.items())}
+        # name the tail's dominant *start-latency* stage from the data:
+        # tick_s = commit-advancing tick scheduled late (loop
+        # contention), rpc_s = batch RPC dispatch, flush_s = fsync start
+        starts = {k: p99[k] for k in ("tick_s", "rpc_s", "flush_s")
+                  if p99.get(k) is not None}
+        dom = max(starts, key=starts.get) if starts else None
         return {
             "n": len(total),
             "note": "relative ms marks across ops; rpc includes "
@@ -331,7 +338,11 @@ async def run_store(args) -> None:
                     "quorum commit advanced on the engine; tick = the "
                     "advancing tick's span",
             "stage_p50_ms": {k: pct(v, 0.5) for k, v in sorted(stages.items())},
-            "stage_p99_ms": {k: pct(v, 0.99) for k, v in sorted(stages.items())},
+            "stage_p99_ms": p99,
+            "tail_attribution": (
+                f"ack p99 {p99.get('ack')}ms: dominant start-latency "
+                f"stage at p99 is {dom} ({starts.get(dom)}ms) of "
+                + ", ".join(f"{k}={v}ms" for k, v in starts.items())),
         }
 
     while True:

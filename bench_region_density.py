@@ -75,6 +75,8 @@ async def run_config(args) -> dict:
     engines, stores = [], []
     cap = 1 << max(4, (R + 3).bit_length())
     for i, ep in enumerate(endpoints):
+        # the native kv engine's open mkdirs one level only
+        os.makedirs(f"{args.dir}/store{i}", exist_ok=True)
         server = RpcServer(ep)
         net.bind(server)
         transport = InProcTransport(net, ep)
