@@ -166,14 +166,23 @@ class HeartbeatHub:
                 continue
             if (r.peer_multi_hb and r._matched
                     and self._fast_ok.get(r.peer.endpoint, True)):
-                beat = CompactBeat(
-                    group_id=node.group_id,
-                    server_id=str(node.server_id),
-                    peer_id=str(r.peer),
-                    term=node.current_term,
-                    committed_index=min(
-                        node.ballot_box.last_committed_index,
-                        r.match_index))
+                committed = min(node.ballot_box.last_committed_index,
+                                r.match_index)
+                # idle-burn dominator at region density: reuse the beat
+                # object while (term, committed) are unchanged — the
+                # steady state — instead of rebuilding it every pulse
+                cached = getattr(r, "_fast_beat_cache", None)
+                if (cached is not None and cached.term == node.current_term
+                        and cached.committed_index == committed):
+                    beat = cached
+                else:
+                    beat = CompactBeat(
+                        group_id=node.group_id,
+                        server_id=str(node.server_id),
+                        peer_id=str(r.peer),
+                        term=node.current_term,
+                        committed_index=committed)
+                    r._fast_beat_cache = beat
                 by_dst_fast.setdefault(r.peer.endpoint, []).append((r, beat))
                 continue
             classic.append(r)

@@ -15,6 +15,7 @@ device plane (tpuraft.ops) works in *base-relative* int32 space.
 from __future__ import annotations
 
 import enum
+import functools
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -57,17 +58,26 @@ class PeerId:
     def is_empty(self) -> bool:
         return self.ip == "0.0.0.0" and self.port == 0 and self.idx == 0
 
-    @property
+    # endpoint and str() are on the per-beat/per-request hot paths
+    # (every heartbeat builds both); cache the formatted strings on the
+    # frozen instance (cached_property writes __dict__ directly, which
+    # bypasses the frozen __setattr__) — eq/hash/order use declared
+    # fields only, so the memo never affects identity
+    @functools.cached_property
     def endpoint(self) -> str:
         return f"{self.ip}:{self.port}"
 
-    def __str__(self) -> str:
+    @functools.cached_property
+    def _str(self) -> str:
         s = f"{self.ip}:{self.port}"
         if self.priority != ElectionPriority.DISABLED:
             return f"{s}:{self.idx}:{self.priority}"
         if self.idx != 0:
             return f"{s}:{self.idx}"
         return s
+
+    def __str__(self) -> str:
+        return self._str
 
 
 EMPTY_PEER = PeerId()
