@@ -171,6 +171,16 @@ async def run_store(args) -> None:
                 import random
                 await asyncio.sleep(random.random() * args.pace_ms / 1e3)
             while time.monotonic() < stop_at:
+                if not node.is_leader():
+                    # leadership moved (possibly to another store
+                    # process, whose own driver for this group takes
+                    # over): idle instead of spraying not-leader
+                    # rejections at the stale node — the RouteTable-
+                    # client analog, ladder edition
+                    await asyncio.sleep(
+                        max(args.pace_ms / 1e3, 0.05) if args.pace_ms
+                        else 0.05)
+                    continue
                 await sem.acquire()
                 if args.pace_ms:
                     await asyncio.sleep(args.pace_ms / 1e3)
@@ -195,7 +205,11 @@ async def run_store(args) -> None:
                     break
 
         t_start = time.monotonic()
-        await asyncio.gather(*(drive(n) for n in led))
+        # drive EVERY local node, gated on live leadership (not the
+        # boot-time led list): a group whose leadership migrates to
+        # this store mid-window gets driven here, and the stale node
+        # stops being sprayed with not-leader applies
+        await asyncio.gather(*(drive(n) for n in nodes))
         elapsed = time.monotonic() - t_start
         lats.sort()
         import resource
