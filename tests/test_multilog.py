@@ -254,6 +254,41 @@ def test_torn_tail_recovery(tmp_path):
         s.shutdown()
 
 
+def test_torn_registry_tail_recovery(tmp_path):
+    """The group registry is append-only (r5: per-registration rewrites
+    made 16K boot O(G^2)); a torn registration append must be dropped
+    at reopen while every completed registration survives, and new
+    registrations must extend the cleaned stream."""
+    stores = [mk_storage(tmp_path, f"g{i}") for i in range(8)]
+    for s in stores:
+        s.init()
+        s.append_entries(mk_entries(1, 2, size=40))
+    for s in stores:
+        s.shutdown()
+    reg = tmp_path / "mlog" / "groups"
+    reg.write_bytes(reg.read_bytes() + b"\x05\x00\x00\x00")  # torn append
+    back = [mk_storage(tmp_path, f"g{i}") for i in range(8)]
+    for s in back:
+        s.init()
+    try:
+        for s in back:
+            assert s.last_log_index() == 2
+            assert s.get_entry(1) is not None
+        extra = mk_storage(tmp_path, "g-new")
+        extra.init()
+        extra.append_entries(mk_entries(1, 1, size=40))
+        assert extra.last_log_index() == 1
+        extra.shutdown()
+    finally:
+        for s in back:
+            s.shutdown()
+    # and the new registration is durable across another reopen
+    again = mk_storage(tmp_path, "g-new")
+    again.init()
+    assert again.last_log_index() == 1
+    again.shutdown()
+
+
 def test_corrupt_record_drops_tail(tmp_path):
     """A flipped byte mid-journal: recovery keeps the clean prefix, the
     engine reopens (no exception, no half-read groups)."""

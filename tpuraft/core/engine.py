@@ -889,7 +889,10 @@ class MultiRaftEngine:
 
         el = vote_ok(vm)
         in_joint = ovm.any(axis=1)
-        elected_q = np.where(in_joint, el & vote_ok(ovm), el)
+        if in_joint.any():
+            elected_q = np.where(in_joint, el & vote_ok(ovm), el)
+        else:
+            elected_q = el  # steady state: no joint-config vote count
         # joint consensus: the lease needs BOTH configs responsive
         # (NodeImpl#checkDeadNodes walks conf and oldConf)
         ack64 = np.clip(self.last_ack, _NEG_I32, None).astype(np.int64)
@@ -1019,8 +1022,14 @@ def _np_joint_order_stat(values: np.ndarray, vm: np.ndarray,
     largest where a row is in joint mode — the shared shape of
     ballot.joint_quorum_match_index AND joint_quorum_ack_time."""
     new_q = _np_order_stat(values, vm)
+    joint = ovm.any(axis=1)
+    if not joint.any():
+        # no group is mid membership-change (the steady state): skip
+        # the old-config order statistic entirely — it is half the
+        # tick's sort work (profiled: 4 sorts/tick -> 2)
+        return new_q
     old_q = _np_order_stat(values, ovm)
-    return np.where(ovm.any(axis=1), np.minimum(new_q, old_q), new_q)
+    return np.where(joint, np.minimum(new_q, old_q), new_q)
 
 
 def _np_joint_quorum(rel: np.ndarray, vm: np.ndarray, ovm: np.ndarray
