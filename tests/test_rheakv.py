@@ -430,3 +430,36 @@ async def test_split_on_full_engine_grows_plane():
         assert await leader.raft_store.get(b"gk00") == b"v0"
         assert await l2.raft_store.put(b"zz-new", b"after-grow")
         assert await l2.raft_store.get(b"zz-new") == b"after-grow"
+
+
+def test_legacy_region_meta_migrates_to_shared_journal(tmp_path):
+    """Upgrade path for multilog-scheme stores (r5): per-region file://
+    {term, votedFor} seeds the shared multimeta:// journal ONCE, so a
+    restarted store can never fall back to term 0 and double-vote; a
+    replayed migration with an older legacy term is a no-op."""
+    from tpuraft.entity import PeerId
+    from tpuraft.rheakv.store_engine import StoreEngine
+    from tpuraft.storage.meta_multilog import MultiRaftMetaStorage
+    from tpuraft.storage.meta_storage import RaftMetaStorage
+
+    store_base = f"{tmp_path}/s1"
+    base = f"{store_base}/r7"
+    old = RaftMetaStorage(f"{base}/meta")
+    old.init()
+    old.set_term_and_voted_for(9, PeerId.parse("1.2.3.4:80"))
+    StoreEngine._migrate_legacy_meta(store_base, base, 7)
+    m = MultiRaftMetaStorage(f"{store_base}/meta", "r7")
+    m.init()
+    assert m.term == 9
+    assert m.voted_for == PeerId.parse("1.2.3.4:80")
+    m.shutdown()
+    assert not (tmp_path / "s1/r7/meta/raft_meta").exists()  # renamed
+    # a resurrected legacy file with an OLDER term must not regress
+    old2 = RaftMetaStorage(f"{base}/meta")
+    old2.init()
+    old2.set_term_and_voted_for(3, PeerId.parse("1.2.3.4:80"))
+    StoreEngine._migrate_legacy_meta(store_base, base, 7)
+    m2 = MultiRaftMetaStorage(f"{store_base}/meta", "r7")
+    m2.init()
+    assert m2.term == 9
+    m2.shutdown()

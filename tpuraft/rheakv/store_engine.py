@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -90,6 +91,7 @@ class StoreEngine:
         self._started = False
         self._pending_splits: set[int] = set()
         self._heartbeat_task: Optional[asyncio.Task] = None
+        self._meta_journal = None  # store-lifetime ref (multilog scheme)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -132,6 +134,11 @@ class StoreEngine:
         close = getattr(self.raw_store, "close", None)
         if close is not None:
             close()  # native engine: flush + release the WAL fd
+        if self._meta_journal is not None:
+            from tpuraft.storage.meta_multilog import _release_journal
+
+            _release_journal(self._meta_journal)
+            self._meta_journal = None
 
     # -- PD heartbeats -------------------------------------------------------
 
@@ -210,11 +217,25 @@ class StoreEngine:
             base = f"{store_base}/r{region.id}"
             if self.opts.log_scheme == "multilog":
                 # one shared journal engine for every region of this
-                # store: cross-region group-commit fsync
+                # store: cross-region group-commit fsync — and the SAME
+                # treatment for {term, votedFor}: per-region file://
+                # meta would pay one fsync per region per election,
+                # which is the serial-fsync herd the shared meta
+                # journal exists to absorb (storage/meta_multilog.py)
                 opts.log_uri = f"multilog://{store_base}/mlog#r{region.id}"
+                opts.raft_meta_uri = \
+                    f"multimeta://{store_base}/meta#r{region.id}"
+                if self._meta_journal is None:
+                    # store-lifetime ref: per-region opens (migration
+                    # below, node init) become refcount bumps instead
+                    # of journal reopen+fsync cycles on the loop
+                    from tpuraft.storage.meta_multilog import get_journal
+
+                    self._meta_journal = get_journal(f"{store_base}/meta")
+                self._migrate_legacy_meta(store_base, base, region.id)
             else:
                 opts.log_uri = f"{self.opts.log_scheme}://{base}/log"
-            opts.raft_meta_uri = f"file://{base}/meta"
+                opts.raft_meta_uri = f"file://{base}/meta"
             opts.snapshot_uri = f"file://{base}/snapshot"
         else:
             opts.log_uri = "memory://"
@@ -222,6 +243,31 @@ class StoreEngine:
         opts.snapshot = SnapshotOptions(
             interval_secs=self.opts.snapshot_interval_secs)
         return opts
+
+    @staticmethod
+    def _migrate_legacy_meta(store_base: str, base: str, rid: int) -> None:
+        """One-time upgrade: multilog-scheme stores used to keep
+        per-region ``file://`` meta; seed the shared meta journal from
+        it so a restarted store can never fall back to term 0 and vote
+        twice in a term it already voted in.  The legacy file is
+        renamed after seeding (the term guard makes a replayed
+        migration a no-op regardless)."""
+        legacy = os.path.join(base, "meta", "raft_meta")
+        if not os.path.exists(legacy):
+            return
+        from tpuraft.storage.meta_multilog import MultiRaftMetaStorage
+        from tpuraft.storage.meta_storage import RaftMetaStorage
+
+        old = RaftMetaStorage(os.path.join(base, "meta"))
+        old.init()
+        new = MultiRaftMetaStorage(f"{store_base}/meta", f"r{rid}")
+        new.init()
+        try:
+            if old.term > new.term:
+                new.set_term_and_voted_for(old.term, old.voted_for)
+        finally:
+            new.shutdown()
+        os.replace(legacy, legacy + ".migrated")
 
     def ballot_box_factory(self):
         if self.multi_raft_engine is None:
