@@ -12,9 +12,13 @@ a consensus store can get.
         --data /tmp/soak --verbose
 
 Faults: rolling store kill/restart, one-way partitions, packet
-drops+delays.  Durable state dirs are required implicitly — a voter
-restarted without its disk is amnesiac, which Raft does not tolerate
-(the divergence detector would fail it loudly).
+drops+delays — and, with ``--power-loss``, storage-plane crashes: a
+store is killed at a random instant and restarted from its
+durable-only on-disk image, with torn writes / lost fsyncs / bit flips
+injected into the unsynced tails (tpuraft/storage/fault.py).  Durable
+state dirs are required implicitly — a voter restarted without its
+disk is amnesiac, which Raft does not tolerate (the divergence
+detector would fail it loudly).
 """
 
 from __future__ import annotations
@@ -39,6 +43,8 @@ class _BaseSoakCluster:
     layout, option plumbing, and leader lookup."""
 
     read_only_option = None   # set by run_soak for lease-read mode
+    snapshot_interval_secs = 0  # set by run_soak (power-loss soaks
+    #                             snapshot so compaction runs under crashes)
 
     def __init__(self, data_path: str):
         self.data_path = data_path
@@ -53,6 +59,7 @@ class _BaseSoakCluster:
             initial_regions=[r.copy() for r in self.regions],
             data_path=self.data_path,
             election_timeout_ms=election_timeout_ms,
+            snapshot_interval_secs=self.snapshot_interval_secs,
             **extra)
         if self.read_only_option is not None:
             opts.read_only_option = self.read_only_option
@@ -246,8 +253,16 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    lease_reads: bool = False,
                    n_regions: int = 1,
                    engine: bool = False,
-                   election_timeout_ms: int = 400) -> dict:
+                   election_timeout_ms: int = 400,
+                   power_loss: bool = False) -> dict:
     rng = random.Random(seed)
+    if power_loss and (transport != "inproc" or engine):
+        raise ValueError(
+            "--power-loss interposes on the Python storage planes "
+            "(per-region file:// log/meta/snapshot), so it runs on the "
+            "in-proc fabric without --engine; the native multilog's "
+            "fd-level I/O is crash-imaged by the dedicated harness "
+            "(tests/test_storage_fault.py) instead")
     if transport == "native":
         if n_regions > 1 or engine:
             raise ValueError("region-density soak runs on the in-proc "
@@ -257,6 +272,34 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         c = SoakCluster(n_stores, data_path, n_regions=n_regions,
                         engine=engine,
                         election_timeout_ms=election_timeout_ms)
+    chaos = {}
+    try:
+        if power_loss:
+            import os as _os
+
+            from tpuraft.storage.fault import ChaosDir
+
+            # snapshots on: prefix compaction + snapshot commit must
+            # run UNDER the crash schedule, not just appends
+            c.snapshot_interval_secs = 10
+            for ep in c.endpoints:
+                ip, port = ep.rsplit(":", 1)
+                chaos[ep] = ChaosDir(
+                    _os.path.join(data_path, f"{ip}_{port}")).install()
+        return await _run_soak_inner(
+            duration_s, n_keys, verbose, transport, dump_history,
+            lease_reads, n_regions, rng, c, chaos)
+    finally:
+        # uninstall on EVERY exit path, startup failures included: a
+        # leaked install leaves builtins.open/os.fsync patched process-
+        # wide, turning later fsyncs under the roots into silent no-ops
+        for cd in chaos.values():
+            cd.uninstall()
+
+
+async def _run_soak_inner(duration_s, n_keys, verbose, transport,
+                          dump_history, lease_reads, n_regions, rng, c,
+                          chaos) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -337,12 +380,48 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
     async def noise_off():
         c.set_noise(0.0, 0)
 
+    # power loss: capture the durable-only on-disk image at the crash
+    # instant (torn/lost/bit-flipped unsynced tails included), shut the
+    # store down, discard everything the shutdown wrote by materializing
+    # the captured image, and restart FROM that image — the recovery
+    # path must come back clean or the check aborts the drive
+    power_lost: list[str] = []
+    dead_after_power_loss: list[str] = []
+
+    async def power_loss_kill():
+        up = [ep for ep in c.endpoints if ep in c.stores]
+        if not up:
+            raise SkipFault
+        ep = rng.choice(up)
+        plan = chaos[ep].capture_crash(rng)   # the instant power dies
+        power_lost.append(ep)
+        await c.stop_store(ep)
+        chaos[ep].apply_crash(plan)
+
+    async def power_loss_restart():
+        while power_lost:
+            ep = power_lost.pop()
+            try:
+                await c.start_store(ep)
+            except Exception:
+                dead_after_power_loss.append(ep)
+                raise
+
+    async def power_loss_ok():
+        assert not dead_after_power_loss, \
+            f"stores failed power-loss recovery: {dead_after_power_loss}"
+
     actions = [
         NemesisAction("leader-kill", kill_leader, restart_killed,
                       dwell_s=0.7, weight=1.5),
         NemesisAction("one-way-partition", one_way, heal_net, dwell_s=0.5),
         NemesisAction("drops+delays", noise_on, noise_off, dwell_s=0.8),
     ]
+    if chaos:
+        actions.append(
+            NemesisAction("power-loss", power_loss_kill,
+                          power_loss_restart, dwell_s=0.6, weight=1.5,
+                          check=power_loss_ok))
 
     workers = [asyncio.ensure_future(worker(i)) for i in range(5)]
     try:
@@ -365,6 +444,14 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             "faults": {a.name: a.applied for a in actions},
             "checker_s": round(check_s, 1),
         }
+        if chaos:
+            injected: dict[str, int] = {}
+            for cd in chaos.values():
+                for k, v in cd.injected.items():
+                    injected[k] = injected.get(k, 0) + v
+            result["power_loss_crashes"] = sum(
+                cd.crash_count for cd in chaos.values())
+            result["storage_injections"] = injected
         if not rep.ok:
             result["violation"] = str(rep)
         if dump_history and not rep.ok:
@@ -394,6 +481,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         ct = getattr(c, "_client_t", None)
         if ct is not None and hasattr(ct, "close"):
             await ct.close()
+        # chaos uninstall happens in run_soak's outer finally (it must
+        # cover startup failures before this block exists too)
 
 
 def main() -> None:
@@ -425,6 +514,12 @@ def main() -> None:
                          "journal per store (required reading at "
                          "region density)")
     ap.add_argument("--election-timeout-ms", type=int, default=400)
+    ap.add_argument("--power-loss", action="store_true",
+                    help="add power-loss crashes to the nemesis menu: "
+                         "a store is killed at a random instant and "
+                         "restarted from its durable-only on-disk image "
+                         "(torn writes / lost fsyncs / bit flips in the "
+                         "unsynced tails; tpuraft/storage/fault.py)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -435,7 +530,8 @@ def main() -> None:
                                   lease_reads=args.lease_reads,
                                   n_regions=args.regions,
                                   engine=args.engine,
-                                  election_timeout_ms=args.election_timeout_ms))
+                                  election_timeout_ms=args.election_timeout_ms,
+                                  power_loss=args.power_loss))
     import json
 
     print(json.dumps(result))

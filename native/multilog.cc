@@ -195,15 +195,26 @@ struct tlm_handle {
     return true;
   }
 
-  void load_groups() {
+  // Returns false when the registry cannot be READ (open failure, or a
+  // short/failed read of an existing file).  The caller must treat that
+  // as fatal for the whole open: the journal scan's unregistered-gid
+  // guard depends on a complete registry — scanning with a partial one
+  // would misread every group's acked records as orphan garbage and
+  // truncate the journals to nothing.
+  bool load_groups() {
     reg_fd = ::open((dir + "/groups").c_str(),
                     O_RDWR | O_CREAT | O_CLOEXEC, 0644);
-    if (reg_fd < 0) return;
+    if (reg_fd < 0) return false;
     fsync_dir(dir);  // the one-time file creation
     struct stat st {};
     size_t good = 0;
     bool read_ok = false;
-    if (::fstat(reg_fd, &st) == 0 && st.st_size > 0) {
+    // fstat failure must NOT read as "fresh empty registry" (st is
+    // zero-initialized): an empty groups map + populated journals would
+    // send every record into the unregistered-gid tear below.  The
+    // caller fails the open and closes reg_fd.
+    if (::fstat(reg_fd, &st) != 0) return false;
+    if (st.st_size > 0) {
       std::vector<uint8_t> buf((size_t)st.st_size);
       size_t got = 0;
       while (got < buf.size()) {
@@ -216,10 +227,33 @@ struct tlm_handle {
       if (got == buf.size()) {
         read_ok = true;
         size_t off = 0;
+        uint32_t expect = 1;
         while (off + 8 <= buf.size()) {
           uint32_t gid = load_u32(buf.data() + off);
           uint32_t nl = load_u32(buf.data() + off + 4);
           if (off + 8 + nl > buf.size()) break;  // torn append
+          // Registry records carry no per-record CRC, but gids are
+          // allocated monotonically under mu, so records MUST carry
+          // strictly increasing gids.  A violation is unsynced-tail
+          // garbage (partial-page writeback can flip bits there):
+          // without this check a flipped gid byte could ALIAS an acked
+          // gid and shadow that group's log.  Treat it as a torn tail.
+          // Strictly INCREASING — not gap-free — because registries
+          // written before register_group rolled next_gid back on a
+          // failed append can legally hold gaps in their durable
+          // region; demanding exact sequence would truncate those
+          // acked registrations on upgrade.  (A flipped NAME byte in
+          // the tail stays undetected — it only garbles an unacked
+          // group's name, never aliases a gid; a flipped-HIGH gid
+          // registers a garbage gid whose real records then hit the
+          // journal scan's unregistered-gid tear.)  Known residual:
+          // records carry no per-record CRC, so rot in the FSYNCED
+          // region is indistinguishable from tail garbage and gets
+          // truncated rather than failing loudly — strictly safer than
+          // the silent gid aliasing the unguarded parse allowed, but a
+          // future registry format bump should add per-record CRCs.
+          if (gid < expect) break;
+          expect = gid + 1;
           off += 8;
           std::string name((const char*)buf.data() + off, nl);
           off += nl;
@@ -238,6 +272,7 @@ struct tlm_handle {
     if (read_ok && good < (size_t)st.st_size)
       (void)!::ftruncate(reg_fd, (off_t)good);
     ::lseek(reg_fd, (off_t)(read_ok ? good : st.st_size), SEEK_SET);
+    return read_ok;
   }
 
   // -- record application (shared by recovery scan and live appends) --------
@@ -285,7 +320,7 @@ struct tlm_handle {
   // append validation); the recovery scan treats false as corruption.
   bool apply_record(uint32_t gid, uint8_t rectype, const uint8_t* payload,
                     size_t plen, Loc loc, std::string* err) {
-    GroupLog& g = groups[gid];  // scan may see gids before registry load
+    GroupLog& g = groups[gid];  // callers verified gid is registered
     switch (rectype) {
       case kRecEntry: {
         if (plen < kEntryHdr || payload[0] != kEntryMagic) {
@@ -473,16 +508,38 @@ tlm_handle* tlm_open(const char* dir_path, int64_t seg_max_bytes,
   auto h = std::make_unique<tlm_handle>();
   h->dir = dir_path;
   if (seg_max_bytes > 0) h->seg_max = seg_max_bytes;
+  // every error return below must release what was opened so far:
+  // open failures are RETRYABLE (transient EIO), and a caller looping
+  // on retries must not leak fds per attempt until EMFILE
+  auto fail_close = [&]() {
+    for (auto& f : h->files)
+      if (f->fd >= 0) ::close(f->fd);
+    h->files.clear();
+    if (h->reg_fd >= 0) {
+      ::close(h->reg_fd);
+      h->reg_fd = -1;
+    }
+  };
   if (::mkdir(dir_path, 0755) != 0 && errno != EEXIST) {
     set_err(std::string("mkdir failed: ") + strerror(errno));
     return nullptr;
   }
-  h->load_groups();
+  if (!h->load_groups()) {
+    // FAIL the open rather than scan with a partial registry: the
+    // unregistered-gid guard below would read every group's acked
+    // records as orphan garbage and truncate the journals to nothing —
+    // a transient registry EIO must surface as a retryable open error,
+    // never as data destruction.
+    fail_close();
+    set_err("groups registry unreadable");
+    return nullptr;
+  }
 
   std::vector<std::pair<uint32_t, std::string>> names;
   DIR* d = ::opendir(dir_path);
   if (!d) {
     set_err(std::string("opendir failed: ") + strerror(errno));
+    fail_close();
     return nullptr;
   }
   while (struct dirent* ent = ::readdir(d)) {
@@ -509,12 +566,16 @@ tlm_handle* tlm_open(const char* dir_path, int64_t seg_max_bytes,
     struct stat st;
     if (::fstat(f->fd, &st) != 0) {
       set_err("fstat failed");
+      ::close(f->fd);  // not yet in h->files: fail_close won't see it
+      fail_close();
       return nullptr;
     }
     std::vector<uint8_t> buf((size_t)st.st_size);
     if (st.st_size > 0 &&
         ::pread(f->fd, buf.data(), buf.size(), 0) != (ssize_t)buf.size()) {
       set_err("journal read failed");
+      ::close(f->fd);
+      fail_close();
       return nullptr;
     }
     // the file must be registered before records apply (live counts)
@@ -530,6 +591,16 @@ tlm_handle* tlm_open(const char* dir_path, int64_t seg_max_bytes,
       if ((uint32_t)c != crc) break;  // torn/corrupt
       uint32_t gid = load_u32(buf.data() + off + 8);
       uint8_t rectype = buf[(size_t)off + 12];
+      // Power-loss orphan guard: a record whose gid has no registry
+      // entry can only be an unsynced tail — every sync round fsyncs
+      // the registry BEFORE the journal, so any DURABLY ACKED journal
+      // byte at or past this offset would imply the registry entry is
+      // durable too.  Adopting the record instead would let a future
+      // re-register reassign the gid and shadow this data (and a
+      // contiguity clash between orphan and adopted entries could tear
+      // the scan mid-journal, dropping later groups' acked records).
+      if (h->groups.find(gid) == h->groups.end())
+        break;  // unregistered gid -> unacked tail: truncate here
       std::string aerr;
       if (!h->apply_record(gid, rectype, buf.data() + off + 13, len - 9,
                            Loc{seq, (uint32_t)off}, &aerr))
@@ -542,6 +613,7 @@ tlm_handle* tlm_open(const char* dir_path, int64_t seg_max_bytes,
       // unreachable (they were created after this tail was written)
       if (::ftruncate(fp->fd, good_end) != 0) {
         set_err("torn-tail truncate failed");
+        fail_close();
         return nullptr;
       }
       drop_rest = true;
@@ -578,6 +650,15 @@ uint32_t tlm_register_group(tlm_handle* h, const char* name,
   h->by_name[name] = gid;
   h->groups[gid].reg_epoch_at = h->reg_epoch + 1;  // set by the append
   if (!h->append_group_record(gid, name)) {
+    // roll the registration back COMPLETELY: leaving the gid cached in
+    // by_name would make a retried register return it without any
+    // registry record staged (the staging guard in write_record_locked
+    // then passes vacuously), so journal records could become durable
+    // for a gid absent from the registry — on reboot the gid orphans
+    // and next_gid could reassign it, shadowing the group's data.
+    h->groups.erase(gid);
+    h->by_name.erase(name);
+    h->next_gid = gid;  // we hold mu: nobody consumed a later gid
     if (errbuf && errlen > 0)
       snprintf(errbuf, (size_t)errlen, "groups registry write failed");
     return 0;
@@ -718,10 +799,12 @@ int64_t tlm_file_count(tlm_handle* h) {
   return (int64_t)h->files.size();
 }
 
-// Returns blob length and sets *out (caller frees with tlm_free), or -1.
-// The preads run OUTSIDE the engine mutex (a cold read must not stall
-// every group's appends); the fd is dup'd under the lock so a racing
-// GC unlink/close cannot invalidate it mid-read.
+// Returns blob length and sets *out (caller frees with tlm_free); -1 on
+// a missing index, -2 on record corruption (CRC/gid mismatch — bit rot
+// of a record the index says is live; callers must fail LOUDLY, not
+// treat it as a hole).  The preads run OUTSIDE the engine mutex (a cold
+// read must not stall every group's appends); the fd is dup'd under the
+// lock so a racing GC unlink/close cannot invalidate it mid-read.
 int64_t tlm_get(tlm_handle* h, uint32_t gid, int64_t index, uint8_t** out) {
   int fd = -1;
   Loc loc{0, 0};
@@ -737,17 +820,40 @@ int64_t tlm_get(tlm_handle* h, uint32_t gid, int64_t index, uint8_t** out) {
     fd = ::dup(f->fd);
     if (fd < 0) return -1;
   }
+  // -1 = environmental failure (short pread, malloc) — indistinct from
+  // a missing record, NOT a corruption verdict; -2 only when the bytes
+  // were fully read and the CRC or stored gid actually mismatches.
   int64_t result = -1;
   uint8_t hdr[kRecHdr];
-  if (::pread(fd, hdr, kRecHdr, loc.off) == (ssize_t)kRecHdr) {
+  struct stat st {};
+  if (::pread(fd, hdr, kRecHdr, loc.off) == (ssize_t)kRecHdr &&
+      ::fstat(fd, &st) == 0) {
     uint32_t len = load_u32(hdr);
-    if (len >= 9) {
+    // CRC-guard the read path, not just recovery: the stored crc covers
+    // gid..payload, so recompute over the header tail + blob and reject
+    // rotted records instead of silently decoding garbage.  A len rotted
+    // HIGH overruns the journal extent (records are never physically
+    // truncated under a live index — suffix truncation only clamps the
+    // in-memory positions, GC unlinks whole files) — that is corruption
+    // too, not a short read to shrug off as a hole.
+    if (len < 9 || load_u32(hdr + 8) != gid ||
+        loc.off + 4 + (int64_t)len > (int64_t)st.st_size) {
+      result = -2;  // framing/gid contradicts the live index: corruption
+    } else {
       uint32_t blen = len - 9;
       uint8_t* blob = (uint8_t*)malloc(blen ? blen : 1);
       if (blob) {
         if (::pread(fd, blob, blen, loc.off + kRecHdr) == (ssize_t)blen) {
-          *out = blob;
-          result = (int64_t)blen;
+          uLong c = crc32(0L, Z_NULL, 0);
+          c = crc32(c, hdr + 8, 5);          // gid + rectype
+          c = crc32(c, blob, (uInt)blen);     // payload
+          if ((uint32_t)c == load_u32(hdr + 4)) {
+            *out = blob;
+            result = (int64_t)blen;
+          } else {
+            free(blob);
+            result = -2;
+          }
         } else {
           free(blob);
         }

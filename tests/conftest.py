@@ -20,7 +20,13 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count knob as a config option; the
+    # installed 0.4.37 doesn't have it and the XLA_FLAGS fallback above
+    # already forces 8 host devices — collection must not die either way
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

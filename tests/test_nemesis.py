@@ -1,0 +1,197 @@
+"""Unit coverage for the fault plumbing itself: run_nemesis error
+paths (heal-after-apply-failure, SkipFault, post-heal checks) and
+FaultInjectingTransport block/drop/heal semantics — the machinery every
+chaos drive and soak stands on.
+"""
+
+import asyncio
+import random
+
+from tpuraft.errors import RaftError
+from tpuraft.rpc.fault import FaultInjectingTransport
+from tpuraft.rpc.transport import RpcError, TransportBase
+from tpuraft.util.nemesis import NemesisAction, SkipFault, run_nemesis
+
+
+def _rng(seed=0):
+    return random.Random(seed)
+
+
+async def test_nemesis_applies_dwells_heals():
+    events = []
+
+    async def apply():
+        events.append("apply")
+
+    async def heal():
+        events.append("heal")
+
+    a = NemesisAction("a", apply, heal, dwell_s=0.0)
+    timeline = await run_nemesis([a], duration_s=0.2, rng=_rng(),
+                                 pause_s=0.05)
+    assert a.applied >= 1 and len(timeline) == a.applied
+    # strict alternation: every applied fault healed before the next
+    assert events == ["apply", "heal"] * a.applied
+
+
+async def test_nemesis_heals_after_apply_failure():
+    """apply() may PARTIALLY take effect before raising: the nemesis
+    must heal best-effort so a botched fault can't linger, and the
+    drive keeps going."""
+    state = {"applied": 0, "healed": 0}
+
+    async def bad_apply():
+        state["applied"] += 1
+        raise RuntimeError("fault half-applied")
+
+    async def heal():
+        state["healed"] += 1
+
+    a = NemesisAction("bad", bad_apply, heal, dwell_s=0.0)
+    timeline = await run_nemesis([a], duration_s=0.15, rng=_rng(),
+                                 pause_s=0.03)
+    assert state["applied"] >= 1
+    assert state["healed"] == state["applied"]   # healed on EVERY failure
+    assert timeline == [] and a.applied == 0     # never recorded as applied
+
+
+async def test_nemesis_check_runs_on_apply_failure_path_too():
+    """A recovery failure that a best-effort heal swallowed must still
+    abort the drive via the check hook — not hide in a log line."""
+    async def bad_apply():
+        raise RuntimeError("apply died half-way")
+
+    async def heal():
+        pass
+
+    async def check():
+        raise AssertionError("store never recovered")
+
+    a = NemesisAction("pl", bad_apply, heal, dwell_s=0.0, check=check)
+    try:
+        await run_nemesis([a], duration_s=5.0, rng=_rng(), pause_s=0.01)
+        raise AssertionError("swallowed recovery failure did not abort")
+    except AssertionError as e:
+        assert "never recovered" in str(e)
+
+
+async def test_nemesis_heal_failure_after_apply_error_is_swallowed():
+    async def bad_apply():
+        raise RuntimeError("apply blew up")
+
+    async def bad_heal():
+        raise RuntimeError("heal blew up too")
+
+    a = NemesisAction("worse", bad_apply, bad_heal, dwell_s=0.0)
+    # neither error may escape: the drive rides through
+    timeline = await run_nemesis([a], duration_s=0.1, rng=_rng(),
+                                 pause_s=0.03)
+    assert timeline == []
+
+
+async def test_nemesis_skipfault_does_not_heal():
+    healed = []
+
+    async def skip():
+        raise SkipFault
+
+    async def heal():
+        healed.append(1)
+
+    a = NemesisAction("skip", skip, heal, dwell_s=0.0)
+    timeline = await run_nemesis([a], duration_s=0.1, rng=_rng(),
+                                 pause_s=0.03)
+    assert timeline == [] and not healed and a.applied == 0
+
+
+async def test_nemesis_check_runs_after_heal_and_aborts_on_violation():
+    order = []
+
+    async def apply():
+        order.append("apply")
+
+    async def heal():
+        order.append("heal")
+
+    async def check():
+        order.append("check")
+        if order.count("check") == 2:
+            raise AssertionError("recovery invariant violated")
+
+    a = NemesisAction("chk", apply, heal, dwell_s=0.0, check=check)
+    try:
+        await run_nemesis([a], duration_s=5.0, rng=_rng(), pause_s=0.01)
+        raise AssertionError("invariant violation did not abort the drive")
+    except AssertionError as e:
+        assert "recovery invariant" in str(e)
+    assert order == ["apply", "heal", "check"] * 2
+
+
+# ---------------------------------------------------------------------------
+# FaultInjectingTransport
+# ---------------------------------------------------------------------------
+
+
+class _EchoTransport(TransportBase):
+    def __init__(self):
+        self.endpoint = "127.0.0.1:1"
+        self.calls = []
+        self.closed = False
+
+    async def call(self, dst, method, request, timeout_ms=None):
+        self.calls.append((dst, method, request))
+        return ("ok", dst, request)
+
+    async def close(self):
+        self.closed = True
+
+
+async def test_fault_transport_block_is_one_way_per_destination():
+    inner = _EchoTransport()
+    t = FaultInjectingTransport(inner, seed=1)
+    t.block("b:1")
+    try:
+        await t.call("b:1", "m", 1, timeout_ms=10)
+        raise AssertionError("blocked dst answered")
+    except RpcError as e:
+        assert e.status.code == RaftError.EHOSTDOWN
+    # other destinations unaffected
+    assert (await t.call("c:1", "m", 2))[1] == "c:1"
+    # unblock restores exactly the named destination
+    t.unblock("b:1")
+    assert (await t.call("b:1", "m", 3))[1] == "b:1"
+    assert [c[0] for c in inner.calls] == ["c:1", "b:1"]
+
+
+async def test_fault_transport_drop_rate_and_heal():
+    inner = _EchoTransport()
+    t = FaultInjectingTransport(inner, seed=7)
+    t.set_drop_rate(1.0)
+    for _ in range(3):
+        try:
+            await t.call("d:1", "m", 0, timeout_ms=5)
+            raise AssertionError("100% drop rate let a call through")
+        except RpcError:
+            pass
+    assert inner.calls == []
+    t.set_drop_rate(0.0)
+    assert (await t.call("d:1", "m", 1))[0] == "ok"
+
+    # heal() clears every partition at once
+    t.block("x:1")
+    t.block("y:1")
+    t.heal()
+    await t.call("x:1", "m", 2)
+    await t.call("y:1", "m", 3)
+    assert len(inner.calls) == 3
+
+
+async def test_fault_transport_delay_and_close_passthrough():
+    inner = _EchoTransport()
+    t = FaultInjectingTransport(inner, seed=3)
+    t.set_delay_ms(5)
+    t0 = asyncio.get_running_loop().time()
+    await t.call("z:1", "m", 1)
+    assert asyncio.get_running_loop().time() - t0 >= 0.004
+    await t.close()
+    assert inner.closed

@@ -1,0 +1,616 @@
+"""Crash-consistency harness: simulated power-loss crashes over the
+storage plane (tpuraft/storage/fault.py).
+
+Three generational harnesses — FileLogStorage + MetaJournal under live
+``ChaosDir`` interposition, the native multilog under
+``NativeJournalTracker`` tail imaging — each runs dozens of seeded
+power-loss crashes (>= 220 in total across the module) and checks the
+recovery invariants after EVERY one:
+
+  - recovery never raises (a torn/bit-flipped unsynced tail is
+    truncated at the last CRC-valid record, not crashed on);
+  - log prefix property: recovered entries byte-match what was staged;
+  - acked floor: nothing proven durable by a completed fsync is lost
+    (last_recovered >= last_acked, {term, votedFor} never regresses
+    below an acked save);
+  - staged ceiling: recovery never invents entries beyond what was
+    staged;
+  - no orphaned gids: an acked registration keeps its gid across
+    crashes; journal records whose registration was lost are truncated,
+    never adopted or shadowed.
+
+Bit rot of the DURABLE region is the opposite contract — fail loudly,
+never truncate silently — and is covered by the explicit tests at the
+bottom.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+
+from tpuraft.entity import EMPTY_PEER, EntryType, LogEntry, LogId, PeerId
+from tpuraft.storage.fault import (
+    ChaosDir,
+    NativeJournalTracker,
+)
+from tpuraft.storage.log_storage import CorruptLogError, FileLogStorage
+from tpuraft.storage.meta_multilog import MetaJournal
+from tpuraft.storage.multilog import MultiLogStorage
+
+
+def _entry(index: int, gen: int, term: int = 1) -> LogEntry:
+    return LogEntry(type=EntryType.DATA, id=LogId(index, term),
+                    data=b"g%03d-i%06d" % (gen, index))
+
+
+# ---------------------------------------------------------------------------
+# FileLogStorage under ChaosDir
+# ---------------------------------------------------------------------------
+
+
+def _filelog_lifetime(root: str, rng: random.Random, gens: int) -> int:
+    """One directory, ``gens`` crash generations; returns crash count."""
+    first, entries, acked_last = 1, {}, 0
+
+    def staged_last():
+        return max(entries) if entries else first - 1
+
+    with ChaosDir(root) as chaos:
+        for gen in range(gens):
+            st = FileLogStorage(os.path.join(root, "log"),
+                                segment_max_bytes=200)
+            st.init()  # must tolerate whatever the crash left
+            rf, rl = st.first_log_index(), st.last_log_index()
+            assert rf == first, f"gen {gen}: first {rf} != {first}"
+            assert acked_last <= rl <= staged_last(), \
+                f"gen {gen}: last {rl} not in [{acked_last}, {staged_last()}]"
+            for i in range(rf, rl + 1):
+                e = st.get_entry(i)
+                assert e is not None and e.data == entries[i], \
+                    f"gen {gen}: entry {i} mismatch"
+            # recovered state is durable (init re-fsyncs + watermarks)
+            for i in list(entries):
+                if i > rl:
+                    del entries[i]
+            acked_last = rl
+
+            for _ in range(rng.randrange(1, 5)):
+                op = rng.random()
+                if op < 0.70 or not entries:
+                    n = rng.randrange(1, 6)
+                    batch = [_entry(staged_last() + 1 + k, gen)
+                             for k in range(n)]
+                    st.append_entries(batch, sync=True)  # fsynced => acked
+                    for e in batch:
+                        entries[e.id.index] = e.data
+                    acked_last = staged_last()
+                elif op < 0.85 and acked_last >= first:
+                    keep = rng.randrange(first - 1, staged_last() + 1)
+                    st.truncate_suffix(keep)  # fsynced by contract
+                    for i in list(entries):
+                        if i > keep:
+                            del entries[i]
+                    acked_last = min(acked_last, keep)
+                elif op < 0.95 and staged_last() > first:
+                    cut = rng.randrange(first, staged_last() + 1)
+                    st.truncate_prefix(cut)  # meta fsynced by contract
+                    first = max(first, cut)
+                    for i in list(entries):
+                        if i < first:
+                            del entries[i]
+                    acked_last = max(acked_last, first - 1)
+                else:
+                    nxt = staged_last() + rng.randrange(1, 10)
+                    st.reset(nxt)
+                    first, entries, acked_last = nxt, {}, nxt - 1
+
+            if rng.random() < 0.7:
+                # the in-flight append the power interrupts: staged
+                # bytes on disk, fsync never completed — on-disk
+                # identical to a crash mid sync=True append
+                n = rng.randrange(1, 5)
+                batch = [_entry(staged_last() + 1 + k, gen, term=2)
+                         for k in range(n)]
+                st.append_entries(batch, sync=False)
+                for e in batch:
+                    entries[e.id.index] = e.data
+
+            plan = chaos.capture_crash(rng)   # power dies here
+            st.shutdown()                     # in-proc cleanup only...
+            chaos.apply_crash(plan)           # ...discarded by the image
+        return chaos.crash_count
+
+
+def test_filelog_power_loss_recovery():
+    import tempfile
+
+    crashes = 0
+    for seed in range(3):
+        with tempfile.TemporaryDirectory() as tmp:
+            crashes += _filelog_lifetime(
+                os.path.join(tmp, f"flog{seed}"),
+                random.Random(1000 + seed), gens=20)
+    assert crashes >= 60
+
+
+# ---------------------------------------------------------------------------
+# MetaJournal under ChaosDir
+# ---------------------------------------------------------------------------
+
+
+def _meta_lifetime(root: str, rng: random.Random, gens: int) -> int:
+    groups = [f"r{i}" for i in range(4)]
+    history = {g: [(0, "")] for g in groups}   # staged (term, voted) per group
+    acked = {g: 0 for g in groups}             # index into history[g]
+    term = {g: 0 for g in groups}
+
+    with ChaosDir(root) as chaos:
+        for gen in range(gens):
+            j = MetaJournal(root)
+            j.COMPACT_MIN_BYTES = 512  # force compaction under chaos
+            for g in groups:
+                t, voted = j.get(g)
+                v = "" if voted.is_empty() else str(voted)
+                hist = history[g]
+                assert (t, v) in hist, f"gen {gen}: {g} has unknown {t}/{v}"
+                pos = hist.index((t, v))
+                assert pos >= acked[g], \
+                    f"gen {gen}: {g} regressed below acked " \
+                    f"({t} < {hist[acked[g]][0]})"
+                # recovered value is durable now (reopen fsync + wm)
+                history[g] = [(t, v)]
+                acked[g] = 0
+                term[g] = max(term[g], t)
+
+            for _ in range(rng.randrange(2, 8)):
+                g = rng.choice(groups)
+                term[g] += rng.randrange(1, 3)
+                voted = PeerId.parse(f"10.0.0.{rng.randrange(1, 5)}:80") \
+                    if rng.random() < 0.8 else EMPTY_PEER
+                j.stage(g, term[g], voted)
+                history[g].append(
+                    (term[g], "" if voted.is_empty() else str(voted)))
+                if rng.random() < 0.4:
+                    j.sync()  # group-commit round: everything staged acks
+                    for gg in groups:
+                        acked[gg] = len(history[gg]) - 1
+
+            plan = chaos.capture_crash(rng)
+            j.close()
+            chaos.apply_crash(plan)
+        return chaos.crash_count
+
+
+def test_meta_journal_power_loss_recovery():
+    import tempfile
+
+    crashes = 0
+    for seed in range(4):
+        with tempfile.TemporaryDirectory() as tmp:
+            crashes += _meta_lifetime(
+                os.path.join(tmp, f"meta{seed}"),
+                random.Random(2000 + seed), gens=20)
+    assert crashes >= 80
+
+
+# ---------------------------------------------------------------------------
+# native multilog under tail imaging
+# ---------------------------------------------------------------------------
+
+
+class _GroupModel:
+    def __init__(self) -> None:
+        self.first = 1
+        self.acked_first = 1
+        self.entries: dict[int, bytes] = {}
+        self.acked_last = 0
+
+    def staged_last(self) -> int:
+        return max(self.entries) if self.entries else self.first - 1
+
+
+def _native_lifetime(base: str, rng: random.Random, gens: int) -> int:
+    names = [f"g{i}" for i in range(3)]
+    model = {n: _GroupModel() for n in names}
+    gids: dict[str, int] = {}
+    live = os.path.join(base, "gen0")
+    crashes = 0
+
+    for gen in range(gens):
+        stores = {n: MultiLogStorage(live, n) for n in names}
+        for n in names:
+            stores[n].init()  # shared engine; recovery scan runs once
+        eng = stores[names[0]].engine
+        eng.sync()  # registrations of any new names ack immediately
+        for n in names:
+            if n in gids:
+                assert stores[n]._gid == gids[n], \
+                    f"gen {gen}: acked group {n} changed gid " \
+                    f"{gids[n]} -> {stores[n]._gid} (orphan/shadow)"
+            else:
+                gids[n] = stores[n]._gid
+
+        tracker = NativeJournalTracker(live)
+        tracker.note_sync()  # the recovered image IS the durable state
+
+        for n in names:
+            m, s = model[n], stores[n]
+            rf, rl = s.first_log_index(), s.last_log_index()
+            assert m.acked_first <= rf, \
+                f"gen {gen}: {n} first {rf} below acked {m.acked_first}"
+            assert rf <= max(m.first, m.acked_first), \
+                f"gen {gen}: {n} first {rf} beyond staged {m.first}"
+            assert m.acked_last <= rl, \
+                f"gen {gen}: {n} last {rl} below acked {m.acked_last}"
+            assert rl <= m.staged_last() or not m.entries, \
+                f"gen {gen}: {n} last {rl} beyond staged {m.staged_last()}"
+            for i in range(rf, rl + 1):
+                e = s.get_entry(i)
+                assert e is not None and e.data == m.entries[i], \
+                    f"gen {gen}: {n} entry {i} mismatch"
+            m.first = rf
+            m.acked_first = rf
+            for i in list(m.entries):
+                if i < rf or i > rl:
+                    del m.entries[i]
+            m.acked_last = rl
+
+        synced = False
+        for _ in range(rng.randrange(2, 6)):
+            n = rng.choice(names)
+            m, s = model[n], stores[n]
+            op = rng.random()
+            if op < 0.60 or not m.entries:
+                cnt = rng.randrange(1, 5)
+                batch = [_entry(m.staged_last() + 1 + k, gen)
+                         for k in range(cnt)]
+                s.append_entries(batch, sync=False)  # staged, not acked
+                for e in batch:
+                    m.entries[e.id.index] = e.data
+            elif op < 0.75:
+                eng.sync()
+                tracker.note_sync()
+                for mm in model.values():
+                    mm.acked_last = mm.staged_last()
+                    mm.acked_first = mm.first
+                synced = True
+            elif op < 0.85 and m.acked_last >= m.first:
+                keep = rng.randrange(m.first - 1, m.staged_last() + 1)
+                s.truncate_suffix(keep)  # fsyncs everything staged
+                tracker.note_sync()
+                for i in list(m.entries):
+                    if i > keep:
+                        del m.entries[i]
+                for mm in model.values():
+                    mm.acked_last = mm.staged_last()
+                    mm.acked_first = mm.first
+            elif op < 0.95 and m.staged_last() > m.first:
+                cut = rng.randrange(m.first, m.staged_last() + 1)
+                s.truncate_prefix(cut)  # lazily durable control record
+                m.first = max(m.first, cut)
+                # keep entries down to acked_first: a crash can lose the
+                # staged trunc record and legitimately revive them
+                for i in list(m.entries):
+                    if i < m.acked_first:
+                        del m.entries[i]
+            else:
+                nxt = m.staged_last() + rng.randrange(1, 8)
+                s.reset(nxt)  # fsyncs everything staged
+                tracker.note_sync()
+                m.first = m.acked_first = nxt
+                m.entries = {}
+                m.acked_last = nxt - 1
+                for mm in model.values():
+                    mm.acked_last = mm.staged_last()
+        del synced
+
+        nxt_dir = os.path.join(base, f"gen{gen + 1}")
+        tracker.crash_image(nxt_dir, rng)  # power dies here
+        for s in stores.values():
+            s.shutdown()  # releases/closes the live engine afterwards
+        live = nxt_dir
+        crashes += 1
+    return crashes
+
+
+def test_native_multilog_power_loss_recovery(tmp_path):
+    crashes = 0
+    for seed in range(4):
+        crashes += _native_lifetime(
+            str(tmp_path / f"nat{seed}"), random.Random(3000 + seed),
+            gens=30)
+    assert crashes >= 120
+
+
+# ---------------------------------------------------------------------------
+# explicit contract tests
+# ---------------------------------------------------------------------------
+
+
+def test_torn_tail_truncated_at_last_crc_valid_record(tmp_path):
+    """A torn unsynced tail recovers by CRC truncation — acked prefix
+    intact, no exception, no garbage read."""
+    root = str(tmp_path / "torn")
+    rng = random.Random(7)
+    with ChaosDir(root, modes=(("torn-write", 1.0),)) as chaos:
+        st = FileLogStorage(os.path.join(root, "log"))
+        st.init()
+        st.append_entries([_entry(i, 0) for i in range(1, 6)], sync=True)
+        st.append_entries([_entry(i, 0) for i in range(6, 9)], sync=False)
+        plan = chaos.capture_crash(rng)
+        st.shutdown()
+        chaos.apply_crash(plan)
+        st2 = FileLogStorage(os.path.join(root, "log"))
+        st2.init()
+        assert 5 <= st2.last_log_index() <= 8
+        for i in range(1, st2.last_log_index() + 1):
+            assert st2.get_entry(i).data == _entry(i, 0).data
+        st2.shutdown()
+
+
+def test_bit_flip_in_unsynced_tail_is_truncated(tmp_path):
+    root = str(tmp_path / "flip")
+    rng = random.Random(11)
+    with ChaosDir(root, modes=(("bit-flip", 1.0),)) as chaos:
+        st = FileLogStorage(os.path.join(root, "log"))
+        st.init()
+        st.append_entries([_entry(i, 0) for i in range(1, 4)], sync=True)
+        st.append_entries([_entry(i, 0) for i in range(4, 9)], sync=False)
+        plan = chaos.capture_crash(rng)
+        st.shutdown()
+        chaos.apply_crash(plan)
+        st2 = FileLogStorage(os.path.join(root, "log"))
+        st2.init()  # must not raise: flip is in the unsynced region
+        assert st2.last_log_index() >= 3
+        for i in range(1, st2.last_log_index() + 1):
+            assert st2.get_entry(i).data == _entry(i, 0).data
+        st2.shutdown()
+
+
+def test_durable_bit_rot_fails_loudly_filelog(tmp_path):
+    """Corruption BELOW the durability watermark is not a torn tail:
+    startup must refuse to truncate acked entries."""
+    d = str(tmp_path / "rot")
+    st = FileLogStorage(d)
+    st.init()
+    st.append_entries([_entry(i, 0) for i in range(1, 6)], sync=True)
+    st.shutdown()  # advances the watermark over everything
+    seg = next(n for n in os.listdir(d) if n.startswith("seg_"))
+    p = os.path.join(d, seg)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(p, "wb").write(bytes(blob))
+    st2 = FileLogStorage(d)
+    try:
+        st2.init()
+        raise AssertionError("durable-region rot went undetected")
+    except CorruptLogError:
+        pass
+
+
+def test_multilog_get_crc_guards_read_path(tmp_path):
+    """Bit rot in a live, indexed record: tlm_get must fail loudly
+    (CorruptLogError), not hand garbage (or a silent hole) upward."""
+    d = str(tmp_path / "mrot")
+    s = MultiLogStorage(d, "g")
+    s.init()
+    s.append_entries([_entry(i, 0) for i in range(1, 4)], sync=True)
+    jnl = next(n for n in sorted(os.listdir(d))
+               if n.startswith("journal_"))
+    p = os.path.join(d, jnl)
+    blob = bytearray(open(p, "rb").read())
+    blob[30] ^= 0x10  # inside the first record's payload
+    open(p, "wb").write(bytes(blob))
+    try:
+        s.get_entry(1)
+        raise AssertionError("rotted record served without complaint")
+    except CorruptLogError:
+        pass
+    finally:
+        s.shutdown()
+
+
+def test_multilog_len_rot_on_live_record_fails_loudly(tmp_path):
+    """A len field rotted HIGH on a live, indexed record must surface
+    as corruption (CorruptLogError), not read as a missing-entry hole
+    via a short payload read."""
+    d = str(tmp_path / "lenrot")
+    s = MultiLogStorage(d, "g")
+    s.init()
+    s.append_entries([_entry(i, 0) for i in range(1, 3)], sync=True)
+    jnl = next(n for n in sorted(os.listdir(d))
+               if n.startswith("journal_"))
+    p = os.path.join(d, jnl)
+    blob = bytearray(open(p, "rb").read())
+    blob[3] |= 0x40  # inflate the first record's len field past the file
+    open(p, "wb").write(bytes(blob))
+    try:
+        s.get_entry(1)
+        raise AssertionError("len-rotted record read as a hole")
+    except CorruptLogError:
+        pass
+    finally:
+        s.shutdown()
+
+
+def test_multilog_unreadable_registry_fails_open_not_truncates(tmp_path):
+    """A registry that cannot be READ must fail the engine open loudly
+    (retryable) — scanning journals against a partial registry would
+    read every acked record as orphan garbage and truncate them."""
+    d = str(tmp_path / "regdead")
+    s = MultiLogStorage(d, "g")
+    s.init()
+    s.append_entries([_entry(1, 0)], sync=True)
+    s.shutdown()
+    jsize = os.path.getsize(os.path.join(d, next(
+        n for n in sorted(os.listdir(d)) if n.startswith("journal_"))))
+    reg = os.path.join(d, "groups")
+    os.remove(reg)
+    os.mkdir(reg)  # open(O_RDWR) now fails EISDIR: unreadable registry
+    s2 = MultiLogStorage(d, "g")
+    try:
+        s2.init()
+        raise AssertionError("open succeeded against unreadable registry")
+    except IOError:
+        pass
+    # the acked journal bytes must be untouched by the failed open
+    jnl = next(n for n in sorted(os.listdir(d))
+               if n.startswith("journal_"))
+    assert os.path.getsize(os.path.join(d, jnl)) == jsize
+    os.rmdir(reg)
+
+
+def test_multilog_registry_gid_alias_is_truncated(tmp_path):
+    """A flipped gid in the registry's unsynced tail must not alias an
+    acked gid (shadowing another group's log): the sequential-gid scan
+    truncates the tail at the deviation."""
+    d = str(tmp_path / "reg")
+    sa, sb = MultiLogStorage(d, "a"), MultiLogStorage(d, "b")
+    sa.init(), sb.init()
+    sa.engine.sync()  # both registrations acked
+    gid_a, gid_b = sa._gid, sb._gid
+    sa.shutdown(), sb.shutdown()
+    # forge a tail record claiming gid_a for a different name (what a
+    # partial-page writeback bit flip can leave behind)
+    with open(os.path.join(d, "groups"), "ab") as f:
+        f.write(struct.pack("<II", gid_a, 1) + b"z")
+    sa2, sz = MultiLogStorage(d, "a"), MultiLogStorage(d, "z")
+    sa2.init(), sz.init()
+    try:
+        assert sa2._gid == gid_a
+        assert sz._gid not in (gid_a, gid_b), "alias adopted: shadowing"
+    finally:
+        sa2.shutdown(), sz.shutdown()
+
+
+def test_multilog_registry_tolerates_legacy_gid_gaps(tmp_path):
+    """Registries written before register_group rolled next_gid back on
+    a failed append can hold gid GAPS in their durable region; the
+    alias guard must accept those (strictly increasing), not truncate
+    acked registrations on upgrade."""
+    d = str(tmp_path / "gap")
+    sa, sb = MultiLogStorage(d, "a"), MultiLogStorage(d, "b")
+    sa.init(), sb.init()
+    gid_a, gid_b = sa._gid, sb._gid
+    sa.engine.sync()
+    sa.shutdown(), sb.shutdown()
+    # legacy gap: a registration that consumed gid_b+1 without a record,
+    # then a later group registered at gid_b+2
+    with open(os.path.join(d, "groups"), "ab") as f:
+        f.write(struct.pack("<II", gid_b + 2, 1) + b"c")
+    sa2 = MultiLogStorage(d, "a")
+    sb2 = MultiLogStorage(d, "b")
+    sc2 = MultiLogStorage(d, "c")
+    sd2 = MultiLogStorage(d, "dnew")
+    for s in (sa2, sb2, sc2, sd2):
+        s.init()
+    try:
+        assert sa2._gid == gid_a and sb2._gid == gid_b
+        assert sc2._gid == gid_b + 2, "gap-following record truncated"
+        assert sd2._gid == gid_b + 3  # next_gid resumed past the gap
+    finally:
+        for s in (sa2, sb2, sc2, sd2):
+            s.shutdown()
+
+
+def test_multilog_orphan_journal_records_are_torn(tmp_path):
+    """Journal records whose registration never became durable are an
+    unsynced tail by construction: recovery truncates them instead of
+    adopting records for an unregistered gid."""
+    import shutil
+
+    d = str(tmp_path / "orph")
+    sa = MultiLogStorage(d, "a")
+    sa.init()
+    sa.append_entries([_entry(1, 0)], sync=True)   # a: acked
+    reg_durable = os.path.getsize(os.path.join(d, "groups"))
+    sb = MultiLogStorage(d, "b")
+    sb.init()                                       # b: registration staged
+    sb.append_entries([_entry(1, 0), _entry(2, 0)], sync=False)
+    # power loss: journal pages survived writeback, registry tail didn't
+    img = str(tmp_path / "orph_img")
+    shutil.copytree(d, img)
+    with open(os.path.join(img, "groups"), "r+b") as f:
+        f.truncate(reg_durable)
+    sa.shutdown(), sb.shutdown()
+    ra, rb = MultiLogStorage(img, "a"), MultiLogStorage(img, "b")
+    ra.init(), rb.init()
+    try:
+        assert ra.last_log_index() == 1
+        assert ra.get_entry(1).data == _entry(1, 0).data
+        # b's staged-only records were truncated with its registration;
+        # the re-registered b starts empty (no adopted orphan records)
+        assert rb.last_log_index() == 0
+        assert rb.get_entry(1) is None
+    finally:
+        ra.shutdown(), rb.shutdown()
+
+
+async def test_reboot_after_compaction_keeps_acked_suffix(tmp_path):
+    """Regression for the amnesiac-reboot bug the power-loss soak found:
+    after snapshot compaction prunes the entry AT the snapshot index
+    (margin 0, first == S+1), the next boot's set_snapshot saw term 0
+    there, called it divergence, and RESET the log — silently dropping
+    the whole acked suffix.  Two stores rebooting in one fault window
+    then break quorum intersection and un-commit acked writes."""
+    from tpuraft.conf import Configuration, ConfigurationEntry
+    from tpuraft.storage.log_manager import LogManager
+
+    d = str(tmp_path / "lm")
+    conf = ConfigurationEntry(
+        LogId(0, 0), Configuration.parse("1.1.1.1:1,1.1.1.2:1,1.1.1.3:1"))
+
+    st = FileLogStorage(d)
+    lm = LogManager(st)
+    await lm.init()
+    await lm.append_entries_follower(
+        0, 0, [_entry(i, 0, term=3) for i in range(1, 11)])
+    # snapshot at 5 (margin 0): prunes entries <= 5, first becomes 6
+    await lm.set_snapshot(LogId(5, 3), conf)
+    assert lm.first_log_index() == 6 and lm.last_log_index() == 10
+    await lm.shutdown()
+
+    # reboot: snapshot load replays set_snapshot on the compacted log
+    st2 = FileLogStorage(d)
+    lm2 = LogManager(st2)
+    await lm2.init()
+    await lm2.set_snapshot(LogId(5, 3), conf)
+    assert lm2.last_log_index() == 10, \
+        "acked suffix dropped on reboot after compaction"
+    for i in range(6, 11):
+        assert lm2.get_term(i) == 3
+    assert lm2.check_consistency().is_ok()
+    await lm2.shutdown()
+
+    # the true-divergence case still resets: entry AT the snapshot index
+    # present with a DIFFERENT term (install-snapshot over a stale log)
+    st3 = FileLogStorage(str(tmp_path / "lm3"))
+    lm3 = LogManager(st3)
+    await lm3.init()
+    await lm3.append_entries_follower(
+        0, 0, [_entry(i, 0, term=2) for i in range(1, 11)])
+    await lm3.set_snapshot(LogId(7, 5), conf)   # term 5 != stored term 2
+    assert lm3.last_log_index() == 7            # stale tail dropped
+    assert lm3.first_log_index() == 8
+    await lm3.shutdown()
+
+
+def test_chaosdir_lost_fsync_and_survival(tmp_path):
+    """Sanity of the model itself: unsynced bytes vanish under
+    lost-fsync; fsynced bytes always survive."""
+    root = str(tmp_path / "model")
+    rng = random.Random(5)
+    with ChaosDir(root, modes=(("lost-fsync", 1.0),)) as chaos:
+        p = os.path.join(root, "f.bin")
+        f = open(p, "wb")
+        f.write(b"durable")
+        f.flush()
+        os.fsync(f.fileno())
+        f.write(b"+volatile")
+        f.flush()
+        f.close()
+        assert open(p, "rb").read() == b"durable+volatile"
+        chaos.crash(rng)
+        assert open(p, "rb").read() == b"durable"

@@ -5,6 +5,11 @@ Reference parity (SURVEY.md §3.1): ``core:storage/`` — LogStorage
 LocalRaftMetaStorage, snapshot subsystem.  The file log storage here is a
 segmented append log (the C++ native engine in ``native/`` implements the
 same on-disk format; selected via ``log_uri`` scheme ``native://``).
+
+Crash-consistency fault injection for all of it lives in
+``tpuraft.storage.fault`` (ChaosDir / FaultInjectingFile /
+NativeJournalTracker — see docs/operations.md "Crash-consistency
+testing"); imported lazily, never on the serving path.
 """
 
 from tpuraft.storage.log_storage import (

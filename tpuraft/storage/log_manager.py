@@ -419,6 +419,22 @@ class LogManager:
         if term_here == snapshot_id.term:
             # local log agrees with the snapshot: keep the tail after it
             first_kept = min(first_kept, snapshot_id.index + 1)
+        elif (term_here == 0 and self._first_index == snapshot_id.index + 1
+                and self._last_index >= snapshot_id.index):
+            # Boot-after-compaction: the entry AT the snapshot index was
+            # already pruned (margin 0), so its term is unknowable — but
+            # the stored tail starts exactly at snapshot.index + 1, i.e.
+            # it was appended contiguously after the snapshot point and
+            # Log Matching vouches for it.  KEEP it.  Treating term 0 as
+            # divergence here reset the log and silently dropped the
+            # whole acked suffix on every reboot that followed a
+            # completed compaction — two such amnesiac reboots in one
+            # fault window break quorum intersection and un-commit acked
+            # writes (found by the power-loss soak, examples/soak.py
+            # --power-loss; regression: tests/test_storage_fault.py).
+            # The reference resets only on a KNOWN different term
+            # (LogManagerImpl#setSnapshot: term == 0 -> truncatePrefix).
+            return
         else:
             # log diverges from (or predates) the snapshot: drop everything
             await self._drain_flushes()

@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import zlib
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -47,6 +48,50 @@ def _fsync_dir(path: str) -> None:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+def save_crc_watermark(path: str, dir_path: str, vals: bytes,
+                       sync: bool) -> None:
+    """Write a durability watermark as [values | crc32(values)] via
+    tmp + atomic rename (fsynced only when ``sync``— stale-LOW is
+    always safe, so the ordinary save skips the fsync)."""
+    blob = vals + struct.pack("<I", zlib.crc32(vals))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        if sync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if sync:
+        _fsync_dir(dir_path)
+
+
+def load_crc_watermark(path: str, value_size: int) -> Optional[bytes]:
+    """CRC-guarded watermark read: the ordinary save is NOT fsynced, so
+    after a power loss the file can hold garbage — and a garbage
+    watermark read as trusted would brick a healthy store with a false
+    CorruptLogError.  Returns the raw value bytes ONLY for an exact
+    [values | crc32(values)] record; anything else — absent, wrong
+    size (pre-CRC legacy files and prefix-torn records included), or a
+    CRC mismatch — returns None and the caller degrades to its
+    nothing-proven sentinel, which always falls back to safe torn-tail
+    semantics.  Trusting bare value_size-byte content was considered
+    and rejected: partial-page writeback can leave right-sized GARBAGE
+    (a torn CRC record with flipped bytes), and a garbage-high value
+    bricks recovery; degrading a legacy watermark once costs only one
+    boot's fail-loud coverage."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        return None
+    if len(blob) != value_size + 4:
+        return None
+    (crc,) = struct.unpack_from("<I", blob, value_size)
+    if zlib.crc32(blob[:value_size]) == crc:
+        return blob[:value_size]
+    return None
 
 
 class LogStorage(ABC):
@@ -441,26 +486,16 @@ class FileLogStorage(LogStorage):
         return os.path.join(self._dir, "synced")
 
     def _load_watermark(self) -> tuple[int, int]:
-        try:
-            with open(self._watermark_path(), "rb") as f:
-                first, size = struct.unpack("<qq", f.read(16))
-                return first, size
-        except (FileNotFoundError, struct.error):
-            # no watermark: nothing provably durable (-1 sorts below
-            # every segment first_index, so every durable_end is 0)
+        # CRC-guarded (see load_crc_watermark): garbage degrades to
+        # (-1, 0) = nothing provably durable, which is always safe
+        vals = load_crc_watermark(self._watermark_path(), 16)
+        if vals is None:
             return (-1, 0)
+        return struct.unpack("<qq", vals)
 
     def _save_watermark(self, sync: bool = False) -> None:
-        blob = struct.pack("<qq", *self._synced)
-        tmp = self._watermark_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            if sync:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, self._watermark_path())
-        if sync:
-            _fsync_dir(self._dir)
+        save_crc_watermark(self._watermark_path(), self._dir,
+                           struct.pack("<qq", *self._synced), sync)
 
     def _meta_path(self) -> str:
         return os.path.join(self._dir, "meta")

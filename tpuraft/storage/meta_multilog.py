@@ -7,9 +7,13 @@ sharding).  The reference pays one ProtoBufFile fsync per group per
 change; a 16K-group election herd on one process would issue 16K fsyncs
 serially through the executor, which is exactly the r3 starvation
 regime.  Here every group of a process appends its meta record to ONE
-shared journal and joins the SAME group-commit round the multilog uses
-for log entries (:class:`tpuraft.storage.multilog._GroupCommit`): N
-groups voting concurrently cost one fsync.
+shared journal whose flushes coalesce through the same group-commit
+*machinery* the multilog uses
+(:class:`tpuraft.storage.multilog._GroupCommit`) — but over its own
+file and its own rounds, so meta saves coalesce with other meta saves,
+not with log-entry fsyncs (an election plus an append burst pays two
+fsync rounds, one per journal): N groups voting concurrently still cost
+one meta fsync.
 
 Wiring::
 
@@ -40,7 +44,12 @@ import zlib
 from typing import Optional
 
 from tpuraft.entity import EMPTY_PEER, PeerId
-from tpuraft.storage.log_storage import CorruptLogError, _fsync_dir
+from tpuraft.storage.log_storage import (
+    CorruptLogError,
+    _fsync_dir,
+    load_crc_watermark,
+    save_crc_watermark,
+)
 from tpuraft.storage.meta_storage import RaftMetaStorage
 
 _HDR = struct.Struct("<H")      # group / votedFor length prefixes
@@ -70,6 +79,15 @@ class MetaJournal:
         # guards the file handle, the value map and compaction: stagers
         # run on event loops, the fsync runs in executor threads
         self._lock = threading.Lock()
+        # serializes whole fsync rounds with compaction's file-handle
+        # swap and with close() (mirrors the native engine's sync_mu):
+        # without it, a synchronous _save-path sync() racing a
+        # group-commit round's compaction would fsync a closed handle —
+        # ValueError remapped to a spurious IOError("meta journal
+        # closed") failing every waiter in the batch.  Lock order:
+        # _sync_lock -> _lock, never the reverse; stage() takes only
+        # _lock so staging never stalls behind a flush.
+        self._sync_lock = threading.Lock()
         self._values: dict[bytes, tuple[int, bytes]] = {}
         self._f = None
         self._size = 0
@@ -91,22 +109,14 @@ class MetaJournal:
         return os.path.join(self.dir, _WM)
 
     def _load_wm(self) -> int:
-        try:
-            with open(self._wm_path(), "rb") as f:
-                return struct.unpack("<q", f.read(8))[0]
-        except (FileNotFoundError, struct.error):
-            return 0
+        # CRC-guarded (see load_crc_watermark): garbage degrades to 0 =
+        # nothing proven, which always falls back to torn-tail semantics
+        vals = load_crc_watermark(self._wm_path(), 8)
+        return struct.unpack("<q", vals)[0] if vals is not None else 0
 
     def _save_wm(self, sync: bool) -> None:
-        tmp = self._wm_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(struct.pack("<q", self._synced))
-            if sync:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, self._wm_path())
-        if sync:
-            _fsync_dir(self.dir)
+        save_crc_watermark(self._wm_path(), self.dir,
+                           struct.pack("<q", self._synced), sync)
 
     def _open(self) -> None:
         wm = self._load_wm()
@@ -175,34 +185,40 @@ class MetaJournal:
         """One fsync round (called by _GroupCommit, possibly from an
         executor thread); compacts when garbage dominates.
 
-        The fsync runs OUTSIDE the lock: stage() is called inline on
-        the event loop (save_async), and holding the lock through a
-        writeback-stalled fsync would stall the loop — heartbeats for
-        every group in the process — exactly what the group-commit
-        machinery exists to prevent.  Only bytes staged BEFORE this
-        flush are claimed synced."""
-        with self._lock:
-            if self._f is None:
+        The fsync runs OUTSIDE the staging lock: stage() is called
+        inline on the event loop (save_async), and holding that lock
+        through a writeback-stalled fsync would stall the loop —
+        heartbeats for every group in the process — exactly what the
+        group-commit machinery exists to prevent.  ``_sync_lock`` is
+        held for the whole round instead, so a concurrent round (the
+        synchronous ``_save`` path racing a group-commit round) cannot
+        interleave with compaction closing the handle mid-fsync.  Only
+        bytes staged BEFORE this flush are claimed synced."""
+        with self._sync_lock:
+            with self._lock:
+                if self._f is None:
+                    raise IOError("meta journal closed")
+                f = self._f
+                f.flush()
+                size = self._size
+            try:
+                os.fsync(f.fileno())
+            except ValueError:
+                # unreachable while _sync_lock serializes close() and
+                # compaction; kept as a defensive remap
                 raise IOError("meta journal closed")
-            f = self._f
-            f.flush()
-            size = self._size
-        try:
-            os.fsync(f.fileno())
-        except ValueError:
-            raise IOError("meta journal closed")  # closed mid-round
-        with self._lock:
-            self.sync_count += 1
-            if self._f is f and size > self._synced:
-                self._synced = size
-            live = max(1, len(self._values))
-            if (self._f is f and size >= self.COMPACT_MIN_BYTES
-                    and self._size > 8 * live * 64):
-                # compaction stays under the lock (it swaps the file
-                # handle out from under stagers): rare — threshold-
-                # gated — and bounded by the live set's size, unlike
-                # the per-round fsync above
-                self._compact_locked()
+            with self._lock:
+                self.sync_count += 1
+                if self._f is f and size > self._synced:
+                    self._synced = size
+                live = max(1, len(self._values))
+                if (self._f is f and size >= self.COMPACT_MIN_BYTES
+                        and self._size > 8 * live * 64):
+                    # compaction stays under both locks (it swaps the
+                    # file handle out from under stagers and fsyncers):
+                    # rare — threshold-gated — and bounded by the live
+                    # set's size, unlike the per-round fsync above
+                    self._compact_locked()
 
     def _compact_locked(self) -> None:
         # floor the watermark (fsynced) BEFORE replacing the file: if the
@@ -233,7 +249,10 @@ class MetaJournal:
         return term, (PeerId.parse(v.decode()) if v else EMPTY_PEER)
 
     def close(self) -> None:
-        with self._lock:
+        # _sync_lock first: an in-flight sync round must finish its
+        # fsync before the handle disappears (same discipline as
+        # MultiLogEngine.close vs its sync lock)
+        with self._sync_lock, self._lock:
             if self._f is not None:
                 try:
                     self._f.flush()

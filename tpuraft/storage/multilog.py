@@ -30,7 +30,7 @@ import time
 from typing import Optional
 
 from tpuraft.entity import LogEntry
-from tpuraft.storage.log_storage import LogStorage
+from tpuraft.storage.log_storage import CorruptLogError, LogStorage
 
 _FRAME = struct.Struct("<I")
 _LIB_NAME = "libtpuraft_multilog.so"
@@ -381,6 +381,13 @@ class MultiLogStorage(LogStorage):
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = self._lib.tlm_get(self._eng._h, self._gid, index,
                               ctypes.byref(out))
+        if n == -2:
+            # the index says the record is live but its CRC fails: bit
+            # rot of acked data — silently returning None here would
+            # read as a hole and could ship garbage to a follower
+            raise CorruptLogError(
+                f"multilog record for group {self._group} index {index} "
+                f"fails CRC — acked entry corrupted")
         if n < 0:
             return None
         try:

@@ -40,6 +40,11 @@ class NemesisAction:
     # faults that sometimes cannot fire (e.g. no current leader) may
     # raise SkipFault from apply; the nemesis just picks again
     applied: int = field(default=0, compare=False)
+    # optional post-heal invariant probe (crash-recovery actions assert
+    # their recovery invariants here); a failure ABORTS the drive —
+    # unlike apply/heal errors, a violated invariant is the verdict,
+    # not noise to ride through
+    check: Optional[Callable[[], Awaitable[None]]] = None
 
 
 class SkipFault(Exception):
@@ -77,6 +82,11 @@ async def run_nemesis(actions: list[NemesisAction], duration_s: float,
             except Exception:
                 LOG.exception("nemesis action %s failed to heal after "
                               "apply error", action.name)
+            # the invariant probe runs on THIS path too: a recovery
+            # failure the best-effort heal just swallowed must still
+            # abort the drive, not hide in a log line
+            if action.check is not None:
+                await action.check()
             await asyncio.sleep(pause_s)
             continue
         action.applied += 1
@@ -91,5 +101,7 @@ async def run_nemesis(actions: list[NemesisAction], duration_s: float,
             except Exception:
                 LOG.exception("nemesis action %s failed to heal",
                               action.name)
+        if action.check is not None:
+            await action.check()   # invariant violation aborts the drive
         await asyncio.sleep(pause_s)
     return timeline
