@@ -28,9 +28,20 @@ import asyncio
 import sys
 
 from tpuraft.conf import Configuration
-from tpuraft.core.cli_service import CliService
+from tpuraft.core.cli_service import CliService, describe_status
 from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError
 from tpuraft.rpc.tcp import TcpTransport
+
+
+def _report(st) -> int:
+    """Print the op outcome; exit 0 = done, 3 = busy (safe to just
+    retry), 1 = definite failure (inspect before retrying)."""
+    if st.is_ok():
+        print("OK")
+        return 0
+    print(describe_status(st), file=sys.stderr)
+    return 3 if st.raft_error == RaftError.EBUSY else 1
 
 
 async def run(args) -> int:
@@ -70,16 +81,14 @@ async def run(args) -> int:
                 st = await cli.add_peer(args.group, conf, peer)
             else:
                 st = await cli.remove_peer(args.group, conf, peer)
-            print("OK" if st.is_ok() else f"error: {st}")
-            rc = 0 if st.is_ok() else 1
+            rc = _report(st)
         elif cmd == "change-peers":
             if len(args.command) < 2:
                 print("change-peers needs a conf argument", file=sys.stderr)
                 return 2
             new_conf = Configuration.parse(args.command[1])
             st = await cli.change_peers(args.group, conf, new_conf)
-            print("OK" if st.is_ok() else f"error: {st}")
-            rc = 0 if st.is_ok() else 1
+            rc = _report(st)
         elif cmd in ("add-learners", "remove-learners", "reset-learners"):
             if len(args.command) < 2:
                 print(f"{cmd} needs a peer-list argument "
@@ -97,8 +106,7 @@ async def run(args) -> int:
                   "remove-learners": cli.remove_learners,
                   "reset-learners": cli.reset_learners}[cmd]
             st = await op(args.group, conf, learners)
-            print("OK" if st.is_ok() else f"error: {st}")
-            rc = 0 if st.is_ok() else 1
+            rc = _report(st)
         else:
             print(f"unknown command: {cmd}", file=sys.stderr)
             rc = 2

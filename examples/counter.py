@@ -86,7 +86,7 @@ class CounterStateMachine(StateMachine):
     async def on_leader_start(self, term: int) -> None:
         self.leader_term = term
 
-    async def on_leader_stop(self) -> None:
+    async def on_leader_stop(self, status: Status) -> None:
         self.leader_term = -1
 
     async def on_snapshot_save(self, writer, done) -> None:

@@ -51,7 +51,7 @@ class ElectionOnlyStateMachine(StateMachine):
         if self._on_start:
             self._on_start(term)
 
-    async def on_leader_stop(self) -> None:
+    async def on_leader_stop(self, status) -> None:
         self.is_leader = False
         if self._on_stop:
             self._on_stop()

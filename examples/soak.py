@@ -12,13 +12,20 @@ a consensus store can get.
         --data /tmp/soak --verbose
 
 Faults: rolling store kill/restart, one-way partitions, packet
-drops+delays — and, with ``--power-loss``, storage-plane crashes: a
-store is killed at a random instant and restarted from its
-durable-only on-disk image, with torn writes / lost fsyncs / bit flips
-injected into the unsynced tails (tpuraft/storage/fault.py).  Durable
-state dirs are required implicitly — a voter restarted without its
-disk is amnesiac, which Raft does not tolerate (the divergence
-detector would fail it loudly).
+drops+delays+duplication+bounded-reordering — and, with
+``--power-loss``, storage-plane crashes: a store is killed at a random
+instant and restarted from its durable-only on-disk image, with torn
+writes / lost fsyncs / bit flips injected into the unsynced tails
+(tpuraft/storage/fault.py).  Durable state dirs are required
+implicitly — a voter restarted without its disk is amnesiac, which
+Raft does not tolerate (the divergence detector would fail it loudly).
+
+``--churn`` adds continuous elastic-membership churn (add/remove
+voters, add/promote/remove learners, leadership transfers) running
+CONCURRENTLY with the fault schedule, plus a stage-trap nemesis action
+that lands seeded crashes inside each joint-consensus stage; after
+every fault the committed conf of every live node must be one of
+{old, joint, new} of an attempted change.
 """
 
 from __future__ import annotations
@@ -29,13 +36,24 @@ import random
 import tempfile
 import time
 
+import itertools
+
+from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError
 from tpuraft.rheakv.client import RheaKVStore
 from tpuraft.rheakv.metadata import Region
 from tpuraft.rheakv.pd_client import FakePlacementDriverClient
 from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
 from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
 from tpuraft.util.linearizability import History, check_history
-from tpuraft.util.nemesis import NemesisAction, SkipFault, run_nemesis
+from tpuraft.util.nemesis import (
+    NemesisAction,
+    SkipFault,
+    StageTrap,
+    run_nemesis,
+)
+from tpuraft.util.quorum import joint_quorums_intersect as \
+    _joint_quorums_intersect  # shared with tests/oracle.py — one oracle
 
 
 class _BaseSoakCluster:
@@ -139,9 +157,12 @@ class SoakCluster(_BaseSoakCluster):
     def heal_partitions(self) -> None:
         self.net.heal()
 
-    def set_noise(self, drop: float, delay_ms: float) -> None:
+    def set_noise(self, drop: float, delay_ms: float, dup: float = 0.0,
+                  reorder: float = 0.0, reorder_ms: float = 8.0) -> None:
         self.net.set_drop_rate(drop)
         self.net.set_delay_ms(delay_ms)
+        self.net.set_duplicate_rate(dup)
+        self.net.set_reorder(reorder, reorder_ms)
 
 
 class NativeSoakCluster(_BaseSoakCluster):
@@ -158,7 +179,8 @@ class NativeSoakCluster(_BaseSoakCluster):
         self._faults: dict[str, object] = {}
         # active fault state survives store restarts (the in-proc fabric
         # gets this for free from its shared network object)
-        self._noise: tuple[float, float] = (0.0, 0.0)
+        self._noise: tuple[float, float, float, float, float] = (
+            0.0, 0.0, 0.0, 0.0, 8.0)
         self._blocks: set[tuple[str, str]] = set()
 
     async def boot(self) -> None:
@@ -199,6 +221,8 @@ class NativeSoakCluster(_BaseSoakCluster):
         # re-apply the fault state active at (re)start time
         transport.set_drop_rate(self._noise[0])
         transport.set_delay_ms(self._noise[1])
+        transport.set_duplicate_rate(self._noise[2])
+        transport.set_reorder(self._noise[3], self._noise[4])
         for src, dst in self._blocks:
             if src == ep:
                 transport.block(dst)
@@ -239,11 +263,269 @@ class NativeSoakCluster(_BaseSoakCluster):
         for ft in self._faults.values():
             ft.heal()
 
-    def set_noise(self, drop: float, delay_ms: float) -> None:
-        self._noise = (drop, delay_ms)
+    def set_noise(self, drop: float, delay_ms: float, dup: float = 0.0,
+                  reorder: float = 0.0, reorder_ms: float = 8.0) -> None:
+        self._noise = (drop, delay_ms, dup, reorder, reorder_ms)
         for ft in self._faults.values():
             ft.set_drop_rate(drop)
             ft.set_delay_ms(delay_ms)
+            ft.set_duplicate_rate(dup)
+            ft.set_reorder(reorder, reorder_ms)
+
+
+class MembershipChurn:
+    """Continuous elastic-membership churn against one region of an
+    in-proc soak cluster: add/remove voters, add/promote/remove
+    learners, transfer leadership — running CONCURRENTLY with the
+    nemesis schedule, so every seeded crash may land mid-joint-config,
+    mid-catch-up, or mid-transfer.
+
+    Tracks the committed-configuration history and asserts, after every
+    fault heals, that each live node's conf is one of {old, joint, new}
+    of some attempted change and that consecutive stable confs kept
+    quorum intersection (through the joint's dual quorum).
+    """
+
+    def __init__(self, cluster, region_id: int, rng, say):
+        self.c = cluster
+        self.rid = region_id
+        self.rng = rng
+        self.say = say
+        self.trap = StageTrap()
+        self.completed = 0
+        self.transfers = 0
+        self.busy_retries = 0
+        self.failures: dict[str, int] = {}
+        self.stage_crashes: dict[str, int] = {}
+        # committed stable voter sets, in completion order
+        initial = frozenset(PeerId.parse(p) if isinstance(p, str) else p
+                            for p in self._region_peers())
+        self.conf_history: list[frozenset] = [initial]
+        # every (old, new) pair ever attempted: lagging nodes may hold a
+        # joint from a change several rounds back
+        self.attempted: list[tuple[frozenset, frozenset]] = []
+        self._stop = asyncio.Event()
+        self._task = None
+
+    def _region_peers(self) -> list:
+        for r in self.c.regions:
+            if r.id == self.rid:
+                return list(r.peers)
+        raise ValueError(f"region {self.rid} not in cluster layout")
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _nodes(self):
+        out = {}
+        for ep, s in self.c.stores.items():
+            eng = s.get_region_engine(self.rid)
+            if eng is not None and eng.node is not None:
+                out[ep] = eng.node
+        return out
+
+    def leader_node(self):
+        for ep, node in self._nodes().items():
+            if node.is_leader():
+                return ep, node
+        return None, None
+
+    def _install_listeners(self) -> None:
+        """(Re)hook the stage trap on every live node — idempotent, and
+        repeated each round so restarted stores rejoin the trap."""
+        for node in self._nodes().values():
+            node.conf_stage_listener = self.trap.listener
+
+    # -- the churn loop ------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self._one_change()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # a churn-op crash must not stop churn
+                self._note_failure(f"driver:{type(e).__name__}")
+            await asyncio.sleep(0.05 + self.rng.random() * 0.15)
+
+    def _note_failure(self, key: str) -> None:
+        self.failures[key] = self.failures.get(key, 0) + 1
+
+    async def _one_change(self) -> None:
+        """Pick one membership op against the current conf and drive it
+        through with bounded EBUSY backoff-retry (the operator loop)."""
+        self._install_listeners()
+        for attempt in range(12):
+            if self._stop.is_set():
+                return
+            ep, node = self.leader_node()
+            if node is None:
+                await asyncio.sleep(0.2)
+                continue
+            plan = self._plan_op(node)
+            if plan is None:
+                await asyncio.sleep(0.2)
+                continue
+            op, coro, old_set, new_set = plan
+            # record the attempt BEFORE the call: a crash window may
+            # commit the change without us seeing the ack, and the
+            # invariant check must know the pair was legal.  Definite
+            # pre-append rejections un-record it below so the oracle's
+            # allowed set doesn't silently widen with changes that
+            # never touched any log.
+            pair = (old_set, new_set)
+            recorded = op != "transfer" and new_set != old_set
+            if recorded:
+                self.attempted.append(pair)
+
+            def unrecord():
+                if recorded and pair in self.attempted:
+                    self.attempted.remove(pair)
+
+            try:
+                st = await asyncio.wait_for(coro, 20.0)
+            except asyncio.TimeoutError:
+                self._note_failure(f"{op}:timeout")
+                return
+            except Exception as e:
+                # node shut down mid-call (a crash landed on it) — the
+                # change may or may not complete; the invariant check
+                # reconciles either way
+                self._note_failure(f"{op}:{type(e).__name__}")
+                return
+            if st.is_ok():
+                if op == "transfer":
+                    self.transfers += 1
+                else:
+                    self.completed += 1
+                    if new_set != self.conf_history[-1]:
+                        self.conf_history.append(new_set)
+                self.say(f"  churn: {op} ok "
+                         f"(voters={len(new_set)})")
+                return
+            code = st.raft_error
+            if code == RaftError.EBUSY:
+                # rejected before anything was appended
+                unrecord()
+                self.busy_retries += 1
+                await asyncio.sleep(0.15 + self.rng.random() * 0.1)
+                continue
+            if code in (RaftError.EINVAL, RaftError.EPERM):
+                unrecord()  # rejected at propose time, nothing appended
+            # transient outcomes under chaos (deposed leader, catch-up
+            # against a killed store, shutdown): note and move on —
+            # the invariant check decides whether the change took
+            self._note_failure(f"{op}:{code.name}")
+            return
+
+    def _plan_op(self, node):
+        """Build (op, coroutine, old_voters, new_voters) for one change
+        against the leader's CURRENT conf."""
+        voters = list(node.conf_entry.conf.peers)
+        learners = list(node.conf_entry.conf.learners)
+        all_peers = [PeerId.parse(e) for e in self.c.endpoints]
+        spare = [p for p in all_peers
+                 if p not in voters and p not in learners]
+        menu: list[str] = []
+        if spare:
+            menu += ["add_voter", "add_learner"]
+        if learners:
+            menu += ["promote_learner", "remove_learner"]
+        if len(voters) > 2:
+            menu += ["remove_voter", "remove_voter"]
+        if len(voters) > 1:
+            menu += ["transfer"]
+        if not menu:
+            return None
+        op = self.rng.choice(menu)
+        old_set = frozenset(voters)
+        new_conf = node.conf_entry.conf.copy()
+        if op == "add_voter":
+            new_conf.peers.append(self.rng.choice(spare))
+        elif op == "add_learner":
+            new_conf.learners.append(self.rng.choice(spare))
+        elif op == "promote_learner":
+            p = self.rng.choice(learners)
+            new_conf.learners.remove(p)
+            new_conf.peers.append(p)
+        elif op == "remove_learner":
+            new_conf.learners.remove(self.rng.choice(learners))
+        elif op == "remove_voter":
+            victim = self.rng.choice(voters)
+            new_conf.peers.remove(victim)
+        elif op == "transfer":
+            target = self.rng.choice(
+                [p for p in voters if p != node.server_id] or voters)
+            return (op, node.transfer_leadership_to(target),
+                    old_set, old_set)
+        new_set = frozenset(new_conf.peers)
+        return (op, node.change_peers(new_conf), old_set, new_set)
+
+    # -- invariants (run as the nemesis post-heal check) ---------------------
+
+    async def check_invariants(self) -> None:
+        """After a fault heals: every live node's conf must be one of
+        {old, joint, new} of some attempted change, and the stable-conf
+        chain must keep quorum intersection.  An ok-status the driver
+        missed (leader died after committing) is reconciled here."""
+        history = set(self.conf_history)
+        for ep, node in self._nodes().items():
+            conf = frozenset(node.conf_entry.conf.peers)
+            old = frozenset(node.conf_entry.old_conf.peers)
+            if old:
+                assert (old, conf) in self.attempted, (
+                    f"{ep}: joint conf {sorted(map(str, old))} -> "
+                    f"{sorted(map(str, conf))} matches no attempted "
+                    f"change (history={self.conf_history})")
+                # quorum intersection across the change, verified by
+                # enumerating the joint's dual quorums against both
+                # sides' majorities
+                assert _joint_quorums_intersect(old, conf), (
+                    f"{ep}: joint {sorted(map(str, old))} -> "
+                    f"{sorted(map(str, conf))} lacks quorum intersection")
+            else:
+                if conf in history:
+                    continue
+                # a stable conf the driver never saw complete: legal iff
+                # it is the target of an attempted change leaving a
+                # known stable conf (the leader died between commit and
+                # ack) — adopt it as completed
+                adopted = False
+                for o, n in self.attempted:
+                    if n == conf and o in history:
+                        self.conf_history.append(conf)
+                        history.add(conf)
+                        self.completed += 1
+                        adopted = True
+                        self.say(f"  churn: adopted conf completed "
+                                 f"under crash (voters={len(conf)})")
+                        break
+                assert adopted, (
+                    f"{ep}: stable conf {sorted(map(str, conf))} is "
+                    f"neither a committed conf nor an attempted target "
+                    f"(history={self.conf_history})")
+
+    def summary(self) -> dict:
+        return {
+            "completed_conf_changes": self.completed,
+            "transfers": self.transfers,
+            "busy_retries": self.busy_retries,
+            "stage_crashes": dict(self.stage_crashes),
+            "failures": dict(self.failures),
+            "conf_history_len": len(self.conf_history),
+        }
 
 
 async def run_soak(duration_s: float, n_stores: int, n_keys: int,
@@ -254,8 +536,13 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    n_regions: int = 1,
                    engine: bool = False,
                    election_timeout_ms: int = 400,
-                   power_loss: bool = False) -> dict:
+                   power_loss: bool = False,
+                   churn: bool = False) -> dict:
     rng = random.Random(seed)
+    if churn and transport != "inproc":
+        raise ValueError(
+            "--churn drives membership ops and stage traps through "
+            "direct node access, so it runs on the in-proc fabric")
     if power_loss and (transport != "inproc" or engine):
         raise ValueError(
             "--power-loss interposes on the Python storage planes "
@@ -288,7 +575,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                     _os.path.join(data_path, f"{ip}_{port}")).install()
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
-            lease_reads, n_regions, rng, c, chaos)
+            lease_reads, n_regions, rng, c, chaos, churn)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -299,7 +586,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 
 async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
-                          chaos) -> dict:
+                          chaos, churn=False) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -375,7 +662,9 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         c.heal_partitions()
 
     async def noise_on():
-        c.set_noise(0.05, 2)
+        # drops + delays + the two other classic network faults:
+        # duplication (receiver executes twice) and bounded reordering
+        c.set_noise(0.05, 2, dup=0.03, reorder=0.05, reorder_ms=8.0)
 
     async def noise_off():
         c.set_noise(0.0, 0)
@@ -411,23 +700,99 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         assert not dead_after_power_loss, \
             f"stores failed power-loss recovery: {dead_after_power_loss}"
 
+    # -- membership churn (--churn): continuous conf changes under the
+    # fault schedule + a stage-trap action that lands seeded crashes
+    # INSIDE each _ConfigurationCtx stage ------------------------------------
+    churn_driver = None
+    crash_stage_cycle = itertools.cycle(["catching_up", "joint", "stable"])
+    churn_lost: list[str] = []
+    churn_dead: list[str] = []
+
+    async def churn_crash():
+        """Arm the stage trap for the next target stage; when a change
+        enters it, crash THAT node's store mid-stage (power-loss image
+        when --power-loss is on, plain kill otherwise)."""
+        target = next(crash_stage_cycle)
+        churn_driver.trap.arm(target)
+        try:
+            hit = await churn_driver.trap.wait(12.0)
+        finally:
+            churn_driver.trap.disarm()
+        if not hit:
+            raise SkipFault
+        node = churn_driver.trap.node
+        ep = node.server_id.endpoint
+        if ep not in c.stores:
+            raise SkipFault
+        churn_driver.stage_crashes[target] = \
+            churn_driver.stage_crashes.get(target, 0) + 1
+        say(f"  nemesis: churn-crash landing in stage={target} on {ep}")
+        if chaos:
+            plan = chaos[ep].capture_crash(rng)
+            churn_lost.append(ep)
+            await c.stop_store(ep)
+            chaos[ep].apply_crash(plan)
+        else:
+            churn_lost.append(ep)
+            await c.stop_store(ep)
+
+    async def churn_crash_restart():
+        while churn_lost:
+            ep = churn_lost.pop()
+            try:
+                await c.start_store(ep)
+            except Exception:
+                churn_dead.append(ep)
+                raise
+
+    async def churn_ok():
+        assert not churn_dead, \
+            f"stores failed churn-crash recovery: {churn_dead}"
+        await churn_driver.check_invariants()
+
+    def with_conf_check(existing):
+        """Compose an action's own recovery probe with the membership
+        invariant check — under churn, EVERY fault's heal must leave
+        each node's conf in {old, joint, new}."""
+        if churn_driver is None:
+            return existing
+
+        async def _check():
+            if existing is not None:
+                await existing()
+            await churn_driver.check_invariants()
+        return _check
+
+    if churn:
+        churn_driver = MembershipChurn(c, sampled_regions[0], rng, say)
+
     actions = [
         NemesisAction("leader-kill", kill_leader, restart_killed,
-                      dwell_s=0.7, weight=1.5),
-        NemesisAction("one-way-partition", one_way, heal_net, dwell_s=0.5),
-        NemesisAction("drops+delays", noise_on, noise_off, dwell_s=0.8),
+                      dwell_s=0.7, weight=1.5,
+                      check=with_conf_check(None)),
+        NemesisAction("one-way-partition", one_way, heal_net, dwell_s=0.5,
+                      check=with_conf_check(None)),
+        NemesisAction("drops+delays", noise_on, noise_off, dwell_s=0.8,
+                      check=with_conf_check(None)),
     ]
     if chaos:
         actions.append(
             NemesisAction("power-loss", power_loss_kill,
                           power_loss_restart, dwell_s=0.6, weight=1.5,
-                          check=power_loss_ok))
+                          check=with_conf_check(power_loss_ok)))
+    if churn_driver is not None:
+        actions.append(
+            NemesisAction("churn-crash", churn_crash, churn_crash_restart,
+                          dwell_s=0.6, weight=1.5, check=churn_ok))
+        churn_driver.start()
 
     workers = [asyncio.ensure_future(worker(i)) for i in range(5)]
     try:
         await run_nemesis(actions, duration_s, rng,
                           on_tick=lambda n: say("  nemesis:", n))
         stop.set()
+        if churn_driver is not None:
+            await churn_driver.stop()
         await asyncio.gather(*workers)
         ops = h.ops()
         completed = sum(1 for o in ops if o.ret is not None)
@@ -452,6 +817,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             result["power_loss_crashes"] = sum(
                 cd.crash_count for cd in chaos.values())
             result["storage_injections"] = injected
+        if churn_driver is not None:
+            result["membership"] = churn_driver.summary()
         if not rep.ok:
             result["violation"] = str(rep)
         if dump_history and not rep.ok:
@@ -472,6 +839,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         # also on checker errors / cancellation: no leaked workers or
         # still-running stores
         stop.set()
+        if churn_driver is not None:
+            await churn_driver.stop()
         for w in workers:
             w.cancel()
         await asyncio.gather(*workers, return_exceptions=True)
@@ -520,6 +889,14 @@ def main() -> None:
                          "restarted from its durable-only on-disk image "
                          "(torn writes / lost fsyncs / bit flips in the "
                          "unsynced tails; tpuraft/storage/fault.py)")
+    ap.add_argument("--churn", action="store_true",
+                    help="continuous membership churn while faults fly: "
+                         "add/remove voters, add/promote/remove "
+                         "learners, leadership transfers — plus a "
+                         "stage-trap nemesis action that lands seeded "
+                         "crashes inside each joint-consensus stage "
+                         "(catching_up / joint / stable); conf "
+                         "invariants asserted after every fault")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -531,7 +908,8 @@ def main() -> None:
                                   n_regions=args.regions,
                                   engine=args.engine,
                                   election_timeout_ms=args.election_timeout_ms,
-                                  power_loss=args.power_loss))
+                                  power_loss=args.power_loss,
+                                  churn=args.churn))
     import json
 
     print(json.dumps(result))

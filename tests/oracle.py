@@ -1,9 +1,15 @@
 """Scalar oracle: per-index Ballot semantics exactly as the reference
 implements them (core:entity/Ballot, core:core/BallotBox) — used to
 property-test the vectorized order-statistic kernels against.
+
+Also the MEMBERSHIP oracle: quorum-intersection math and the legal
+committed-configuration sequence (old -> joint -> new) that the
+membership-churn chaos drives assert after every fault.
 """
 
 from __future__ import annotations
+
+from typing import Iterable
 
 
 class OracleBallot:
@@ -51,3 +57,67 @@ def oracle_commit_index(
         else:
             break
     return commit
+
+
+# ---------------------------------------------------------------------------
+# membership oracle
+# ---------------------------------------------------------------------------
+
+
+# the arithmetic lives in tpuraft/util/quorum.py so the soak's live
+# invariant check (examples/soak.py, which can't import tests/) shares
+# ONE implementation with this oracle — re-exported here for the tests
+from tpuraft.util.quorum import (  # noqa: F401  (re-export)
+    joint_quorums_intersect,
+    majorities_intersect,
+)
+
+
+def check_conf_sequence(entries: Iterable[tuple[Iterable, Iterable]]) -> None:
+    """Assert a committed CONFIGURATION-entry sequence is a legal chain
+    of joint-consensus transitions.
+
+    ``entries``: (peers, old_peers) tuples in commit order.  Invariants
+    (the ISSUE's "committed conf is always one of {old, joint, new}"):
+
+    - a joint entry's old side must equal the current stable conf;
+    - a stable entry must be either the current stable conf re-committed
+      (a new leader's no-op conf entry — legal only while NO joint is
+      pending: once the joint entry commits, leader completeness bars
+      any future leader from committing plain C_old again) or the new
+      side of the pending joint;
+    - every transition's quorum systems must intersect.
+    """
+    last_stable: frozenset | None = None
+    pending: frozenset | None = None
+    for i, (peers, old) in enumerate(entries):
+        peers, old = frozenset(peers), frozenset(old)
+        assert peers, f"entry {i}: empty voter set committed"
+        if old:
+            assert last_stable is None or old == last_stable, (
+                f"entry {i}: joint leaves old={set(old)} but the stable "
+                f"conf is {set(last_stable)}")
+            assert joint_quorums_intersect(old, peers), (
+                f"entry {i}: joint {set(old)}->{set(peers)} lacks quorum "
+                f"intersection")
+            pending = peers
+            if last_stable is None:
+                last_stable = old
+        else:
+            ok = (last_stable is None
+                  or (pending is None and peers == last_stable)
+                  or peers == pending)
+            assert ok, (
+                f"entry {i}: stable conf {set(peers)} is not "
+                + (f"the pending new conf {set(pending)} (a stable "
+                   f"C_old after the joint committed is a rollback)"
+                   if pending is not None else
+                   f"the current conf {set(last_stable)} re-committed"))
+            if peers == pending:
+                assert joint_quorums_intersect(last_stable, peers), (
+                    f"entry {i}: transition {set(last_stable)} -> "
+                    f"{set(peers)} lacks quorum intersection")
+                last_stable = peers
+                pending = None
+            elif last_stable is None:
+                last_stable = peers

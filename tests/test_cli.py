@@ -165,3 +165,79 @@ async def test_reset_learners_via_cli(tmp_path):
         assert b"post-reset" in c.fsms[l2].logs
         assert l2 in c.nodes[leader.server_id].list_learners()
         assert l1 not in c.nodes[leader.server_id].list_learners()
+
+
+class _BusyLeaderTransport:
+    """Fake wire: one fixed leader that answers change ops EBUSY a set
+    number of times before accepting — the shape a leader mid-membership-
+    change presents to the admin client."""
+
+    def __init__(self, busy_answers: int):
+        from tpuraft.errors import RaftError
+
+        self.busy_left = busy_answers
+        self.leader = "127.0.0.1:5100"
+        self.op_calls = 0
+        self._ebusy = int(RaftError.EBUSY)
+
+    async def call(self, dst, method, req, timeout_ms=None):
+        from tpuraft.rpc.cli_messages import CliResponse, GetLeaderResponse
+
+        if method == "cli_get_leader":
+            return GetLeaderResponse(leader_id=self.leader, success=True)
+        self.op_calls += 1
+        if self.busy_left > 0:
+            self.busy_left -= 1
+            return CliResponse(code=self._ebusy,
+                               msg="another membership change in progress")
+        return CliResponse(code=0)
+
+
+async def test_cli_busy_backoff_retries_until_change_completes():
+    """EBUSY is transient by contract: the CLI retries with its own
+    bounded backoff budget (not max_retry), keeps the cached leader, and
+    succeeds once the in-flight change drains."""
+    from tpuraft.entity import PeerId
+    from tpuraft.options import CliOptions
+
+    t = _BusyLeaderTransport(busy_answers=3)
+    cli = CliService(t, CliOptions(busy_max_retry=5, busy_backoff_ms=1,
+                                   busy_backoff_max_ms=4))
+    conf = Configuration([PeerId.parse(t.leader)])
+    st = await cli.add_peer("g", conf, PeerId.parse("127.0.0.1:5101"))
+    assert st.is_ok(), st
+    assert t.op_calls == 4  # 3 busy answers + the accepted attempt
+    # busy retries did NOT evict the leader cache
+    assert cli._leaders.get("g") == PeerId.parse(t.leader)
+
+
+async def test_cli_busy_budget_exhausted_returns_ebusy():
+    """A persistently busy leader yields a structured EBUSY (so the
+    operator knows to just retry later), not EAGAIN/EPERM."""
+    from tpuraft.errors import RaftError
+    from tpuraft.entity import PeerId
+    from tpuraft.options import CliOptions
+
+    t = _BusyLeaderTransport(busy_answers=99)
+    cli = CliService(t, CliOptions(busy_max_retry=2, busy_backoff_ms=1,
+                                   busy_backoff_max_ms=2))
+    conf = Configuration([PeerId.parse(t.leader)])
+    st = await cli.add_peer("g", conf, PeerId.parse("127.0.0.1:5101"))
+    assert st.raft_error == RaftError.EBUSY, st
+    assert "still busy" in st.error_msg
+    assert t.op_calls == 3  # initial attempt + busy_max_retry retries
+
+
+def test_describe_status_classifies_operator_outcomes():
+    """describe_status: 'busy, retry' reads differently from 'your conf
+    is wrong' — the admin CLI's exit-code policy builds on this."""
+    from tpuraft.core.cli_service import describe_status
+    from tpuraft.errors import RaftError, Status
+
+    assert describe_status(Status.OK()) == "OK"
+    busy = describe_status(Status.error(RaftError.EBUSY, "change in flight"))
+    assert "EBUSY" in busy and "retry" in busy
+    bad = describe_status(Status.error(RaftError.EINVAL, "dup peer"))
+    assert "EINVAL" in bad and "configuration" in bad
+    catchup = describe_status(Status.error(RaftError.ECATCHUP, "no"))
+    assert "ECATCHUP" in catchup and "catch up" in catchup

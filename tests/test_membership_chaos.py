@@ -1,0 +1,752 @@
+"""Joint consensus under chaos: the reconfiguration-plane sibling of
+test_storage_fault.py's crash-consistency harness.
+
+Covers the invariants the membership-churn soak (examples/soak.py
+--churn) asserts live, as deterministic seeded single-process tests:
+
+- the committed conf is always one of {old, joint, new} and quorum
+  intersection holds across the change (oracle.check_conf_sequence);
+- a crash mid-joint is recovered by the NEXT leader resuming the change
+  (_ConfigurationCtx.resume_joint at becomeLeader);
+- a reboot mid-change recovers the correct conf from log+snapshot,
+  including a snapshot taken while joint;
+- a stuck catch-up aborts with a clean EBUSY-free retry path instead of
+  wedging _conf_ctx forever, and a step-down racing a catch-up
+  completion cannot append a joint entry to a follower's log;
+- a voter removed from the conf cannot depose the remaining cluster
+  (removed-server disruption guard), and reset_learners of a current
+  voter is rejected, not silently demoted;
+- transfer_leadership_to under faults: target crashed before
+  timeout_now, transfer vs concurrent conf change (EBUSY both ways),
+  and the _transfer_watchdog restoring availability.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from tests.cluster import TestCluster
+from tests.oracle import (
+    check_conf_sequence,
+    joint_quorums_intersect,
+    majorities_intersect,
+)
+from tpuraft.conf import Configuration
+from tpuraft.core.ballot_box import BallotBox
+from tpuraft.core.node import State, _ConfigurationCtx
+from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.messages import AppendEntriesRequest, RequestVoteRequest
+
+
+async def poll(cond, timeout_s: float = 5.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+async def start_joiner(c: TestCluster, peer: PeerId):
+    """Boot a node with an empty conf: it learns membership via
+    replication (the reference's joiner pattern)."""
+    c.peers.append(peer)
+    save = c.conf
+    c.conf = Configuration()
+    await c.start(peer)
+    c.conf = save
+
+
+# ---------------------------------------------------------------------------
+# removed-server disruption guard
+# ---------------------------------------------------------------------------
+
+
+async def test_votes_from_non_member_candidate_rejected():
+    """Pre-votes from a candidate outside the conf are refused outright;
+    a real vote with a huge term must not depose a leader whose lease
+    holds (pre-fix: handle_request_vote stepped down unconditionally)."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"x")
+    term = leader.current_term
+    last = leader.log_manager.last_log_id()
+    outsider = "127.0.0.1:5099"
+
+    pre = RequestVoteRequest(
+        group_id=c.group_id, server_id=outsider,
+        peer_id=str(leader.server_id), term=term + 1,
+        last_log_index=last.index + 10, last_log_term=term + 5,
+        pre_vote=True)
+    resp = await leader.handle_request_vote(pre)
+    assert not resp.granted
+
+    real = RequestVoteRequest(
+        group_id=c.group_id, server_id=outsider,
+        peer_id=str(leader.server_id), term=term + 5,
+        last_log_index=last.index + 10, last_log_term=term + 5,
+        pre_vote=False)
+    resp = await leader.handle_request_vote(real)
+    assert not resp.granted
+    assert leader.state == State.LEADER, "non-member vote deposed the leader"
+    assert leader.current_term == term, "non-member vote bumped the term"
+
+    follower = next(n for n in c.nodes.values() if n is not leader)
+    resp = await follower.handle_request_vote(real)
+    assert not resp.granted
+    assert follower.current_term == term
+    await c.stop_all()
+
+
+async def test_non_member_prevote_allowed_when_no_live_leader():
+    """The recovery escape, mirroring the real-vote guard: a voter whose
+    conf is STALE (it never received the entry adding the candidate)
+    must still grant pre-vote once no leader is alive — otherwise a
+    {A,B,D} group where only B lags at {A,B,C} can never elect D after
+    A dies.  While a leader IS alive the same pre-vote stays refused."""
+    c = TestCluster(3, election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"x")
+    follower = next(n for n in c.nodes.values() if n is not leader)
+    term = follower.current_term
+    last = follower.log_manager.last_log_id()
+    pre = RequestVoteRequest(
+        group_id=c.group_id, server_id="127.0.0.1:5099",
+        peer_id=str(follower.server_id), term=term + 5,
+        last_log_index=last.index + 10, last_log_term=term + 5,
+        pre_vote=True)
+    resp = await follower.handle_request_vote(pre)
+    assert not resp.granted, "non-member pre-vote granted under a live leader"
+    # isolate the follower (its own pre-votes fail, so no term bumps)
+    # and let its leader lease lapse
+    c.net.isolate(follower.server_id.endpoint)
+    await asyncio.sleep(0.5)
+    resp = await follower.handle_request_vote(pre)
+    assert resp.granted, "stale-conf voter blocked recovery pre-vote"
+    c.net.heal()
+    await c.stop_all()
+
+
+async def test_removed_voter_cannot_depose_leader():
+    """A voter removed while partitioned from the leader never learns
+    its removal and keeps electioneering with ever-growing terms; the
+    survivors must stay stable (reference: Raft §4.2.3 disruption)."""
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"a")
+    victim = next(p for p in c.peers if p != leader.server_id)
+    vnode = c.nodes[victim]
+    survivors = {p.endpoint for p in c.peers if p != victim}
+    # victim receives nothing (never sees the conf entry removing it)
+    # but its own calls still reach the survivors
+    c.net.partition_one_way(survivors, {victim.endpoint})
+    st = await asyncio.wait_for(leader.remove_peer(victim), 10)
+    assert st.is_ok(), str(st)
+    term = leader.current_term
+    # worst case: the stale victim skips pre-vote entirely (lease-expiry
+    # edge) and solicits real votes at term+1, repeatedly
+    for _ in range(3):
+        async with vnode._lock:
+            if vnode.state in (State.FOLLOWER, State.CANDIDATE):
+                await vnode._elect_self()
+        await asyncio.sleep(0.25)
+    assert leader.state == State.LEADER, \
+        "removed voter deposed the remaining cluster"
+    assert leader.current_term == term, \
+        "removed voter's elections bumped the survivors' term"
+    st = await c.apply_ok(leader, b"b")
+    assert st.is_ok(), str(st)
+    c.net.heal()
+    await c.stop_all()
+
+
+async def test_reset_learners_of_current_voter_rejected():
+    """reset_learners/add_learners naming a CURRENT VOTER must be
+    rejected (EINVAL), not silently demote it out of the quorum."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    voter = next(p for p in c.peers if p != leader.server_id)
+    st = await asyncio.wait_for(leader.reset_learners([voter]), 10)
+    assert st.raft_error == RaftError.EINVAL, str(st)
+    assert voter in leader.list_peers(), "voter silently demoted"
+    assert voter not in leader.list_learners()
+    st = await asyncio.wait_for(leader.add_learners([voter]), 10)
+    assert st.raft_error == RaftError.EINVAL, str(st)
+    assert voter in leader.list_peers()
+    await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# catch-up abort / EBUSY-free retry
+# ---------------------------------------------------------------------------
+
+
+async def test_catchup_timeout_aborts_cleanly_and_retry_succeeds():
+    """Adding an unreachable peer must fail ECATCHUP after the bounded
+    catch-up window, tear down the provisioned replicator, clear
+    _conf_ctx, and leave the node immediately ready for the next change
+    — no EBUSY wedge, no zombie replicator."""
+    c = TestCluster(3, election_timeout_ms=150)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    ghost = PeerId.parse("127.0.0.1:5009")  # never started
+    st = await asyncio.wait_for(leader.add_peer(ghost), 15)
+    assert st.raft_error == RaftError.ECATCHUP, str(st)
+    assert leader._conf_ctx is None, "_conf_ctx wedged after catch-up abort"
+    assert leader.replicators.get(ghost) is None, \
+        "catch-up replicator leaked after abort"
+    assert ghost not in leader.list_peers()
+    # EBUSY-free retry path: a subsequent change starts right away
+    joiner = PeerId.parse("127.0.0.1:5003")
+    await start_joiner(c, joiner)
+    st = await asyncio.wait_for(leader.add_peer(joiner), 15)
+    assert st.is_ok(), str(st)
+    assert joiner in leader.list_peers()
+    await c.stop_all()
+
+
+async def test_cancelled_change_peers_tears_down_catchup_replicator():
+    """The CALLER abandons change_peers (operator timeout) while the new
+    peer is still catching up: the abort must tear down the provisioned
+    replicator like the ECATCHUP path does — a leaked one keeps shipping
+    to a non-member, and a retry of the change would reuse its stale
+    match_index and pass catch-up instantly even after a peer wipe."""
+    c = TestCluster(3, election_timeout_ms=150)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    ghost = PeerId.parse("127.0.0.1:5009")  # never started
+    with pytest.raises(asyncio.TimeoutError):
+        # far below the ~10-election-timeout catch-up window: the caller
+        # gives up first
+        await asyncio.wait_for(leader.add_peer(ghost), 0.3)
+    await poll(lambda: leader._conf_ctx is None,
+               what="ctx cleared after caller cancellation")
+    assert leader.replicators.get(ghost) is None, \
+        "catch-up replicator leaked after caller cancellation"
+    assert ghost not in leader.list_peers()
+    # retry path stays clean: a real joiner is added from scratch
+    joiner = PeerId.parse("127.0.0.1:5003")
+    await start_joiner(c, joiner)
+    st = await asyncio.wait_for(leader.add_peer(joiner), 15)
+    assert st.is_ok(), str(st)
+    await c.stop_all()
+
+
+async def test_stale_catchup_completion_after_abort_cannot_enter_joint():
+    """The zombie-joint race: catch-up waiters resolve True concurrently
+    with a step-down; the aborted ctx must NOT re-enter _enter_joint and
+    append a joint entry to what is now a follower's log."""
+    c = TestCluster(3)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    new_conf = leader.conf_entry.conf.copy()
+    new_conf.peers.append(PeerId.parse("127.0.0.1:5008"))
+    ctx = _ConfigurationCtx(leader, leader.conf_entry.conf.copy(), new_conf)
+    ctx._set_stage("catching_up")
+    # the step-down lands first (it marks the stage terminal)...
+    ctx.fail(Status.error(RaftError.ENEWLEADER, "leader stepped down"))
+    assert ctx.stage == "aborted"
+    before = leader.log_manager.last_log_index()
+    # ...then the catch-up completion arrives with all-True results
+    done: asyncio.Future = asyncio.get_running_loop().create_future()
+    done.set_result(True)
+    await ctx._wait_catchup([done])
+    assert leader.log_manager.last_log_index() == before, \
+        "aborted ctx appended a joint entry"
+    assert ctx.stage == "aborted"
+    await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# crash mid-joint: the next leader resumes and completes the change
+# ---------------------------------------------------------------------------
+
+
+def _freeze_at_joint(node):
+    """Stage listener that freezes the node's conf change the moment it
+    enters joint: the joint entry still commits and applies cluster-wide
+    but the ctx never advances to stable — modeling a leader that dies
+    between the two commit rounds."""
+    box = {}
+
+    def listener(n, stage):
+        if stage == "joint" and n is node and n._conf_ctx is not None:
+            ctx = n._conf_ctx
+            box["ctx"] = ctx
+
+            async def _noop(entry):
+                return None
+
+            ctx.on_committed = _noop
+
+    node.conf_stage_listener = listener
+    return box
+
+
+async def test_leader_crash_mid_joint_next_leader_completes_change():
+    """The old leader dies with the joint conf committed but the stable
+    entry never appended.  The next elected leader must ADOPT the joint
+    (ConfigurationCtx resume at becomeLeader) and drive it to the new
+    conf — without the fix the group stays joint forever and every
+    subsequent change_peers returns EBUSY."""
+    c = TestCluster(3, election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"pre")
+    joiner = PeerId.parse("127.0.0.1:5003")
+    await start_joiner(c, joiner)
+    target = set(c.peers)
+
+    _freeze_at_joint(leader)
+    new_conf = Configuration(list(c.peers))
+    task = asyncio.ensure_future(leader.change_peers(new_conf))
+    followers = [n for n in c.nodes.values()
+                 if n is not leader and n.server_id != joiner]
+    await poll(lambda: all(not f.conf_entry.old_conf.is_empty()
+                           for f in followers),
+               what="joint conf replicated to followers")
+    dead = leader.server_id
+    await c.stop(dead)
+    st = await task
+    assert not st.is_ok()  # the change's initiator died with it
+
+    new_leader = await c.wait_leader(10)
+    # note: conf_entry turns stable when the stable entry is STAGED; the
+    # ctx clears when it COMMITS — poll for both
+    await poll(lambda: new_leader.conf_entry.old_conf.is_empty()
+               and set(new_leader.conf_entry.conf.peers) == target
+               and new_leader._conf_ctx is None,
+               timeout_s=10,
+               what="resumed change completed to the new conf")
+    # availability: the new conf carries writes (quorum 3/4 with 1 dead);
+    # a re-election racing the probe (ENEWLEADER) is retried — duplicate
+    # application of the probe write is harmless here
+    for _ in range(3):
+        st = await c.apply_ok(new_leader, b"post")
+        if st.is_ok():
+            break
+        new_leader = await c.wait_leader(10)
+    assert st.is_ok(), str(st)
+    # a fresh change is accepted — no EBUSY wedge from the resume
+    st = await asyncio.wait_for(new_leader.remove_peer(dead), 15)
+    assert st.is_ok(), str(st)
+    await c.stop_all()
+
+
+async def test_reboot_mid_change_recovers_joint_conf_from_snapshot(tmp_path):
+    """A snapshot taken WHILE JOINT must carry the joint conf in its
+    meta (peers + old_peers), and a node rebooted from it — with the
+    joint log entry compacted away — must come back in the joint conf,
+    then complete the change once the cluster reassembles."""
+    c = TestCluster(3, tmp_path=str(tmp_path), snapshot=True,
+                    election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    for i in range(4):
+        await c.apply_ok(leader, b"w%d" % i)
+    joiner = PeerId.parse("127.0.0.1:5003")
+    await start_joiner(c, joiner)
+    old_set = set(leader.conf_entry.conf.peers)
+    target = set(c.peers)
+
+    box = _freeze_at_joint(leader)
+    task = asyncio.ensure_future(
+        leader.change_peers(Configuration(list(c.peers))))
+    await poll(lambda: "ctx" in box, what="change entered joint")
+    joint_index = box["ctx"]._joint_index
+    await poll(lambda: leader.fsm_caller.last_applied_index >= joint_index,
+               what="joint entry committed+applied on the leader")
+
+    # snapshot while joint, compacting the joint entry out of the log
+    leader.options.snapshot.log_index_margin = 0
+    st = await leader.snapshot()
+    assert st.is_ok(), str(st)
+    meta = leader.snapshot_executor._storage.open().load_meta()
+    assert set(PeerId.parse(p) for p in meta.old_peers) == old_set, \
+        "snapshot taken while joint lost old_peers in its meta"
+    assert set(PeerId.parse(p) for p in meta.peers) == target
+    await poll(lambda: leader.log_manager.first_log_index() > joint_index,
+               what="joint entry compacted out of the log")
+
+    # power down the whole cluster mid-change
+    dead = leader.server_id
+    await c.stop(dead)
+    st = await task
+    assert not st.is_ok()
+    for p in list(c.nodes):
+        await c.stop(p)
+
+    # reboot the ex-leader ALONE: recovery must come from ITS disk
+    node = await c.start(dead)
+    assert set(node.conf_entry.conf.peers) == target, \
+        "rebooted node lost the joint conf"
+    assert set(node.conf_entry.old_conf.peers) == old_set, \
+        "rebooted node lost the OLD side of the joint conf"
+
+    # reassemble; some leader resumes and completes the change
+    for p in c.peers:
+        if p not in c.nodes:
+            await c.start(p)
+    new_leader = await c.wait_leader(10)
+    await poll(lambda: new_leader.conf_entry.old_conf.is_empty()
+               and set(new_leader.conf_entry.conf.peers) == target,
+               timeout_s=10, what="change completed after full reboot")
+    # liveness probe: the freshly reassembled cluster may re-elect once
+    # more right under the apply (ENEWLEADER) — duplicate application of
+    # the probe write is harmless, so retry through the next leader
+    for _ in range(3):
+        st = await c.apply_ok(new_leader, b"alive")
+        if st.is_ok():
+            break
+        new_leader = await c.wait_leader(10)
+    assert st.is_ok(), str(st)
+    await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# follower conf must track log truncation
+# ---------------------------------------------------------------------------
+
+
+async def test_follower_conf_rolls_back_when_joint_entry_truncated():
+    """A joint CONFIGURATION entry appended (uncommitted) on a follower
+    is later truncated by the next leader's conflict resolution: the
+    follower's conf must roll back to what the log actually holds, not
+    keep a phantom joint membership."""
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"x")
+    await c.wait_applied(1)
+    follower = next(n for n in c.nodes.values() if n is not leader)
+    other = next(p for p in c.peers
+                 if p != leader.server_id and p != follower.server_id)
+    c.net.isolate(follower.server_id.endpoint)  # keep real traffic out
+    orig = set(follower.conf_entry.conf.peers)
+    last = follower.log_manager.last_log_id()
+    t1 = follower.current_term + 1
+
+    joint = LogEntry(
+        type=EntryType.CONFIGURATION,
+        peers=sorted(orig) + [PeerId.parse("127.0.0.1:5007")],
+        old_peers=sorted(orig),
+        id=LogId(last.index + 1, t1))
+    req = AppendEntriesRequest(
+        group_id=c.group_id, server_id=str(other),
+        peer_id=str(follower.server_id), term=t1,
+        prev_log_index=last.index, prev_log_term=last.term,
+        committed_index=follower.ballot_box.last_committed_index,
+        entries=[joint])
+    resp = await follower.handle_append_entries(req)
+    assert resp.success
+    assert not follower.conf_entry.old_conf.is_empty(), \
+        "joint conf not adopted from the appended entry"
+
+    # a NEW leader overwrites that suffix with a DATA entry at term+2
+    data = LogEntry(type=EntryType.DATA, data=b"z",
+                    id=LogId(last.index + 1, t1 + 1))
+    req2 = AppendEntriesRequest(
+        group_id=c.group_id, server_id=str(leader.server_id),
+        peer_id=str(follower.server_id), term=t1 + 1,
+        prev_log_index=last.index, prev_log_term=last.term,
+        committed_index=follower.ballot_box.last_committed_index,
+        entries=[data])
+    resp = await follower.handle_append_entries(req2)
+    assert resp.success
+    assert follower.conf_entry.old_conf.is_empty(), \
+        "phantom joint conf survived its entry's truncation"
+    assert set(follower.conf_entry.conf.peers) == orig, \
+        "conf did not roll back to the last conf the log holds"
+    c.net.heal()
+    await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# ballot box: dual-quorum accounting under churn
+# ---------------------------------------------------------------------------
+
+
+def test_ballot_box_prunes_stale_match_of_removed_peer():
+    """A voter removed, wiped, and re-added must re-earn its matchIndex:
+    its stale pre-removal row must not advance the commit point (the
+    re-added peer's log is empty — counting the old row commits entries
+    a 'quorum' never stored)."""
+    committed: list[int] = []
+    box = BallotBox(committed.append)
+    p1, p2, p3 = (PeerId.parse(f"1.1.1.1:{i}") for i in (1, 2, 3))
+    conf3 = Configuration([p1, p2, p3])
+    empty = Configuration()
+    box.reset_pending_index(1)
+    box.commit_at(p3, 10, conf3, empty)     # p3 acked through 10
+    assert box.last_committed_index == 0    # no quorum yet
+    box.update_conf(Configuration([p1, p2]), empty)   # p3 removed (wiped)
+    box.update_conf(conf3, empty)                     # p3 re-added, empty log
+    box.commit_at(p1, 5, conf3, empty)
+    assert box.last_committed_index == 0, \
+        "stale match row of a removed+re-added peer advanced the commit"
+    assert committed == []
+    box.commit_at(p3, 5, conf3, empty)      # the reborn peer re-earns it
+    assert box.last_committed_index == 5
+    assert committed == [5]
+
+
+def test_membership_oracle_math():
+    """The quorum-intersection oracle itself: known-good and known-bad
+    voter-set pairs, and legal/illegal conf sequences."""
+    a3 = frozenset({1, 2, 3})
+    a4 = frozenset({1, 2, 3, 4})
+    disjointish = frozenset({4, 5, 6})
+    assert majorities_intersect(a3, a3)
+    # single-server add: majorities of {1,2,3} and {1,2,3,4} always meet
+    assert majorities_intersect(a3, a4)
+    # but {1,2,3} vs {1,2,4} admits the disjoint pair {1,3} / {2,4} —
+    # exactly why a swap must go through joint consensus
+    assert not majorities_intersect(a3, frozenset({1, 2, 4}))
+    assert not majorities_intersect(a3, disjointish)
+    assert joint_quorums_intersect(a3, disjointish)  # dual quorum saves it
+    check_conf_sequence([
+        (a3, ()),                  # bootstrap
+        (a3, ()),                  # re-commit at a new term
+        (frozenset({1, 2, 3, 4}), a3),   # joint out
+        (frozenset({1, 2, 3, 4}), a3),   # resumed joint after crash
+        ((1, 2, 3, 4), ()),        # stable new
+        ((1, 2, 4), (1, 2, 3, 4)),  # next change
+        ((1, 2, 4), ()),
+    ])
+    with pytest.raises(AssertionError):
+        check_conf_sequence([
+            (a3, ()),
+            (disjointish, ()),     # stable jump with no joint between
+        ])
+    with pytest.raises(AssertionError):
+        check_conf_sequence([
+            (a3, ()),
+            (frozenset({1, 2, 5}), frozenset({1, 2, 4})),
+            # ^ joint leaving a conf we never had
+        ])
+    with pytest.raises(AssertionError):
+        check_conf_sequence([
+            (a3, ()),
+            (a4, a3),     # joint committed...
+            (a3, ()),     # ...then stable C_old again: a rollback —
+        ])                # leader completeness forbids this
+
+
+# ---------------------------------------------------------------------------
+# leadership transfer under faults
+# ---------------------------------------------------------------------------
+
+
+async def test_transfer_to_crashed_target_restores_leadership():
+    """The transfer target crashes before timeout_now reaches it: the
+    _transfer_watchdog must return the node to LEADER and the group to
+    availability within an election timeout."""
+    c = TestCluster(3, election_timeout_ms=200)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    await c.apply_ok(leader, b"a")
+    target = next(p for p in c.peers if p != leader.server_id)
+    await c.stop(target)
+    st = await leader.transfer_leadership_to(target)
+    assert st.is_ok(), str(st)  # initiation is accepted; delivery fails
+    await poll(lambda: leader.state == State.LEADER, timeout_s=3,
+               what="watchdog restored leadership")
+    st = await c.apply_ok(leader, b"b")
+    assert st.is_ok(), str(st)
+    await c.stop_all()
+
+
+async def test_transfer_rejected_while_conf_change_in_flight():
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    ghost = PeerId.parse("127.0.0.1:5009")
+    task = asyncio.ensure_future(leader.add_peer(ghost))  # stuck catching up
+    await poll(lambda: leader._conf_ctx is not None,
+               what="change entered catch-up")
+    target = next(p for p in c.peers if p != leader.server_id)
+    st = await leader.transfer_leadership_to(target)
+    assert st.raft_error == RaftError.EBUSY, str(st)
+    assert leader.state == State.LEADER
+    st = await task
+    assert st.raft_error == RaftError.ECATCHUP
+    await c.stop_all()
+
+
+async def test_stale_transfer_watchdog_cannot_end_a_newer_transfer():
+    """A watchdog armed for an EARLIER transfer (the leader was deposed,
+    re-elected, and started a new transfer while it slept) must not flip
+    TRANSFERRING back to LEADER under the newer transfer — that would
+    re-open change_peers while the new target's TimeoutNow is armed."""
+    c = TestCluster(3, election_timeout_ms=250)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    peers = [p for p in c.peers if p != leader.server_id]
+    target = peers[0]
+    # hold the target's match below the transfer index so TRANSFERRING
+    # persists long enough to observe
+    c.net.partition({target.endpoint},
+                    {p.endpoint for p in c.peers if p != target})
+    st = await c.apply_ok(leader, b"x")
+    assert st.is_ok()
+    st = await leader.transfer_leadership_to(target)
+    assert st.is_ok(), str(st)
+    assert leader.state == State.TRANSFERRING
+    # a watchdog pinned to a PREVIOUS term is a no-op...
+    await leader._transfer_watchdog(target, leader.current_term - 1)
+    assert leader.state == State.TRANSFERRING, \
+        "stale watchdog ended a transfer it did not start"
+    # ...while the real one (armed by transfer_leadership_to) recovers
+    await poll(lambda: leader.state == State.LEADER, timeout_s=3,
+               what="current-term watchdog restored leadership")
+    c.net.heal()
+    await c.stop_all()
+
+
+async def test_change_peers_rejected_while_transferring_then_recovers():
+    """change_peers racing a transfer gets a clean EBUSY (not a half-run
+    change under a TRANSFERRING leader); after the watchdog restores
+    leadership the same change succeeds."""
+    c = TestCluster(3, election_timeout_ms=300)
+    await c.start_all()
+    leader = await c.wait_leader(10)
+    peers = [p for p in c.peers if p != leader.server_id]
+    target, third = peers[0], peers[1]
+    # hold the target's match below the transfer index so TRANSFERRING
+    # persists until the watchdog fires
+    c.net.partition({target.endpoint},
+                    {p.endpoint for p in c.peers if p != target})
+    st = await c.apply_ok(leader, b"x")
+    assert st.is_ok()
+    st = await leader.transfer_leadership_to(target)
+    assert st.is_ok(), str(st)
+    assert leader.state == State.TRANSFERRING
+    st = await leader.change_peers(
+        Configuration([leader.server_id, third]))
+    assert st.raft_error == RaftError.EBUSY, str(st)
+    # the partition holds, so the transfer cannot complete — only the
+    # watchdog can end TRANSFERRING
+    await poll(lambda: leader.state == State.LEADER, timeout_s=3,
+               what="watchdog restored leadership")
+    c.net.heal()
+    st = await asyncio.wait_for(
+        leader.change_peers(Configuration([leader.server_id, third])), 15)
+    assert st.is_ok(), str(st)
+    assert set(leader.list_peers()) == {leader.server_id, third}
+    await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos drive: churn + kills + partitions, invariants after every step
+# ---------------------------------------------------------------------------
+
+
+async def test_seeded_membership_chaos_drive(tmp_path):
+    """A compressed in-pytest version of the soak's --churn drive:
+    12 seeded rounds of membership ops with kills and one-way partitions
+    interleaved, client writes throughout; afterwards every node's
+    committed-configuration sequence must be a legal joint-consensus
+    chain (oracle.check_conf_sequence) and all live nodes must converge
+    on one stable conf."""
+    rng = random.Random(11)
+    c = TestCluster(5, tmp_path=str(tmp_path), election_timeout_ms=200)
+    c.conf = Configuration(list(c.peers[:3]))  # 2 standbys for churn
+    await c.start_all()
+
+    sequences: list[list] = []
+
+    def record(node):
+        seq: list = []
+        sequences.append(seq)
+        orig = node.fsm_caller.on_configuration_applied
+
+        async def wrapped(entry):
+            seq.append((tuple(entry.peers or ()),
+                        tuple(entry.old_peers or ())))
+            await orig(entry)
+
+        node.fsm_caller.on_configuration_applied = wrapped
+
+    for n in c.nodes.values():
+        record(n)
+
+    async def change(op_coro):
+        """Drive one membership op with bounded EBUSY retry."""
+        for _ in range(20):
+            try:
+                st = await asyncio.wait_for(op_coro(), 15)
+            except (TimeoutError, asyncio.TimeoutError):
+                return None
+            if st.is_ok():
+                return st
+            if st.raft_error != RaftError.EBUSY:
+                return st
+            await asyncio.sleep(0.1)
+        return st
+
+    completed = 0
+    for rnd in range(12):
+        leader = await c.wait_leader(10)
+        for k in range(3):
+            await c.apply_ok(leader, b"r%d-%d" % (rnd, k), timeout_s=10)
+        leader = await c.wait_leader(10)
+        voters = list(leader.conf_entry.conf.peers)
+        spare = [p for p in c.peers if p not in voters]
+        menu = []
+        if spare and len(voters) < 4:
+            menu.append("add")
+        if len(voters) > 2:
+            menu.append("remove")
+        op = rng.choice(menu)
+        if op == "add":
+            pick = rng.choice(spare)
+            st = await change(lambda: leader.add_peer(pick))
+        else:
+            pick = rng.choice(voters)
+            st = await change(lambda: leader.remove_peer(pick))
+        if st is not None and st.is_ok():
+            completed += 1
+        # interleaved faults: kill+restart a random node, or a one-way
+        # partition healed next round
+        if rnd % 3 == 2:
+            victim = rng.choice(c.peers)
+            if victim in c.nodes:
+                await c.stop(victim)
+                record(await c.start(victim))
+        elif rnd % 3 == 0:
+            a, b = rng.sample([p.endpoint for p in c.peers], 2)
+            c.net.partition_one_way({a}, {b})
+        else:
+            c.net.heal()
+    c.net.heal()
+
+    assert completed >= 3, f"only {completed} conf changes completed"
+    leader = await c.wait_leader(10)
+    await poll(lambda: leader.conf_entry.old_conf.is_empty(),
+               timeout_s=15, what="final change settled")
+    final = set(leader.conf_entry.conf.peers)
+    # every voter of the final conf converges to it
+    await poll(lambda: all(
+        set(c.nodes[p].conf_entry.conf.peers) == final
+        and c.nodes[p].conf_entry.old_conf.is_empty()
+        for p in final if p in c.nodes),
+        timeout_s=15, what="voters converged on the final conf")
+    st = await c.apply_ok(leader, b"final")
+    assert st.is_ok(), str(st)
+    # the committed conf sequence every node observed is a legal chain
+    checked = 0
+    for seq in sequences:
+        if seq:
+            check_conf_sequence(seq)
+            checked += 1
+    assert checked >= 3, "too few conf sequences recorded to mean anything"
+    await c.stop_all()

@@ -519,6 +519,31 @@ class TestRaftMetaStorage:
             m2 = RaftMetaStorage(str(tmp_path))
             m2.init()
 
+    def test_stale_instance_cannot_regress_term_or_vote(self, tmp_path):
+        """A store restart creates a new storage over the same dir while
+        the old node's last save may still be in flight on an executor
+        thread: a late stale save must neither regress the durable term
+        nor switch/forget a vote within a term (double-vote after the
+        next crash)."""
+        a, b = PeerId.parse("1.1.1.1:1"), PeerId.parse("1.1.1.1:2")
+        stale = RaftMetaStorage(str(tmp_path))
+        stale.init()
+        fresh = RaftMetaStorage(str(tmp_path))  # the restarted node
+        fresh.init()
+        fresh.set_term_and_voted_for(5, a)
+        stale.set_term_and_voted_for(3, b)     # late lower-term save
+        m = RaftMetaStorage(str(tmp_path))
+        m.init()
+        assert (m.term, m.voted_for) == (5, a), "stale save regressed term"
+        stale.set_term_and_voted_for(5, b)     # same-term vote SWITCH
+        m = RaftMetaStorage(str(tmp_path))
+        m.init()
+        assert (m.term, m.voted_for) == (5, a), "same-term vote switched"
+        fresh.set_term_and_voted_for(6, b)     # higher term always wins
+        m = RaftMetaStorage(str(tmp_path))
+        m.init()
+        assert (m.term, m.voted_for) == (6, b)
+
 
 class TestMultiMetaStorage:
     """Shared {term, votedFor} journal with group-commit fsync

@@ -76,8 +76,17 @@ class BallotBox:
         return True
 
     def update_conf(self, conf: Configuration, old_conf: Configuration) -> None:
-        """SPI hook: the scalar box reads conf per commit_at call; the
-        engine-backed TpuBallotBox maintains device voter masks here."""
+        """Membership changed: drop match rows for peers no longer in any
+        voter/learner set.  Load-bearing for churn: a voter that is
+        removed, wiped, and later re-added must re-earn its matchIndex
+        from zero — its stale pre-removal row counting toward the quorum
+        order statistic would commit entries the reborn peer never
+        stored, breaking quorum intersection.  (The engine-backed
+        TpuBallotBox maintains device voter masks in its override.)"""
+        members = set(conf.peers) | set(old_conf.peers) \
+            | set(conf.learners) | set(old_conf.learners)
+        for peer in [p for p in self._match if p not in members]:
+            del self._match[peer]
 
     def close(self) -> None:
         """SPI hook: release engine resources (no-op for the scalar box)."""

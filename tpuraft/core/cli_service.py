@@ -394,11 +394,15 @@ class CliService:
     async def _leader_op(self, group_id: str, conf: Configuration,
                          method: str, make_req) -> Status:
         last = Status.error(RaftError.EAGAIN, "no attempt")
-        for attempt in range(self._opts.max_retry):
+        attempt = 0
+        busy_left = self._opts.busy_max_retry
+        busy_backoff_ms = self._opts.busy_backoff_ms
+        while attempt < self._opts.max_retry:
             try:
                 leader = await self._require_leader(group_id, conf)
             except RpcError as e:
                 last = e.status
+                attempt += 1
                 await asyncio.sleep(self._opts.retry_interval_ms / 1000.0)
                 continue
             try:
@@ -407,6 +411,7 @@ class CliService:
                     self._opts.timeout_ms)
             except RpcError as e:
                 last = e.status
+                attempt += 1
                 self._leaders.pop(group_id, None)
                 await asyncio.sleep(self._opts.retry_interval_ms / 1000.0)
                 continue
@@ -414,8 +419,47 @@ class CliService:
                 return Status.OK()
             last = Status(resp.code, resp.msg)
             if resp.code == int(RaftError.EPERM):  # stale leader; refresh
+                attempt += 1
                 self._leaders.pop(group_id, None)
                 await asyncio.sleep(self._opts.retry_interval_ms / 1000.0)
                 continue
-            return last
+            if resp.code == int(RaftError.EBUSY):
+                # another change in flight: transient by contract —
+                # bounded exponential backoff, leader cache KEPT (busy
+                # does not mean wrong leader)
+                if busy_left <= 0:
+                    return Status(
+                        int(RaftError.EBUSY),
+                        f"still busy after {self._opts.busy_max_retry} "
+                        f"retries: {resp.msg}")
+                busy_left -= 1
+                await asyncio.sleep(busy_backoff_ms / 1000.0)
+                busy_backoff_ms = min(busy_backoff_ms * 2,
+                                      self._opts.busy_backoff_max_ms)
+                continue
+            return last  # definite rejection (EINVAL, ECATCHUP, ...)
         return last
+
+
+def describe_status(st: Status) -> str:
+    """Operator-facing classification of an admin-op status: makes
+    'busy, retry later' distinguishable from 'your conf is wrong' at a
+    glance (and by exit-code policy in examples/admin.py)."""
+    if st.is_ok():
+        return "OK"
+    code = st.raft_error
+    if code == RaftError.EBUSY:
+        kind = "busy (transient — another membership change or a " \
+               "leadership transfer is in flight; retry later)"
+    elif code == RaftError.EINVAL:
+        kind = "invalid request (check the configuration argument)"
+    elif code == RaftError.ECATCHUP:
+        kind = "new peers failed to catch up (are they running and " \
+               "reachable?)"
+    elif code == RaftError.EPERM:
+        kind = "not leader (leadership moved; rediscover and retry)"
+    elif code == RaftError.EAGAIN:
+        kind = "no leader found (cluster electing or unreachable)"
+    else:
+        kind = "failed"
+    return f"error[{code.name if code else st.code}]: {kind}: {st.error_msg}"

@@ -255,6 +255,10 @@ class Node:
         # election no-op); reads are unsafe until it commits
         self._term_first_index: int = 0
         self._conf_ctx: Optional["_ConfigurationCtx"] = None
+        # chaos-harness hook: called as listener(node, stage) on every
+        # _ConfigurationCtx stage transition (catching_up/joint/stable/
+        # aborted) — lets a nemesis land a seeded crash mid-stage
+        self.conf_stage_listener: Optional[Callable[["Node", str], None]] = None
         self._transfer_deadline: float = 0.0
         self._shutdown_event = asyncio.Event()
         self._wakeup_candidate: Optional[PeerId] = None
@@ -404,6 +408,12 @@ class Node:
                 return
             prev_state = self.state
             self.state = State.SHUTTING
+            if self._conf_ctx is not None:
+                # an in-flight membership change must not wedge its
+                # waiter (the admin RPC / nemesis driver) forever
+                self._conf_ctx.fail(Status.error(
+                    RaftError.ENODESHUTTING, "node is shutting down"))
+                self._conf_ctx = None
             if self._ctrl is not None:
                 self._ctrl.shutdown()
             if self._snapshot_timer:
@@ -570,6 +580,13 @@ class Node:
                 return Status.error(RaftError.EPERM, "not leader")
             if peer == self.server_id:
                 return Status.OK()  # already the leader
+            if self._conf_ctx is not None:
+                # a transfer mid-change would hand the (possibly joint)
+                # conf to a leader with no ctx driving it to completion;
+                # the change resumes it, but racing the two on purpose is
+                # an operator error (reference: NodeImpl refuses too)
+                return Status.error(RaftError.EBUSY,
+                                    "membership change in progress")
             if not self.conf_entry.conf.contains(peer):
                 return Status.error(RaftError.EINVAL, f"{peer} not in conf")
             r = self.replicators.get(peer)
@@ -581,15 +598,25 @@ class Node:
             r.transfer_leadership(self.log_manager.last_log_index())
             r.wake()
             LOG.info("%s transferring leadership to %s", self, peer)
-            asyncio.ensure_future(self._transfer_watchdog())
+            asyncio.ensure_future(
+                self._transfer_watchdog(peer, self.current_term))
             return Status.OK()
 
-    async def _transfer_watchdog(self) -> None:
+    async def _transfer_watchdog(self, peer: PeerId, term: int) -> None:
         await asyncio.sleep(self.options.election_timeout_ms / 1000.0)
         async with self._lock:
-            if self.state == State.TRANSFERRING:
+            # the term pins the watchdog to ITS transfer: deposed and
+            # re-elected within the sleep, a new transfer may be in
+            # flight — a stale watchdog resuming LEADER for it would arm
+            # change_peers while the new target's TimeoutNow is pending
+            if self.state == State.TRANSFERRING and self.current_term == term:
                 LOG.info("%s leadership transfer timed out; resuming", self)
                 self.state = State.LEADER
+                # cancel the pending TimeoutNow trigger: the target
+                # catching up later must not depose the resumed leader
+                r = self.replicators.get(peer)
+                if r is not None:
+                    r.stop_transfer_leadership()
 
     # ======================================================================
     # apply-side commit plumbing
@@ -602,8 +629,15 @@ class Node:
     def on_match_advanced(self, peer: PeerId, match_index: int) -> None:
         if not self.is_leader():
             return
-        self.ballot_box.commit_at(
-            peer, match_index, self.conf_entry.conf, self.conf_entry.old_conf)
+        e = self.conf_entry
+        if not (e.contains(peer) or peer in e.conf.learners
+                or peer in e.old_conf.learners):
+            # a RETIRING replicator (removed peer still being shipped its
+            # removal entry) must not repopulate the ballot row that
+            # update_conf just pruned — a later wipe+re-add of the same
+            # peer would inherit the stale row and commit on a phantom ack
+            return
+        self.ballot_box.commit_at(peer, match_index, e.conf, e.old_conf)
 
     def on_peer_ack(self, peer: PeerId, when: float) -> None:
         self._ctrl.record_ack(peer, when)
@@ -621,6 +655,15 @@ class Node:
         return (time.monotonic() - self._last_leader_timestamp
                 < self.options.election_timeout_ms
                 * self.options.raft_options.leader_lease_time_ratio / 1000.0)
+
+    def _believes_leader_alive(self) -> bool:
+        """Is there, from THIS node's view, a live leader right now?  On
+        a follower that is the leader-contact lease; on the leader
+        itself it is its own quorum-ack lease (the follower-side
+        timestamp is not refreshed while leading)."""
+        if self.is_leader():
+            return self._ctrl.lease_valid()
+        return not self.leader_id.is_empty() and self._leader_lease_valid()
 
     # -- priority election [1.3+] ------------------------------------------
 
@@ -871,6 +914,18 @@ class Node:
         # (reference: ReadOnlyServiceImpl's ERAFTTIMEDOUT until the
         # leader commits in its current term).
         self._term_first_index = last_id.index
+        if not self.conf_entry.old_conf.is_empty():
+            # elected while a joint configuration is in flight (the old
+            # leader died mid-change): adopt the change and drive it to
+            # completion — without this, the conf entry just committed
+            # above finds no ctx to advance and the group is wedged in
+            # joint forever (reference: ConfigurationCtx#flush at
+            # becomeLeader)
+            self._conf_ctx = _ConfigurationCtx.resume_joint(
+                self, self.conf_entry.old_conf.copy(),
+                self.conf_entry.conf.copy(), joint_index=last_id.index)
+            LOG.info("%s resuming joint membership change %s -> %s", self,
+                     self.conf_entry.old_conf, self.conf_entry.conf)
         self.replicators.wake_all()
         self.fsm_caller.on_leader_start(term)
         asyncio.ensure_future(self._flush_and_self_commit(term, last_id.index))
@@ -957,6 +1012,16 @@ class Node:
             # real vote
             if req.term < self.current_term:
                 return RequestVoteResponse(term=self.current_term, granted=False)
+            if (not self.conf_entry.contains(candidate)
+                    and self._believes_leader_alive()):
+                # removed-server disruption guard (Raft §4.2.3): a voter
+                # removed from the conf may keep timing out and soliciting
+                # votes with ever-higher terms; while we have a live
+                # leader, a non-member's request must not depose it (the
+                # term bump in _step_down below is exactly the storm).
+                # Without a live leader the request is processed normally
+                # — a behind-the-conf node must not block recovery.
+                return RequestVoteResponse(term=self.current_term, granted=False)
             if req.term > self.current_term:
                 await self._step_down(req.term, Status.error(
                     RaftError.EHIGHERTERMREQUEST,
@@ -977,6 +1042,16 @@ class Node:
         """Pre-vote grant: candidate's log >= ours, req.term >= ours, and we
         haven't heard from a live leader within the lease."""
         if req.term < self.current_term:
+            return RequestVoteResponse(term=self.current_term, granted=False)
+        if (not self.conf_entry.contains(candidate)
+                and self._believes_leader_alive()):
+            # removed-server noise (reference: NodeImpl#handlePreVoteRequest
+            # membership check) — but ONLY while a live leader exists,
+            # mirroring the real-vote guard below: with no leader, a
+            # node whose conf is STALE (the entry adding the candidate
+            # hasn't reached it yet) must still let the candidate
+            # through pre-vote, or a {A,B,D} group where only B lags at
+            # {A,B,C} can never elect D after A dies
             return RequestVoteResponse(term=self.current_term, granted=False)
         if not self.leader_id.is_empty() and self._leader_lease_valid():
             return RequestVoteResponse(term=self.current_term, granted=False)
@@ -1101,10 +1176,29 @@ class Node:
 
     def _refresh_conf_from_log(self) -> None:
         last = self.log_manager.conf_manager.last()
-        if not last.conf.is_empty() and last.id.index > self.conf_entry.id.index:
-            self.conf_entry = last
-            self.ballot_box.update_conf(last.conf, last.old_conf)
-            self._refresh_target_priority()
+        if last.conf.is_empty():
+            # no conf anywhere in log/snapshot: if ours came from a log
+            # entry that a conflict truncation just removed, roll back to
+            # the boot conf instead of keeping a phantom membership
+            if self.conf_entry.id.index > self.log_manager.last_log_index():
+                self._apply_conf_entry(ConfigurationEntry(
+                    LogId(0, 0), self.options.initial_conf.copy()))
+            return
+        if (last.id.index == self.conf_entry.id.index
+                and last.id.term == self.conf_entry.id.term):
+            return
+        # forward: a newer conf entry was appended.  BACKWARD: the entry
+        # our conf came from was truncated away (new-leader conflict
+        # resolution) — the membership must follow the log both ways, or
+        # a follower keeps voting under a conf that no longer exists.
+        # SAME INDEX, different term: conflict resolution REPLACED our
+        # conf entry with another leader's — adopt the replacement.
+        self._apply_conf_entry(last)
+
+    def _apply_conf_entry(self, entry: ConfigurationEntry) -> None:
+        self.conf_entry = entry
+        self.ballot_box.update_conf(entry.conf, entry.old_conf)
+        self._refresh_target_priority()
 
     async def handle_timeout_now(self, req: TimeoutNowRequest
                                  ) -> TimeoutNowResponse:
@@ -1180,10 +1274,16 @@ class Node:
     async def change_peers(self, new_conf: Configuration) -> Status:
         """Arbitrary configuration change via joint consensus."""
         async with self._lock:
+            if self.state == State.TRANSFERRING:
+                return Status.error(RaftError.EBUSY,
+                                    "leadership transferring; retry")
             if self.state != State.LEADER:
                 return Status.error(RaftError.EPERM, "not leader")
             if self._conf_ctx is not None:
-                return Status.error(RaftError.EBUSY, "another change in progress")
+                return Status.error(
+                    RaftError.EBUSY,
+                    f"another membership change in progress "
+                    f"(stage={self._conf_ctx.stage}); retry")
             if not new_conf.is_valid():
                 return Status.error(RaftError.EINVAL, f"invalid conf {new_conf}")
             if new_conf == self.conf_entry.conf:
@@ -1196,7 +1296,30 @@ class Node:
         finally:
             async with self._lock:
                 if self._conf_ctx is ctx:
-                    self._conf_ctx = None
+                    if ctx.stage in ("none", "catching_up"):
+                        # caller CANCELLED (operator timeout) before any
+                        # entry was appended: abort cleanly — detaching a
+                        # live ctx would let a slow catch-up later append
+                        # a joint entry nothing drives, while a second
+                        # change starts concurrently
+                        ctx.fail(Status.error(
+                            RaftError.ECANCELED, "change_peers caller gone"))
+                        # tear down the replicators provisioned for the
+                        # catch-up peers (mirrors the ECATCHUP abort):
+                        # a leaked one would keep shipping to a
+                        # non-member, and — worse — a retry of the same
+                        # change would reuse its stale match_index and
+                        # pass catch-up instantly even if the peer was
+                        # wiped meanwhile.  Safe here ONLY because
+                        # _conf_ctx is still ctx under the lock: no
+                        # concurrent change can own these peers yet.
+                        ctx._teardown_added_replicators()
+                        self._conf_ctx = None
+                    elif ctx.stage in ("done", "aborted"):
+                        self._conf_ctx = None
+                    # joint/stable with the caller gone: the entries are
+                    # in the log — leave the ctx attached to drive the
+                    # change to completion; _finish clears the slot
 
     async def reset_peers(self, new_conf: Configuration) -> Status:
         """Unsafe manual override when quorum is permanently lost
@@ -1273,6 +1396,14 @@ class _ConfigurationCtx:
     """Membership-change state machine: CATCHING_UP -> JOINT -> STABLE.
 
     Reference: NodeImpl's inner ConfigurationCtx (SURVEY.md §3.1/§4.3).
+
+    Termination discipline (chaos-hardened): every exit path —
+    completion, catch-up timeout, step-down, shutdown — moves ``stage``
+    to a terminal value ("stable" or "aborted") and resolves ``_done``
+    exactly once.  ``fail()`` marking the stage terminal is load-bearing:
+    a catch-up waiter resolving True *concurrently* with a step-down
+    would otherwise re-enter ``_enter_joint`` on a node that is no
+    longer leader and append a joint entry to a FOLLOWER's log.
     """
 
     def __init__(self, node: Node, old_conf: Configuration,
@@ -1284,6 +1415,30 @@ class _ConfigurationCtx:
         self._done: asyncio.Future = asyncio.get_running_loop().create_future()
         self._joint_index = 0
         self._stable_index = 0
+        self._added: list[PeerId] = []
+
+    @classmethod
+    def resume_joint(cls, node: Node, old_conf: Configuration,
+                     new_conf: Configuration,
+                     joint_index: int) -> "_ConfigurationCtx":
+        """A freshly elected leader found a joint conf in its log: build
+        a ctx already in the joint stage, keyed to the conf entry the
+        leader just staged for its own term, so the commit of that entry
+        advances the change to stable instead of wedging the group in
+        joint forever (reference: ConfigurationCtx#flush)."""
+        ctx = cls(node, old_conf, new_conf)
+        ctx._set_stage("joint")
+        ctx._joint_index = joint_index
+        return ctx
+
+    def _set_stage(self, stage: str) -> None:
+        self.stage = stage
+        listener = self._node.conf_stage_listener
+        if listener is not None:
+            try:
+                listener(self._node, stage)
+            except Exception:
+                LOG.exception("conf stage listener failed at %s", stage)
 
     async def start(self) -> None:
         """Called under node lock."""
@@ -1296,7 +1451,8 @@ class _ConfigurationCtx:
         if not added:
             await self._enter_joint()
             return
-        self.stage = "catching_up"
+        self._set_stage("catching_up")
+        self._added = list(added)
         waiters = []
         for peer in added:
             r = node.replicators.add(peer)  # replicate as learner during catch-up
@@ -1310,8 +1466,12 @@ class _ConfigurationCtx:
         node = self._node
         async with node._lock:
             if self.stage != "catching_up":
-                return
+                return  # aborted (step-down/shutdown) while we gathered
             if not all(r is True for r in results):
+                # clean abort: tear down the replicators provisioned for
+                # the peers that never caught up, so the next change
+                # starts from scratch instead of inheriting stuck state
+                self._teardown_added_replicators()
                 self.fail(Status.error(RaftError.ECATCHUP,
                                        "new peers failed to catch up"))
                 if node._conf_ctx is self:
@@ -1319,10 +1479,20 @@ class _ConfigurationCtx:
                 return
             await self._enter_joint()
 
+    def _teardown_added_replicators(self) -> None:
+        """Remove replicators added for catch-up peers that are not part
+        of the committed configuration (under node lock)."""
+        node = self._node
+        for peer in self._added:
+            if (not node.conf_entry.contains(peer)
+                    and peer not in node.conf_entry.conf.learners
+                    and peer not in node.conf_entry.old_conf.learners):
+                node.replicators.remove(peer)
+
     async def _enter_joint(self) -> None:
         """Append the joint-consensus CONFIGURATION entry (under lock)."""
         node = self._node
-        self.stage = "joint"
+        self._set_stage("joint")
         in_joint = self.old_conf.peers != self.new_conf.peers
         entry = LogEntry(
             type=EntryType.CONFIGURATION,
@@ -1352,7 +1522,7 @@ class _ConfigurationCtx:
         if self.stage == "joint" and entry.id.index == self._joint_index:
             if entry.old_peers:
                 # leave joint: append the stable (new-conf-only) entry
-                self.stage = "stable"
+                self._set_stage("stable")
                 stable = LogEntry(
                     type=EntryType.CONFIGURATION,
                     peers=list(self.new_conf.peers),
@@ -1376,19 +1546,34 @@ class _ConfigurationCtx:
 
     async def _finish(self) -> None:
         node = self._node
-        # drop replicators for peers no longer in conf
+        self._set_stage("done")
+        # retire replicators for peers no longer in conf: keep shipping
+        # until the removed peer has RECEIVED the conf entry that removes
+        # it (so it learns its removal and stops starting elections
+        # against the survivors), then stop — bounded by a timeout for
+        # peers that are dead or partitioned away
+        final_index = self._stable_index or self._joint_index
         for peer in list(node.replicators.peers()):
             if not node.conf_entry.contains(peer) and \
                     peer not in node.conf_entry.conf.learners:
-                node.replicators.remove(peer)
+                node.replicators.retire(
+                    peer, final_index,
+                    node.options.election_timeout_ms * 4 / 1000.0)
         if not self._done.done():
             self._done.set_result(Status.OK())
+        # clear the slot HERE, not only in change_peers' finally: a
+        # resumed ctx (joint adopted at election) has no change_peers
+        # caller, and a dangling ctx means EBUSY forever
+        if node._conf_ctx is self:
+            node._conf_ctx = None
         # leader removed itself: step down
         if not node.conf_entry.conf.contains(node.server_id):
             await node._step_down(node.current_term, Status.error(
                 RaftError.ELEADERREMOVED, "leader removed from configuration"))
 
     def fail(self, status: Status) -> None:
+        if self.stage not in ("done", "aborted"):
+            self._set_stage("aborted")
         if not self._done.done():
             self._done.set_result(status)
 

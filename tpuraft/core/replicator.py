@@ -66,6 +66,10 @@ class Replicator:
         # every AppendEntries response (probe/ack/beat); drives AUTO
         # coalescing (RaftOptions.coalesce_heartbeats=None)
         self.peer_multi_hb = False
+        # set while this replicator lingers for a REMOVED peer (it keeps
+        # shipping until the peer has the conf entry removing it, or a
+        # timeout) — cleared if the peer is re-added meanwhile
+        self.retiring = False
         self._transfer_target_index: Optional[int] = None
         self._catchup_waiters: list[tuple[int, asyncio.Future]] = []
         self.inflight_peak = 0  # high-water mark of the batch window
@@ -450,11 +454,11 @@ class Replicator:
 
     # -- catch-up (membership change) ----------------------------------------
 
-    def wait_caught_up(self, margin: int, timeout_s: float) -> asyncio.Future:
-        """Resolves True when match_index is within ``margin`` of the log
-        tail (reference: Replicator#waitForCaughtUp driving CATCHING_UP)."""
-        fut = asyncio.get_running_loop().create_future()
-        target = max(1, self._node.log_manager.last_log_index() - margin)
+    def wait_matched(self, target: int, timeout_s: float) -> asyncio.Future:
+        """Resolves True when match_index reaches ``target``, False on
+        timeout or replicator stop."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
         if self.match_index >= target:
             fut.set_result(True)
             return fut
@@ -464,8 +468,15 @@ class Replicator:
             if not fut.done():
                 fut.set_result(False)
 
-        asyncio.get_running_loop().call_later(timeout_s, _timeout)
+        handle = loop.call_later(timeout_s, _timeout)
+        fut.add_done_callback(lambda _f: handle.cancel())
         return fut
+
+    def wait_caught_up(self, margin: int, timeout_s: float) -> asyncio.Future:
+        """Resolves True when match_index is within ``margin`` of the log
+        tail (reference: Replicator#waitForCaughtUp driving CATCHING_UP)."""
+        target = max(1, self._node.log_manager.last_log_index() - margin)
+        return self.wait_matched(target, timeout_s)
 
     def _check_catchup(self) -> None:
         rest = []
@@ -488,6 +499,14 @@ class Replicator:
             t.add_done_callback(_consume)
         else:
             self.wake()
+
+    def stop_transfer_leadership(self) -> None:
+        """Cancel a pending TimeoutNow trigger (reference:
+        Replicator#stopTransferLeadership).  Called when the transfer
+        watchdog resumes leadership: without this, a partitioned target
+        catching up MUCH later would still receive TimeoutNow and depose
+        a leader that long since moved on."""
+        self._transfer_target_index = None
 
     async def _maybe_timeout_now(self) -> None:
         if (self._transfer_target_index is not None
@@ -537,8 +556,16 @@ class ReplicatorGroup:
         self._replicators: dict[PeerId, Replicator] = {}
 
     def add(self, peer: PeerId) -> Replicator:
-        if peer in self._replicators:
-            return self._replicators[peer]
+        r = self._replicators.get(peer)
+        if r is not None:
+            if not r.retiring:
+                return r
+            # re-added while lingering for its REMOVAL: the old
+            # replicator's match_index may predate a storage wipe —
+            # start fresh so the peer re-earns its match from a probe
+            # instead of instantly "passing" catch-up with a stale high
+            # watermark
+            self.remove(peer)
         r = Replicator(self._node, peer)
         self._replicators[peer] = r
         r.start()
@@ -548,6 +575,29 @@ class ReplicatorGroup:
         r = self._replicators.pop(peer, None)
         if r:
             r.stop()
+
+    def retire(self, peer: PeerId, min_match_index: int,
+               timeout_s: float) -> None:
+        """Linger a REMOVED peer's replicator until the peer has received
+        the log through ``min_match_index`` (the conf entry that removed
+        it — so it steps out instead of starting disruptive elections),
+        then stop it.  Bounded by ``timeout_s`` for dead/partitioned
+        peers.  A concurrent re-add (membership flap) cancels the
+        retirement; a step-down's stop_all wins over it."""
+        r = self._replicators.get(peer)
+        if r is None:
+            return
+        r.retiring = True
+        if r.match_index >= min_match_index:
+            self.remove(peer)
+            return
+        fut = r.wait_matched(min_match_index, timeout_s)
+
+        def _done(_f):
+            if r.retiring and self._replicators.get(peer) is r:
+                self.remove(peer)
+
+        fut.add_done_callback(_done)
 
     def get(self, peer: PeerId) -> Optional[Replicator]:
         return self._replicators.get(peer)

@@ -147,6 +147,13 @@ class CliOptions:
     timeout_ms: int = 3000
     max_retry: int = 3
     retry_interval_ms: int = 100
+    # EBUSY ("another membership change in flight") gets its own bounded
+    # exponential backoff budget: busy is transient-by-contract, unlike a
+    # leader redirect, so it neither consumes max_retry nor drops the
+    # cached leader
+    busy_max_retry: int = 8
+    busy_backoff_ms: int = 200
+    busy_backoff_max_ms: int = 2000
 
 
 @dataclass

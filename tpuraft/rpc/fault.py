@@ -8,6 +8,12 @@ chaos and adversarial drives run against production wire paths too.
 A dropped call raises EHOSTDOWN after a short delay, modeling a lost
 request the way the loopback does; the caller's retry/timeout machinery
 reacts identically either way.
+
+Beyond drop/delay/partition it injects the two other classic network
+faults: **duplication** (the request is delivered and EXECUTED twice at
+the receiver; the duplicate's response is discarded — receiver handlers
+must be idempotent) and **bounded reordering** (a frame is held for a
+random bounded interval so later frames overtake it).
 """
 
 from __future__ import annotations
@@ -27,6 +33,9 @@ class FaultInjectingTransport(TransportBase):
         self._rng = random.Random(seed)
         self.drop_rate = 0.0
         self.delay_ms = 0.0
+        self.duplicate_rate = 0.0
+        self.reorder_rate = 0.0
+        self.reorder_max_delay_ms = 10.0
         self._blocked_dsts: set[str] = set()
 
     # -- injection controls --------------------------------------------------
@@ -36,6 +45,18 @@ class FaultInjectingTransport(TransportBase):
 
     def set_delay_ms(self, ms: float) -> None:
         self.delay_ms = ms
+
+    def set_duplicate_rate(self, rate: float) -> None:
+        """Each call is delivered (and executed) twice with probability
+        ``rate``; the duplicate's response is discarded."""
+        self.duplicate_rate = rate
+
+    def set_reorder(self, rate: float, max_delay_ms: float = 10.0) -> None:
+        """With probability ``rate``, hold a frame for a seeded random
+        interval in (0, max_delay_ms] so later frames overtake it —
+        bounded reordering, never starvation."""
+        self.reorder_rate = rate
+        self.reorder_max_delay_ms = max_delay_ms
 
     def block(self, dst: str) -> None:
         """Partition: calls to dst fail (one-way, from this side)."""
@@ -51,6 +72,10 @@ class FaultInjectingTransport(TransportBase):
 
     async def call(self, dst: str, method: str, request: Any,
                    timeout_ms: Optional[float] = None) -> Any:
+        if self.reorder_rate > 0 and self._rng.random() < self.reorder_rate:
+            # hold THIS frame so later-submitted frames overtake it
+            await asyncio.sleep(
+                self._rng.uniform(0.0, self.reorder_max_delay_ms) / 1000.0)
         if self.delay_ms > 0:
             await asyncio.sleep(self.delay_ms / 1000.0)
         if dst in self._blocked_dsts or (
@@ -62,6 +87,14 @@ class FaultInjectingTransport(TransportBase):
             await asyncio.sleep(wait_ms / 1000.0)
             raise RpcError(Status.error(
                 RaftError.EHOSTDOWN, f"injected drop to {dst}"))
+        if self.duplicate_rate > 0 \
+                and self._rng.random() < self.duplicate_rate:
+            # the wire delivered the frame twice: the receiver executes
+            # both; we return the first response and drop the other's
+            dup = asyncio.ensure_future(
+                self._inner.call(dst, method, request, timeout_ms))
+            dup.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
         return await self._inner.call(dst, method, request, timeout_ms)
 
     async def close(self) -> None:

@@ -115,6 +115,9 @@ class InProcNetwork:
         self._down: set[str] = set()
         self.delay_ms: float = 0.0
         self.drop_rate: float = 0.0
+        self.duplicate_rate: float = 0.0
+        self.reorder_rate: float = 0.0
+        self.reorder_max_delay_ms: float = 10.0
         self._rng = random.Random(0)
 
     # -- server registry -----------------------------------------------------
@@ -161,10 +164,24 @@ class InProcNetwork:
     def set_drop_rate(self, rate: float) -> None:
         self.drop_rate = rate
 
+    def set_duplicate_rate(self, rate: float) -> None:
+        """Deliver (and execute) a frame twice with probability ``rate``;
+        the duplicate's response is discarded."""
+        self.duplicate_rate = rate
+
+    def set_reorder(self, rate: float, max_delay_ms: float = 10.0) -> None:
+        """Hold a frame for a seeded random bounded interval with
+        probability ``rate`` so later frames overtake it."""
+        self.reorder_rate = rate
+        self.reorder_max_delay_ms = max_delay_ms
+
     # -- the "wire" ----------------------------------------------------------
 
     async def call(self, src: str, dst: str, method: str, request: Any,
                    timeout_ms: float) -> Any:
+        if self.reorder_rate and self._rng.random() < self.reorder_rate:
+            await asyncio.sleep(
+                self._rng.uniform(0.0, self.reorder_max_delay_ms) / 1000.0)
         if self.delay_ms:
             await asyncio.sleep(self.delay_ms / 1000.0)
         if (
@@ -179,6 +196,12 @@ class InProcNetwork:
             raise RpcError(
                 Status.error(RaftError.EHOSTDOWN, f"{dst} unreachable from {src}"))
         server = self._servers[dst]
+        if self.duplicate_rate and self._rng.random() < self.duplicate_rate:
+            # the wire delivered the frame twice: the receiver executes
+            # both copies; the duplicate's response evaporates
+            dup = asyncio.ensure_future(asyncio.wait_for(
+                server.dispatch(method, request), timeout_ms / 1000.0))
+            dup.add_done_callback(lambda t: t.cancelled() or t.exception())
         try:
             return await asyncio.wait_for(
                 server.dispatch(method, request), timeout_ms / 1000.0)

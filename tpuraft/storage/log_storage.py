@@ -460,16 +460,20 @@ class FileLogStorage(LogStorage):
         # written this run, so the next scan treats it all as durable.
         # Everything at/above the synced frontier may be dirty (rolled
         # segments in a sync=False run included) — flush it all.
-        if self._segments:
+        # Under _lock: a snapshot compaction still running in an
+        # executor thread (truncate_prefix) mutates _segments, and the
+        # unguarded walk raced it into an IndexError mid-shutdown.
+        with self._lock:
+            if self._segments:
+                for s in self._segments:
+                    if s.first_index >= self._synced[0]:
+                        s.sync()
+                last = self._segments[-1]
+                self._synced = (last.first_index, last.size)
+                self._save_watermark()
             for s in self._segments:
-                if s.first_index >= self._synced[0]:
-                    s.sync()
-            last = self._segments[-1]
-            self._synced = (last.first_index, last.size)
-            self._save_watermark()
-        for s in self._segments:
-            s.close()
-        self._segments.clear()
+                s.close()
+            self._segments.clear()
 
     # -- durability watermark ------------------------------------------------
     # Persists the synced frontier (active_segment_first_index, size) —

@@ -52,6 +52,49 @@ class SkipFault(Exception):
     applicable (e.g. no leader to kill); the nemesis moves on."""
 
 
+class StageTrap:
+    """Membership-churn coordination: land a seeded crash INSIDE a
+    specific ``_ConfigurationCtx`` stage (catching_up / joint / stable).
+
+    Install :meth:`listener` as ``Node.conf_stage_listener`` on every
+    node; a nemesis action then ``arm()``s the trap for a target stage
+    and awaits :meth:`wait` — the moment any node's conf-change machine
+    enters that stage, the trap records the node and fires, and the
+    action kills the recorded node's store while the change is mid-stage.
+    One-shot per arm(); disarmed while no action is waiting so steady-
+    state churn costs nothing.
+    """
+
+    def __init__(self) -> None:
+        self._armed: Optional[str] = None
+        self._event = asyncio.Event()
+        self.node = None   # the Node whose ctx hit the armed stage
+
+    def listener(self, node, stage: str) -> None:
+        """Install as ``node.conf_stage_listener`` (sync, called under
+        the node lock — record and signal only)."""
+        if self._armed == stage and not self._event.is_set():
+            self.node = node
+            self._event.set()
+
+    def arm(self, stage: str) -> None:
+        self._armed = stage
+        self.node = None
+        self._event = asyncio.Event()
+
+    def disarm(self) -> None:
+        self._armed = None
+
+    async def wait(self, timeout_s: float) -> bool:
+        """True when the armed stage was entered within ``timeout_s``
+        (``self.node`` holds the node that entered it)."""
+        try:
+            await asyncio.wait_for(self._event.wait(), timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+
 async def run_nemesis(actions: list[NemesisAction], duration_s: float,
                       rng, pause_s: float = 0.3,
                       on_tick: Optional[Callable[[str], None]] = None
