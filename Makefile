@@ -10,6 +10,8 @@
 #   make chaos-smoke-> storage-plane crash-consistency harness + short
 #                      power-loss soak (<60s)
 #   make bench      -> the device-plane headline benchmark (one JSON line)
+#   make bench-gate -> short e2e bench; fails on >20% commits/s
+#                      regression vs the committed BENCH_E2E.json
 
 PY ?= python
 
@@ -35,9 +37,10 @@ soak:
 # (docs/operations.md "Crash-consistency testing" + "Elastic
 # membership runbook").
 chaos-smoke:
-	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py -q
+	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py tests/test_quiescence.py -q
 	$(PY) -m examples.soak --duration 20 --seed 1 --power-loss
 	$(PY) -m examples.soak --duration 20 --seed 3 --churn --power-loss
+	$(PY) -m examples.soak --duration 20 --seed 5 --regions 48 --engine --quiesce
 
 # The PRE-MERGE bar for consensus-path changes (VERDICT r2 weak #6):
 # the multi-minute chaos soaks are what actually catch protocol bugs
@@ -48,8 +51,18 @@ soak-long:
 	$(PY) -m examples.soak --duration 120 --seed 7
 	$(PY) -m examples.soak --duration 120 --seed 42
 
-check: san test soak
-	@echo "make check: native sanitizers + suite + soak all green"
+# Perf regression gate: a short bench_e2e.py run at the committed
+# BENCH_E2E.json's configuration fails if e2e commits/s regresses >20%
+# vs the committed same-shape calibration (extra.gate_commits_per_sec,
+# re-record with `python bench_gate.py --record`; falls back to the
+# full-run value).  A below-floor run retries best-of-3 before failing
+# so shared-host noise doesn't flap CI.  Threshold/duration/retries via
+# BENCH_GATE_THRESHOLD / BENCH_GATE_DURATION / BENCH_GATE_RETRIES env.
+bench-gate:
+	$(PY) bench_gate.py
+
+check: san test soak bench-gate
+	@echo "make check: native sanitizers + suite + soak + perf gate all green"
 	@echo "(consensus-path changes: also run make soak-long before merge;"
 	@echo " storage-path changes: also run make chaos-smoke)"
 
@@ -59,4 +72,4 @@ bench:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native san test soak chaos-smoke check bench clean
+.PHONY: all native san test soak chaos-smoke check bench bench-gate clean

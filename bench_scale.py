@@ -90,6 +90,7 @@ async def run_rung(args) -> dict:
                     f"multimeta://{args.dir}/store{i}/meta#{gid}"
                     if args.meta == "multimeta" else "memory://"),
                 enable_metrics=False)
+            opts.raft_options.quiesce_after_rounds = args.quiesce
             node = Node(gid, peers[i], opts, transports[i],
                         ballot_box_factory=factories[i])
             node.node_manager = managers[i]
@@ -142,6 +143,78 @@ async def run_rung(args) -> dict:
                   flush=True)
         await asyncio.sleep(0.5)
     elect_s = time.monotonic() - t_boot - boot_s
+
+    if args.idle_window > 0:
+        # -- idle beat-plane probe (ISSUE 4 acceptance): no write drive.
+        # Seed one committed write per group so every group is provably
+        # at a fully-matched tail, let quiescence (if enabled) take
+        # hold, then measure the beat plane's RPC rate over a quiet
+        # window from the hub + engine counters.
+        async def seed(node: Node) -> None:
+            fut = asyncio.get_running_loop().create_future()
+
+            def done_cb(st, fut=fut):
+                if not fut.done():
+                    fut.set_result(st)
+
+            await node.apply(Task(data=b"s", done=done_cb))
+            await asyncio.wait_for(fut, 60)
+
+        for k0 in range(0, len(led), 256):
+            await asyncio.gather(*(seed(n) for n in led[k0:k0 + 256]))
+        # settle: quiesce_after_rounds fully-acked beat rounds + the
+        # handshake round, at the (possibly floor-raised) beat interval
+        hb_s = max(float(e.hb_ms[e.has_ctrl].max()) for e in engines
+                   if e.has_ctrl.any()) / 1000.0
+        settle = min(120.0, (args.quiesce + 3) * hb_s + 2.0)
+        print(f"PROGRESS idle-probe settling {settle:.0f}s "
+              f"(hb={hb_s * 1000:.0f}ms)", flush=True)
+        await asyncio.sleep(settle)
+        hubs = [m.heartbeat_hub for m in managers]
+
+        def beat_counters():
+            return {
+                "rpcs": sum(h.rpcs_sent for h in hubs),
+                "beats": sum(h.beats_sent + h.fast_beats_sent
+                             for h in hubs),
+                "lease_rpcs": sum(h.lease_rpcs_sent for h in hubs),
+            }
+
+        c0 = beat_counters()
+        await asyncio.sleep(args.idle_window)
+        c1 = beat_counters()
+        w = args.idle_window
+        from tpuraft.ops.tick import ROLE_LEADER as _RL
+        res = {
+            "groups": G,
+            "replicas": R,
+            "leaders": len(led),
+            "quiesce_after_rounds": args.quiesce,
+            "idle_window_s": w,
+            "beat_rpcs_per_s": round((c1["rpcs"] - c0["rpcs"]) / w, 2),
+            "beats_per_s": round((c1["beats"] - c0["beats"]) / w, 2),
+            "lease_rpcs_per_s": round(
+                (c1["lease_rpcs"] - c0["lease_rpcs"]) / w, 2),
+            "idle_rpcs_per_s": round(
+                (c1["rpcs"] - c0["rpcs"]
+                 + c1["lease_rpcs"] - c0["lease_rpcs"]) / w, 2),
+            "quiescent_groups": sum(int(e.quiescent.sum())
+                                    for e in engines),
+            "quiescent_leaders": sum(
+                int((e.quiescent & (e.role == _RL)).sum())
+                for e in engines),
+            "groups_quiesced": sum(h.groups_quiesced for h in hubs),
+            "groups_woken": sum(h.groups_woken for h in hubs),
+            "lease_expiries": sum(h.lease_expiries for h in hubs),
+            "eto_floor_ms": max(e._floor_applied_ms for e in engines),
+            "eff_eto_ms": int(max(int(e.eto_ms[e.has_ctrl].max())
+                                  for e in engines if e.has_ctrl.any())),
+            "rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                / 1024, 1),
+        }
+        print("RESULT " + json.dumps(res), flush=True)
+        os._exit(0)
 
     ok = [0]
     errs = [0]
@@ -237,11 +310,69 @@ async def run_rung(args) -> dict:
         "batch": args.batch,
         "meta": args.meta,
         "engine_ticks": sum(e.ticks for e in engines),
+        # density-aware floors (ISSUE 4): the effective operating point
+        # the engine derived — no hand-tuned timeout in the command line
+        "eto_floor_ms": max(e._floor_applied_ms for e in engines),
+        "eff_eto_ms": int(max(int(e.eto_ms[e.has_ctrl].max())
+                              for e in engines if e.has_ctrl.any())),
+        "requested_eto_ms": args.election_timeout_ms,
     }
     print("RESULT " + json.dumps(res), flush=True)
     # skip graceful teardown of 3G nodes: the subprocess exits and the
     # measurement is done — teardown at 48K nodes costs minutes
     os._exit(0)
+
+
+def _run_idle_probe(args) -> None:
+    """A/B the idle beat plane at one (G, R): quiescence off vs on.
+    Acceptance: idle beat-plane RPC rate drops >= 10x with quiescence
+    (the hub's rpcs+lease counters are the measurement)."""
+    import tempfile
+
+    from tpuraft.storage.multilog import ensure_built
+
+    ensure_built()
+    g = int(args.rungs.split(",")[0])
+    window = args.duration if args.duration > 0 else 30.0
+    pair = {}
+    for label, quiesce in (("quiesce_off", 0),
+                           ("quiesce_on", args.quiesce or 8)):
+        workdir = tempfile.mkdtemp(prefix=f"tpuraft_idle_{g}_")
+        cmd = [sys.executable, os.path.join(REPO, "bench_scale.py"),
+               "--rung", "--groups", str(g), "--dir", workdir,
+               "--replicas", str(args.replicas),
+               "--elect-spread-s", str(args.elect_spread_s),
+               "--duration", "0", "--idle-window", str(window),
+               "--quiesce", str(quiesce), "--meta", args.meta,
+               "--election-timeout-ms", str(args.election_timeout_ms)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
+        row = None
+        for line in p.stdout:
+            line = line.decode().strip()
+            if line.startswith("RESULT "):
+                row = json.loads(line[len("RESULT "):])
+            elif line.startswith("PROGRESS"):
+                print(line, flush=True)
+        p.wait()
+        pair[label] = row or {"error": "rung produced no result"}
+        print(label, json.dumps(pair[label]), flush=True)
+        subprocess.run(["rm", "-rf", workdir])
+    off = pair.get("quiesce_off") or {}
+    on = pair.get("quiesce_on") or {}
+    if "idle_rpcs_per_s" in off and "idle_rpcs_per_s" in on:
+        denom = max(on["idle_rpcs_per_s"], 0.01)
+        pair["rpc_reduction_x"] = round(off["idle_rpcs_per_s"] / denom, 1)
+    path = os.path.join(REPO, args.json_out)
+    out = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            out = json.load(f)
+    out["idle_beat_plane"] = pair
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"idle_probe": "done",
+                      "rpc_reduction_x": pair.get("rpc_reduction_x")}))
 
 
 def main() -> None:
@@ -278,10 +409,27 @@ def main() -> None:
                          "journal — the durable-meta election-herd "
                          "measurement, VERDICT r4 #3)")
     ap.add_argument("--dir", default="")
+    ap.add_argument("--quiesce", type=int, default=0,
+                    help="RaftOptions.quiesce_after_rounds: >0 lets "
+                         "idle groups hibernate (store-level lease "
+                         "liveness; ISSUE 4)")
+    ap.add_argument("--idle-window", type=float, default=0.0,
+                    help="rung-internal: measure the IDLE beat plane "
+                         "over this window instead of driving writes")
+    ap.add_argument("--idle-probe", action="store_true",
+                    help="run the quiescence A/B idle probe at "
+                         "--rungs[0] x --replicas (quiesce off vs on), "
+                         "merge the pair into BENCH_SCALE.json as "
+                         "'idle_beat_plane', and leave the drive rows "
+                         "untouched")
     args = ap.parse_args()
 
     if args.rung:
         asyncio.run(run_rung(args))
+        return
+
+    if args.idle_probe:
+        _run_idle_probe(args)
         return
 
     import tempfile
@@ -338,6 +486,11 @@ def main() -> None:
         subprocess.run(["rm", "-rf", workdir])
 
     complete = [r for r in rows if "error" not in r]
+    prev = {}
+    prev_path = os.path.join(REPO, args.json_out)
+    if os.path.exists(prev_path):
+        with open(prev_path) as f:
+            prev = json.load(f)
     out = {
         "metric": "protocol_plane_scale_ladder",
         "rows": rows,
@@ -354,6 +507,8 @@ def main() -> None:
         "note": "one PROCESS hosts all three replicas of every group; the "
                 "3-process loopback-TCP variant is BENCH_E2E.json",
     }
+    if "idle_beat_plane" in prev:   # the quiescence A/B rides along
+        out["idle_beat_plane"] = prev["idle_beat_plane"]
     with open(os.path.join(REPO, args.json_out), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"rungs": len(rows), "ok": len(complete)}))

@@ -26,6 +26,13 @@ CONCURRENTLY with the fault schedule, plus a stage-trap nemesis action
 that lands seeded crashes inside each joint-consensus stage; after
 every fault the committed conf of every live node must be one of
 {old, joint, new} of an attempted change.
+
+``--quiesce`` (with ``--engine``) lets idle groups hibernate
+(RaftOptions.quiesce_after_rounds) and adds a
+store-kill-while-quiescent nemesis action: a store leading hibernating
+groups is killed, and its dependents must wake on store-lease expiry
+and elect within the normal fault-detection envelope — with the
+history still linearizable.
 """
 
 from __future__ import annotations
@@ -100,12 +107,14 @@ class SoakCluster(_BaseSoakCluster):
     the configuration the G>=1K chaos soak (VERDICT r3 #6) runs."""
 
     def __init__(self, n_stores: int, data_path: str, n_regions: int = 1,
-                 engine: bool = False, election_timeout_ms: int = 400):
+                 engine: bool = False, election_timeout_ms: int = 400,
+                 quiesce_after_rounds: int = 0):
         super().__init__(data_path)
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
         self.election_timeout_ms = election_timeout_ms
         self.engine = engine
+        self.quiesce_after_rounds = quiesce_after_rounds
         if n_regions <= 1:
             self.regions = [Region(id=1, peers=list(self.endpoints))]
         else:
@@ -124,6 +133,8 @@ class SoakCluster(_BaseSoakCluster):
         self.net.start_endpoint(ep)
         transport = InProcTransport(self.net, ep)
         extra = {}
+        if self.quiesce_after_rounds:
+            extra["quiesce_after_rounds"] = self.quiesce_after_rounds
         raft_engine = None
         if self.engine:
             from tpuraft.core.engine import MultiRaftEngine
@@ -537,8 +548,14 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    engine: bool = False,
                    election_timeout_ms: int = 400,
                    power_loss: bool = False,
-                   churn: bool = False) -> dict:
+                   churn: bool = False,
+                   quiesce: bool = False) -> dict:
     rng = random.Random(seed)
+    if quiesce and (transport != "inproc" or not engine):
+        raise ValueError(
+            "--quiesce hibernates engine-driven groups (TimerControl "
+            "nodes never quiesce): run with --engine on the in-proc "
+            "fabric")
     if churn and transport != "inproc":
         raise ValueError(
             "--churn drives membership ops and stage traps through "
@@ -558,7 +575,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
     else:
         c = SoakCluster(n_stores, data_path, n_regions=n_regions,
                         engine=engine,
-                        election_timeout_ms=election_timeout_ms)
+                        election_timeout_ms=election_timeout_ms,
+                        quiesce_after_rounds=4 if quiesce else 0)
     chaos = {}
     try:
         if power_loss:
@@ -575,7 +593,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                     _os.path.join(data_path, f"{ip}_{port}")).install()
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
-            lease_reads, n_regions, rng, c, chaos, churn)
+            lease_reads, n_regions, rng, c, chaos, churn, quiesce)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -586,7 +604,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 
 async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
-                          chaos, churn=False) -> dict:
+                          chaos, churn=False, quiesce=False) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -700,6 +718,48 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         assert not dead_after_power_loss, \
             f"stores failed power-loss recovery: {dead_after_power_loss}"
 
+    # store-kill-while-quiescent (--quiesce): wait for hibernation to
+    # actually take hold on some store, then kill THAT store — its
+    # dependent quiescent follower groups (on other stores) must wake on
+    # store-lease expiry and elect within the normal fault-detection
+    # envelope, and the history must stay linearizable
+    quiesce_killed: list[str] = []
+    quiesce_kill_counts: list[int] = []
+
+    def _quiescent_leader_count(ep: str) -> int:
+        store = c.stores.get(ep)
+        if store is None or store.multi_raft_engine is None:
+            return 0
+        from tpuraft.ops.tick import ROLE_LEADER
+
+        eng = store.multi_raft_engine
+        return int((eng.quiescent & (eng.role == ROLE_LEADER)).sum())
+
+    async def quiescent_store_kill():
+        # give hibernation a moment to take hold, then pick the store
+        # leading the most QUIESCENT groups
+        deadline = asyncio.get_running_loop().time() + 6.0
+        victim, best = None, 0
+        while asyncio.get_running_loop().time() < deadline:
+            counts = {ep: _quiescent_leader_count(ep)
+                      for ep in list(c.stores)}
+            victim = max(counts, key=counts.get) if counts else None
+            best = counts.get(victim, 0)
+            if best > 0:
+                break
+            await asyncio.sleep(0.2)
+        if victim is None or best == 0:
+            raise SkipFault   # the workload kept everything awake
+        say(f"  nemesis: killing store {victim} with {best} "
+            f"quiescent leader groups")
+        quiesce_kill_counts.append(best)
+        quiesce_killed.append(victim)
+        await c.stop_store(victim)
+
+    async def quiescent_store_restart():
+        while quiesce_killed:
+            await c.start_store(quiesce_killed.pop())
+
     # -- membership churn (--churn): continuous conf changes under the
     # fault schedule + a stage-trap action that lands seeded crashes
     # INSIDE each _ConfigurationCtx stage ------------------------------------
@@ -785,6 +845,15 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             NemesisAction("churn-crash", churn_crash, churn_crash_restart,
                           dwell_s=0.6, weight=1.5, check=churn_ok))
         churn_driver.start()
+    if quiesce:
+        # dwell past the store-lease expiry + randomized election spread
+        # (~3x eto) so fail-over actually runs while the store is down
+        eto_s = getattr(c, "election_timeout_ms", 400) / 1000.0
+        actions.append(
+            NemesisAction("store-kill-quiescent", quiescent_store_kill,
+                          quiescent_store_restart,
+                          dwell_s=max(2.5, 3.0 * eto_s), weight=1.5,
+                          check=with_conf_check(None)))
 
     workers = [asyncio.ensure_future(worker(i)) for i in range(5)]
     try:
@@ -819,6 +888,18 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             result["storage_injections"] = injected
         if churn_driver is not None:
             result["membership"] = churn_driver.summary()
+        # beat-plane + quiescence counters (HeartbeatHub.counters() via
+        # each live store's NodeManager) — the soak stats line's view of
+        # how much idle traffic hibernation actually removed
+        hub_totals: dict[str, int] = {}
+        for store in c.stores.values():
+            for k, v in store.node_manager.heartbeat_hub.counters().items():
+                hub_totals[k] = hub_totals.get(k, 0) + v
+        if hub_totals:
+            result["hub"] = hub_totals
+        if quiesce:
+            result["store_kills_while_quiescent"] = len(quiesce_kill_counts)
+            result["quiescent_groups_at_kill"] = quiesce_kill_counts
         if not rep.ok:
             result["violation"] = str(rep)
         if dump_history and not rep.ok:
@@ -897,6 +978,14 @@ def main() -> None:
                          "crashes inside each joint-consensus stage "
                          "(catching_up / joint / stable); conf "
                          "invariants asserted after every fault")
+    ap.add_argument("--quiesce", action="store_true",
+                    help="enable group quiescence (hibernate-raft, "
+                         "quiesce_after_rounds=4; requires --engine) and "
+                         "add a store-kill-while-quiescent nemesis "
+                         "action: a store leading quiescent groups is "
+                         "killed, and its dependents must elect via "
+                         "store-lease expiry within the normal "
+                         "fault-detection envelope")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -909,7 +998,8 @@ def main() -> None:
                                   engine=args.engine,
                                   election_timeout_ms=args.election_timeout_ms,
                                   power_loss=args.power_loss,
-                                  churn=args.churn))
+                                  churn=args.churn,
+                                  quiesce=args.quiesce))
     import json
 
     print(json.dumps(result))

@@ -20,6 +20,7 @@ class MultiRaftCluster:
     group and ONE MultiRaftEngine batching all its groups' commits."""
 
     coalesce_heartbeats = False
+    quiesce_after_rounds = 0  # >0: idle groups hibernate (quiescence)
 
     def __init__(self, n_endpoints: int, n_groups: int,
                  election_timeout_ms: int = 300, tick_ms: int = 5):
@@ -61,6 +62,8 @@ class MultiRaftCluster:
                     fsm=fsm, log_uri="memory://", raft_meta_uri="memory://")
                 opts.raft_options.coalesce_heartbeats = \
                     self.coalesce_heartbeats
+                opts.raft_options.quiesce_after_rounds = \
+                    self.quiesce_after_rounds
                 node = Node(gid, ep, opts, transport,
                             ballot_box_factory=factory)
                 node.node_manager = manager
@@ -640,3 +643,76 @@ def test_set_conf_grace_window_for_added_peers():
     slot2 = eng.alloc_slot()
     eng.set_conf(slot2, Configuration([a, b]), Configuration())
     assert (eng.last_ack[slot2, :2] <= _NEG_I32).all()
+
+
+# -- density-aware timeout floors (ISSUE 4 tentpole part 4) ------------------
+
+def test_density_floor_math_and_slot_application():
+    """The derived floor must scale with registered group count and the
+    configured per-beat cost, and raising a slot must scale hb/lease
+    proportionally (the factor and ratio survive the raise)."""
+    eng = MultiRaftEngine(TickOptions(
+        max_groups=64, max_peers=4, backend="numpy", beat_cost_us=2000.0))
+    eng.has_ctrl[:32] = True
+    eng.voter_mask[:32, :3] = True
+    eng.req_eto_ms[:32] = 1000
+    eng.req_hb_ms[:32] = 100
+    eng.req_lease_ms[:32] = 900
+    floor = eng._density_floor_ms()
+    # beat term: 32 groups x 2 followers x factor 10 x 2000us / (10% of
+    # one core) = 12.8s — far above the requested 1s
+    assert floor == 12800, floor
+    eng._floor_applied_ms = floor
+    eng._apply_floor_slot(0)
+    assert int(eng.eto_ms[0]) == 12800
+    assert int(eng.hb_ms[0]) == 1280      # factor 10 preserved
+    assert int(eng.lease_ms[0]) == 11520  # 0.9 ratio preserved
+    # a slot REQUESTING above the floor keeps its own values
+    eng.req_eto_ms[1] = 60_000
+    eng.req_hb_ms[1] = 6000
+    eng.req_lease_ms[1] = 54_000
+    eng._apply_floor_slot(1)
+    assert int(eng.eto_ms[1]) == 60_000
+    # disabled: floor is 0 regardless of density
+    eng2 = MultiRaftEngine(TickOptions(
+        max_groups=64, max_peers=4, backend="numpy",
+        density_aware_timeouts=False, beat_cost_us=2000.0))
+    eng2.has_ctrl[:32] = True
+    eng2.voter_mask[:32, :3] = True
+    assert eng2._density_floor_ms() == 0
+
+
+async def test_density_floor_raises_live_cluster_timeouts():
+    """End to end: groups registering into a dense engine must come up
+    with RAISED effective timeouts (node options adopted, device rows
+    scaled) — no hand-tuned 60s timeout — and still elect + commit."""
+
+    class DenseCluster(MultiRaftCluster):
+        # beat_cost cranked so even 8 groups x 3 replicas breaches the
+        # budget: floor = 8 x 2 x 10 x 5000us / 100 = 8s > requested 300ms
+        def _tick_options(self):
+            opts = super()._tick_options()
+            opts.beat_cost_us = 5000.0
+            return opts
+
+    c = DenseCluster(3, 8, election_timeout_ms=300)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        node = c.nodes[(gid, c.endpoints[0])]
+        eng = c.engines[c.endpoints[0].endpoint]
+        slot = node._ctrl.slot
+        assert int(eng.eto_ms[slot]) >= 8000, \
+            "density floor did not raise the device row"
+        assert node.options.election_timeout_ms >= 8000, \
+            "node options did not adopt the raised timeout"
+        assert node._ctrl._eto_ms == node.options.election_timeout_ms
+        # the raised cluster still elects and commits (elections ride
+        # the engine's boot deadlines, not a wall-clock 8s wait: the
+        # initial elect_deadline was pushed pre-raise at ~300ms scale)
+        leader = await c.wait_leader(gid, timeout_s=30.0)
+        fut = asyncio.get_running_loop().create_future()
+        await leader.apply(Task(data=b"dense", done=fut.set_result))
+        assert (await asyncio.wait_for(fut, 15)).is_ok()
+    finally:
+        await c.stop_all()

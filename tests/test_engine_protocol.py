@@ -460,15 +460,21 @@ async def test_engine_scheduled_snapshot_cadence(tmp_path):
                                fut.done() or fut.set_result(st)))
             assert (await asyncio.wait_for(fut, 5)).is_ok()
         # within ~2.5 intervals every group's engine-driven snapshot fired
+        # AND landed in the log manager (the FSM counter bumps before the
+        # executor's done-path calls log_manager.set_snapshot — polling on
+        # the counter alone races the tail of the save pipeline)
         deadline = time.monotonic() + 6
         while time.monotonic() < deadline:
-            if all(f.snapshots_saved >= 1 for f in fsms):
+            if (all(f.snapshots_saved >= 1 for f in fsms)
+                    and all(n.log_manager.last_snapshot_id().index >= 1
+                            for n in nodes)):
                 break
             await asyncio.sleep(0.1)
         assert all(f.snapshots_saved >= 1 for f in fsms), \
             [f.snapshots_saved for f in fsms]
         assert all(n.log_manager.last_snapshot_id().index >= 1
-                   for n in nodes)
+                   for n in nodes), \
+            [n.log_manager.last_snapshot_id().index for n in nodes]
     finally:
         for n in nodes:
             await n.shutdown()

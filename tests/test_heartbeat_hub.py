@@ -220,6 +220,63 @@ async def test_fast_beat_crash_is_reaped_and_falls_back_classic():
     assert not hub._inflight  # chunk slot released for the next pulse
 
 
+def test_compact_beat_decodes_old_wire_format():
+    """Mixed-version fleets: a CompactBeat encoded BEFORE the quiesce
+    handshake fields existed is 9 bytes shorter (bool + i64).  The
+    positional field-stream decode must fill the missing trailing
+    defaulted fields from their defaults instead of raising — an
+    upgraded receiver behind an old sender would otherwise fail every
+    fast-beat batch, and the old sender (seeing a generic error, not
+    ENOMETHOD) would never fall back to classic beats."""
+    import pytest
+
+    from tpuraft.rpc.messages import CompactBeat, decode_message, \
+        encode_message
+
+    beat = CompactBeat(group_id="g0", server_id="127.0.0.1:1",
+                       peer_id="127.0.0.2:2", term=3, committed_index=17,
+                       quiesce=True, lease_ms=4000)
+    wire = encode_message(beat)
+    assert decode_message(wire) == beat          # new <-> new round trip
+    got = decode_message(wire[:-9])              # strip quiesce+lease_ms
+    assert got == CompactBeat(group_id="g0", server_id="127.0.0.1:1",
+                              peer_id="127.0.0.2:2", term=3,
+                              committed_index=17)  # defaults: no handshake
+    # a genuinely truncated REQUIRED field still fails loudly
+    with pytest.raises(Exception):
+        decode_message(wire[:-10])
+
+
+async def test_fast_beat_enomethod_counts_fallbacks_and_pins_classic():
+    """ENOMETHOD (receiver predates the beat plane) must count one
+    fallback per affected replicator, pin the dst to classic beats, and
+    re-pulse the chunk classically — and the counters must surface
+    through the hub's MetricRegistry gauges (util/metrics.py)."""
+    from tpuraft.errors import RaftError, Status
+    from tpuraft.rpc.transport import RpcError
+
+    class NoMethodTransport:
+        async def call(self, dst, method, request, timeout_ms=None):
+            raise RpcError(Status.error(RaftError.ENOMETHOD,
+                                        f"no handler {method}"))
+
+    hub = HeartbeatHub()
+    tr = NoMethodTransport()
+    reps = [_fake_beat_rep(tr) for _ in range(3)]
+    fell_back: list = []
+    hub._pulse_classic = lambda rs: fell_back.extend(rs)
+    hub.pulse(reps)
+    await asyncio.sleep(0.05)
+    assert hub.fast_fallbacks == 3
+    assert hub._fast_ok["dst:1"] is False
+    assert len(fell_back) == 3        # the re-pulse went classic
+    snap = hub.metrics.snapshot()["gauges"]
+    assert snap["hub.fast_fallbacks"] == 3
+    assert snap["hub.rpcs_sent"] == hub.rpcs_sent
+    # counters() (the soak stats line's view) agrees with the gauges
+    assert hub.counters()["fast_fallbacks"] == 3
+
+
 class AutoMultiRaftCluster(MultiRaftCluster):
     coalesce_heartbeats = None  # the RaftOptions DEFAULT: auto
 

@@ -186,6 +186,10 @@ def test_numpy_twin_matches_device_tick_randomized():
         eng.hb_deadline = rng.integers(0, 2000, G)
         eng.last_ack = np.where(rng.random((G, P)) < 0.8,
                                 rng.integers(0, 1500, (G, P)), _NEG_I32)
+        # quiescence lane: hibernating groups must suppress hb_due /
+        # election_due identically in both formulations (step_down and
+        # lease_valid stay LIVE for quiescent leaders)
+        eng.quiescent = rng.random(G) < 0.3
         rel = rng.integers(0, 100, (G, P)).astype(np.int32)
         commit_now = rng.integers(0, 40, G).astype(np.int32)
         now = int(rng.integers(500, 1500))
@@ -204,6 +208,7 @@ def test_numpy_twin_matches_device_tick_randomized():
             hb_deadline=eng.hb_deadline.astype(np.int32),
             last_ack=eng.last_ack.astype(np.int32),
             snap_deadline=eng.snap_deadline.astype(np.int32),
+            quiescent=eng.quiescent.copy(),
         )
         _, dev_out = raft_tick(state, np.int32(now),
                                TickParams.make(eng.eto_ms, eng.hb_ms,

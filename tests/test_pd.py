@@ -23,6 +23,46 @@ async def pd_cluster(**kw):
         await c.stop_all()
 
 
+async def test_legacy_batch_fallback_decomposes_and_requests_full():
+    """The legacy (pre-batch / PD-less) store_heartbeat_batch fallback
+    must decompose deltas into per-region heartbeats AND answer
+    need_full=True: a legacy PD runs its split/balance policy off the
+    per-region reports and cannot request a resync, so delta-only
+    reporting would starve it and a failed-over legacy PD leader would
+    stay cold forever.  need_full=True makes every store round carry
+    every led region — exactly the pre-batch wire behavior."""
+    from tpuraft.rheakv.metadata import StoreMeta
+    from tpuraft.rheakv.pd_client import PlacementDriverClient
+
+    class Recorder(PlacementDriverClient):
+        def __init__(self):
+            self.store_rounds = []
+            self.region_reports = []
+
+        async def store_heartbeat(self, meta):
+            self.store_rounds.append([r.id for r in meta.regions])
+
+        async def region_heartbeat(self, region, leader, metrics=None):
+            self.region_reports.append(
+                (region.id, leader, (metrics or {}).get("approximate_keys")))
+            return [("split-order", region.id)]
+
+    pd = Recorder()
+    regions = [Region(id=i, start_key=bytes([i]), end_key=bytes([i + 1]))
+               for i in (1, 2, 3)]
+    meta = StoreMeta(id=7, endpoint="127.0.0.1:9001", regions=[])
+    instructions, need_full = await pd.store_heartbeat_batch(
+        meta, [(r, "127.0.0.1:9001", 10 * r.id) for r in regions])
+    assert need_full, "legacy fallback must force full rounds"
+    assert pd.store_rounds == [[1, 2, 3]]
+    assert pd.region_reports == [(1, "127.0.0.1:9001", 10),
+                                 (2, "127.0.0.1:9001", 20),
+                                 (3, "127.0.0.1:9001", 30)]
+    # per-region instructions surface through the batched return
+    assert instructions == [("split-order", 1), ("split-order", 2),
+                            ("split-order", 3)]
+
+
 async def test_pd_tracks_stores_and_regions():
     async with pd_cluster() as c:
         await c.wait_pd_leader()

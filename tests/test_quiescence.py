@@ -1,0 +1,313 @@
+"""Group quiescence ("hibernate raft"): idle groups suppress their
+beat plane and delegate liveness to the store-level lease
+(RaftOptions.quiesce_after_rounds; ISSUE 4 tentpole).
+
+Covers the wake races the design note calls out: a write arriving
+during hibernation, a store-lease expiry waking exactly the dependent
+groups, a conf change waking the group, and a leader-store kill while
+every group is quiescent (fail-over inside the normal fault-detection
+envelope).
+"""
+
+import asyncio
+
+import pytest  # noqa: F401
+
+from tests.test_engine import MultiRaftCluster
+from tpuraft.core.node import State
+from tpuraft.entity import Task
+
+
+class QuiesceCluster(MultiRaftCluster):
+    coalesce_heartbeats = None   # AUTO: the handshake rides the fast path
+    quiesce_after_rounds = 3
+
+
+async def _commit(leader, data: bytes):
+    fut = asyncio.get_running_loop().create_future()
+    await leader.apply(Task(data=data, done=fut.set_result))
+    st = await asyncio.wait_for(fut, 10)
+    assert st.is_ok(), str(st)
+
+
+async def _wait(pred, timeout_s: float, what: str):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while loop.time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _group_slots(c, gid):
+    return [(c.engines[ep.endpoint], c.nodes[(gid, ep)]._ctrl.slot)
+            for ep in c.endpoints]
+
+
+def _all_quiescent(c, gid) -> bool:
+    return all(bool(e.quiescent[s]) for e, s in _group_slots(c, gid))
+
+
+async def test_idle_group_quiesces_and_beats_stop():
+    """The headline: after N fully-acked idle rounds every replica of
+    the group hibernates, the hub's beat counters stop advancing, and
+    the store-level lease keeps flowing instead."""
+    c = QuiesceCluster(3, 4, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        for gid in c.groups:
+            leader = await c.wait_leader(gid)
+            await _commit(leader, b"seed-" + gid.encode())
+        await _wait(lambda: all(_all_quiescent(c, g) for g in c.groups),
+                    8.0, "all groups quiescent")
+        hubs = [c.nodes[(c.groups[0], ep)].node_manager.heartbeat_hub
+                for ep in c.endpoints]
+        beats0 = sum(h.beats_sent + h.fast_beats_sent for h in hubs)
+        lease0 = sum(h.lease_rpcs_sent for h in hubs)
+        await asyncio.sleep(0.8)   # several beat intervals of quiet
+        beats1 = sum(h.beats_sent + h.fast_beats_sent for h in hubs)
+        lease1 = sum(h.lease_rpcs_sent for h in hubs)
+        assert beats1 == beats0, "quiescent groups still beating"
+        assert lease1 > lease0, "store lease not flowing"
+        # nobody lost leadership while hibernating
+        for gid in c.groups:
+            assert sum(1 for ep in c.endpoints
+                       if c.nodes[(gid, ep)].state == State.LEADER) == 1
+        assert sum(h.groups_quiesced for h in hubs) >= 3 * len(c.groups)
+    finally:
+        await c.stop_all()
+
+
+async def test_write_arriving_during_quiesce_wakes_and_commits():
+    """The classic race: a client write lands on a hibernating leader.
+    note_activity must wake the group and the write must commit on
+    every replica (the woken leader's beats re-absorb its followers)."""
+    c = QuiesceCluster(3, 2, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        await _commit(leader, b"w1")
+        await _wait(lambda: _all_quiescent(c, gid), 8.0, "group quiescent")
+        await _commit(leader, b"w2")
+        eng = c.engines[leader.server_id.endpoint]
+        assert not eng.quiescent[leader._ctrl.slot]
+        await _wait(lambda: all(
+            c.fsms[(gid, ep)].logs == [b"w1", b"w2"] for ep in c.endpoints),
+            8.0, "w2 applied everywhere")
+        # and the group hibernates AGAIN once idle — quiescence is a
+        # steady state, not a one-shot
+        await _wait(lambda: _all_quiescent(c, gid), 8.0, "re-quiesced")
+        await _commit(leader, b"w3")   # still writable after the 2nd nap
+    finally:
+        await c.stop_all()
+
+
+async def test_conf_change_wakes_quiescent_group():
+    c = QuiesceCluster(3, 1, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        await _commit(leader, b"x")
+        await _wait(lambda: _all_quiescent(c, gid), 8.0, "group quiescent")
+        victim = next(ep for ep in c.endpoints if ep != leader.server_id)
+        st = await asyncio.wait_for(leader.remove_peer(victim), 15)
+        assert st.is_ok(), str(st)
+        eng = c.engines[leader.server_id.endpoint]
+        assert eng.voter_mask[leader._ctrl.slot].sum() == 2
+        await _commit(leader, b"y")
+    finally:
+        await c.stop_all()
+
+
+async def test_leader_store_kill_wakes_exactly_dependent_groups():
+    """Store-lease expiry: killing the endpoint that leads SOME groups
+    must wake (and re-elect) exactly those groups' followers; groups
+    led by surviving stores stay hibernated."""
+    c = QuiesceCluster(3, 6, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        for gid in c.groups:
+            leader = await c.wait_leader(gid)
+            await _commit(leader, b"seed")
+        await _wait(lambda: all(_all_quiescent(c, g) for g in c.groups),
+                    10.0, "all groups quiescent")
+        by_leader: dict[str, list[str]] = {}
+        for gid in c.groups:
+            ld = next(n for (g, ep), n in c.nodes.items()
+                      if g == gid and n.is_leader())
+            by_leader.setdefault(ld.server_id.endpoint, []).append(gid)
+        # kill the endpoint leading the most groups
+        dead_ep_s = max(by_leader, key=lambda k: len(by_leader[k]))
+        dead_groups = by_leader[dead_ep_s]
+        live_groups = [g for g in c.groups if g not in dead_groups]
+        dead_ep = next(ep for ep in c.endpoints
+                       if ep.endpoint == dead_ep_s)
+        c.net.stop_endpoint(dead_ep_s)
+        for g in c.groups:
+            n = c.nodes.pop((g, dead_ep))
+            await n.shutdown()
+        await c.engines.pop(dead_ep_s).shutdown()
+        c.net.unbind(dead_ep_s)
+
+        # the dead store's dependent groups elect within the normal
+        # fault-detection envelope: lease expiry (~eto) + randomized
+        # election spread (up to ~2x eto) + the election itself
+        for gid in dead_groups:
+            leader2 = await c.wait_leader(gid, timeout_s=12.0)
+            assert leader2.server_id.endpoint != dead_ep_s
+            await _commit(leader2, b"post-failover")
+        # groups led by SURVIVING stores never woke: their store's
+        # lease kept flowing the whole time (lease beats between the
+        # two live endpoints), so hibernation held
+        for gid in live_groups:
+            for ep in c.endpoints:
+                if ep == dead_ep:
+                    continue
+                n = c.nodes[(gid, ep)]
+                if n.is_leader():
+                    continue   # the leader row wakes only on activity
+                eng = c.engines[ep.endpoint]
+                assert eng.quiescent[n._ctrl.slot], \
+                    f"{gid}@{ep.endpoint} woke without cause"
+    finally:
+        await c.stop_all()
+
+
+async def test_quiescent_group_survives_on_lease_and_wakes_on_vote():
+    """A quiescent follower must refuse to elect while its leader's
+    store lease is fresh (suppressed election timeout), and the whole
+    group must resume cleanly when a vote request arrives anyway."""
+    c = QuiesceCluster(3, 1, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        term0 = leader.current_term
+        await _commit(leader, b"a")
+        await _wait(lambda: _all_quiescent(c, gid), 8.0, "group quiescent")
+        # several election timeouts of TOTAL beat silence: without the
+        # store lease this is guaranteed re-election territory
+        await asyncio.sleep(1.5)
+        assert leader.state == State.LEADER
+        assert leader.current_term == term0, \
+            "a quiescent group re-elected under a fresh store lease"
+        await _commit(leader, b"b")
+    finally:
+        await c.stop_all()
+
+
+async def test_prevote_against_quiescent_group_refused_while_lease_fresh():
+    """The wake-vs-guard race: a vote solicitation wakes a quiescent
+    follower (note_activity) BEFORE the pre-vote guard runs, which
+    clears quiescent_leader_alive() — the wake must carry the store
+    lease's liveness proof into _last_leader_timestamp, or one
+    restarted store pre-voting at thousands of hibernating groups
+    deposes every healthy leader at once."""
+    from tpuraft.rpc.messages import RequestVoteRequest
+
+    c = QuiesceCluster(3, 1, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        term0 = leader.current_term
+        await _commit(leader, b"a")
+        await _wait(lambda: _all_quiescent(c, gid), 8.0, "group quiescent")
+        # long enough that the per-group leader-contact timestamp is
+        # stale by every non-delegated measure
+        await asyncio.sleep(1.2)
+        cand_ep, tgt_ep = [ep for ep in c.endpoints
+                           if ep != leader.server_id]
+        target = c.nodes[(gid, tgt_ep)]
+        last = target.log_manager.last_log_id()
+        resp = await target.handle_request_vote(RequestVoteRequest(
+            group_id=gid, server_id=str(cand_ep),
+            peer_id=str(tgt_ep), term=term0 + 1,
+            last_log_index=last.index, last_log_term=last.term,
+            pre_vote=True))
+        assert not resp.granted, \
+            "pre-vote granted against a lease-fresh hibernating leader"
+        # the solicitation woke the follower (by design) ...
+        assert not c.engines[tgt_ep.endpoint].quiescent[target._ctrl.slot]
+        # ... but the leader keeps its seat through the follower's next
+        # election window: the woken guard still counts the leader alive
+        await asyncio.sleep(1.0)
+        assert leader.state == State.LEADER and leader.current_term == term0
+        await _commit(leader, b"b")
+    finally:
+        await c.stop_all()
+
+
+async def test_store_lease_pair_dedupe_suppresses_one_direction():
+    """The lease beat is a bidirectional liveness proof (the beat
+    proves its sender alive, the ack proves the receiver alive): with
+    leaders hibernating on BOTH endpoints of a pair, the higher
+    endpoint must ride the lower's beats (lease_suppressed advances)
+    instead of sending its own — and neither side's hibernation or
+    leadership may suffer for it."""
+    c = QuiesceCluster(3, 2, election_timeout_ms=400)
+    await c.start_all()
+    try:
+        eps = sorted(c.endpoints, key=lambda e: e.endpoint)
+        lo, hi = eps[0], eps[1]
+        # pin one leader to the LOW endpoint and one to the HIGH so the
+        # (lo, hi) pair has lease senders both ways
+        for gid, target in zip(c.groups, (lo, hi)):
+            leader = await c.wait_leader(gid)
+            if leader.server_id != target:
+                st = await asyncio.wait_for(
+                    leader.transfer_leadership_to(target), 15)
+                assert st.is_ok(), str(st)
+                await _wait(lambda: c.nodes[(gid, target)].is_leader(),
+                            10.0, f"{gid} led by {target.endpoint}")
+        for gid in c.groups:
+            await _commit(await c.wait_leader(gid), b"seed")
+        await _wait(lambda: all(_all_quiescent(c, g) for g in c.groups),
+                    10.0, "all groups quiescent")
+        hub_hi = c.nodes[(c.groups[0], hi)].node_manager.heartbeat_hub
+        sup0 = hub_hi.lease_suppressed
+        # several lease intervals (eto/4 = 100ms) of steady state
+        await asyncio.sleep(1.0)
+        assert hub_hi.lease_suppressed > sup0, \
+            "higher endpoint kept sending its half of the pair"
+        # suppression cost nothing: both leaders still lead, every
+        # group is still hibernated, and both groups still take writes
+        for gid, target in zip(c.groups, (lo, hi)):
+            assert c.nodes[(gid, target)].is_leader()
+            assert _all_quiescent(c, gid)
+            await _commit(c.nodes[(gid, target)], b"post-dedupe")
+    finally:
+        await c.stop_all()
+
+
+async def test_single_voter_group_quiesces_without_lease():
+    """A single-voter group has nobody to handshake with and needs no
+    store lease: it hibernates on its own and wakes on writes."""
+    from tests.test_engine import MultiRaftCluster
+
+    class OneVoter(MultiRaftCluster):
+        coalesce_heartbeats = None
+        quiesce_after_rounds = 3
+
+        def __init__(self):
+            super().__init__(1, 2, election_timeout_ms=400)
+
+    c = OneVoter()
+    await c.start_all()
+    try:
+        gid = c.groups[0]
+        leader = await c.wait_leader(gid)
+        await _commit(leader, b"solo")
+        eng = c.engines[leader.server_id.endpoint]
+        await _wait(lambda: bool(eng.quiescent[leader._ctrl.slot]),
+                    8.0, "single-voter quiesced")
+        hub = leader.node_manager.heartbeat_hub
+        assert not hub._lease_targets   # no peers -> no lease traffic
+        await _commit(leader, b"solo2")
+        await _wait(lambda: bool(eng.quiescent[leader._ctrl.slot]),
+                    8.0, "re-quiesced")
+    finally:
+        await c.stop_all()

@@ -198,16 +198,44 @@ class EngineControl:
                                         self._eto_ms))
         self._jitter = random.randrange(self._jitter_range)
         self._scheduled: set = set()
+        # quiescence ("hibernate raft") state
+        self._quiesce_after = opts.raft_options.quiesce_after_rounds
+        self._quiesce_streak = 0
+        self._quiesce_await: Optional[set] = None   # peers yet to ack
+        self._lease_eps: list[str] = []   # leader: endpoints on the lease
+        self._lease_src: Optional[str] = None  # follower: leader's store
         snap_ms = 0
         if opts.snapshot_uri and opts.snapshot.interval_secs > 0:
             snap_ms = opts.snapshot.interval_secs * 1000
-        engine.register_ctrl(self, node.server_id,
-                             eto_ms=self._eto_ms,
-                             hb_ms=max(1, self._eto_ms
-                                       // opts.raft_options.election_heartbeat_factor),
-                             lease_ms=int(self._eto_ms
-                                          * opts.raft_options.leader_lease_time_ratio),
-                             snapshot_ms=snap_ms)
+        eff = engine.register_ctrl(
+            self, node.server_id,
+            eto_ms=self._eto_ms,
+            hb_ms=max(1, self._eto_ms
+                      // opts.raft_options.election_heartbeat_factor),
+            lease_ms=int(self._eto_ms
+                         * opts.raft_options.leader_lease_time_ratio),
+            snapshot_ms=snap_ms)
+        if eff != self._eto_ms:
+            self._adopt_eto(eff)
+
+    def _adopt_eto(self, eff_eto_ms: int) -> None:
+        """The engine's density floor raised this group's effective
+        election timeout: adopt it host-side too, so RPC budgets, the
+        follower leader-contact lease and jitter all agree with the
+        device rows (a host lease shorter than the device timeout would
+        re-open the vote guards long before any deadline can fire)."""
+        opts = self.node.options
+        if eff_eto_ms != opts.election_timeout_ms:
+            LOG.info("%s: density floor raised election timeout "
+                     "%dms -> %dms", self.node,
+                     opts.election_timeout_ms, eff_eto_ms)
+            opts.election_timeout_ms = eff_eto_ms
+        self._eto_ms = eff_eto_ms
+        self._lease_ms = int(eff_eto_ms
+                             * opts.raft_options.leader_lease_time_ratio)
+        self._jitter_range = max(1, min(
+            opts.raft_options.max_election_delay_ms, eff_eto_ms))
+        self._jitter = min(self._jitter, self._jitter_range - 1)
 
     # -- scheduling plumbing (engine tick -> node slow path) -----------------
 
@@ -243,6 +271,7 @@ class EngineControl:
 
     def start_follower(self) -> None:
         e = self.engine
+        self._clear_quiesce_state()
         e.role[self.slot] = ROLE_FOLLOWER
         self.push_election_deadline()
         e.mark_dirty()
@@ -255,6 +284,7 @@ class EngineControl:
 
     def on_candidate(self) -> None:
         e = self.engine
+        self._clear_quiesce_state()
         e.role[self.slot] = ROLE_CANDIDATE
         self.push_election_deadline()   # vote-round timeout
         e.mark_dirty()
@@ -299,6 +329,7 @@ class EngineControl:
     def on_leader(self) -> None:
         e, s = self.engine, self.slot
         now = e.now_ms()
+        self._clear_quiesce_state()
         e.role[s] = ROLE_LEADER
         # grace period (reference: becomeLeader resets the replicators'
         # lastRpcSendTimestamp): every peer counts as freshly acked, so
@@ -310,6 +341,7 @@ class EngineControl:
         e.mark_dirty()
 
     def on_step_down(self, was_candidate: bool, was_leader: bool) -> None:
+        self._clear_quiesce_state()
         self.engine.granted[self.slot, :] = False
 
     def on_follower(self) -> None:
@@ -352,8 +384,15 @@ class EngineControl:
         return max(0.0, (self.engine.now_ms() - q) / 1000.0)
 
     def lease_valid(self) -> bool:
-        return (self.engine.now_ms() - self._quorum_ack_ms()
-                < self._lease_ms)
+        if (self.engine.now_ms() - self._quorum_ack_ms()
+                < self._lease_ms):
+            return True
+        # quiescent leader: its per-group ack stream is suppressed, so
+        # the store-level lease IS the leader lease (LEASE_BASED reads /
+        # dead-quorum re-verification consult it through here).  The
+        # rows are normally refreshed by note_store_ack, but an ack
+        # landing between ticks must not fail a read spuriously.
+        return self.is_quiescent() and self.store_lease_quorum_ok()
 
     def alive_peers(self) -> list[PeerId]:
         e, s = self.engine, self.slot
@@ -368,9 +407,225 @@ class EngineControl:
                 out.append(peer)
         return out
 
+    # -- quiescence ("hibernate raft") ---------------------------------------
+    # A fully-replicated idle leader group hibernates after N consecutive
+    # fully-acked beat rounds: the device masks skip it (hb_due /
+    # election_due), its followers suppress election timeouts, and
+    # liveness is delegated to ONE store-level lease beat per endpoint
+    # pair (HeartbeatHub) — idle beat traffic collapses from O(G x P)
+    # rows to O(stores^2) RPCs.  Any apply / conf change / vote request /
+    # incoming entries instantly wakes the group; a store-lease expiry
+    # wakes its dependents with randomized election timeouts.
+
+    def is_quiescent(self) -> bool:
+        return bool(self.engine.quiescent[self.slot])
+
+    def note_activity(self) -> None:
+        """Hot-path hook on protocol activity (apply staged, vote
+        request, entries received): one array read when awake."""
+        if self.engine.quiescent[self.slot]:
+            self.wake_from_quiescence("activity")
+
+    def _hub(self):
+        nm = self.node.node_manager
+        return None if nm is None else nm.heartbeat_hub
+
+    def maybe_quiesce(self, now: int) -> None:
+        """Called by the engine on every hb_due round for this (awake,
+        leader) slot: track the idle streak; at the threshold this
+        round's beats carry the quiesce handshake (hub.pulse reads the
+        per-replicator intent), and the group hibernates only once
+        EVERY follower acked — a refusal keeps it active, because a
+        follower with a live election timer must keep receiving beats."""
+        if self._quiesce_after <= 0 or self.engine.quiescent[self.slot]:
+            return
+        if not self._quiesce_eligible(now):
+            self._quiesce_streak = 0
+            self._quiesce_await = None
+            return
+        self._quiesce_streak += 1
+        if self._quiesce_streak < self._quiesce_after:
+            return
+        reps = self.node.replicators.all()
+        if not reps:
+            # single-voter group: nobody to hand-shake, no lease needed
+            # (its own self-ack keeps step_down quiet) — hibernate now
+            self._finalize_quiesce()
+            return
+        self._quiesce_await = {r.peer for r in reps}
+        for r in reps:
+            r._quiesce_lease_ms = self._eto_ms
+
+    def _quiesce_eligible(self, now: int) -> bool:
+        """No pending appends, full match at the tail, not mid-change,
+        every voter freshly acked — the 'provably idle' predicate."""
+        node = self.node
+        if node.node_manager is None or node.state.name != "LEADER":
+            return False
+        if node._conf_ctx is not None:
+            return False
+        e, s = self.engine, self.slot
+        if e.old_voter_mask[s].any():
+            return False
+        tail = node.log_manager.last_log_index()
+        if node.ballot_box.last_committed_index != tail:
+            return False
+        reps = node.replicators.all()
+        for r in reps:
+            if (not r._matched or r.retiring or r.match_index < tail
+                    or not r.peer_multi_hb):
+                return False
+        if reps:
+            # every voter acked within the last two beat intervals
+            horizon = now - 2 * int(e.hb_ms[s]) - 50
+            row, mask = e.last_ack[s], e.voter_mask[s].copy()
+            col = int(e.self_col[s])
+            if 0 <= col < mask.size:
+                mask[col] = False
+            if mask.any() and bool((row[mask] < horizon).any()):
+                return False
+        return True
+
+    def note_quiesce_ack(self, peer: PeerId) -> None:
+        """A follower acked a quiesce-handshake beat."""
+        aw = self._quiesce_await
+        if aw is None:
+            return
+        aw.discard(peer)
+        if not aw:
+            self._quiesce_await = None
+            self._finalize_quiesce()
+
+    def abort_quiesce(self) -> None:
+        """A follower refused (or the fast path fell back): stay active."""
+        self._quiesce_await = None
+        self._quiesce_streak = 0
+
+    def _finalize_quiesce(self) -> None:
+        e, s = self.engine, self.slot
+        node = self.node
+        if e.quiescent[s] or node.node_manager is None:
+            return
+        if not self._quiesce_eligible(e.now_ms()):
+            # an apply raced the handshake acks: stay active
+            self._quiesce_streak = 0
+            return
+        e.quiescent[s] = True
+        e.quiesce_events += 1
+        hub = node.node_manager.heartbeat_hub
+        hub.groups_quiesced += 1
+        eps = sorted({r.peer.endpoint for r in node.replicators.all()})
+        self._lease_eps = eps
+        src = node.server_id.endpoint
+        for ep in eps:
+            hub.lease_add(ep, e, node.transport, src, self._eto_ms)
+        e.note_quiesce_leader(s)
+
+    def enter_quiescent_follower(self, leader_endpoint: str,
+                                 lease_ms: int) -> bool:
+        """The leader proposed hibernation via a quiesce beat and this
+        node matched its row at the tail: suppress the election timeout
+        and ride the leader store's liveness lease instead."""
+        node = self.node
+        e, s = self.engine, self.slot
+        if node.node_manager is None:
+            return False
+        if e.quiescent[s]:
+            return True
+        e.quiescent[s] = True
+        e.quiesce_events += 1
+        self._lease_src = leader_endpoint
+        hub = node.node_manager.heartbeat_hub
+        hub.groups_quiesced += 1
+        hub.lease_depend(leader_endpoint, self, lease_ms or self._eto_ms)
+        return True
+
+    def wake_from_quiescence(self, reason: str = "activity",
+                             lease_expired: bool = False) -> None:
+        e, s = self.engine, self.slot
+        if not e.quiescent[s]:
+            return
+        now = e.now_ms()
+        # a follower waking under a FRESH store lease (e.g. a vote
+        # solicitation from a restarted peer) must carry the delegated
+        # liveness proof back into the per-group guard: clearing the
+        # quiescent state kills quiescent_leader_alive(), and the raw
+        # _last_leader_timestamp went stale by design while hibernating
+        # — without this refresh the vote guards would swing open the
+        # moment a group wakes, letting one restarted store depose
+        # every healthy hibernating leader it pre-votes against
+        leader_alive = self.quiescent_leader_alive()
+        self._clear_quiesce_state()
+        if leader_alive:
+            self.node._last_leader_timestamp = time.monotonic()
+        if e.role[s] == ROLE_LEADER:
+            e.hb_deadline[s] = now   # beat NOW; followers wake on it
+        else:
+            self._jitter = random.randrange(self._jitter_range)
+            # store-lease expiry wakes WHOLE stores' worth of groups at
+            # once: spread their elections over an extra full timeout so
+            # the herd stays under the host's election capacity
+            extra = random.randrange(self._eto_ms) if lease_expired else 0
+            e.elect_deadline[s] = now + self._eto_ms + self._jitter + extra
+        e.mark_dirty()
+
+    def wake_for_lease_expiry(self) -> None:
+        """Hub lease watcher: the store this group's (quiescent) leader
+        lives on went silent past its lease — resume fault detection."""
+        self.wake_from_quiescence("store-lease-expiry", lease_expired=True)
+
+    def _clear_quiesce_state(self) -> None:
+        e, s = self.engine, self.slot
+        was = bool(e.quiescent[s])
+        e.quiescent[s] = False
+        self._quiesce_streak = 0
+        self._quiesce_await = None
+        hub = self._hub()
+        if self._lease_eps:
+            e.note_wake_leader(s)
+            if hub is not None:
+                for ep in self._lease_eps:
+                    hub.lease_remove(ep, e)
+            self._lease_eps = []
+        if self._lease_src is not None:
+            if hub is not None:
+                hub.lease_undepend(self._lease_src, self)
+            self._lease_src = None
+        if was:
+            e.wake_events += 1
+            if hub is not None:
+                hub.groups_woken += 1
+
+    def quiescent_leader_alive(self) -> bool:
+        """Follower-side vote-guard consult: while hibernating, 'my
+        leader is alive' means 'its store's lease is fresh' — the
+        per-group leader-contact timestamp legitimately goes stale."""
+        e, s = self.engine, self.slot
+        if not e.quiescent[s] or self._lease_src is None:
+            return False
+        hub = self._hub()
+        return hub is not None and hub.lease_fresh(self._lease_src)
+
+    def store_lease_quorum_ok(self) -> bool:
+        """Leader-side lease-read consult for a QUIESCENT group: fresh
+        store-lease acks must cover a voter quorum (the per-group ack
+        stream is suppressed, so the store lease IS the leader lease)."""
+        node = self.node
+        hub = self._hub()
+        if hub is None:
+            return False
+        voters = node.list_peers()
+        if not voters:
+            return False
+        ok = sum(1 for p in voters
+                 if p == node.server_id
+                 or hub.lease_ack_fresh(p.endpoint, self._lease_ms))
+        return ok >= len(voters) // 2 + 1
+
     # -- lifecycle -----------------------------------------------------------
 
     def deactivate(self) -> None:
+        self._clear_quiesce_state()
         self.engine.role[self.slot] = ROLE_INACTIVE
 
     def shutdown(self) -> None:
@@ -412,6 +667,19 @@ class MultiRaftEngine:
         self.granted = np.zeros((g, p), bool)
         self.self_col = np.full(g, -1, np.int32)
         self.has_ctrl = np.zeros(g, bool)
+        # quiescence ("hibernate raft"): a True row suppresses the
+        # group's hb_due/election_due masks on device; liveness rides
+        # the store-level lease (HeartbeatHub).  Host-owned like role.
+        self.quiescent = np.zeros(g, bool)
+        # store-lease plumbing for QUIESCENT LEADER slots: endpoint ->
+        # {slot: [cols]} of last_ack cells refreshed by one store-lease
+        # ack from that endpoint (flattened index arrays cached per
+        # endpoint) — dead-quorum step-down and leader-lease reads for
+        # hibernating groups consult the store lease through these rows.
+        self._lease_cols: dict[str, dict[int, list[int]]] = {}
+        self._lease_arrays: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        self.quiesce_events = 0   # groups that entered hibernation
+        self.wake_events = 0      # groups woken (activity / lease expiry)
         self._peer_cols: list[dict[PeerId, int]] = [dict() for _ in range(g)]
         self._boxes: list[Optional[TpuBallotBox]] = [None] * g
         self._ctrls: list[Optional[EngineControl]] = [None] * g
@@ -432,6 +700,24 @@ class MultiRaftEngine:
         self.eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
         self.hb_ms = np.full(g, _DEF_HB_MS, np.int64)
         self.lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
+        # density-aware timeout floors: the REQUESTED NodeOptions values
+        # per slot; the effective rows above are max(requested, derived
+        # floor) with hb/lease scaled proportionally.  The floor grows
+        # with registered group count and the measured tick cost, so a
+        # 16K-group process lands on a safe operating point without the
+        # hand-tuned 60s timeouts BENCH_SCALE previously required.
+        self.req_eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
+        self.req_hb_ms = np.full(g, _DEF_HB_MS, np.int64)
+        self.req_lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
+        self._floor_applied_ms = 0
+        self._tick_cost_ema_s = 0.0
+        # the floor derivation scans every registered slot, so it runs
+        # only at geometric registration counts (the floor is ~linear
+        # in n, and the apply gate already tolerates 25% staleness) —
+        # a 16K-group boot pays O(G) total floor work, not O(G^2)
+        self._n_ctrls = 0
+        self._floor_cached_ms = 0
+        self._floor_next_n = 0
         # engine-scheduled snapshot cadence (the reference's 4th timer,
         # snapshotTimer): [G] interval row (0 = disabled) + deadline row
         # replace G per-group RepeatedTimers; fires staggered by jitter
@@ -473,15 +759,33 @@ class MultiRaftEngine:
 
     def register_ctrl(self, ctrl: EngineControl, server_id: PeerId,
                       eto_ms: int, hb_ms: int, lease_ms: int,
-                      snapshot_ms: int = 0) -> None:
+                      snapshot_ms: int = 0) -> int:
+        """Register a node's control plane.  Returns the EFFECTIVE
+        election timeout for the slot — the requested value raised to the
+        engine's density floor when the process hosts more groups than
+        the requested timeout can beat within the cpu budget."""
         s = ctrl.slot
         self._ctrls[s] = ctrl
         self._ctrl_server[s] = server_id
         self.has_ctrl[s] = True
         col = self._peer_cols[s].get(server_id)
         self.self_col[s] = -1 if col is None else col
-        self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
+        self.req_eto_ms[s], self.req_hb_ms[s], self.req_lease_ms[s] = \
             eto_ms, hb_ms, lease_ms
+        self._n_ctrls += 1
+        if self._n_ctrls >= self._floor_next_n:
+            self._floor_cached_ms = self._density_floor_ms()
+            self._floor_next_n = int(self._n_ctrls * 1.25) + 1
+        floor = self._floor_cached_ms
+        if floor > self._floor_applied_ms * 1.25:
+            # the floor grew materially (more groups / slower ticks):
+            # re-derive every controlled slot's effective rows.  Gated
+            # to >25% growth so a 16K-registration boot costs O(G log G)
+            # row rewrites, not O(G^2).
+            self._floor_applied_ms = floor
+            self._reapply_floor()
+        else:
+            self._apply_floor_slot(s)
         self.snap_ms[s] = snapshot_ms
         if snapshot_ms > 0:
             # first due staggered over [0.5, 1.5) intervals: groups
@@ -489,12 +793,76 @@ class MultiRaftEngine:
             self.snap_deadline[s] = self.now_ms() + int(
                 snapshot_ms * (0.5 + random.random()))
         self._params_dev = None  # (re)built at next device tick
+        return int(self.eto_ms[s])
+
+    # -- density-aware timeout floors ---------------------------------------
+
+    def _density_floor_ms(self) -> int:
+        """Minimum safe election timeout at the CURRENT registered
+        density, derived from group count and measured costs instead of
+        operator hand-tuning.  Two terms:
+
+        - beat-budget: idle beats/s = groups x followers x factor /
+          eto_s; each beat costs ~``beat_cost_us`` end to end, and the
+          idle beat plane may use at most ``beat_cpu_budget`` of one
+          core — solve for eto.
+        - tick-cost: one heartbeat interval must dwarf a measured tick
+          dispatch (x50), or the engine cannot keep every group's beat
+          schedule — a tunneled/slow device raises the floor on its own.
+        """
+        if not self.opts.density_aware_timeouts:
+            return 0
+        n = int(self.has_ctrl.sum())
+        if n == 0:
+            return 0
+        vm = self.voter_mask[self.has_ctrl]
+        per = np.clip(vm.sum(axis=1) - 1, 0, None)
+        followers = float(per.mean()) if per.size else 2.0
+        req_eto = self.req_eto_ms[self.has_ctrl].astype(np.float64)
+        req_hb = np.maximum(self.req_hb_ms[self.has_ctrl], 1)
+        factor = float((req_eto / req_hb).mean()) if req_eto.size else 10.0
+        beat_term = (n * followers * factor * self.opts.beat_cost_us
+                     / (max(self.opts.beat_cpu_budget, 1e-3) * 1000.0))
+        tick_term = self._tick_cost_ema_s * 1000.0 * factor * 50.0
+        return int(max(beat_term, tick_term))
+
+    def _apply_floor_slot(self, s: int) -> None:
+        floor = self._floor_applied_ms
+        req = int(self.req_eto_ms[s])
+        if req >= floor or floor <= 0:
+            self.eto_ms[s] = self.req_eto_ms[s]
+            self.hb_ms[s] = self.req_hb_ms[s]
+            self.lease_ms[s] = self.req_lease_ms[s]
+            return
+        ratio = floor / max(req, 1)
+        self.eto_ms[s] = floor
+        self.hb_ms[s] = max(1, int(self.req_hb_ms[s] * ratio))
+        self.lease_ms[s] = max(1, int(self.req_lease_ms[s] * ratio))
+
+    def _reapply_floor(self) -> None:
+        floor = self._floor_applied_ms
+        changed = 0
+        for s in np.nonzero(self.has_ctrl)[0]:
+            before = int(self.eto_ms[s])
+            self._apply_floor_slot(int(s))
+            after = int(self.eto_ms[s])
+            if after != before:
+                changed += 1
+                ctrl = self._ctrls[s]
+                if ctrl is not None:
+                    ctrl._adopt_eto(after)
+        if changed:
+            LOG.info("engine density floor %dms raised %d groups' "
+                     "election timeouts (%d registered)",
+                     floor, changed, int(self.has_ctrl.sum()))
+        self._params_dev = None
 
     def unregister_ctrl(self, slot: int) -> None:
         self._ctrls[slot] = None
         self._ctrl_server[slot] = None
         self.has_ctrl[slot] = False
         self.self_col[slot] = -1
+        self._n_ctrls -= 1
 
     def alloc_slot(self) -> int:
         if not self._free:
@@ -527,9 +895,13 @@ class MultiRaftEngine:
         self.granted = pad(self.granted)
         self.self_col = pad(self.self_col, -1)
         self.has_ctrl = pad(self.has_ctrl)
+        self.quiescent = pad(self.quiescent)
         self.eto_ms = pad(self.eto_ms, _DEF_ETO_MS)
         self.hb_ms = pad(self.hb_ms, _DEF_HB_MS)
         self.lease_ms = pad(self.lease_ms, _DEF_LEASE_MS)
+        self.req_eto_ms = pad(self.req_eto_ms, _DEF_ETO_MS)
+        self.req_hb_ms = pad(self.req_hb_ms, _DEF_HB_MS)
+        self.req_lease_ms = pad(self.req_lease_ms, _DEF_LEASE_MS)
         self.snap_ms = pad(self.snap_ms)
         self.snap_deadline = pad(self.snap_deadline)
         self._params_dev = None  # [G] rows must match the grown shape
@@ -556,7 +928,11 @@ class MultiRaftEngine:
         self.hb_deadline[s] = 0
         self.last_ack[s] = _NEG_I32
         self.granted[s] = False
+        self.quiescent[s] = False
+        self.note_wake_leader(s)
         self.eto_ms[s], self.hb_ms[s], self.lease_ms[s] = \
+            _DEF_ETO_MS, _DEF_HB_MS, _DEF_LEASE_MS
+        self.req_eto_ms[s], self.req_hb_ms[s], self.req_lease_ms[s] = \
             _DEF_ETO_MS, _DEF_HB_MS, _DEF_LEASE_MS
         self.snap_ms[s] = 0
         self.snap_deadline[s] = 0
@@ -610,6 +986,13 @@ class MultiRaftEngine:
         if server is not None:
             col = cols.get(server)
             self.self_col[slot] = -1 if col is None else col
+        if self.quiescent[slot]:
+            # a configuration change is protocol activity: a hibernating
+            # group must wake to drive it (and its lease bookkeeping no
+            # longer matches the new peer set)
+            ctrl = self._ctrls[slot]
+            if ctrl is not None:
+                ctrl.wake_from_quiescence("conf-change")
         self.mark_dirty()
 
     def peer_col(self, slot: int, peer: PeerId) -> Optional[int]:
@@ -618,6 +1001,50 @@ class MultiRaftEngine:
     def mark_dirty(self) -> None:
         self._dirty = True
         self._dirty_event.set()
+
+    # -- store-lease plumbing (quiescent leader slots) -----------------------
+
+    def note_quiesce_leader(self, slot: int) -> None:
+        """A leader slot hibernated: its peers' last_ack cells are now
+        refreshed from store-lease acks (one per endpoint per interval)
+        instead of per-group beat acks."""
+        self_col = int(self.self_col[slot])
+        for peer, col in self._peer_cols[slot].items():
+            if col == self_col:
+                continue
+            d = self._lease_cols.setdefault(peer.endpoint, {})
+            d.setdefault(slot, []).append(col)
+            self._lease_arrays.pop(peer.endpoint, None)
+
+    def note_wake_leader(self, slot: int) -> None:
+        for ep in list(self._lease_cols):
+            if self._lease_cols[ep].pop(slot, None) is not None:
+                self._lease_arrays.pop(ep, None)
+                if not self._lease_cols[ep]:
+                    del self._lease_cols[ep]
+
+    def note_store_ack(self, endpoint: str,
+                       when_ms: Optional[int] = None) -> None:
+        """A store-lease ack from ``endpoint``: refresh every quiescent
+        leader slot's last_ack cells toward it (vectorized — one fancy-
+        indexed write per ack, not O(G) RPC bookkeeping).  Dead-quorum
+        step-down and leader-lease reads then see a live quorum for
+        hibernating groups exactly as long as the store lease flows."""
+        d = self._lease_cols.get(endpoint)
+        if not d:
+            return
+        arrs = self._lease_arrays.get(endpoint)
+        if arrs is None:
+            slots: list[int] = []
+            cols: list[int] = []
+            for s, cs in d.items():
+                slots.extend([s] * len(cs))
+                cols.extend(cs)
+            arrs = (np.asarray(slots, np.int64), np.asarray(cols, np.int64))
+            self._lease_arrays[endpoint] = arrs
+        ms = self.now_ms() if when_ms is None else when_ms
+        sl, co = arrs
+        self.last_ack[sl, co] = np.maximum(self.last_ack[sl, co], ms)
 
     def describe(self) -> str:
         """Live engine state for operators (the device-plane counterpart
@@ -628,7 +1055,11 @@ class MultiRaftEngine:
                 f"backend={self.opts.backend} "
                 f"mesh={self.opts.mesh_devices or 1} "
                 f"ticks={self.ticks} commit_advances={self.commit_advances} "
-                f"leaders={int((self.role == ROLE_LEADER).sum())}>")
+                f"leaders={int((self.role == ROLE_LEADER).sum())} "
+                f"quiescent={int(self.quiescent.sum())} "
+                f"quiesce_events={self.quiesce_events} "
+                f"wake_events={self.wake_events} "
+                f"eto_floor_ms={self._floor_applied_ms}>")
 
     # -- tick loop -----------------------------------------------------------
 
@@ -679,7 +1110,8 @@ class MultiRaftEngine:
                     role=row, commit_rel=row, pending_rel=row,
                     match_rel=mat, granted=mat, voter_mask=mat,
                     old_voter_mask=mat, elect_deadline=row,
-                    hb_deadline=row, last_ack=mat, snap_deadline=row)
+                    hb_deadline=row, last_ack=mat, snap_deadline=row,
+                    quiescent=row)
                 out_sh = TickOutputs(
                     commit_rel=row, commit_advanced=row, elected=row,
                     election_due=row, step_down=row, hb_due=row,
@@ -743,8 +1175,10 @@ class MultiRaftEngine:
 
     def _next_deadline(self) -> int:
         """Earliest engine-scheduled deadline (election or heartbeat)
-        over controlled slots; a huge sentinel when none."""
-        hc = self.has_ctrl
+        over controlled slots; a huge sentinel when none.  Quiescent
+        slots schedule NOTHING — a fully hibernated engine sleeps until
+        a dirty mark (wake, lease round, client traffic) arrives."""
+        hc = self.has_ctrl & ~self.quiescent
         ec = hc & ((self.role == ROLE_FOLLOWER) | (self.role == ROLE_CANDIDATE))
         ld = hc & (self.role == ROLE_LEADER)
         nxt = 1 << 60
@@ -775,6 +1209,11 @@ class MultiRaftEngine:
                     LOG.exception("engine tick failed")
                     self._dirty = True  # re-process pending acks next tick
                 dur = time.perf_counter() - t0
+                # measured tick dispatch cost: one input to the density-
+                # aware election-timeout floor (_density_floor_ms)
+                self._tick_cost_ema_s = (
+                    dur if self._tick_cost_ema_s == 0.0
+                    else 0.9 * self._tick_cost_ema_s + 0.1 * dur)
                 pace = max(min_pace_s, dur * self.opts.pace_factor)
                 if advanced == 0:
                     # a no-op tick (e.g. the leader's OWN ack before any
@@ -864,6 +1303,7 @@ class MultiRaftEngine:
             hb_deadline=self.hb_deadline.astype(np.int32),
             last_ack=self.last_ack.astype(np.int32),
             snap_deadline=self.snap_deadline.astype(np.int32),
+            quiescent=self.quiescent,
         )
         with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
             out = self._tick_fn(state, np.int32(now), self._params_dev)
@@ -898,14 +1338,18 @@ class MultiRaftEngine:
         ack64 = np.clip(self.last_ack, _NEG_I32, None).astype(np.int64)
         q_ack = _np_joint_order_stat(ack64, vm, ovm)
         have_ack = q_ack > _NEG_I32
+        awake = ~self.quiescent
         return _NpOutputs(
             commit_rel=new_commit,
             commit_advanced=new_commit > commit_rel_now,
             elected=is_candidate & elected_q,
-            election_due=(is_follower | is_candidate)
+            election_due=(is_follower | is_candidate) & awake
             & (now >= self.elect_deadline),
+            # step_down stays LIVE for quiescent leaders: store-lease
+            # acks refresh their rows, so a dead store still deposes
+            # its hibernating leaders (mirrors ops/tick.py)
             step_down=is_leader & have_ack & (now - q_ack >= self.eto_ms),
-            hb_due=is_leader & (now >= self.hb_deadline),
+            hb_due=is_leader & awake & (now >= self.hb_deadline),
             lease_valid=is_leader & have_ack & (now - q_ack < self.lease_ms),
             snap_due=(self.role != ROLE_INACTIVE) & (self.snap_ms > 0)
             & (now >= self.snap_deadline),
@@ -980,6 +1424,13 @@ class MultiRaftEngine:
             node = ctrl.node
             if not node.is_leader():
                 continue
+            # quiescence bookkeeping: count consecutive fully-acked idle
+            # rounds; at the threshold the round's beats carry the
+            # quiesce handshake (every follower must ack before the
+            # group hibernates — see EngineControl.maybe_quiesce)
+            ctrl.maybe_quiesce(now)
+            if self.quiescent[s]:
+                continue  # hibernated (e.g. single-voter: no handshake)
             reps = node.replicators.all()
             if not reps:
                 continue

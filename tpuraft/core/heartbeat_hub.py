@@ -35,14 +35,33 @@ Two drivers share :meth:`pulse`:
 
 Operating envelope (timer mode): the hub is one shared clock per
 process, so a late loop wakeup delays EVERY group's beat at once — a
-correlation that independent per-group timers don't have.  Size
-election timeouts with headroom over worst-case event-loop latency at
-your group count (round 1 measured 64 groups x 3 replicas in one
-CPython process needing ~2s timeouts to ride out boot-storm lag; the
-engine control plane has since removed the per-group timers — 4096
-groups elect in one process at 300ms timeouts through the device
-tick — so at scale prefer engine mode).  The timer-mode hub beats at
-HALF the per-group heartbeat interval for margin.
+correlation that independent per-group timers don't have.  TIMER MODE
+IS THE LEGACY/SMALL-DEPLOYMENT PATH: at density, run the engine control
+plane — the device tick's masks schedule beats with no per-group
+timers, the engine now derives election-timeout floors from registered
+group count + measured tick cost (TickOptions.density_aware_timeouts),
+and idle groups hibernate entirely (RaftOptions.quiesce_after_rounds),
+collapsing idle beat traffic to the store-level lease below.  The
+timer-mode hub still beats at HALF the per-group heartbeat interval
+for margin, and timer-mode nodes neither quiesce nor get derived
+floors — size their timeouts by docs/operations.md "Density tuning &
+quiescence".
+
+Store-level liveness lease (quiescence): while any LOCAL leader group
+is hibernating toward an endpoint, the hub sends ONE tiny
+``store_lease`` beat per endpoint pair per interval — O(stores^2)
+idle RPCs regardless of group count, and pair-deduped on top: a beat
+proves the sender alive and its ack proves the receiver alive, so the
+higher endpoint of each pair suppresses its own sender while the
+lower's beats flow with margin (``lease_suppressed`` counter), roughly
+halving even that.  Receiver side, the hub re-arms the sender's lease
+(and credits the beat to its own quiescent leaders toward that store,
+as an ack would) and a watcher task wakes every dependent quiescent
+group (randomized election timeouts) the moment a lease expires;
+sender side, each ack refreshes the engine rows of the quiescent
+leader groups behind it and re-arms the acking store's lease, keeping
+dead-quorum step-down and leader-lease reads live for hibernating
+groups.
 """
 
 from __future__ import annotations
@@ -57,6 +76,7 @@ from tpuraft.rpc.messages import (
     CompactBeat,
     MultiHeartbeatRequest,
     MultiHeartbeatResponse,
+    StoreLeaseBeat,
     decode_message,
     encode_message,
 )
@@ -88,6 +108,51 @@ class HeartbeatHub:
         self.fast_beats_sent = 0
         self.fast_fallbacks = 0
         self._fast_ok: dict[str, bool] = {}  # dst lacks multi_beat_fast
+        # -- store-level liveness lease (quiescence) -------------------------
+        # sender: dst endpoint -> {id(engine): [engine, transport,
+        # src_endpoint, refcount, min_eto_ms]} — one lease beat per dst
+        # per interval while any local leader group hibernates toward it
+        self._lease_targets: dict[str, dict[int, list]] = {}
+        self._lease_task: Optional[asyncio.Task] = None
+        # sender: dst -> monotonic time of the last successful lease ack
+        # (store_lease_quorum_ok consults this for hibernating leaders)
+        # — ALSO refreshed by an incoming beat from dst: a store that
+        # beats us is just as provably alive as one that acks us, which
+        # is what lets the pair-dedupe below halve idle lease traffic
+        self._lease_ack_at: dict[str, float] = {}
+        # receiver: src endpoint -> monotonic lease expiry deadline
+        self._lease_from: dict[str, float] = {}
+        # receiver: src endpoint -> set of EngineControls to wake on expiry
+        self._lease_deps: dict[str, set] = {}
+        self._lease_watch_task: Optional[asyncio.Task] = None
+        # nudges the watcher out of its sleep-to-horizon when a NEW
+        # dependency may carry an earlier deadline (so the watcher can
+        # sleep until the actual next expiry — minutes at derived
+        # timeouts — instead of polling at a fixed sub-second cadence)
+        self._lease_watch_nudge = asyncio.Event()
+        # lease/quiescence counters (surfaced via describe + soak stats)
+        self.lease_rpcs_sent = 0
+        self.lease_acks = 0
+        self.lease_beats_seen = 0   # receiver side
+        self.lease_expiries = 0
+        self.lease_suppressed = 0   # pair-dedupe: rounds we rode the
+        # peer's beats instead of sending our own
+        self.groups_quiesced = 0
+        self.groups_woken = 0
+        from tpuraft.util import describer
+        from tpuraft.util.metrics import MetricRegistry
+
+        # one registry per hub, gauges bound to the live counters — the
+        # beat-plane sibling of Node.metrics (util/metrics.py idiom);
+        # snapshot() is what the soak stats line and benches read
+        self.metrics = MetricRegistry()
+        for name in ("rpcs_sent", "beats_sent", "fast_beats_sent",
+                     "fast_fallbacks", "groups_quiesced", "groups_woken",
+                     "lease_rpcs_sent", "lease_acks", "lease_beats_seen",
+                     "lease_expiries", "lease_suppressed"):
+            self.metrics.gauge(f"hub.{name}",
+                               lambda n=name: getattr(self, n))
+        describer.register(self)
 
     def register(self, replicator: "Replicator") -> None:
         node = replicator._node
@@ -116,13 +181,237 @@ class HeartbeatHub:
 
     async def shutdown(self) -> None:
         self._members.clear()
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        self._lease_targets.clear()
+        self._lease_deps.clear()
+        for task in (self._task, self._lease_task, self._lease_watch_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._task = self._lease_task = self._lease_watch_task = None
+        from tpuraft.util import describer
+
+        describer.unregister(self)
+
+    def describe(self) -> str:
+        """Hub counters for operators (registered with util.describer —
+        the beat-plane counterpart of Node#describe)."""
+        return (f"HeartbeatHub<members={len(self._members)} "
+                f"rpcs_sent={self.rpcs_sent} beats_sent={self.beats_sent} "
+                f"fast_beats_sent={self.fast_beats_sent} "
+                f"fast_fallbacks={self.fast_fallbacks} "
+                f"quiesced={self.groups_quiesced} woken={self.groups_woken} "
+                f"lease_rpcs={self.lease_rpcs_sent} "
+                f"lease_acks={self.lease_acks} "
+                f"lease_beats_seen={self.lease_beats_seen} "
+                f"lease_expiries={self.lease_expiries} "
+                f"lease_suppressed={self.lease_suppressed} "
+                f"lease_targets={len(self._lease_targets)} "
+                f"lease_deps={sum(map(len, self._lease_deps.values()))}>")
+
+    def counters(self) -> dict:
+        """Counter snapshot (soak stats line / tests)."""
+        return {
+            "rpcs_sent": self.rpcs_sent,
+            "beats_sent": self.beats_sent,
+            "fast_beats_sent": self.fast_beats_sent,
+            "fast_fallbacks": self.fast_fallbacks,
+            "groups_quiesced": self.groups_quiesced,
+            "groups_woken": self.groups_woken,
+            "lease_rpcs_sent": self.lease_rpcs_sent,
+            "lease_acks": self.lease_acks,
+            "lease_beats_seen": self.lease_beats_seen,
+            "lease_expiries": self.lease_expiries,
+            "lease_suppressed": self.lease_suppressed,
+        }
+
+    # -- store-level liveness lease (sender side) ----------------------------
+
+    def lease_add(self, dst: str, engine, transport, src_endpoint: str,
+                  eto_ms: int) -> None:
+        """A local leader group hibernated toward ``dst``: keep its
+        store's liveness proven by one lease beat per interval."""
+        entries = self._lease_targets.setdefault(dst, {})
+        ent = entries.get(id(engine))
+        if ent is None:
+            entries[id(engine)] = [engine, transport, src_endpoint, 1,
+                                   eto_ms]
+        else:
+            ent[3] += 1
+            ent[4] = min(ent[4], eto_ms)
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = asyncio.ensure_future(self._lease_loop())
+
+    def lease_remove(self, dst: str, engine) -> None:
+        entries = self._lease_targets.get(dst)
+        if entries is None:
+            return
+        ent = entries.get(id(engine))
+        if ent is None:
+            return
+        ent[3] -= 1
+        if ent[3] <= 0:
+            del entries[id(engine)]
+        if not entries:
+            del self._lease_targets[dst]
+
+    def lease_ack_fresh(self, dst: str, within_ms: int) -> bool:
+        at = self._lease_ack_at.get(dst)
+        return at is not None and (time.monotonic() - at) * 1000 < within_ms
+
+    async def _lease_loop(self) -> None:
+        """ONE store_lease RPC per dst endpoint per interval — the whole
+        idle cost of a hibernated deployment.  Interval = min dependent
+        eto / 4, so a silent store misses ~4 beats before its lease
+        expires — inside the normal fault-detection envelope."""
+        try:
+            while self._lease_targets:
+                min_eto = min(ent[4] for entries in
+                              self._lease_targets.values()
+                              for ent in entries.values())
+                await asyncio.sleep(max(0.02, min_eto / 4000.0))
+                for dst, entries in list(self._lease_targets.items()):
+                    ents = list(entries.values())
+                    if not ents:
+                        continue
+                    # pair dedupe: a lease beat is a BIDIRECTIONAL
+                    # liveness proof (the beat proves the sender alive,
+                    # its ack proves the receiver alive), so only one
+                    # side of each endpoint pair needs to send.  The
+                    # higher endpoint rides the lower's beats while they
+                    # flow with margin to spare, and resumes its own the
+                    # moment they thin out (peer died, or stopped having
+                    # leaders toward us) — the fault-detection envelope
+                    # is unchanged, the idle RPC rate halves.
+                    if ents[0][2] > dst:
+                        margin = (self._lease_from.get(dst, 0.0)
+                                  - time.monotonic())
+                        if margin > min(e[4] for e in ents) / 2000.0:
+                            self.lease_suppressed += 1
+                            continue
+                    t = asyncio.ensure_future(self._lease_beat(dst, ents))
+                    t.add_done_callback(
+                        lambda tt: tt.cancelled() or tt.exception())
+                # lease rounds drive the (otherwise fully idle) engines'
+                # ticks, so quiescent-leader step_down staleness is
+                # re-evaluated at lease cadence even with zero traffic
+                seen = set()
+                for entries in self._lease_targets.values():
+                    for ent in entries.values():
+                        if id(ent[0]) not in seen:
+                            seen.add(id(ent[0]))
+                            ent[0].mark_dirty()
+        except asyncio.CancelledError:
+            return
+        finally:
+            self._lease_task = None
+
+    async def _lease_beat(self, dst: str, ents: list) -> None:
+        engine_list = [ent[0] for ent in ents]
+        transport = ents[0][1]
+        src = ents[0][2]
+        lease_ms = min(ent[4] for ent in ents)
+        self.lease_rpcs_sent += 1
+        try:
+            await transport.call(
+                dst, "store_lease",
+                StoreLeaseBeat(endpoint=src, lease_ms=lease_ms),
+                timeout_ms=max(1, lease_ms // 2))
+        except RpcError:
+            return  # silence: rows go stale -> step_down, as designed
+        self.lease_acks += 1
+        now = time.monotonic()
+        self._lease_ack_at[dst] = now
+        for engine in engine_list:
+            engine.note_store_ack(dst)
+        # the ack also proves dst alive for OUR quiescent followers
+        # (pair dedupe: dst may be riding these beats instead of
+        # sending its own, so this re-arm is their only refresh)
+        deadline = now + lease_ms / 1000.0
+        if deadline > self._lease_from.get(dst, 0.0):
+            self._lease_from[dst] = deadline
+
+    # -- store-level liveness lease (receiver side) --------------------------
+
+    def note_lease_from(self, src: str, lease_ms: int) -> int:
+        """An incoming store_lease beat: re-arm ``src``'s lease.
+        Returns the dependent count (ack observability)."""
+        self.lease_beats_seen += 1
+        now = time.monotonic()
+        deadline = now + lease_ms / 1000.0
+        if deadline > self._lease_from.get(src, 0.0):
+            self._lease_from[src] = deadline
+        # the beat also proves src alive for OUR quiescent leaders
+        # toward it — exactly what an ack of our own beat would prove
+        # (pair dedupe: while src keeps beating us, our sender skips
+        # its half of the pair and this is the leaders' only refresh)
+        entries = self._lease_targets.get(src)
+        if entries:
+            self._lease_ack_at[src] = now
+            for ent in list(entries.values()):
+                ent[0].note_store_ack(src)
+        return len(self._lease_deps.get(src, ()))
+
+    def lease_fresh(self, src: str) -> bool:
+        return self._lease_from.get(src, 0.0) > time.monotonic()
+
+    def lease_depend(self, src: str, ctrl, lease_ms: int) -> None:
+        """A local quiescent follower group delegates liveness of its
+        leader's store to this lease.  Registration arms the lease (the
+        quiesce beat itself just proved the store alive)."""
+        self._lease_deps.setdefault(src, set()).add(ctrl)
+        self.note_lease_from(src, lease_ms)
+        self.lease_beats_seen -= 1  # registration is not a beat
+        self._lease_watch_nudge.set()  # new dep may have an earlier
+        # deadline than the watcher's current sleep-to-horizon
+        if self._lease_watch_task is None or self._lease_watch_task.done():
+            self._lease_watch_task = asyncio.ensure_future(
+                self._lease_watch())
+
+    def lease_undepend(self, src: str, ctrl) -> None:
+        deps = self._lease_deps.get(src)
+        if deps is None:
+            return
+        deps.discard(ctrl)
+        if not deps:
+            del self._lease_deps[src]
+
+    async def _lease_watch(self) -> None:
+        """Wake EXACTLY the groups depending on an expired store lease,
+        each with a randomized election timeout (no thundering herd).
+        Sleeps until the earliest expiry (deadlines only ever extend;
+        lease_depend nudges us when a new dependency might be earlier)
+        — a fully-hibernated process takes no standing sub-second
+        wakeups from the watcher."""
+        try:
+            while self._lease_deps:
+                horizon = min(self._lease_from.get(src, 0.0)
+                              for src in self._lease_deps)
+                wait = max(0.02, horizon - time.monotonic())
+                self._lease_watch_nudge.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._lease_watch_nudge.wait(), wait)
+                except asyncio.TimeoutError:
+                    pass
+                now = time.monotonic()
+                for src in [s for s in list(self._lease_deps)
+                            if self._lease_from.get(s, 0.0) <= now]:
+                    ctrls = self._lease_deps.pop(src, set())
+                    self.lease_expiries += 1
+                    LOG.info("store lease from %s expired: waking %d "
+                             "quiescent groups", src, len(ctrls))
+                    for ctrl in ctrls:
+                        try:
+                            ctrl.wake_for_lease_expiry()
+                        except Exception:  # noqa: BLE001 — one group's
+                            LOG.exception("lease-expiry wake failed")
+        except asyncio.CancelledError:
+            return
+        finally:
+            self._lease_watch_task = None
 
     async def _loop(self) -> None:
         try:
@@ -164,6 +453,9 @@ class HeartbeatHub:
             node = r._node
             if not node.is_leader() or not r._running:
                 continue
+            quiesce_ms = getattr(r, "_quiesce_lease_ms", 0)
+            if quiesce_ms:
+                r._quiesce_lease_ms = 0
             if (r.peer_multi_hb and r._matched
                     and self._fast_ok.get(r.peer.endpoint, True)):
                 committed = min(node.ballot_box.last_committed_index,
@@ -172,7 +464,17 @@ class HeartbeatHub:
                 # object while (term, committed) are unchanged — the
                 # steady state — instead of rebuilding it every pulse
                 cached = getattr(r, "_fast_beat_cache", None)
-                if (cached is not None and cached.term == node.current_term
+                if quiesce_ms:
+                    # quiesce handshake rides its own (uncached) beat
+                    beat = CompactBeat(
+                        group_id=node.group_id,
+                        server_id=str(node.server_id),
+                        peer_id=str(r.peer),
+                        term=node.current_term,
+                        committed_index=committed,
+                        quiesce=True, lease_ms=quiesce_ms)
+                elif (cached is not None
+                        and cached.term == node.current_term
                         and cached.committed_index == committed):
                     beat = cached
                 else:
@@ -185,6 +487,12 @@ class HeartbeatHub:
                     r._fast_beat_cache = beat
                 by_dst_fast.setdefault(r.peer.endpoint, []).append((r, beat))
                 continue
+            if quiesce_ms:
+                # the handshake needs the fast path; a classic-only peer
+                # cannot carry it — the group just stays active
+                ctrl = getattr(node, "_ctrl", None)
+                if ctrl is not None and hasattr(ctrl, "abort_quiesce"):
+                    ctrl.abort_quiesce()
             classic.append(r)
         for dst, pairs in by_dst_fast.items():
             for ci in range(0, len(pairs), self.max_fast_beats_per_rpc):
@@ -216,6 +524,7 @@ class HeartbeatHub:
             return
         LOG.warning("heartbeat batch %s failed: %r", key, exc)
         if fallback:
+            self._abort_quiesce(fallback)
             self.fast_fallbacks += len(fallback)
             self._pulse_classic([r for r in fallback if r._running])
 
@@ -240,10 +549,22 @@ class HeartbeatHub:
                 t.add_done_callback(
                     lambda _t, k=key: self._reap(k, _t))
 
+    @staticmethod
+    def _abort_quiesce(reps: list["Replicator"]) -> None:
+        """A chunk carrying quiesce-handshake beats failed (RPC error,
+        short response, classic fallback): the affected groups stay
+        active — a hibernation the followers may not have joined is a
+        safety hole, an aborted one just costs beats."""
+        for r in reps:
+            ctrl = getattr(r._node, "_ctrl", None)
+            if ctrl is not None and hasattr(ctrl, "abort_quiesce"):
+                ctrl.abort_quiesce()
+
     async def _beat_fast(self, dst: str,
                          pairs: list[tuple["Replicator", object]]) -> None:
         reps = [r for r, _ in pairs]
         items = [b for _, b in pairs]
+        quiescing = [r for r, b in pairs if getattr(b, "quiesce", False)]
         node = reps[0]._node
         self.rpcs_sent += 1
         self.fast_beats_sent += len(items)
@@ -252,9 +573,11 @@ class HeartbeatHub:
                 dst, "multi_beat_fast", BatchRequest(items=items),
                 timeout_ms=node.options.election_timeout_ms // 2 or 1)
         except RpcError as e:
+            self._abort_quiesce(quiescing)
             if is_no_method(e):
                 # receiver predates the beat plane: classic beats only
                 self._fast_ok[dst] = False
+                self.fast_fallbacks += len(reps)
                 self.pulse(reps)
             return  # else: silence — dead-node detection, as direct
         if len(resp.items) != len(items):
@@ -262,20 +585,29 @@ class HeartbeatHub:
             # replicators' acks — treat the whole chunk as deviating
             LOG.warning("multi_beat_fast %s: %d acks for %d beats",
                         dst, len(resp.items), len(items))
+            self._abort_quiesce(quiescing)
             self.fast_fallbacks += len(reps)
             self._pulse_classic(reps)
             return
         now = time.monotonic()
         fallback: list["Replicator"] = []
-        for r, ack in zip(reps, resp.items):
+        for (r, beat), ack in zip(pairs, resp.items):
             if not r._running or not r._node.is_leader():
                 continue
+            proposed = getattr(beat, "quiesce", False)
             if getattr(ack, "ok", False):
                 # inline ack bookkeeping: the lease plane only needs the
                 # (peer, when) write — no per-ack task, no node lock
                 r.last_rpc_ack = now
                 r._node.on_peer_ack(r.peer, now)
+                if proposed:
+                    ctrl = getattr(r._node, "_ctrl", None)
+                    if ctrl is not None and \
+                            hasattr(ctrl, "note_quiesce_ack"):
+                        ctrl.note_quiesce_ack(r.peer)
             else:
+                if proposed:
+                    self._abort_quiesce([r])
                 fallback.append(r)
         if fallback:
             # full-semantics follow-up for just the deviating groups

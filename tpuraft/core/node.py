@@ -125,6 +125,9 @@ class TimerControl:
     def note_leader_contact(self) -> None:
         pass  # the election handler's lease check covers timer mode
 
+    def note_activity(self) -> None:
+        pass  # timer-mode nodes never quiesce (EngineControl wakes)
+
     def on_candidate(self) -> None:
         self._election_timer.stop()
         self._vote_timer.start()
@@ -517,6 +520,8 @@ class Node:
                 return
             entries = [LogEntry(type=EntryType.DATA, data=t.data)
                        for t in good]
+            self._ctrl.note_activity()  # a write instantly wakes a
+            # hibernating leader group (quiescence)
             term = self.current_term
             last_id = self.log_manager.stage_leader_entries(entries, term)
             first_index = last_id.index - len(good) + 1
@@ -652,9 +657,17 @@ class Node:
     # ======================================================================
 
     def _leader_lease_valid(self) -> bool:
-        return (time.monotonic() - self._last_leader_timestamp
+        if (time.monotonic() - self._last_leader_timestamp
                 < self.options.election_timeout_ms
-                * self.options.raft_options.leader_lease_time_ratio / 1000.0)
+                * self.options.raft_options.leader_lease_time_ratio / 1000.0):
+            return True
+        # quiescent follower: the per-group leader-contact timestamp
+        # legitimately goes stale (beats are suppressed) — 'my leader is
+        # alive' is delegated to its STORE's liveness lease, so the vote
+        # guards and the election-timeout lease check stay closed exactly
+        # as long as the store lease flows (hibernate-raft safety)
+        q = getattr(self._ctrl, "quiescent_leader_alive", None)
+        return q is not None and q()
 
     def _believes_leader_alive(self) -> bool:
         """Is there, from THIS node's view, a live leader right now?  On
@@ -1007,6 +1020,12 @@ class Node:
             if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR,
                               State.UNINITIALIZED):
                 return RequestVoteResponse(term=self.current_term, granted=False)
+            # a vote solicitation is protocol activity: a hibernating
+            # group (leader included) resumes its timers — a woken
+            # leader's next beat then re-absorbs the soliciting
+            # follower instead of leaving it pre-voting forever against
+            # a lease-fresh quorum
+            self._ctrl.note_activity()
             if req.pre_vote:
                 return self._handle_pre_vote(req, candidate)
             # real vote
@@ -1053,7 +1072,13 @@ class Node:
             # through pre-vote, or a {A,B,D} group where only B lags at
             # {A,B,C} can never elect D after A dies
             return RequestVoteResponse(term=self.current_term, granted=False)
-        if not self.leader_id.is_empty() and self._leader_lease_valid():
+        # role-aware liveness: a follower consults its leader-contact
+        # lease (store-delegated while quiescent), the LEADER consults
+        # its own quorum-ack lease — the follower-side timestamp is not
+        # refreshed while leading, so the bare _leader_lease_valid()
+        # would have a long-lived (or hibernating) leader grant
+        # pre-votes against itself
+        if self._believes_leader_alive():
             return RequestVoteResponse(term=self.current_term, granted=False)
         granted = self._candidate_log_up_to_date(req)
         return RequestVoteResponse(term=self.current_term, granted=granted)
@@ -1102,6 +1127,12 @@ class Node:
                     last_log_index=self.log_manager.last_log_index())
             self._last_leader_timestamp = time.monotonic()
             self._ctrl.note_leader_contact()
+            # an incoming full-semantics append (entries, probe, or
+            # classic beat) means the leader is ACTIVE: a quiescent
+            # follower wakes — heals the asymmetric state left by an
+            # aborted quiesce handshake within one beat instead of one
+            # store-lease expiry
+            self._ctrl.note_activity()
 
             lm = self.log_manager
             if not req.entries:

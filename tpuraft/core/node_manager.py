@@ -45,6 +45,10 @@ class NodeManager:
         server.register("multi_append", self._handle_multi_append)
         server.register("multi_vote", self._handle_multi_vote)
         server.register("multi_beat_fast", self._handle_multi_beat_fast)
+        # store-level liveness lease (quiescence): one tiny beat per
+        # endpoint pair proves a whole store alive while its groups
+        # hibernate (HeartbeatHub receiver side)
+        server.register("store_lease", self._handle_store_lease)
         self._send_plane = None
         self._heartbeat_hub = None  # created on first coalescing leader
         # at most ONE outstanding beat handler per (group, peer): beats
@@ -92,12 +96,44 @@ class NodeManager:
                     <= node.ballot_box.last_committed_index):
                 node._ctrl.note_leader_contact()
                 node._last_leader_timestamp = time.monotonic()
-                acks.append(BeatAck(ok=True, term=node.current_term))
+                ok = True
+                if getattr(b, "quiesce", False):
+                    # quiesce handshake: join the hibernation ONLY when
+                    # this follower is provably at the leader's tail
+                    # (the leader's committed == its last index == our
+                    # last index and we applied it) — a lagging or
+                    # timer-mode follower refuses, keeping the group
+                    # active and its election timer live
+                    enter = getattr(node._ctrl,
+                                    "enter_quiescent_follower", None)
+                    ok = (enter is not None
+                          and node.log_manager.last_log_index()
+                          == b.committed_index
+                          and node.ballot_box.last_committed_index
+                          == b.committed_index
+                          and enter(PeerId.parse(b.server_id).endpoint,
+                                    getattr(b, "lease_ms", 0)))
+                else:
+                    # a NORMAL beat from an active leader: a follower
+                    # still hibernating (aborted handshake, leader woke)
+                    # resumes fault detection with it
+                    node._ctrl.note_activity()
+                acks.append(BeatAck(ok=bool(ok), term=node.current_term))
             else:
                 acks.append(BeatAck(
                     ok=False,
                     term=node.current_term if node is not None else 0))
         return BatchResponse(items=acks)
+
+    async def _handle_store_lease(self, request):
+        """Receiver side of the store-level liveness lease: re-arm the
+        sending store's lease; the hub's watcher wakes every dependent
+        quiescent group the moment it expires."""
+        from tpuraft.rpc.messages import StoreLeaseAck
+
+        deps = self.heartbeat_hub.note_lease_from(
+            request.endpoint, request.lease_ms)
+        return StoreLeaseAck(ok=True, dependents=deps)
 
     async def _handle_multi_vote(self, request):
         """Fan a vote BatchRequest out concurrently; vote handlers only

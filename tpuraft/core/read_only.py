@@ -141,6 +141,14 @@ class ReadOnlyService:
     async def _confirm_once(self) -> tuple[bool, int]:
         node = self._node
         read_index = node.ballot_box.last_committed_index
+        # a SAFE confirmation round beats the followers directly, and a
+        # beaten follower WAKES (note_activity) — the leader must wake
+        # with it or its hibernation outlives its followers' patience
+        # and they elect over it.  LEASE_BASED reads stay quiescent: the
+        # store-level lease already refreshes the leader's ack rows.
+        if node.options.raft_options.read_only_option != \
+                ReadOnlyOption.LEASE_BASED:
+            node._ctrl.note_activity()
         # SAFETY GATE: until this leader commits the first entry of its
         # OWN term (the election no-op), its lastCommittedIndex is a
         # follower-time carry-over that may LAG entries the previous

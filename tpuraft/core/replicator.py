@@ -66,6 +66,10 @@ class Replicator:
         # every AppendEntries response (probe/ack/beat); drives AUTO
         # coalescing (RaftOptions.coalesce_heartbeats=None)
         self.peer_multi_hb = False
+        # quiesce handshake: EngineControl.maybe_quiesce arms this with
+        # the lease horizon; the next hub pulse sends ONE quiesce beat
+        # to this peer and clears it (0 = no handshake pending)
+        self._quiesce_lease_ms = 0
         # set while this replicator lingers for a REMOVED peer (it keeps
         # shipping until the peer has the conf entry removing it, or a
         # timeout) — cleared if the peer is re-added meanwhile

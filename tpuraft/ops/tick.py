@@ -59,6 +59,12 @@ class GroupState:
     last_ack: jnp.ndarray      # int32 [G,P] ms: last response time per peer
     snap_deadline: jnp.ndarray  # int32 [G] ms: next snapshot due (engine-
     # scheduled snapshotTimer: one [G] row + mask replaces G RepeatedTimers)
+    quiescent: jnp.ndarray     # bool [G] hibernating group: beats and
+    # election timeouts suppressed on device; liveness is delegated to the
+    # store-level lease (HeartbeatHub), which wakes the group on expiry.
+    # step_down stays LIVE for quiescent leaders — the host refreshes
+    # their last_ack rows from store-lease acks, so a dead store still
+    # deposes its quiescent leaders through ordinary ack staleness.
 
     @staticmethod
     def zeros(g: int, p: int) -> "GroupState":
@@ -74,6 +80,7 @@ class GroupState:
             hb_deadline=jnp.zeros((g,), jnp.int32),
             last_ack=jnp.zeros((g, p), jnp.int32),
             snap_deadline=jnp.zeros((g,), jnp.int32),
+            quiescent=jnp.zeros((g,), bool),
         )
 
 
@@ -147,7 +154,12 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     elected = is_candidate & vote_ok
 
     # --- election timeout (RepeatedTimer electionTimer, vectorized) --------
-    election_due = (is_follower | is_candidate) & (now_ms >= state.elect_deadline)
+    # Quiescent followers suppress their election timeout: liveness for a
+    # hibernating group rides the store-level lease, and the lease-expiry
+    # wake path re-arms the deadline (with fresh jitter) before clearing
+    # the quiescent bit — so the mask can never fire on stale deadlines.
+    election_due = (is_follower | is_candidate) & ~state.quiescent & (
+        now_ms >= state.elect_deadline)
 
     # --- leader lease / step-down (NodeImpl#checkDeadNodes) ----------------
     # Count the leader itself as acked "now" via its self slot: the host
@@ -163,7 +175,12 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     )
 
     # --- heartbeat scheduling ---------------------------------------------
-    hb_due = is_leader & (now_ms >= state.hb_deadline)
+    # Quiescent leaders beat nothing: idle beat traffic collapses from
+    # O(G x P) rows to the store-level lease's O(stores^2) RPCs.  The
+    # step_down mask above intentionally stays ungated — store-lease acks
+    # refresh quiescent leaders' last_ack rows host-side, so a silent
+    # store still deposes its hibernating leaders within one timeout.
+    hb_due = is_leader & ~state.quiescent & (now_ms >= state.hb_deadline)
     new_hb_deadline = jnp.where(hb_due, now_ms + params.heartbeat_ms, state.hb_deadline)
 
     # --- snapshot cadence (RepeatedTimer snapshotTimer, vectorized) --------
@@ -188,6 +205,7 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         hb_deadline=new_hb_deadline,
         last_ack=state.last_ack,
         snap_deadline=new_snap_deadline,
+        quiescent=state.quiescent,
     )
     outputs = TickOutputs(
         commit_rel=new_commit,

@@ -57,6 +57,19 @@ class RaftOptions:
     # cluster's RPC rate is O(endpoints) out of the box.  True = always
     # (peers must serve multi_heartbeat), False = never.
     coalesce_heartbeats: Optional[bool] = None
+    # Group quiescence ("hibernate raft"): an engine-driven leader group
+    # that is fully replicated, has nothing pending, and sees this many
+    # CONSECUTIVE fully-acked beat rounds hibernates — its beats and its
+    # followers' election timeouts are suppressed on device, and liveness
+    # is delegated to ONE store-level lease beat per endpoint pair
+    # (HeartbeatHub), so an idle deployment's beat-plane RPC rate drops
+    # from O(groups x peers) to O(stores^2).  Any apply / conf change /
+    # incoming traffic instantly wakes the group; a store-lease expiry
+    # wakes its dependent groups with randomized election timeouts.
+    # 0 disables (the conservative default); 4-16 is a sensible range —
+    # smaller = faster to hibernate, larger = more proof of idleness.
+    # Engine-driven nodes only (TimerControl nodes never quiesce).
+    quiesce_after_rounds: int = 0
     read_only_option: ReadOnlyOption = ReadOnlyOption.SAFE
     max_replicator_retry_times: int = 3
     step_down_when_vote_timedout: bool = True
@@ -100,6 +113,26 @@ class TickOptions:
     # instead of per-group RepeatedTimers — the SURVEY §8.1 device
     # plane.  False = commit-reduce only (legacy: host timers).
     drive_protocol: bool = True
+    # Density-aware timeout floors: the engine derives a minimum election
+    # timeout from the REGISTERED group count and the measured tick
+    # dispatch cost, and raises any group whose requested timeout sits
+    # below it (hb/lease scale proportionally; the node's host-side
+    # options adopt the raise).  Replaces the hand-tuned "60s at 16Kx3"
+    # operating point: the floor keeps the idle beat plane under
+    # ``beat_cpu_budget`` of one core at whatever density the process
+    # actually reaches.  False = never raise (benchmarks of the raw
+    # envelope; misconfigured densities then wedge exactly as before).
+    density_aware_timeouts: bool = True
+    # Estimated end-to-end cost of ONE beat row (sender build + RPC share
+    # + receiver validate + ack bookkeeping), microseconds.  Seeded from
+    # the measured beat-plane envelope (docs/operations.md "Scale
+    # election timeouts with group density"); the engine additionally
+    # folds its own measured tick cost into the floor, so a slow host
+    # raises timeouts further than this constant alone would.
+    beat_cost_us: float = 20.0
+    # Fraction of one core the idle beat plane may consume before the
+    # floor starts raising timeouts.
+    beat_cpu_budget: float = 0.10
     backend: str = "auto"         # "auto" | "jax" | "numpy" (numpy for tiny tests)
     donate_state: bool = True     # donate state buffers to the tick kernel
     # Shard the engine's [G, P] planes over a device mesh along the group

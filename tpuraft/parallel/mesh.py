@@ -66,7 +66,7 @@ def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
             role=row, commit_rel=row, pending_rel=row, match_rel=mat,
             granted=mat, voter_mask=mat, old_voter_mask=mat,
             elect_deadline=row, hb_deadline=row, last_ack=mat,
-            snap_deadline=row)
+            snap_deadline=row, quiescent=row)
 
     out_outputs = TickOutputs(
         commit_rel=row, commit_advanced=row, elected=row, election_due=row,

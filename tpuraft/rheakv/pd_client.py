@@ -38,6 +38,30 @@ class PlacementDriverClient:
         """Returns PD instructions (e.g. split orders); empty by default."""
         return []
 
+    async def store_heartbeat_batch(
+            self, meta: StoreMeta,
+            deltas: list[tuple[Region, str, int]],
+            full: bool = False) -> tuple[list, bool]:
+        """Delta-batched reporting: ONE call per interval carrying only
+        the CHANGED (region, leader, approximate_keys) rows.  Returns
+        (instructions, need_full).  Default: decompose into the legacy
+        per-region calls — PD-less / legacy clients keep exact
+        semantics while batch-aware clients override with one RPC.
+        need_full is always True here: a legacy PD has no delta state
+        and runs its policy (split re-issue, leader balancing) off the
+        per-region reports, so every round must carry EVERY led region
+        — delta-only reporting would starve it, and a failed-over
+        legacy PD leader would stay cold forever (it cannot ask for a
+        resync the way the batch protocol can)."""
+        meta = StoreMeta(id=meta.id, endpoint=meta.endpoint,
+                         regions=[r.copy() for (r, _l, _k) in deltas])
+        await self.store_heartbeat(meta)
+        instructions: list = []
+        for region, leader, keys in deltas:
+            instructions.extend(await self.region_heartbeat(
+                region, leader, {"approximate_keys": keys}))
+        return instructions, True
+
     async def shutdown(self) -> None:
         pass
 
@@ -71,6 +95,10 @@ class RemotePlacementDriverClient(PlacementDriverClient):
         self._endpoints = list(pd_endpoints)
         self._timeout_ms = timeout_ms
         self._leader: Optional[str] = None
+        # does the PD serve pd_store_heartbeat_batch?  Optimistic until
+        # an ENOMETHOD proves otherwise (a pre-delta-batch PD), then the
+        # legacy per-region decomposition takes over permanently.
+        self._batch_ok = True
 
     async def _call(self, method: str, request):
         from tpuraft.rpc.transport import RpcError
@@ -149,3 +177,32 @@ class RemotePlacementDriverClient(PlacementDriverClient):
         resp = await self._call("pd_region_heartbeat", RegionHeartbeatRequest(
             region=region.encode(), leader=leader, approximate_keys=keys))
         return [Instruction.decode(b) for b in resp.instructions]
+
+    async def store_heartbeat_batch(
+            self, meta: StoreMeta,
+            deltas: list[tuple[Region, str, int]],
+            full: bool = False) -> tuple[list, bool]:
+        from tpuraft.rheakv.pd_messages import (
+            Instruction,
+            StoreHeartbeatBatchRequest,
+            encode_region_delta,
+        )
+        from tpuraft.rpc.transport import RpcError, is_no_method
+
+        if not self._batch_ok:
+            return await super().store_heartbeat_batch(meta, deltas, full)
+        req = StoreHeartbeatBatchRequest(
+            store_id=meta.id, endpoint=meta.endpoint,
+            deltas=[encode_region_delta(r.encode(), leader, keys)
+                    for (r, leader, keys) in deltas],
+            full=full)
+        try:
+            resp = await self._call("pd_store_heartbeat_batch", req)
+        except RpcError as e:
+            if is_no_method(e):
+                self._batch_ok = False
+                return await super().store_heartbeat_batch(
+                    meta, deltas, full)
+            raise
+        return ([Instruction.decode(b) for b in resp.instructions],
+                bool(getattr(resp, "need_full", False)))

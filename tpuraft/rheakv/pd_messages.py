@@ -103,6 +103,49 @@ class CreateRegionIdResponse:
     msg: str = ""
 
 
+@_pd(152)
+class StoreHeartbeatBatchRequest:
+    """Delta-batched PD reporting (quiescent multi-raft): ONE RPC per
+    store per interval carrying only CHANGED region rows — an idle
+    2K-region store's PD traffic collapses from O(regions) RPCs/s to
+    one near-empty batch/s.  ``full=True`` marks a complete resync
+    (first contact, or the PD answered ``need_full``)."""
+
+    store_id: int
+    endpoint: str
+    # changed-region rows, each encode_region_delta() (leader peer,
+    # approximate keys, Region encoding)
+    deltas: list[bytes] = field(default_factory=list)
+    full: bool = False
+
+
+@_pd(153)
+class StoreHeartbeatBatchResponse:
+    # flat list: each Instruction already names its region_id
+    instructions: list[bytes] = field(default_factory=list)
+    # the PD leader has no full picture of this store (new leader /
+    # store unknown): send a full batch next round
+    need_full: bool = False
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+def encode_region_delta(region_blob: bytes, leader: str,
+                        approximate_keys: int) -> bytes:
+    lp = leader.encode()
+    return (struct.pack("<H", len(lp)) + lp
+            + struct.pack("<q", approximate_keys) + region_blob)
+
+
+def decode_region_delta(blob: bytes) -> tuple[bytes, str, int]:
+    """Returns (region_encoding, leader, approximate_keys)."""
+    (n,) = struct.unpack_from("<H", blob, 0)
+    leader = bytes(blob[2:2 + n]).decode()
+    (keys,) = struct.unpack_from("<q", blob, 2 + n)
+    return bytes(blob[10 + n:]), leader, keys
+
+
 @dataclass
 class Instruction:
     """A PD order to a store (reference: ``rhea:metadata/Instruction`` —

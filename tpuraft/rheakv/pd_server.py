@@ -254,6 +254,11 @@ class ClusterStatsManager:
     def record(self, region_id: int, approximate_keys: int) -> None:
         self._keys[region_id] = approximate_keys
 
+    def last_keys(self, region_id: int) -> int:
+        """Last reported key count (delta-batched stores skip unchanged
+        regions, so the policy pass reads the standing estimate)."""
+        return self._keys.get(region_id, 0)
+
     def should_split(self, region_id: int) -> bool:
         if self.split_threshold_keys <= 0:
             return False
@@ -360,10 +365,17 @@ class PlacementDriverServer:
             ("pd_list_stores", self._list_stores),
             ("pd_store_heartbeat", self._store_heartbeat),
             ("pd_region_heartbeat", self._region_heartbeat),
+            ("pd_store_heartbeat_batch", self._store_heartbeat_batch),
             ("pd_report_split", self._report_split),
             ("pd_create_region_id", self._create_region_id),
         ]:
             rpc_server.register(method, handler)
+        # delta-batch protocol state (leader-local, like ClusterStats):
+        # store endpoint -> PD term of the last FULL batch seen.  A new
+        # PD leader's stats are cold, so it answers need_full until each
+        # store resyncs — deltas alone can't rebuild the key counts its
+        # split/balance decisions read.
+        self._batch_synced: dict[str, int] = {}
 
     @property
     def node(self):
@@ -494,12 +506,75 @@ class PlacementDriverServer:
         if node is None or not node.is_leader():
             return self._not_leader(RegionHeartbeatResponse)
         await self._maybe_seed()
-        region = Region.decode(req.region)
-        if self._region_changed(region, req.leader):
-            leader = req.leader.encode()
-            payload = struct.pack("<H", len(leader)) + leader + region.encode()
+        instructions = await self._region_hb_core(
+            Region.decode(req.region), req.leader, req.approximate_keys)
+        return RegionHeartbeatResponse(
+            instructions=[i.encode() for i in instructions])
+
+    async def _store_heartbeat_batch(self, req) -> "object":
+        """Delta-batched store reporting: one RPC per store per interval
+        with only CHANGED region rows — the PD-plane counterpart of
+        group quiescence (idle stores cost one near-empty RPC/s, not
+        O(regions)).  Replication stays change-driven exactly as the
+        per-region path: an empty batch applies nothing."""
+        from tpuraft.rheakv.pd_messages import (
+            StoreHeartbeatBatchResponse,
+            decode_region_delta,
+        )
+
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(StoreHeartbeatBatchResponse)
+        await self._maybe_seed()
+        cur = self.fsm.stores.get(req.endpoint)
+        if cur is None or cur.store_id != req.store_id:
+            await self._apply(_cmd(
+                _CMD_STORE_UPSERT,
+                encode_store_meta(req.store_id, req.endpoint)))
+        instructions: list[Instruction] = []
+        reported: set[int] = set()
+        for blob in req.deltas:
+            region_blob, leader, keys = decode_region_delta(blob)
+            region = Region.decode(region_blob)
+            reported.add(region.id)
+            instructions.extend(await self._region_hb_core(
+                region, leader, keys))
+        # policy pass over the store's UNREPORTED led regions: deltas
+        # only flow when something changed, but split re-issue and
+        # leader balancing are PD-side decisions that must keep running
+        # over the idle majority (the per-region path got this for free
+        # by re-reporting every region every interval) — pure in-memory
+        # checks, no replication for unchanged rows
+        for rid, leader in list(self.fsm.region_leaders.items()):
+            if rid in reported:
+                continue
+            region = self.fsm.regions.get(rid)
+            if region is None or \
+                    PeerId.parse(leader).endpoint != req.endpoint:
+                continue
+            instructions.extend(await self._region_hb_core(
+                region, leader, self.stats.last_keys(rid)))
+        term = node.current_term
+        if req.full:
+            self._batch_synced[req.endpoint] = term
+        # this PD leader's stats (key counts, cooldowns) are term-local:
+        # until the store resyncs under THIS term, ask for a full batch
+        # so split/balance decisions never run on a cold picture
+        need_full = self._batch_synced.get(req.endpoint) != term
+        return StoreHeartbeatBatchResponse(
+            instructions=[i.encode() for i in instructions],
+            need_full=need_full)
+
+    async def _region_hb_core(self, region: Region, leader: str,
+                              approximate_keys: int) -> list[Instruction]:
+        """Shared by the per-region and delta-batched paths: epoch-
+        guarded metadata upsert, stats, split/balance instructions."""
+        node = self.node
+        if self._region_changed(region, leader):
+            lp = leader.encode()
+            payload = struct.pack("<H", len(lp)) + lp + region.encode()
             await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
-        self.stats.record(region.id, req.approximate_keys)
+        self.stats.record(region.id, approximate_keys)
         instructions: list[Instruction] = []
         pending_child = self.fsm.pending_splits.get(region.id)
         if pending_child is not None:
@@ -523,14 +598,13 @@ class PlacementDriverServer:
             self.stats.note_leadership(node.current_term,
                                        self.opts.transfer_cooldown_s)
             target = self.stats.pick_transfer_target(
-                region, req.leader, self.fsm.region_leaders,
+                region, leader, self.fsm.region_leaders,
                 cooldown_s=self.opts.transfer_cooldown_s)
             if target is not None:
                 instructions.append(Instruction(
                     kind=Instruction.KIND_TRANSFER_LEADER,
                     region_id=region.id, target_peer=target))
-        return RegionHeartbeatResponse(
-            instructions=[i.encode() for i in instructions])
+        return instructions
 
     async def _report_split(self, req: ReportSplitRequest
                             ) -> ReportSplitResponse:

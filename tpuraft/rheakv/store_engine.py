@@ -66,6 +66,14 @@ class StoreEngineOptions:
     # regions, O(bytes/segment) fds (the reference's single-RocksDB
     # role; storage/multilog.py).  Only used when data_path is set.
     log_scheme: str = "file"
+    # group quiescence (engine-driven regions only): an idle, fully
+    # replicated region hibernates after this many consecutive fully-
+    # acked beat rounds — see RaftOptions.quiesce_after_rounds.  0 = off.
+    quiesce_after_rounds: int = 0
+    # cap for the PD-heartbeat failure backoff (bounded exponential:
+    # interval x 2^fails, clamped here) — a down PD costs one cheap
+    # probe per cap interval, not a hot retry loop
+    pd_backoff_max_ms: int = 30000
 
 
 class StoreEngine:
@@ -92,6 +100,18 @@ class StoreEngine:
         self._pending_splits: set[int] = set()
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._meta_journal = None  # store-lifetime ref (multilog scheme)
+        # delta-batched PD reporting state: region -> (fingerprint,
+        # last-reported approximate_keys); dirty = force-report next
+        # round (fresh leadership, failed instruction); need_full =
+        # next batch carries EVERY led region (first contact, or the
+        # PD answered need_full after its own failover)
+        self._pd_reported: dict[int, tuple] = {}
+        self._pd_dirty: set[int] = set()
+        self._pd_need_full = True
+        self.pd_batches_sent = 0     # observability (bench counters)
+        self.pd_deltas_sent = 0
+        self.pd_full_syncs = 0
+        self.pd_hb_failures = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -143,22 +163,55 @@ class StoreEngine:
     # -- PD heartbeats -------------------------------------------------------
 
     async def _heartbeat_loop(self) -> None:
-        """Reference: ``rhea:StoreEngine``'s Store/Region heartbeat senders
-        — report meta + stats to the PD, execute returned Instructions."""
+        """Reference: ``rhea:StoreEngine``'s Store/Region heartbeat
+        senders — now DELTA-BATCHED: one ``pd_store_heartbeat_batch``
+        RPC per interval carrying only changed-region rows (idle PD
+        traffic is O(stores), not O(regions)), executing returned
+        Instructions.
+
+        Hardening: every store used to beat on the same 1000 ms phase
+        and drop failed rounds at LOG.debug — now each store starts at
+        a seeded random phase with per-round jitter (the PD never sees
+        the whole fleet in one burst), and consecutive failures back
+        off exponentially (bounded by ``pd_backoff_max_ms``) with a
+        WARNING once the PD looks actually down."""
+        import random
+
         interval = self.opts.heartbeat_interval_ms / 1000.0
+        rng = random.Random(zlib.crc32(str(self.server_id).encode())
+                            ^ 0x5bd1e995)
+        # per-store phase offset: spread the fleet over the interval
+        await asyncio.sleep(rng.random() * interval)
+        fails = 0
         while self._started:
             try:
                 await self._heartbeat_once()
+                fails = 0
             except asyncio.CancelledError:
                 return
             except Exception:  # noqa: BLE001 — PD may be down; keep trying
-                LOG.debug("pd heartbeat failed", exc_info=True)
-            await asyncio.sleep(interval)
+                fails += 1
+                self.pd_hb_failures += 1
+                log = LOG.warning if fails in (3, 10) or fails % 60 == 0 \
+                    else LOG.debug
+                log("pd heartbeat failed (%d consecutive)", fails,
+                    exc_info=fails == 3)
+            backoff = interval * (2 ** min(fails, 6)) if fails else interval
+            backoff = min(backoff, self.opts.pd_backoff_max_ms / 1000.0)
+            # ±10% per-round jitter: phase-locked fleets drift apart
+            await asyncio.sleep(backoff * (0.9 + 0.2 * rng.random()))
+
+    def _pd_fingerprint(self, region: Region) -> tuple:
+        return (region.epoch.conf_ver, region.epoch.version,
+                region.start_key, region.end_key, tuple(region.peers))
 
     async def _heartbeat_once(self) -> None:
         from tpuraft.rheakv.pd_messages import Instruction
 
-        await self.pd_client.store_heartbeat(self.store_meta())
+        full = self._pd_need_full
+        deltas: list[tuple[Region, str, int]] = []
+        fps: dict[int, tuple] = {}
+        me = str(self.server_id)
         for rid in self.leader_region_ids():
             engine = self._regions.get(rid)
             if engine is None or not engine.is_leader():
@@ -166,20 +219,47 @@ class StoreEngine:
             region = engine.region
             keys = self.raw_store.approximate_keys_in_range(
                 region.start_key, region.end_key)
-            instructions = await self.pd_client.region_heartbeat(
-                region, str(self.server_id),
-                {"approximate_keys": keys})
-            for ins in instructions:
-                if ins.kind == Instruction.KIND_SPLIT \
-                        and ins.region_id == rid:
-                    st = await self.apply_split(rid, ins.new_region_id)
-                    if not st.is_ok():
-                        LOG.info("pd-ordered split of region %d failed: %s",
-                                 rid, st)
-                elif ins.kind == Instruction.KIND_TRANSFER_LEADER \
-                        and ins.target_peer:
-                    await engine.transfer_leadership_to(
-                        PeerId.parse(ins.target_peer))
+            fp = self._pd_fingerprint(region)
+            last = self._pd_reported.get(rid)
+            # a keys move under ~12.5% (and < 64 abs) is noise, not a
+            # delta — the PD's split threshold only needs coarse counts
+            changed = (full or last is None or last[0] != fp
+                       or rid in self._pd_dirty
+                       or abs(keys - last[1]) * 8 >= max(last[1], 64))
+            if changed:
+                deltas.append((region.copy(), me, keys))
+                fps[rid] = (fp, keys)
+        # batch reporting: region rows ride as deltas, so build the
+        # bare store identity directly — store_meta() would deep-copy
+        # every region just for us to throw the list away each interval
+        meta = StoreMeta(id=zlib.crc32(str(self.server_id).encode()),
+                         endpoint=self.server_id.endpoint, regions=[])
+        instructions, need_full = await self.pd_client.store_heartbeat_batch(
+            meta, deltas, full=full)
+        # only now (RPC succeeded) do the fingerprints count as reported
+        self.pd_batches_sent += 1
+        self.pd_deltas_sent += len(deltas)
+        if full:
+            self.pd_full_syncs += 1
+        self._pd_reported.update(fps)
+        self._pd_dirty.difference_update(fps)
+        self._pd_need_full = bool(need_full)
+        for ins in instructions:
+            engine = self._regions.get(ins.region_id)
+            if engine is None or not engine.is_leader():
+                continue
+            if ins.kind == Instruction.KIND_SPLIT:
+                st = await self.apply_split(ins.region_id,
+                                            ins.new_region_id)
+                if not st.is_ok():
+                    LOG.info("pd-ordered split of region %d failed: %s",
+                             ins.region_id, st)
+                    # the PD only re-issues on a fresh report: force one
+                    self._pd_dirty.add(ins.region_id)
+            elif ins.kind == Instruction.KIND_TRANSFER_LEADER \
+                    and ins.target_peer:
+                await engine.transfer_leadership_to(
+                    PeerId.parse(ins.target_peer))
 
     async def _start_region(self, region: Region) -> RegionEngine:
         engine = RegionEngine(region, self)
@@ -211,6 +291,8 @@ class StoreEngine:
             fsm=fsm,
         )
         opts.raft_options.read_only_option = self.opts.read_only_option
+        opts.raft_options.quiesce_after_rounds = \
+            self.opts.quiesce_after_rounds
         if self.opts.data_path:
             store_base = (f"{self.opts.data_path}/"
                           f"{self.server_id.ip}_{self.server_id.port}")

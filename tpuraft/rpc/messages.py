@@ -11,6 +11,7 @@ entries).
 from __future__ import annotations
 
 import struct
+from dataclasses import MISSING as _MISSING
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -242,12 +243,46 @@ class CompactBeat:
     peer_id: str    # the target node
     term: int
     committed_index: int
+    # quiesce handshake: the leader saw N consecutive fully-acked idle
+    # rounds and proposes hibernation.  A follower that matches the
+    # beat's (term, leader, committed) row AND is at the leader's tail
+    # suppresses its election timeout, registers on the sender store's
+    # liveness lease (lease_ms horizon), and acks ok; the leader only
+    # hibernates once EVERY follower acked — a single refusal keeps the
+    # group active (a follower with a live election timer must keep
+    # receiving beats).
+    quiesce: bool = False
+    lease_ms: int = 0
 
 
 @dataclass
 class BeatAck:
     ok: bool            # False => send a full beat (slow path)
     term: int           # receiver's current term (observability only)
+
+
+@dataclass
+class StoreLeaseBeat:
+    """Store-level liveness lease (ONE per endpoint pair per interval):
+    while groups between two stores are quiescent, this tiny beat is the
+    only thing proving the sender store alive.  The receiver re-arms the
+    sender's lease for ``lease_ms``; on expiry it wakes every quiescent
+    group that depends on that store with a randomized election timeout
+    (no thundering herd).  The ack, back on the sender, refreshes the
+    last_ack rows of the sender's quiescent leader groups toward this
+    endpoint — dead-quorum step-down and leader-lease reads for
+    hibernating groups consult exactly this lease."""
+
+    endpoint: str   # the sending store's endpoint
+    lease_ms: int   # horizon the receiver should hold the lease for
+
+
+@dataclass
+class StoreLeaseAck:
+    ok: bool
+    # how many quiescent groups on the receiver currently depend on the
+    # sender's lease (observability: hub counters / describe)
+    dependents: int = 0
 
 
 @dataclass
@@ -293,6 +328,8 @@ for _i, _t in enumerate([
     BatchResponse,
     CompactBeat,
     BeatAck,
+    StoreLeaseBeat,
+    StoreLeaseAck,
 ]):
     register_message(_i, _t)
 
@@ -355,6 +392,13 @@ def decode_message(buf: bytes | memoryview):
     off = 1
     kwargs = {}
     for name, f in cls.__dataclass_fields__.items():
+        if off >= len(buf) and (f.default is not _MISSING
+                                or f.default_factory is not _MISSING):
+            # a shorter buffer from an old-format sender: trailing
+            # fields added since (always declared with defaults) take
+            # those defaults — mixed-version fleets keep decoding.
+            # Required fields still raise on a genuinely short frame.
+            break
         ann = _ann(f)
         if ann == "bool":
             (v,) = struct.unpack_from("<B", buf, off)
