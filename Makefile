@@ -10,8 +10,9 @@
 #   make chaos-smoke-> storage-plane crash-consistency harness + short
 #                      power-loss soak (<60s)
 #   make bench      -> the device-plane headline benchmark (one JSON line)
-#   make bench-gate -> short e2e bench; fails on >20% commits/s
-#                      regression vs the committed BENCH_E2E.json
+#   make bench-gate -> short e2e + KV serving benches; fails on >20%
+#                      regression vs the committed BENCH_E2E.json /
+#                      BENCH_REGIONS.json calibrations
 
 PY ?= python
 
@@ -40,7 +41,7 @@ chaos-smoke:
 	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py tests/test_quiescence.py -q
 	$(PY) -m examples.soak --duration 20 --seed 1 --power-loss
 	$(PY) -m examples.soak --duration 20 --seed 3 --churn --power-loss
-	$(PY) -m examples.soak --duration 20 --seed 5 --regions 48 --engine --quiesce
+	$(PY) -m examples.soak --duration 20 --seed 5 --regions 48 --engine --quiesce --kv-batching
 
 # The PRE-MERGE bar for consensus-path changes (VERDICT r2 weak #6):
 # the multi-minute chaos soaks are what actually catch protocol bugs
@@ -51,13 +52,17 @@ soak-long:
 	$(PY) -m examples.soak --duration 120 --seed 7
 	$(PY) -m examples.soak --duration 120 --seed 42
 
-# Perf regression gate: a short bench_e2e.py run at the committed
-# BENCH_E2E.json's configuration fails if e2e commits/s regresses >20%
-# vs the committed same-shape calibration (extra.gate_commits_per_sec,
-# re-record with `python bench_gate.py --record`; falls back to the
-# full-run value).  A below-floor run retries best-of-3 before failing
-# so shared-host noise doesn't flap CI.  Threshold/duration/retries via
-# BENCH_GATE_THRESHOLD / BENCH_GATE_DURATION / BENCH_GATE_RETRIES env.
+# Perf regression gate, two rows: (1) a short bench_e2e.py run at the
+# committed BENCH_E2E.json configuration fails if e2e commits/s
+# regresses >20% vs the committed same-shape calibration
+# (extra.gate_commits_per_sec); (2) a short bench_region_density.py run
+# fails if KV ops/s through the full serving stack regresses >20% vs
+# BENCH_REGIONS.json extra.gate_kv_ops_per_sec — the KV-vs-protocol gap
+# (ROADMAP #1) can't silently reopen.  Re-record both with
+# `python bench_gate.py --record`.  A below-floor run retries best-of-3
+# before failing so shared-host noise doesn't flap CI.  Threshold/
+# duration/retries via BENCH_GATE_THRESHOLD / BENCH_GATE_DURATION /
+# BENCH_GATE_RETRIES env.
 bench-gate:
 	$(PY) bench_gate.py
 

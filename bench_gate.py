@@ -1,25 +1,34 @@
-"""Pre-merge perf gate (`make bench-gate`): a short `bench_e2e.py` run
-at the committed BENCH_E2E.json's configuration must not regress e2e
-commits/s by more than the threshold (default 20%).
+"""Pre-merge perf gate (`make bench-gate`): short bench runs at the
+committed configurations must not regress by more than the threshold
+(default 20%).
 
-The committed JSON is the contract, but the gate run is SHORT (boot +
+Two rows:
+  e2e_commits_per_sec — a short `bench_e2e.py` run vs BENCH_E2E.json
+  kv_ops_per_sec      — a short `bench_region_density.py` run (the full
+                        RheaKV serving stack: batching client →
+                        kv_command_batch → propose fan-out → coalesced
+                        FSM apply) vs BENCH_REGIONS.json, so the
+                        KV-vs-protocol throughput gap (ROADMAP item 1)
+                        can't silently reopen.
+
+The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
-the floor is derived from a same-shape calibration value stored as
-``extra.gate_commits_per_sec`` in BENCH_E2E.json — record it with
-``python bench_gate.py --record`` on the host that runs the gate.
-Without a calibration the gate falls back to the full-run ``value``
-(conservative: short runs understate it, expect to re-record).
+each floor is derived from a same-shape calibration value stored as
+``extra.gate_commits_per_sec`` / ``extra.gate_kv_ops_per_sec`` in the
+respective JSON — record both with ``python bench_gate.py --record`` on
+the host that runs the gate.  Without a calibration the e2e row falls
+back to the full-run ``value`` (conservative); the KV row cannot (its
+full run uses a different duration/region shape) and reads as broken.
 
-A run below the floor is retried (best-of-N, default 2 extra runs)
+A run below its floor is retried (best-of-N, default 2 extra runs)
 before the gate fails: a real regression makes EVERY run slow, while a
 noisy-neighbour phase on a shared host does not survive three samples.
 Exit 0 = within threshold, 1 = regression, 2 = the gate itself could
 not run (missing baseline, bench crash) — a broken gate must read as
 failure, not as a pass.
 
-    python bench_gate.py                 # vs BENCH_E2E.json, 20%
-    python bench_gate.py --record        # (re)calibrate the short-run
-                                         # baseline into BENCH_E2E.json
+    python bench_gate.py                 # both rows, 20%
+    python bench_gate.py --record        # (re)calibrate both baselines
     BENCH_GATE_THRESHOLD=0.3 python bench_gate.py   # looser (noisy CI)
     BENCH_GATE_RETRIES=0 python bench_gate.py       # strict single run
 """
@@ -33,7 +42,7 @@ import tempfile
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _run_once(extra: dict, duration: float) -> float:
+def _run_e2e_once(extra: dict, duration: float) -> float:
     """One short bench_e2e run at the committed shape; returns commits/s
     or raises RuntimeError when the bench itself fails."""
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_"),
@@ -54,62 +63,135 @@ def _run_once(extra: dict, duration: float) -> float:
         return float(json.load(f)["value"])
 
 
-def main() -> int:
-    base_path = os.path.join(REPO, "BENCH_E2E.json")
-    if not os.path.exists(base_path):
-        print("bench-gate: no committed BENCH_E2E.json baseline")
-        return 2
-    with open(base_path) as f:
-        base = json.load(f)
-    extra = base.get("extra", {})
-    threshold = float(os.environ.get("BENCH_GATE_THRESHOLD", "0.20"))
-    duration = float(os.environ.get("BENCH_GATE_DURATION", "6"))
-    retries = int(os.environ.get("BENCH_GATE_RETRIES", "2"))
+def _run_kv_once(extra: dict, duration: float) -> float:
+    """One short bench_region_density run at the gate shape; returns
+    KV ops/s through the full serving stack."""
+    regions = int(extra.get("gate_regions", 128))
+    out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
+                            "gate_regions.json")
+    cmd = [sys.executable, os.path.join(REPO, "bench_region_density.py"),
+           "--regions", str(regions),
+           "--duration", str(duration),
+           "--election-timeout-ms", str(extra.get("gate_eto_ms", 1000)),
+           "--json-out", out_path]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    print("bench-gate:", " ".join(cmd), flush=True)
+    rc = subprocess.call(cmd, env=env)
+    if rc != 0 or not os.path.exists(out_path):
+        raise RuntimeError(f"kv bench run failed (rc={rc})")
+    with open(out_path) as f:
+        data = json.load(f)
+    key = "row" if regions == 1024 else f"row_{regions}"
+    row = data.get(key, {})
+    if "ops_per_sec" not in row:
+        raise RuntimeError(f"kv bench produced no {key}.ops_per_sec")
+    return float(row["ops_per_sec"])
 
-    if "--record" in sys.argv[1:]:
-        # calibrate: best-of-2 short runs -> extra.gate_commits_per_sec
-        try:
-            best = max(_run_once(extra, duration) for _ in range(2))
-        except RuntimeError as exc:
-            print(f"bench-gate: {exc}")
-            return 2
-        extra["gate_commits_per_sec"] = round(best, 1)
-        extra["gate_duration_s"] = duration
-        base["extra"] = extra
-        with open(base_path, "w") as f:
-            json.dump(base, f, indent=1)
-            f.write("\n")
-        print(json.dumps({"gate": "recorded",
-                          "gate_commits_per_sec": extra["gate_commits_per_sec"],
-                          "duration_s": duration}))
-        return 0
 
-    committed = float(extra.get("gate_commits_per_sec", base["value"]))
+def _gate(name: str, committed: float, run_once, threshold: float,
+          retries: int) -> tuple[int, dict]:
     floor = committed * (1.0 - threshold)
     best, runs = 0.0, 0
     try:
         for attempt in range(1 + max(0, retries)):
-            best = max(best, _run_once(extra, duration))
+            best = max(best, run_once())
             runs = attempt + 1
             if best >= floor:
                 break
             if attempt < retries:
-                print(f"bench-gate: {best:.1f} < floor {floor:.1f}, "
+                print(f"bench-gate[{name}]: {best:.1f} < floor {floor:.1f}, "
                       f"retrying ({attempt + 1}/{retries})", flush=True)
     except RuntimeError as exc:
-        print(f"bench-gate: {exc}")
-        return 2
+        print(f"bench-gate[{name}]: {exc}")
+        return 2, {"gate": name, "verdict": "BROKEN", "error": str(exc)}
     verdict = "OK" if best >= floor else "REGRESSION"
-    print(json.dumps({
-        "gate": "e2e_commits_per_sec",
+    report = {
+        "gate": name,
         "committed": committed,
         "measured": round(best, 1),
         "floor": round(floor, 1),
         "threshold": threshold,
         "runs": runs,
         "verdict": verdict,
-    }))
-    return 0 if best >= floor else 1
+    }
+    return (0 if verdict == "OK" else 1), report
+
+
+def main() -> int:
+    e2e_path = os.path.join(REPO, "BENCH_E2E.json")
+    kv_path = os.path.join(REPO, "BENCH_REGIONS.json")
+    if not os.path.exists(e2e_path):
+        print("bench-gate: no committed BENCH_E2E.json baseline")
+        return 2
+    with open(e2e_path) as f:
+        e2e_base = json.load(f)
+    kv_base = {}
+    if os.path.exists(kv_path):
+        with open(kv_path) as f:
+            kv_base = json.load(f)
+    e2e_extra = e2e_base.get("extra", {})
+    kv_extra = kv_base.setdefault("extra", {})
+    threshold = float(os.environ.get("BENCH_GATE_THRESHOLD", "0.20"))
+    duration = float(os.environ.get("BENCH_GATE_DURATION", "6"))
+    retries = int(os.environ.get("BENCH_GATE_RETRIES", "2"))
+
+    if "--record" in sys.argv[1:]:
+        # calibrate: best-of-2 short runs per row
+        try:
+            e2e_best = max(_run_e2e_once(e2e_extra, duration)
+                           for _ in range(2))
+            kv_best = max(_run_kv_once(kv_extra, duration)
+                          for _ in range(2))
+        except RuntimeError as exc:
+            print(f"bench-gate: {exc}")
+            return 2
+        e2e_extra["gate_commits_per_sec"] = round(e2e_best, 1)
+        e2e_extra["gate_duration_s"] = duration
+        e2e_base["extra"] = e2e_extra
+        with open(e2e_path, "w") as f:
+            json.dump(e2e_base, f, indent=1)
+            f.write("\n")
+        kv_extra["gate_kv_ops_per_sec"] = round(kv_best, 1)
+        kv_extra["gate_duration_s"] = duration
+        kv_extra.setdefault("gate_regions", 128)
+        kv_extra.setdefault("gate_eto_ms", 1000)
+        with open(kv_path, "w") as f:
+            json.dump(kv_base, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"gate": "recorded",
+                          "gate_commits_per_sec":
+                              e2e_extra["gate_commits_per_sec"],
+                          "gate_kv_ops_per_sec":
+                              kv_extra["gate_kv_ops_per_sec"],
+                          "duration_s": duration}))
+        return 0
+
+    worst = 0
+    reports = []
+    rc, rep = _gate("e2e_commits_per_sec",
+                    float(e2e_extra.get("gate_commits_per_sec",
+                                        e2e_base["value"])),
+                    lambda: _run_e2e_once(e2e_extra, duration),
+                    threshold, retries)
+    worst = max(worst, rc)
+    reports.append(rep)
+    if "gate_kv_ops_per_sec" not in kv_extra:
+        # no same-shape calibration — a silent pass would defeat the row
+        print("bench-gate[kv_ops_per_sec]: no calibration "
+              "(run `python bench_gate.py --record`)")
+        worst = max(worst, 2)
+        reports.append({"gate": "kv_ops_per_sec", "verdict": "BROKEN",
+                        "error": "no gate_kv_ops_per_sec calibration"})
+    else:
+        rc, rep = _gate("kv_ops_per_sec",
+                        float(kv_extra["gate_kv_ops_per_sec"]),
+                        lambda: _run_kv_once(kv_extra, duration),
+                        threshold, retries)
+        worst = max(worst, rc)
+        reports.append(rep)
+    for rep in reports:
+        print(json.dumps(rep))
+    return worst
 
 
 if __name__ == "__main__":

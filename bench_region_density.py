@@ -144,8 +144,13 @@ async def run_config(args) -> dict:
     elect_s = time.monotonic() - t1
 
     pd = FakePlacementDriverClient([r.copy() for r in regions])
+    # batching ON: concurrent worker ops drain into store-grouped
+    # kv_command_batch RPCs (pre-batch builds passed a default-disabled
+    # BatchingOptions() here, i.e. one kv_command per op)
     client = RheaKVStore(pd, InProcTransport(net, "kvclient:0"),
-                         batching=BatchingOptions())
+                         batching=BatchingOptions(
+                             enabled=True,
+                             max_store_inflight=args.store_inflight))
     hb0 = (CountingPD.store_hbs, CountingPD.region_hbs,
            CountingPD.batch_hbs, CountingPD.delta_rows)
 
@@ -179,7 +184,13 @@ async def run_config(args) -> dict:
            CountingPD.batch_hbs, CountingPD.delta_rows)
     lats.sort()
 
+    stage = await stage_probe(client, stores, R)
+
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    coalesced_flushes = sum(re.fsm.coalesced_flushes
+                            for s in stores for re in s._regions.values())
+    coalesced_ops = sum(re.fsm.coalesced_ops
+                        for s in stores for re in s._regions.values())
     res = {
         "regions": R,
         "stores": S,
@@ -206,9 +217,112 @@ async def run_config(args) -> dict:
         "asyncio_tasks": len(asyncio.all_tasks()),
         "workers": args.workers,
         "pace_ms": args.pace_ms,
+        # serving-plane batching (ISSUE 6): store-grouped client RPCs +
+        # server fan-out + FSM apply coalescing
+        "kv_batch_rpcs_per_s": round(client.batch_rpcs / elapsed, 1),
+        "kv_batch_items_per_rpc": round(
+            client.batch_items / max(1, client.batch_rpcs), 2),
+        "kv_batch_fallbacks": client.batch_fallbacks,
+        "kv_batch_retry_codes": {str(k): v
+                                 for k, v in client.batch_retries.items()},
+        "srv_batch_rpcs": sum(s.kv_processor.batch_rpcs for s in stores),
+        "srv_single_rpcs": sum(s.kv_processor.single_rpcs for s in stores),
+        "fsm_coalesced_flushes": coalesced_flushes,
+        "fsm_coalesced_ops": coalesced_ops,
+        # per-stage latency marks for one post-run probe put (relative
+        # ms, BENCH_E2E ack_breakdown style): queue=batcher wait,
+        # rpc_s→rpc_e=wire round trip, propose_s=server handler reached
+        # the region store, submit=entry handed to the raft node,
+        # apply_s/apply_e=FSM executed, ack=proposal future resolved
+        "stage_marks_ms": stage,
     }
     print("RESULT " + json.dumps(res), flush=True)
     os._exit(0)  # 3R region engines: teardown is not the measurement
+
+
+async def stage_probe(client, stores, R: int) -> dict:
+    """One instrumented put after the measured window: stamps each
+    serving-plane stage so the NEXT bottleneck is attributable —
+    client-queue → rpc → propose → quorum(submit→apply) → apply → ack."""
+    import time as _t
+
+    # pick a region currently led in-process
+    target = None
+    for s in stores:
+        for re in s._regions.values():
+            if re.is_leader():
+                target = re
+                break
+        if target is not None:
+            break
+    if target is None:
+        return {}
+    marks: dict = {}
+    rs, fsm, node = target.raft_store, target.fsm, target.node
+    orig_apply, orig_ab = rs.apply, node.apply_batch
+    orig_disp, orig_call = fsm._dispatch, client.transport.call
+
+    async def apply_mark(op):
+        marks.setdefault("propose_s", _t.perf_counter())
+        try:
+            return await orig_apply(op)
+        finally:
+            marks.setdefault("ack", _t.perf_counter())
+
+    async def ab_mark(tasks):
+        marks.setdefault("submit", _t.perf_counter())
+        return await orig_ab(tasks)
+
+    def disp_mark(op):
+        marks.setdefault("apply_s", _t.perf_counter())
+        try:
+            return orig_disp(op)
+        finally:
+            marks["apply_e"] = _t.perf_counter()
+
+    async def call_mark(ep, method, req, timeout_ms=None):
+        if method.startswith("kv_command"):
+            marks.setdefault("rpc_s", _t.perf_counter())
+        try:
+            return await orig_call(ep, method, req, timeout_ms)
+        finally:
+            if method.startswith("kv_command"):
+                marks.setdefault("rpc_e", _t.perf_counter())
+
+    rs.apply = apply_mark
+    rs._apply = apply_mark
+    node.apply_batch = ab_mark
+    fsm._dispatch = disp_mark
+    client.transport.call = call_mark
+    # the FSM coalescer flushes PUT runs without entering _dispatch;
+    # stamp its batch write too
+    store = fsm.store
+    orig_awb = store.apply_write_batch
+
+    def awb_mark(rows):
+        marks.setdefault("apply_s", _t.perf_counter())
+        try:
+            return orig_awb(rows)
+        finally:
+            marks["apply_e"] = _t.perf_counter()
+
+    store.apply_write_batch = awb_mark
+    key = target.region.start_key + b"/stage-probe"
+    t0 = _t.perf_counter()
+    marks["queue_s"] = t0
+    try:
+        await asyncio.wait_for(client.put(key, b"p"), 30.0)
+        marks["done"] = _t.perf_counter()
+    except Exception:
+        return {}
+    finally:
+        rs.apply = orig_apply
+        rs._apply = orig_apply
+        node.apply_batch = orig_ab
+        fsm._dispatch = orig_disp
+        client.transport.call = orig_call
+        store.apply_write_batch = orig_awb
+    return {k: round((v - t0) * 1e3, 3) for k, v in marks.items()}
 
 
 def main() -> None:
@@ -219,6 +333,9 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=24)
     ap.add_argument("--pace-ms", type=float, default=2.0)
     ap.add_argument("--election-timeout-ms", type=int, default=10000)
+    ap.add_argument("--store-inflight", type=int, default=4,
+                    help="concurrent kv_command_batch RPCs per store "
+                         "(BatchingOptions.max_store_inflight)")
     ap.add_argument("--json-out", default="BENCH_REGIONS.json")
     ap.add_argument("--config", action="store_true",
                     help="internal: run one config in this process")
@@ -243,7 +360,8 @@ def main() -> None:
            "--duration", str(args.duration),
            "--workers", str(args.workers),
            "--pace-ms", str(args.pace_ms),
-           "--election-timeout-ms", str(args.election_timeout_ms)]
+           "--election-timeout-ms", str(args.election_timeout_ms),
+           "--store-inflight", str(args.store_inflight)]
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     t0 = time.monotonic()
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
@@ -268,6 +386,8 @@ def main() -> None:
                     "store, multilog shared journal, engine protocol "
                     "plane, batching RheaKV client, counting PD")
     key = "row" if args.regions == 1024 else f"row_{args.regions}"
+    if args.workers != 24:   # non-default load shapes get their own row
+        key += f"_w{args.workers}"
     out[key] = row
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

@@ -549,7 +549,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    election_timeout_ms: int = 400,
                    power_loss: bool = False,
                    churn: bool = False,
-                   quiesce: bool = False) -> dict:
+                   quiesce: bool = False,
+                   kv_batching: bool = False) -> dict:
     rng = random.Random(seed)
     if quiesce and (transport != "inproc" or not engine):
         raise ValueError(
@@ -593,7 +594,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                     _os.path.join(data_path, f"{ip}_{port}")).install()
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
-            lease_reads, n_regions, rng, c, chaos, churn, quiesce)
+            lease_reads, n_regions, rng, c, chaos, churn, quiesce,
+            kv_batching)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -604,7 +606,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 
 async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
-                          chaos, churn=False, quiesce=False) -> dict:
+                          chaos, churn=False, quiesce=False,
+                          kv_batching=False) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -615,7 +618,14 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         for ep in c.endpoints:
             await c.start_store(ep)
     pd = FakePlacementDriverClient([r.copy() for r in c.regions])
-    kv = RheaKVStore(pd, c.client_transport(), max_retries=1)
+    # --kv-batching: the store-grouped kv_command_batch serving plane —
+    # the oracle history must stay linearizable with ops riding batches
+    # (each batched op acks individually, applies atomically per item)
+    from tpuraft.rheakv.client import BatchingOptions
+
+    kv = RheaKVStore(pd, c.client_transport(), max_retries=1,
+                     batching=BatchingOptions(enabled=True)
+                     if kv_batching else None)
     await kv.start()
 
     def say(*a):
@@ -986,6 +996,11 @@ def main() -> None:
                          "killed, and its dependents must elect via "
                          "store-lease expiry within the normal "
                          "fault-detection envelope")
+    ap.add_argument("--kv-batching", action="store_true",
+                    help="drive load through the batching client: ops "
+                         "coalesce into store-grouped kv_command_batch "
+                         "RPCs; linearizability is checked per op as "
+                         "usual (batched items ack/apply atomically)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -999,7 +1014,8 @@ def main() -> None:
                                   election_timeout_ms=args.election_timeout_ms,
                                   power_loss=args.power_loss,
                                   churn=args.churn,
-                                  quiesce=args.quiesce))
+                                  quiesce=args.quiesce,
+                                  kv_batching=args.kv_batching))
     import json
 
     print(json.dumps(result))

@@ -24,21 +24,32 @@ from tpuraft.rheakv.kv_service import (
     ERR_INVALID_EPOCH,
     ERR_KEY_OUT_OF_RANGE,
     ERR_NO_REGION,
+    KVCommandBatchRequest,
     KVCommandRequest,
     ListRegionsOnStoreRequest,
+    decode_batch_item,
+    decode_batch_reply,
     decode_result,
+    encode_batch_item,
     scan_op,
 )
 from tpuraft.rheakv.metadata import Region
 from tpuraft.rheakv.pd_client import PlacementDriverClient
 from tpuraft.rheakv.raw_store import Sequence
 from tpuraft.rheakv.region_route_table import RegionRouteTable
-from tpuraft.rpc.transport import RpcError
+from tpuraft.rpc.transport import RpcError, is_no_method
 
 LOG = logging.getLogger(__name__)
 
 # ops any replica can serve linearizably (readIndex barrier + local read)
 _READONLY_OPS = {KVOp.GET, KVOp.MULTI_GET, KVOp.CONTAINS_KEY, KVOp.SCAN}
+
+# not leader / electing / readIndex round timed out under load: worth
+# another attempt against a different store
+_RETRYABLE_CODES = {
+    int(RaftError.EPERM), int(RaftError.EBUSY), int(RaftError.EAGAIN),
+    int(RaftError.ERAFTTIMEDOUT), int(RaftError.ETIMEDOUT),
+}
 
 
 class RheaKVError(Exception):
@@ -59,10 +70,23 @@ class BatchingOptions:
     enabled: bool = False
     max_write_batch: int = 128
     max_read_batch: int = 128
+    # cap on (region, op) items per store-grouped ``kv_command_batch``
+    # RPC (the serving-plane analog of the send plane's
+    # MAX_ITEMS_PER_RPC: bounds the receiver's per-RPC fan-out burst)
+    max_store_batch: int = 1024
+    # concurrent kv_command_batch RPCs per store: ops are independent
+    # (no per-region ordering to preserve), so a window stalled on one
+    # slow region's quorum must not idle the whole store pipe — same
+    # reasoning as the send plane's multi-lane vote dispatch
+    max_store_inflight: int = 4
 
 
 class _Batcher:
-    """Coalesces items queued in one loop iteration into chunked flushes."""
+    """Coalesces items queued in one loop iteration into chunked flushes.
+
+    Rounds fire concurrently (one per loop iteration): the per-STORE
+    windowing that adapts batch size to the serving rate lives in
+    :class:`_StoreSender`, which every round's flush submits through."""
 
     def __init__(self, max_batch: int, flush_fn):
         self._max = max_batch
@@ -99,6 +123,103 @@ class _Batcher:
             for i in range(0, len(batch), self._max)])
 
 
+class _StoreSender:
+    """One batched ``kv_command_batch`` sender per store endpoint — the
+    serving-plane analog of the send plane's EndpointSender: a bounded
+    window of RPC lanes per store (``max_store_inflight``), and
+    everything submitted while the window is full rides the next lane
+    together.  Batch size adapts to the store's service rate, a slow
+    region on one store never convoys items bound for another, and
+    items resolve INDIVIDUALLY (future per item) the moment their RPC
+    returns."""
+
+    def __init__(self, client: "RheaKVStore", endpoint: str):
+        self._client = client
+        self.endpoint = endpoint
+        self._q: list = []   # (region, peer_str, op, fut)
+        self._task: Optional[asyncio.Task] = None
+        self._lanes: set = set()   # in-flight send tasks
+
+    def submit(self, region: Region, peer: str, op: KVOperation
+               ) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        # encode HERE, not in the send path: a malformed op (bad key
+        # type) must fail its OWN caller, never poison the unrelated
+        # items sharing its lane (the same invariant RaftRawKVStore.
+        # apply holds one layer down)
+        try:
+            blob = encode_batch_item(region.id, region.epoch.conf_ver,
+                                     region.epoch.version, op.encode())
+        except Exception as e:  # noqa: BLE001
+            fut.set_result(RheaKVError(Status.error(
+                RaftError.EINVAL, f"malformed op: {e!r}")))
+            return fut
+        self._q.append((region, peer, blob, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain())
+        return fut
+
+    async def _drain(self) -> None:
+        # microtask hop so a burst submitted in this loop iteration
+        # rides one RPC; then windowed drain — up to max_store_inflight
+        # lanes in flight, each lane stop-and-wait over its own batch
+        await asyncio.sleep(0)
+        cap = max(1, self._client._batch_opts.max_store_batch)
+        lanes = max(1, self._client._batch_opts.max_store_inflight)
+        while self._q or self._lanes:
+            while self._q and len(self._lanes) < lanes:
+                batch = self._q[:cap]
+                del self._q[:len(batch)]
+                t = asyncio.ensure_future(self._send_safe(batch))
+                self._lanes.add(t)
+                t.add_done_callback(self._lanes.discard)
+            if self._lanes:
+                await asyncio.wait(set(self._lanes),
+                                   return_when=asyncio.FIRST_COMPLETED)
+
+    async def _send_safe(self, batch: list) -> None:
+        try:
+            await self._send(batch)
+        except Exception as e:  # noqa: BLE001 — fail THIS batch only
+            st = Status.error(RaftError.EINTERNAL, f"batch send: {e!r}")
+            for _r, _p, _op, fut in batch:
+                if not fut.done():
+                    fut.set_result(RheaKVError(st))
+
+    async def _send(self, batch: list) -> None:
+        client = self._client
+        req = KVCommandBatchRequest(
+            items=[blob for _r, _p, blob, _f in batch])
+        try:
+            resp = await client.transport.call(
+                self.endpoint, "kv_command_batch", req, client.timeout_ms)
+        except RpcError as e:
+            if is_no_method(e):
+                # a pre-batch store: downgrade permanently, serve this
+                # batch through the per-op path
+                client._batch_ok = False
+                client.batch_fallbacks += 1
+                outs = await asyncio.gather(
+                    *(client._call_region_outcome(
+                        region,
+                        KVOperation.decode(decode_batch_item(blob)[3]))
+                      for region, _p, blob, _f in batch))
+                for (_r, _p, _b, fut), out in zip(batch, outs):
+                    if not fut.done():
+                        fut.set_result(out)
+                return
+            for region, _p, _b, fut in batch:   # dead store: retryable
+                client._leaders.pop(region.id, None)
+                if not fut.done():
+                    fut.set_result(_Retry(status=e.status))
+            return
+        client.batch_rpcs += 1
+        client.batch_items += len(batch)
+        for (region, peer, _b, fut), blob in zip(batch, resp.items):
+            if not fut.done():
+                fut.set_result(client._decode_outcome(region, peer, blob))
+
+
 class RheaKVStore:
     def __init__(self, pd_client: PlacementDriverClient, transport,
                  timeout_ms: float = 5000, max_retries: int = 8,
@@ -124,6 +245,8 @@ class RheaKVStore:
         # region id -> endpoint of the last known leader's store
         self._leaders: dict[int, str] = {}
         self._started = False
+        self._batch_opts = batching if batching is not None \
+            else BatchingOptions()
         self._put_batcher: Optional[_Batcher] = None
         self._get_batcher: Optional[_Batcher] = None
         if batching is not None and batching.enabled:
@@ -131,51 +254,198 @@ class RheaKVStore:
                                          self._flush_put_batch)
             self._get_batcher = _Batcher(batching.max_read_batch,
                                          self._flush_get_batch)
+        # does the fleet serve kv_command_batch?  Optimistic until an
+        # ENOMETHOD proves otherwise (a pre-batch store), then the
+        # legacy per-region kv_command path takes over PERMANENTLY —
+        # the same wire-compat pattern as the PD delta-batch fallback
+        self._batch_ok = True
+        self.batch_rpcs = 0        # kv_command_batch RPCs sent
+        self.batch_items = 0       # (region, op) items carried in them
+        self.batch_fallbacks = 0   # ENOMETHOD downgrades observed
+        self.batch_retries: dict[int, int] = {}  # bounced items by code
+        # endpoint -> windowed batch sender (one RPC in flight each)
+        self._senders: dict[str, _StoreSender] = {}
+        self._refresh_inflight: Optional[asyncio.Task] = None
 
-    def _group_by_region(self, chunk, key_fn):
-        """Shard a batcher chunk by owning region so one region's failure
-        only fails ITS calls — per-region result granularity, as in the
-        reference's per-region batch dispatch."""
-        groups: dict[int, list] = {}
-        for item, fut in chunk:
-            r = self.route_table.find_region_by_key(key_fn(item))
-            groups.setdefault(r.id if r else -1, []).append((item, fut))
-        return list(groups.values())
+    # ------------------------------------------------------------------
+    # store-grouped batch dispatch (the kv_command_batch fast path)
+    # ------------------------------------------------------------------
+
+    def _store_candidates(self, region: Region, attempt: int) -> list[str]:
+        """Per-attempt candidate stores for a region, leader hint first,
+        then EVERY voter (rotated by attempt so a retry herd doesn't
+        camp on one store) — same coverage contract as _endpoints_for:
+        one attempt cycle must be able to reach the real leader even
+        when the cached hint is stale."""
+        voters = [p for p in region.peers if not p.endswith("/learner")]
+        if not voters:
+            return [region.peers[0]] if region.peers else []
+        k = attempt % len(voters)
+        cands = []
+        leader = self._leaders.get(region.id)
+        if leader and leader in voters:
+            cands.append(leader)
+        cands.extend(p for p in voters[k:] + voters[:k] if p not in cands)
+        return cands
+
+    async def _call_region_outcome(self, region: Region, op: KVOperation):
+        """_call_region with its control flow reified as a value so batch
+        dispatch can zip outcomes back to pairs: ("ok", result) |
+        _Retry | RheaKVError."""
+        try:
+            return ("ok", await self._call_region(region, op))
+        except _Retry as r:
+            return r
+        except RheaKVError as e:
+            return e
+
+    def _decode_outcome(self, region: Region, peer: str, blob: bytes):
+        code, msg, result, meta = decode_batch_reply(blob)
+        if code == 0:
+            self._leaders[region.id] = peer
+            return ("ok", decode_result(result))
+        st = Status(code, msg)
+        self.batch_retries[code] = self.batch_retries.get(code, 0) + 1
+        if code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
+            if meta:
+                self.route_table.add_or_update(Region.decode(meta))
+            return _Retry(refresh=True, status=st)
+        if code == ERR_NO_REGION:
+            self._leaders.pop(region.id, None)
+            return _Retry(refresh=True, status=st)
+        if code in _RETRYABLE_CODES:
+            self._leaders.pop(region.id, None)
+            return _Retry(status=st)
+        return RheaKVError(st)
+
+    def _sender(self, endpoint: str) -> _StoreSender:
+        s = self._senders.get(endpoint)
+        if s is None:
+            s = self._senders[endpoint] = _StoreSender(self, endpoint)
+        return s
+
+    async def _dispatch_one(self, region: Region, op: KVOperation,
+                            attempt: int):
+        """One attempt cycle for one (region, op) pair through the store
+        senders: a RETRYABLE bounce (not leader, electing) re-submits to
+        the next candidate store WITHIN the cycle — the batch analog of
+        _call_region probing every endpoint in one attempt, so a cold
+        leader cache costs extra round trips, never the outer backoff
+        sleep."""
+        out = None
+        for peer in self._store_candidates(region, attempt):
+            out = await self._sender(_endpoint(peer)).submit(region, peer, op)
+            if not self._batch_ok:
+                # the fleet downgraded mid-flight; the sender already
+                # served this item through the per-op path
+                return out
+            if not (isinstance(out, _Retry) and not out.refresh):
+                return out
+        return out
+
+    async def _dispatch_region_ops(self, pairs: list, attempt: int = 0
+                                   ) -> list:
+        """One attempt cycle over many (region, op) pairs, each routed
+        through its leader store's :class:`_StoreSender` — everything
+        pending fleet-wide for one store rides ONE kv_command_batch per
+        window (the raft plane's ``multi_append`` pattern one layer up),
+        and every pair resolves independently (a slow region on one
+        store never convoys its neighbours).  Pairs that can't ride a
+        batch — spread reads (per-region round-robin) or a downgraded
+        fleet — go through _call_region.  Returns one outcome per pair
+        (see _call_region_outcome)."""
+        def is_direct(region, op):
+            return (not self._batch_ok
+                    or (self.read_preference == "any"
+                        and op.op in _READONLY_OPS))
+
+        return list(await asyncio.gather(
+            *(self._call_region_outcome(region, op)
+              if is_direct(region, op)
+              else self._dispatch_one(region, op, attempt)
+              for region, op in pairs)))
+
+    # ------------------------------------------------------------------
+    # client-side batcher flushes (one drain round)
+    # ------------------------------------------------------------------
+
+    async def _flush_batched_ops(self, chunk, key_fn, op_fn, deliver) -> None:
+        """Drain one batcher chunk: resolve each item's region ONCE per
+        round (the round's route cache — invalidated only through the
+        retry path on epoch/region errors), group regions by leader
+        store into kv_command_batch RPCs, deliver per-item results, and
+        re-shard ONLY the failed/escaped items after a refresh."""
+        pending = list(chunk)
+        last = Status.error(RaftError.EAGAIN, "exhausted retries")
+        for attempt in range(self.max_retries):
+            groups: dict[int, tuple[Region, list]] = {}
+            unroutable: list = []
+            for item, fut in pending:
+                try:
+                    r = self.route_table.find_region_by_key(key_fn(item))
+                except Exception as e:  # noqa: BLE001 — malformed key:
+                    # fail ITS caller, not the whole chunk
+                    if not fut.done():
+                        fut.set_exception(RheaKVError(Status.error(
+                            RaftError.EINVAL, f"malformed key: {e!r}")))
+                    continue
+                if r is None:
+                    unroutable.append((item, fut))
+                else:
+                    groups.setdefault(r.id, (r, []))[1].append((item, fut))
+            retry: list = list(unroutable)
+            need_refresh = bool(unroutable)
+            parts = list(groups.values())
+            outcomes = await self._dispatch_region_ops(
+                [(region, op_fn(items)) for region, items in parts], attempt)
+            for (region, items), out in zip(parts, outcomes):
+                if isinstance(out, tuple):
+                    deliver(items, out[1])
+                elif isinstance(out, _Retry):
+                    need_refresh = need_refresh or out.refresh
+                    if out.status is not None:
+                        last = out.status
+                    retry.extend(items)
+                else:   # hard error fails ITS region's calls only
+                    for _, fut in items:
+                        if not fut.done():
+                            fut.set_exception(out)
+            if not retry:
+                return
+            pending = retry
+            if need_refresh:
+                await self._refresh_routes()
+            await asyncio.sleep(
+                self.retry_interval_ms * (attempt + 1) / 1000.0)
+        err = RheaKVError(last)
+        for _, fut in pending:
+            if not fut.done():
+                fut.set_exception(err)
 
     async def _flush_put_batch(self, chunk) -> None:
-        async def flush_group(items):
-            try:
-                ok = await self.put_list([kv for kv, _ in items])
-            except Exception as e:  # noqa: BLE001
-                for _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(e)
-                return
+        def deliver(items, result):
             for _, fut in items:
                 if not fut.done():
-                    fut.set_result(ok)
+                    fut.set_result(bool(result))
 
-        await asyncio.gather(*[
-            flush_group(g)
-            for g in self._group_by_region(chunk, lambda kv: kv[0])])
+        await self._flush_batched_ops(
+            chunk, key_fn=lambda kv: kv[0],
+            op_fn=lambda items: KVOperation.put_list(
+                [kv for kv, _ in items]),
+            deliver=deliver)
 
     async def _flush_get_batch(self, chunk) -> None:
-        async def flush_group(items):
-            try:
-                res = await self.multi_get(
-                    list(dict.fromkeys(k for k, _ in items)))
-            except Exception as e:  # noqa: BLE001
-                for _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(e)
-                return
+        def deliver(items, result):
+            res = dict(result)   # list[(key, Optional[value])]
             for k, fut in items:
                 if not fut.done():
                     fut.set_result(res.get(k))
 
-        await asyncio.gather(*[
-            flush_group(g)
-            for g in self._group_by_region(chunk, lambda k: k)])
+        await self._flush_batched_ops(
+            chunk, key_fn=lambda k: k,
+            op_fn=lambda items: KVOperation.multi_get(
+                list(dict.fromkeys(k for k, _ in items))),
+            deliver=deliver)
 
     async def start(self) -> None:
         # best-effort initial route pull: a PD that is still booting (or
@@ -198,6 +468,16 @@ class RheaKVStore:
     # ------------------------------------------------------------------
 
     async def _refresh_routes(self) -> None:
+        """Single-flight wrapper: at region density one refresh decodes
+        every store's whole region list, so a retry herd must share ONE
+        O(regions) pass instead of running one each."""
+        if self._refresh_inflight is None or self._refresh_inflight.done():
+            self._refresh_inflight = asyncio.ensure_future(
+                self._refresh_routes_once())
+        # shield: one caller timing out must not cancel the shared pass
+        await asyncio.shield(self._refresh_inflight)
+
+    async def _refresh_routes_once(self) -> None:
         """Re-pull the region layout: PD first, then store-reported truth
         (PD-less mode — and PD outages — discover split regions this way).
         Best-effort: a down PD must not fail ops the cached routes or the
@@ -306,10 +586,7 @@ class RheaKVStore:
             if resp.code == ERR_NO_REGION:
                 self._leaders.pop(region.id, None)
                 raise _Retry(refresh=True)
-            if resp.code in (int(RaftError.EPERM), int(RaftError.EBUSY),
-                             int(RaftError.EAGAIN),
-                             int(RaftError.ERAFTTIMEDOUT),
-                             int(RaftError.ETIMEDOUT)):
+            if resp.code in _RETRYABLE_CODES:
                 # not leader / electing / readIndex round timed out under
                 # load: try the next store
                 last_status = Status(resp.code, resp.msg)
@@ -386,38 +663,30 @@ class RheaKVStore:
         RE-SHARD whatever failed after every route refresh: a split that
         races the batch must never commit keys through the wrong group
         (the server also range-checks, returning ERR_KEY_OUT_OF_RANGE).
-        Returns the list of per-group results."""
-        remaining = list(items)
-        results = []
-        last = Status.error(RaftError.EAGAIN, "exhausted retries")
-        for attempt in range(self.max_retries):
-            groups: dict[int, list] = {}
-            unroutable = []
-            for it in remaining:
-                r = self.route_table.find_region_by_key(key_fn(it))
-                if r is None:
-                    unroutable.append(it)
-                else:
-                    groups.setdefault(r.id, []).append(it)
-            failed: list = list(unroutable)
-            need_refresh = bool(unroutable)
-            for rid, part in groups.items():
-                region = self.route_table.find_region_by_id(rid)
-                try:
-                    results.append(await self._call_region(region, op_fn(part)))
-                except _Retry as r:
-                    need_refresh = need_refresh or r.refresh
-                    if r.status is not None:
-                        last = r.status
-                    failed.extend(part)
-            if not failed:
-                return results
-            remaining = failed
-            if need_refresh:
-                await self._refresh_routes()
-            await asyncio.sleep(
-                self.retry_interval_ms * (attempt + 1) / 1000.0)
-        raise RheaKVError(last)
+        Returns the list of per-group results.
+
+        A thin wrapper over _flush_batched_ops (one retry engine for the
+        batcher flushes AND the multi-key APIs): each item gets a
+        future, per-group results accumulate via deliver."""
+        results: list = []
+        chunk = [(it, asyncio.get_running_loop().create_future())
+                 for it in items]
+
+        def deliver(group_items, result):
+            results.append(result)
+            for _, fut in group_items:
+                if not fut.done():
+                    fut.set_result(True)
+
+        await self._flush_batched_ops(
+            chunk, key_fn=key_fn,
+            op_fn=lambda pairs: op_fn([it for it, _ in pairs]),
+            deliver=deliver)
+        errs = [err for _, fut in chunk
+                if (err := fut.exception()) is not None]
+        if errs:
+            raise errs[0]
+        return results
 
     async def multi_get(self, keys: list[bytes]
                         ) -> dict[bytes, Optional[bytes]]:

@@ -31,6 +31,11 @@ class KVOp(enum.IntEnum):
     KEY_LOCK = 12
     KEY_LOCK_RELEASE = 13
     RANGE_SPLIT = 14
+    # composite: many sub-ops in ONE log entry (the server-side batch
+    # plane — kv_command_batch items for one region ride a single
+    # quorum round; the FSM applies sub-ops in order with per-op
+    # results).  Never sent by clients directly.
+    MULTI = 15
     # read ops (only replicated when linearizable-via-log is requested;
     # normally served via readIndex + local read)
     GET = 20
@@ -130,6 +135,27 @@ class KVOperation:
     def delete_list(keys: list[bytes]) -> "KVOperation":
         return KVOperation(KVOp.DELETE_LIST,
                            value=KVOperation.pack_key_list(keys))
+
+    @staticmethod
+    def multi(ops: list["KVOperation"]) -> "KVOperation":
+        """One log entry carrying many sub-ops (see KVOp.MULTI)."""
+        blob = bytearray(struct.pack("<I", len(ops)))
+        for op in ops:
+            enc = op.encode()
+            blob += struct.pack("<I", len(enc)) + enc
+        return KVOperation(KVOp.MULTI, value=bytes(blob))
+
+    @staticmethod
+    def unpack_multi(blob: bytes) -> list["KVOperation"]:
+        (n,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            out.append(KVOperation.decode(blob[off:off + ln]))
+            off += ln
+        return out
 
     @staticmethod
     def multi_get(keys: list[bytes]) -> "KVOperation":

@@ -13,6 +13,7 @@ Results travel as a tagged blob (see ``encode_result``).
 
 from __future__ import annotations
 
+import asyncio
 import struct
 from dataclasses import dataclass, field
 from typing import Optional
@@ -58,10 +59,69 @@ class ListRegionsOnStoreResponse:
     regions: list[bytes] = field(default_factory=list)  # Region encodings
 
 
+@dataclass
+class KVCommandBatchRequest:
+    """Store-grouped command batch: ONE RPC carries many (region, op)
+    items — the client groups everything pending by leader store the way
+    the raft plane's ``multi_append`` groups log frames by endpoint.
+    Each item blob packs (region_id, conf_ver, version, op_blob); see
+    :func:`encode_batch_item`.  Epoch checks and result/error codes are
+    PER ITEM — one stale region never fails its neighbours."""
+
+    items: list[bytes] = field(default_factory=list)
+
+
+@dataclass
+class KVCommandBatchResponse:
+    """One reply blob per request item, in order (:func:`encode_batch_reply`)."""
+
+    items: list[bytes] = field(default_factory=list)
+
+
 register_message(128, KVCommandRequest)
 register_message(129, KVCommandResponse)
 register_message(130, ListRegionsOnStoreRequest)
 register_message(131, ListRegionsOnStoreResponse)
+register_message(132, KVCommandBatchRequest)
+register_message(133, KVCommandBatchResponse)
+
+
+# ---- batch item / reply codecs ---------------------------------------------
+
+_ITEM_HDR = struct.Struct("<qqq")   # region_id, conf_ver, version
+
+
+def encode_batch_item(region_id: int, conf_ver: int, version: int,
+                      op_blob: bytes) -> bytes:
+    return _ITEM_HDR.pack(region_id, conf_ver, version) + op_blob
+
+
+def decode_batch_item(blob: bytes) -> tuple[int, int, int, bytes]:
+    region_id, conf_ver, version = _ITEM_HDR.unpack_from(blob, 0)
+    return region_id, conf_ver, version, bytes(blob[_ITEM_HDR.size:])
+
+
+def encode_batch_reply(code: int, msg: str = "", result: bytes = b"",
+                       region_meta: bytes = b"") -> bytes:
+    m = msg.encode()
+    return (struct.pack("<qI", code, len(m)) + m
+            + struct.pack("<I", len(result)) + result
+            + struct.pack("<I", len(region_meta)) + region_meta)
+
+
+def decode_batch_reply(blob: bytes) -> tuple[int, str, bytes, bytes]:
+    buf = memoryview(blob)
+    code, mlen = struct.unpack_from("<qI", buf, 0)
+    off = 12
+    msg = bytes(buf[off:off + mlen]).decode()
+    off += mlen
+    (rlen,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    result = bytes(buf[off:off + rlen])
+    off += rlen
+    (glen,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return code, msg, result, bytes(buf[off:off + glen])
 
 
 # ---- tagged result codec ---------------------------------------------------
@@ -144,13 +204,22 @@ _WRITE_OPS = {
 
 
 class KVCommandProcessor:
-    """Registered as method ``kv_command`` on the store's RpcServer."""
+    """Registered as methods ``kv_command`` (one op, one region) and
+    ``kv_command_batch`` (store-grouped: many regions' ops in one RPC,
+    per-item epoch checks and per-item results) on the store's RpcServer."""
 
     def __init__(self, store_engine) -> None:
         self._se = store_engine
         store_engine.rpc_server.register("kv_command", self.handle)
+        store_engine.rpc_server.register("kv_command_batch",
+                                         self.handle_batch)
         store_engine.rpc_server.register("kv_list_regions",
                                          self.handle_list_regions)
+        # observability (bench counters / wire-compat tests)
+        self.batch_rpcs = 0      # kv_command_batch RPCs served
+        self.batch_items = 0     # items carried inside them
+        self.batch_regions = 0   # distinct regions proposed per batch, summed
+        self.single_rpcs = 0     # legacy per-op kv_command RPCs served
 
     async def handle_list_regions(self, req: ListRegionsOnStoreRequest
                                   ) -> ListRegionsOnStoreResponse:
@@ -159,34 +228,39 @@ class KVCommandProcessor:
         return ListRegionsOnStoreResponse(
             regions=[r.encode() for r in self._se.list_regions()])
 
-    async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
-        engine = self._se.get_region_engine(req.region_id)
+    def _validate(self, region_id: int, conf_ver: int, version: int,
+                  op_blob: bytes):
+        """Shared per-item admission: returns either ``(None, engine, op)``
+        or ``((code, msg, region_meta), None, None)`` on rejection."""
+        engine = self._se.get_region_engine(region_id)
         if engine is None:
-            return KVCommandResponse(
-                code=ERR_NO_REGION,
-                msg=f"region {req.region_id} not on store {self._se.server_id}")
+            return ((ERR_NO_REGION,
+                     f"region {region_id} not on store {self._se.server_id}",
+                     b""), None, None)
         region = engine.region
-        if (region.epoch.conf_ver != req.conf_ver
-                or region.epoch.version != req.version):
-            return KVCommandResponse(
-                code=ERR_INVALID_EPOCH,
-                msg=(f"region {req.region_id} epoch is "
-                     f"{region.epoch.conf_ver}.{region.epoch.version}, "
-                     f"client sent {req.conf_ver}.{req.version}"),
-                region_meta=region.encode())
-        op = KVOperation.decode(req.op_blob)
+        if (region.epoch.conf_ver != conf_ver
+                or region.epoch.version != version):
+            return ((ERR_INVALID_EPOCH,
+                     (f"region {region_id} epoch is "
+                      f"{region.epoch.conf_ver}.{region.epoch.version}, "
+                      f"client sent {conf_ver}.{version}"),
+                     region.encode()), None, None)
+        op = KVOperation.decode(op_blob)
         if not _keys_in_region(op, region):
             # epoch matched but a key escapes the range: the client grouped
             # a batch against a route view that split under it — make it
             # re-shard rather than silently committing through this group
-            return KVCommandResponse(
-                code=ERR_KEY_OUT_OF_RANGE,
-                msg=f"key(s) outside region {req.region_id} range",
-                region_meta=region.encode())
-        rs = engine.raft_store
+            return ((ERR_KEY_OUT_OF_RANGE,
+                     f"key(s) outside region {region_id} range",
+                     region.encode()), None, None)
+        return None, engine, op
+
+    async def _execute_op(self, rs, op: KVOperation
+                          ) -> tuple[int, str, object]:
+        """Run one admitted op through the region store; (code, msg, result)."""
         try:
             if op.op in _WRITE_OPS:
-                result = await rs._apply(op)
+                result = await rs.apply(op)
             elif op.op == KVOp.GET:
                 result = await rs.get(op.key)
             elif op.op == KVOp.MULTI_GET:
@@ -200,17 +274,91 @@ class KVCommandProcessor:
                 scan = rs.reverse_scan if reverse else rs.scan
                 result = await scan(op.key, op.value, limit, bool(rv))
             else:
-                return KVCommandResponse(code=int(RaftError.EINVAL),
-                                         msg=f"bad op {op.op}")
+                return int(RaftError.EINVAL), f"bad op {op.op}", None
         except KVStoreError as e:
-            return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
+            return e.status.code, e.status.error_msg, None
         except (RpcError, ReadIndexError) as e:
             # keep the real status code: ETIMEDOUT/EPERM/ERAFTTIMEDOUT are
             # retryable by the client; EINTERNAL would hard-fail the call
-            return KVCommandResponse(code=e.status.code, msg=e.status.error_msg)
+            return e.status.code, e.status.error_msg, None
         except Exception as e:  # noqa: BLE001
-            return KVCommandResponse(code=int(RaftError.EINTERNAL), msg=str(e))
+            return int(RaftError.EINTERNAL), str(e), None
+        return 0, "", result
+
+    async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
+        self.single_rpcs += 1
+        rejected, engine, op = self._validate(
+            req.region_id, req.conf_ver, req.version, req.op_blob)
+        if rejected is not None:
+            code, msg, meta = rejected
+            return KVCommandResponse(code=code, msg=msg, region_meta=meta)
+        code, msg, result = await self._execute_op(engine.raft_store, op)
+        if code:
+            return KVCommandResponse(code=code, msg=msg)
         return KVCommandResponse(result=encode_result(result))
+
+    async def handle_batch(self, req: KVCommandBatchRequest
+                           ) -> KVCommandBatchResponse:
+        """The store-grouped fast path: validate every item, then propose
+        each region's write sub-batch as ONE multi-op log entry — every
+        region's quorum round runs CONCURRENTLY instead of op-by-op
+        through sequential ``kv_command`` handlers."""
+        self.batch_rpcs += 1
+        self.batch_items += len(req.items)
+        replies: list[bytes] = [b""] * len(req.items)
+        groups: dict[int, list[tuple[int, KVOperation]]] = {}
+        for i, blob in enumerate(req.items):
+            region_id, conf_ver, version, op_blob = decode_batch_item(blob)
+            rejected, engine, op = self._validate(
+                region_id, conf_ver, version, op_blob)
+            if rejected is not None:
+                code, msg, meta = rejected
+                replies[i] = encode_batch_reply(code, msg, region_meta=meta)
+                continue
+            groups.setdefault(region_id, []).append((i, op))
+        self.batch_regions += len(groups)
+
+        async def run_region(rid: int, items: list) -> None:
+            engine = self._se.get_region_engine(rid)
+            if engine is None:   # vanished between validation and here
+                for i, _ in items:
+                    replies[i] = encode_batch_reply(
+                        ERR_NO_REGION, f"region {rid} dropped mid-batch")
+                return
+            rs = engine.raft_store
+            writes = [(i, op) for i, op in items if op.op in _WRITE_OPS]
+            reads = [(i, op) for i, op in items if op.op not in _WRITE_OPS]
+
+            async def run_writes():
+                try:
+                    outs = await rs.apply_multi([op for _, op in writes])
+                    for (i, _), (st, result) in zip(writes, outs):
+                        replies[i] = (
+                            encode_batch_reply(0, result=encode_result(result))
+                            if st.is_ok()
+                            else encode_batch_reply(st.code, st.error_msg))
+                except KVStoreError as e:
+                    for i, _ in writes:
+                        replies[i] = encode_batch_reply(e.status.code,
+                                                        e.status.error_msg)
+                except Exception as e:  # noqa: BLE001
+                    for i, _ in writes:
+                        replies[i] = encode_batch_reply(
+                            int(RaftError.EINTERNAL), str(e))
+
+            async def run_read(i: int, op: KVOperation) -> None:
+                code, msg, result = await self._execute_op(rs, op)
+                replies[i] = (
+                    encode_batch_reply(0, result=encode_result(result))
+                    if code == 0 else encode_batch_reply(code, msg))
+
+            await asyncio.gather(
+                *([run_writes()] if writes else []),
+                *(run_read(i, op) for i, op in reads))
+
+        await asyncio.gather(*(run_region(rid, items)
+                               for rid, items in groups.items()))
+        return KVCommandBatchResponse(items=replies)
 
 
 _SINGLE_KEY_OPS = {

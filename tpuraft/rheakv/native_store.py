@@ -266,6 +266,14 @@ class NativeRawKVStore(RawKVStore):
         if keys:
             self._write([(_OP_DELETE, _COL_DATA, k, b"") for k in keys])
 
+    def apply_write_batch(self, ops: list[tuple[bytes, Optional[bytes]]]
+                          ) -> None:
+        # one ctypes call + one WAL record for the whole mixed run
+        if ops:
+            self._write([(_OP_PUT, _COL_DATA, k, v) if v is not None
+                         else (_OP_DELETE, _COL_DATA, k, b"")
+                         for k, v in ops])
+
     def delete_range(self, start: bytes, end: bytes) -> None:
         self._write([(_OP_DELETE_RANGE, _COL_DATA, start, end)])
 

@@ -29,9 +29,15 @@ class KVStoreError(Exception):
 
 class RaftRawKVStore:
     def __init__(self, node: Node, store: RawKVStore,
-                 apply_batch: int = 32):
+                 apply_batch: int = 32, multi_entries: bool = True):
         self.node = node
         self.store = store
+        # multi_entries=False is the mixed-version escape hatch: a
+        # KVOp.MULTI log entry replicated to a pre-batch replica would
+        # fail its apply (unknown op) and silently diverge state — in a
+        # rolling upgrade, keep per-op entries until every store's FSM
+        # understands MULTI (StoreEngineOptions.multi_op_entries)
+        self._multi_entries = multi_entries
         # server-side apply micro-batching (reference: the apply
         # Disruptor drains up to applyBatch=32 tasks per event):
         # concurrent RPC handlers coalesce into ONE Node.apply_batch —
@@ -43,7 +49,11 @@ class RaftRawKVStore:
 
     # -- write path (through the log) ---------------------------------------
 
-    async def _apply(self, op: KVOperation):
+    async def apply(self, op: KVOperation):
+        """Replicate one KVOperation through the region's raft group and
+        return its FSM result (public API — the KV command processors
+        drive proposals through here).  Raises :class:`KVStoreError` on
+        a failed proposal or a failed apply."""
         fut = asyncio.get_running_loop().create_future()
         # encode HERE, not in the drainer: a malformed op (bad key
         # type) must fail its own caller, not kill the drain task and
@@ -56,6 +66,46 @@ class RaftRawKVStore:
         if not status.is_ok():
             raise KVStoreError(status)
         return result
+
+    # compat alias (pre-batch callers reached into the private name)
+    _apply = apply
+
+    async def apply_multi(self, ops: list[KVOperation]
+                          ) -> list[tuple[Status, object]]:
+        """Replicate MANY ops as ONE log entry (one quorum round, one
+        fsync amortized over the whole sub-batch) and return per-op
+        ``(status, result)`` — the server side of ``kv_command_batch``'s
+        cross-region fan-out.  A sub-op failure fails only its slot; a
+        failed PROPOSAL (not leader, shutting down) raises for the whole
+        batch, exactly like :meth:`apply`."""
+        if not ops:
+            return []
+        if len(ops) == 1:
+            # no wrapping overhead for the degenerate batch
+            try:
+                return [(Status.OK(), await self.apply(ops[0]))]
+            except KVStoreError as e:
+                if e.status.code == int(RaftError.ESTATEMACHINE):
+                    return [(e.status, None)]  # op-level, not proposal-level
+                raise
+        if not self._multi_entries:
+            # per-op log entries (pre-batch-replica compatible): the
+            # sub-batch still coalesces into one drain round / one
+            # node-lock acquisition, just without log-entry amortization
+            outs = await asyncio.gather(*(self.apply(op) for op in ops),
+                                        return_exceptions=True)
+            results: list[tuple[Status, object]] = []
+            for out in outs:
+                if isinstance(out, KVStoreError):
+                    results.append((out.status, None))
+                elif isinstance(out, BaseException):
+                    raise out
+                else:
+                    results.append((Status.OK(), out))
+            return results
+        outs = await self.apply(KVOperation.multi(ops))
+        return [(Status.OK() if code == 0 else Status(code, msg), result)
+                for code, msg, result in outs]
 
     async def _drain(self) -> None:
         # same drain-until-empty invariant as ReadOnlyService's rounds:

@@ -107,6 +107,19 @@ class RawKVStore:
         for k in keys:
             self.delete(k)
 
+    def apply_write_batch(self, ops: list[tuple[bytes, Optional[bytes]]]
+                          ) -> None:
+        """Apply a mixed run of puts (``(key, value)``) and deletes
+        (``(key, None)``) in order.  The FSM's apply coalescer flushes
+        whole PUT/DELETE runs through this; engines with a batch write
+        path (the native store's ``tkv_apply_batch``) override it with
+        ONE atomic call instead of one per op."""
+        for k, v in ops:
+            if v is None:
+                self.delete(k)
+            else:
+                self.put(k, v)
+
     def delete_range(self, start: bytes, end: bytes) -> None:
         for k, _ in self.scan(start, end, -1, return_value=False):
             self.delete(k)

@@ -45,13 +45,16 @@ class RegionEngine:
 
     async def start(self) -> None:
         se = self.store_engine
-        self.fsm = KVStoreStateMachine(self.region, se.raw_store, se)
+        self.fsm = KVStoreStateMachine(
+            self.region, se.raw_store, se,
+            coalesce_applies=se.opts.fsm_coalesce)
         opts = se.make_node_options(self.region, self.fsm)
         self._group_service = RaftGroupService(
             self.group_id, se.server_id, opts, se.node_manager, se.transport,
             ballot_box_factory=se.ballot_box_factory())
         node = await self._group_service.start()
-        self.raft_store = RaftRawKVStore(node, se.raw_store)
+        self.raft_store = RaftRawKVStore(
+            node, se.raw_store, multi_entries=se.opts.multi_op_entries)
         LOG.info("region engine started: %s on %s", self.region,
                  se.server_id)
 

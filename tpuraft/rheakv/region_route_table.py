@@ -18,10 +18,12 @@ class RegionRouteTable:
     def __init__(self) -> None:
         self._starts: list[bytes] = []     # sorted region start keys
         self._regions: dict[bytes, Region] = {}
+        self._by_id: dict[int, bytes] = {}  # region id -> start key
 
     def reset(self, regions: list[Region]) -> None:
         self._starts = []
         self._regions = {}
+        self._by_id = {}
         for r in regions:
             self.add_or_update(r)
 
@@ -43,13 +45,20 @@ class RegionRouteTable:
         if cur is not None and cur.id != r.id \
                 and (cur.epoch.version > r.epoch.version):
             return  # keep the fresher view
+        if cur is not None and cur.id != r.id \
+                and self._by_id.get(cur.id) == r.start_key:
+            del self._by_id[cur.id]   # displaced by a different region
         if r.start_key not in self._regions:
             bisect.insort(self._starts, r.start_key)
         self._regions[r.start_key] = r
+        self._by_id[r.id] = r.start_key
 
     def _remove_start(self, start: bytes) -> None:
-        if start in self._regions:
+        old = self._regions.get(start)
+        if old is not None:
             del self._regions[start]
+            if self._by_id.get(old.id) == start:
+                del self._by_id[old.id]
             i = bisect.bisect_left(self._starts, start)
             if i < len(self._starts) and self._starts[i] == start:
                 self._starts.pop(i)
@@ -68,10 +77,14 @@ class RegionRouteTable:
         return r if r.contains_key(key) else None
 
     def find_region_by_id(self, region_id: int) -> Optional[Region]:
-        for r in self._regions.values():
-            if r.id == region_id:
-                return r
-        return None
+        """O(1) via the id index — this sits on the client's per-round
+        re-shard path, where a linear scan is O(regions) per group per
+        attempt at density."""
+        start = self._by_id.get(region_id)
+        if start is None:
+            return None
+        r = self._regions.get(start)
+        return r if r is not None and r.id == region_id else None
 
     def find_regions_by_range(self, start: bytes, end: bytes) -> list[Region]:
         """All regions intersecting [start, end); ordered by start key."""

@@ -35,20 +35,70 @@ class KVClosure:
 
 
 class KVStoreStateMachine(StateMachine):
+    # write ops the apply coalescer folds into one mixed store write
+    # (all return True and only touch the data namespace)
+    _RUN_OPS = frozenset(
+        (KVOp.PUT, KVOp.DELETE, KVOp.PUT_LIST, KVOp.DELETE_LIST))
+
     def __init__(self, region: Region, store: RawKVStore,
-                 store_engine=None) -> None:
+                 store_engine=None, coalesce_applies: bool = True) -> None:
         self.region = region
         self.store = store
         self.store_engine = store_engine  # for RANGE_SPLIT
         self.leader_term = -1
+        # coalesced-apply knob + counters (StoreEngineOptions.fsm_coalesce):
+        # consecutive PUT/DELETE(-list) entries flush as ONE native batch
+        # write instead of one store call per op
+        self.coalesce_applies = coalesce_applies
+        self.coalesced_flushes = 0   # flushes that merged more than one row
+        self.coalesced_ops = 0       # rows that rode a merged flush
 
     # -- apply ---------------------------------------------------------------
 
+    def _run_rows(self, op: KVOperation
+                  ) -> list[tuple[bytes, Optional[bytes]]]:
+        code = op.op
+        if code == KVOp.PUT:
+            return [(op.key, op.value)]
+        if code == KVOp.DELETE:
+            return [(op.key, None)]
+        if code == KVOp.PUT_LIST:
+            return list(KVOperation.unpack_kv_list(op.value))
+        return [(k, None) for k in KVOperation.unpack_key_list(op.value)]
+
+    def _flush_run(self, rows: list, dones: list) -> None:
+        try:
+            self.store.apply_write_batch(rows)
+            if len(rows) > 1:
+                self.coalesced_flushes += 1
+                self.coalesced_ops += len(rows)
+            st = Status.OK()
+        except Exception as e:  # noqa: BLE001 — run-level failure, not fatal
+            LOG.exception("region %d coalesced apply (%d rows) failed",
+                          self.region.id, len(rows))
+            st = Status.error(RaftError.ESTATEMACHINE, str(e))
+        for done, closure in dones:
+            if closure is not None and st.is_ok():
+                closure.result = True
+            if done is not None:
+                done(st)
+        rows.clear()
+        dones.clear()
+
     async def on_apply(self, it: Iterator) -> None:
+        run_rows: list = []
+        run_dones: list = []   # (done, closure) per coalesced entry
         while it.valid():
             op = KVOperation.decode(it.data())
             done = it.done()
             closure = done if isinstance(done, KVClosure) else None
+            if self.coalesce_applies and op.op in self._RUN_OPS:
+                run_rows.extend(self._run_rows(op))
+                run_dones.append((done, closure))
+                it.next()
+                continue
+            if run_dones:
+                self._flush_run(run_rows, run_dones)
             try:
                 result = self._dispatch(op)
                 if closure is not None:
@@ -61,6 +111,8 @@ class KVStoreStateMachine(StateMachine):
                 if done is not None:
                     done(Status.error(RaftError.ESTATEMACHINE, str(e)))
             it.next()
+        if run_dones:
+            self._flush_run(run_rows, run_dones)
 
     def _dispatch(self, op: KVOperation):
         s = self.store
@@ -101,6 +153,8 @@ class KVStoreStateMachine(StateMachine):
             return s.try_lock_with(op.key, op.value, lease_ms, bool(keep))
         if code == KVOp.KEY_LOCK_RELEASE:
             return s.release_lock(op.key, op.value)
+        if code == KVOp.MULTI:
+            return self._dispatch_multi(KVOperation.unpack_multi(op.value))
         if code == KVOp.RANGE_SPLIT:
             (new_region_id,) = struct.unpack("<q", op.aux)
             if self.store_engine is None:
@@ -116,6 +170,45 @@ class KVStoreStateMachine(StateMachine):
         if code == KVOp.CONTAINS_KEY:
             return s.contains_key(op.key)
         raise ValueError(f"unknown KV op {code}")
+
+    def _dispatch_multi(self, ops: list[KVOperation]
+                        ) -> list[tuple[int, str, object]]:
+        """Apply a MULTI entry's sub-ops in order with PER-OP outcomes
+        ``(code, msg, result)`` — a sub-op failure fails only its item,
+        never the whole entry (the batch handler maps each outcome back
+        to its kv_command_batch item).  Consecutive PUT/DELETE(-list)
+        sub-ops coalesce into one store write, same as entry-level runs."""
+        outs: list = [None] * len(ops)
+        i, n = 0, len(ops)
+        while i < n:
+            if self.coalesce_applies and ops[i].op in self._RUN_OPS:
+                j = i
+                rows: list = []
+                while j < n and ops[j].op in self._RUN_OPS:
+                    rows.extend(self._run_rows(ops[j]))
+                    j += 1
+                try:
+                    self.store.apply_write_batch(rows)
+                    if len(rows) > 1:
+                        self.coalesced_flushes += 1
+                        self.coalesced_ops += len(rows)
+                    out = (0, "", True)
+                except Exception as e:  # noqa: BLE001
+                    LOG.exception("region %d multi-apply run (%d rows) failed",
+                                  self.region.id, len(rows))
+                    out = (int(RaftError.ESTATEMACHINE), str(e), None)
+                for k in range(i, j):
+                    outs[k] = out
+                i = j
+                continue
+            try:
+                outs[i] = (0, "", self._dispatch(ops[i]))
+            except Exception as e:  # noqa: BLE001
+                LOG.exception("region %d multi-apply op %s failed",
+                              self.region.id, ops[i].op)
+                outs[i] = (int(RaftError.ESTATEMACHINE), str(e), None)
+            i += 1
+        return outs
 
     # -- leadership ----------------------------------------------------------
 

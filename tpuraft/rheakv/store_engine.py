@@ -74,6 +74,17 @@ class StoreEngineOptions:
     # interval x 2^fails, clamped here) — a down PD costs one cheap
     # probe per cap interval, not a hot retry loop
     pd_backoff_max_ms: int = 30000
+    # serving-plane apply coalescing: the region FSMs flush consecutive
+    # PUT/DELETE(-list) entries as ONE store batch write (one ctypes
+    # call + one WAL record per run) instead of one call per op — see
+    # KVStoreStateMachine.coalesce_applies
+    fsm_coalesce: bool = True
+    # kv_command_batch write sub-batches ride ONE KVOp.MULTI log entry
+    # per region (one quorum round amortized).  Set False during a
+    # rolling upgrade from a pre-batch build: a MULTI entry replicated
+    # to a replica whose FSM predates it fails to apply and silently
+    # diverges state — per-op entries stay wire/FSM-compatible both ways
+    multi_op_entries: bool = True
 
 
 class StoreEngine:
@@ -86,7 +97,7 @@ class StoreEngine:
         self.transport = transport
         self.node_manager = NodeManager(rpc_server)
         CliProcessors(self.node_manager)
-        KVCommandProcessor(self)
+        self.kv_processor = KVCommandProcessor(self)
         self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
         raw: RawKVStore = opts.raw_store_factory()
         if opts.enable_kv_metrics:
