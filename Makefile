@@ -2,10 +2,14 @@
 # VERDICT r1 weak #7: reproducible in-repo automation).
 #
 #   make            -> build the native engines (release .so's)
-#   make check      -> sanitizer-instrumented native torture drivers
-#                      (TSAN + ASAN/UBSAN x 3 engines), the full Python
-#                      test suite, and a short linearizability soak
+#   make check      -> graftcheck lint, sanitizer-instrumented native
+#                      torture drivers (TSAN + ASAN/UBSAN x 3 engines),
+#                      the full Python test suite, and a short
+#                      linearizability soak
 #   make test       -> Python suite only
+#   make lint       -> graftcheck static analysis over tpuraft/ (lock
+#                      discipline, lock-order cycles, wire-schema drift,
+#                      blocking-call + future-leak lints); <10s
 #   make san        -> sanitizer drivers only
 #   make chaos-smoke-> storage-plane crash-consistency harness + short
 #                      power-loss soak (<60s)
@@ -26,6 +30,16 @@ san:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# graftcheck: the Python plane's analog of `make san` (PAPER.md §6 race
+# detection) — five AST checkers for the defect classes the chaos
+# harness kept catching dynamically (PR 2 storage lock races + wedged
+# waiters, PR 3 wire drift).  Intentional wire/lock-order changes:
+# review, then `python -m tpuraft.analysis --record` and commit the
+# lockfiles (docs/operations.md "Static analysis & wire-format
+# changes").
+lint:
+	$(PY) -m tpuraft.analysis
 
 soak:
 	$(PY) -m examples.soak --duration 30 --seed 1
@@ -66,8 +80,8 @@ soak-long:
 bench-gate:
 	$(PY) bench_gate.py
 
-check: san test soak bench-gate
-	@echo "make check: native sanitizers + suite + soak + perf gate all green"
+check: lint san test soak bench-gate
+	@echo "make check: lint + native sanitizers + suite + soak + perf gate all green"
 	@echo "(consensus-path changes: also run make soak-long before merge;"
 	@echo " storage-path changes: also run make chaos-smoke)"
 
@@ -77,4 +91,4 @@ bench:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native san test soak chaos-smoke check bench bench-gate clean
+.PHONY: all native san test lint soak chaos-smoke check bench bench-gate clean

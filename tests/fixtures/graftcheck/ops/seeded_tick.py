@@ -1,0 +1,17 @@
+"""graftcheck fixture living under an ops/ directory: the whole module
+is tick-plane context.  Parsed by tests/test_analysis.py, never
+imported."""
+
+import time
+
+
+def bad_tick_sleep():
+    time.sleep(0.001)       # VIOLATION: tick plane
+
+
+def bad_tick_wait(fut):
+    return fut.result()     # VIOLATION: tick plane (untimed wait)
+
+
+def ok_compute(x):
+    return x + 1

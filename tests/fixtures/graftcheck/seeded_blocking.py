@@ -1,0 +1,72 @@
+"""graftcheck fixture: seeded blocking-call violations (and the shapes
+that must NOT fire).  Parsed by tests/test_analysis.py, never imported."""
+
+import asyncio
+import socket
+import threading
+import time
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+def bad_sleep_under_lock():
+    with _lock:
+        time.sleep(0.5)                         # VIOLATION: lock held
+
+
+def bad_untimed_result_under_lock(fut):
+    with _lock:
+        return fut.result()                     # VIOLATION: wedged-waiter
+
+
+def ok_timed_result_under_lock(fut):
+    with _lock:
+        return fut.result(timeout=5.0)          # clean: bounded wait
+
+
+def ok_sleep_no_context():
+    time.sleep(0.1)                             # clean: plain sync helper
+
+
+async def bad_sleep_in_coroutine():
+    time.sleep(0.2)                             # VIOLATION: blocks the loop
+
+
+async def ok_result_of_done_task(task):
+    await task
+    return task.result()                        # clean: done task, no block
+
+
+async def ok_executor_reference():
+    loop = asyncio.get_running_loop()
+    # passing the callable is fine; only CALLS are flagged
+    await loop.run_in_executor(None, time.sleep, 0.1)
+
+
+async def bad_untimed_result_under_async_lock(fut):
+    async with _alock:
+        return fut.result()                 # VIOLATION: async lock held
+
+
+async def ok_lambda_off_loop():
+    loop = asyncio.get_running_loop()
+    with _lock:
+        # the sanctioned off-loop pattern: the lambda body runs on an
+        # executor thread, NOT under the lock — must stay clean
+        await loop.run_in_executor(None, lambda: time.sleep(0.1))
+
+
+def bad_socket_under_lock(server_sock):
+    with _lock:
+        return server_sock.accept()             # VIOLATION: blocking IO
+
+
+class ReplayStateMachine:
+    """Name matches *StateMachine: every method is an FSM apply path."""
+
+    def on_apply(self, it):
+        time.sleep(0.01)                        # VIOLATION: FSM path
+
+    def bad_wait(self, fut):
+        return fut.result()                     # VIOLATION: FSM path

@@ -1,0 +1,59 @@
+"""graftcheck fixture: seeded future-completion violations.  Parsed by
+tests/test_analysis.py, never imported."""
+
+import asyncio
+
+
+def risky_step():
+    raise RuntimeError("boom")
+
+
+async def bad_straight_line_completion():
+    fut = asyncio.get_running_loop().create_future()
+    value = risky_step()        # raises -> set_result never runs
+    fut.set_result(value)       # VIOLATION: no except/finally completion
+    return None                 # (fut deliberately not returned)
+
+
+async def bad_never_completed():
+    fut = asyncio.get_running_loop().create_future()
+    risky_step()                # VIOLATION: never completed, never escapes
+    return None
+
+
+async def ok_try_except_completion():
+    fut = asyncio.get_running_loop().create_future()
+    try:
+        fut.set_result(risky_step())
+    except Exception as e:          # noqa: BLE001 — fixture
+        fut.set_exception(e)        # clean: failure path completes it
+    return None
+
+
+async def ok_finally_cancel():
+    fut = asyncio.get_running_loop().create_future()
+    try:
+        fut.set_result(risky_step())
+    finally:
+        fut.cancel()                # clean: finally always completes
+    return None
+
+
+async def bad_annotated_straight_line():
+    fut: asyncio.Future = asyncio.get_running_loop().create_future()
+    value = risky_step()        # raises -> set_result never runs
+    fut.set_result(value)       # VIOLATION: AnnAssign form, same rule
+    return None
+
+
+async def ok_escaping_future(registry):
+    fut = asyncio.get_running_loop().create_future()
+    registry.append(fut)        # ownership transferred: out of scope
+    risky_step()
+    return None
+
+
+async def ok_immediate_completion():
+    fut = asyncio.get_running_loop().create_future()
+    fut.set_result(1)           # clean: nothing risky in between
+    return None

@@ -1,0 +1,93 @@
+"""graftcheck fixture: seeded guarded-by / loop-confined violations.
+
+NOT imported by anything — parsed by tests/test_analysis.py to prove
+each rule fires (and that waivers suppress).  Line markers below are
+matched by substring, not line number, so edits stay cheap.
+"""
+
+import threading
+import time
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []        # guarded-by: _lock
+        self.version = 0        # guarded-by: _lock (writes)
+
+    def ok_locked_access(self):
+        with self._lock:
+            self._items.append(1)       # clean: under the lock
+            self.version += 1
+
+    def bad_unlocked_read(self):
+        return len(self._items)         # VIOLATION: read without lock
+
+    def bad_unlocked_write(self):
+        self.version = 7                # VIOLATION: write without lock
+
+    def ok_writes_mode_read(self):
+        return self.version             # clean: (writes) mode, read ok
+
+    def waived_access(self):
+        # the escape hatch, with a written justification
+        return self._items[:]  # graftcheck: allow(guarded-by) — fixture: snapshot copy is benign here
+
+    def bad_closure_in_with(self):
+        with self._lock:
+            def later():
+                return self._items.pop()    # VIOLATION: closure runs later
+            return later
+
+    def _helper_locked(self):
+        self._items.clear()             # clean: _locked suffix = held
+
+    def bad_call_without_lock(self):
+        self._helper_locked()           # VIOLATION: holds-call site
+
+    def ok_call_with_lock(self):
+        with self._lock:
+            self._helper_locked()       # clean
+
+
+_mod_guard = threading.Lock()
+_mod_registry = {}      # guarded-by: _mod_guard
+
+
+def bad_module_closure():
+    with _mod_guard:
+        def later():
+            return _mod_registry.popitem()  # VIOLATION: closure runs later
+        return later
+
+
+def ok_module_locked():
+    with _mod_guard:
+        _mod_registry.clear()               # clean
+
+
+class TrailingCommentScope:
+    """A trailing annotation must not leak onto the NEXT statement."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 1          # guarded-by: _lock
+        self.b = 2
+
+    def bad_touch_a(self):
+        return self.a               # VIOLATION: a is annotated
+
+    def ok_touch_b(self):
+        self.b = 9                  # clean: b inherited NOTHING from a
+
+
+# graftcheck: loop-confined
+class Confined:
+    def __init__(self):
+        time.sleep(0.01)                # VIOLATION: ctor is confined too
+
+    def bad_thread_primitive(self):
+        return threading.Lock()         # VIOLATION: loop-confined
+
+    def bad_sleep(self):
+        time.sleep(0.1)                 # VIOLATION: loop-confined

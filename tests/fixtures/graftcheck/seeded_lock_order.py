@@ -1,0 +1,33 @@
+"""graftcheck fixture: a lock-order cycle (A->B in one path, B->A in
+another) plus an edge only visible through one level of intra-module
+call resolution.  Parsed by tests/test_analysis.py, never imported."""
+
+import threading
+
+_reg_lock = threading.Lock()
+_registry = {}
+
+
+class Engine:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:       # edge A -> B
+                pass
+
+    def backward(self):
+        with self._block:
+            with self._alock:       # edge B -> A: CYCLE
+                pass
+
+    def close(self):
+        with self._alock:
+            pass
+
+
+def release(eng):
+    with _reg_lock:
+        eng.close()                 # resolved edge _reg_lock -> Engine._alock

@@ -1,0 +1,453 @@
+"""graftcheck (tpuraft.analysis) — analyzer fixture tests + the tier-1
+whole-tree gate.
+
+Three layers:
+  1. fixture tests: every checker catches its seeded violations in
+     tests/fixtures/graftcheck/, honors `# graftcheck: allow` escapes,
+     and stays silent on the clean shapes next to them;
+  2. the meta-test: the committed wire_schema.lock.json matches the LIVE
+     ``_MSG_TYPES`` registry (proves the AST extraction faithful — if
+     the two ever disagree, the checker is linting a fiction);
+  3. the gate: ``python -m tpuraft.analysis`` over the real tree is
+     clean and fast — the same invocation `make lint` runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpuraft.analysis import lock_order, wire_schema
+from tpuraft.analysis.core import load_modules, run_checkers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftcheck")
+
+
+def _findings(path: str, **kw):
+    mods, errs = load_modules([os.path.join(FIXTURES, path)])
+    assert not errs
+    return run_checkers(mods, **kw)
+
+
+def _lines_with(findings, rule, needle=""):
+    return [f for f in findings
+            if f.rule == rule and needle in f.message]
+
+
+# ---- 1. fixture tests -------------------------------------------------------
+
+
+class TestGuardedBy:
+    @pytest.fixture(scope="class")
+    def found(self):
+        return _findings("seeded_guarded_by.py")
+
+    def test_catches_unlocked_read_and_write(self, found):
+        assert _lines_with(found, "guarded-by", "read in bad_unlocked_read")
+        assert _lines_with(found, "guarded-by",
+                           "written in bad_unlocked_write")
+
+    def test_writes_mode_allows_reads(self, found):
+        assert not _lines_with(found, "guarded-by", "ok_writes_mode_read")
+
+    def test_locked_access_clean(self, found):
+        assert not _lines_with(found, "guarded-by", "ok_locked_access")
+
+    def test_waiver_honored(self, found):
+        assert not _lines_with(found, "guarded-by", "waived_access")
+
+    def test_closure_resets_held_set(self, found):
+        # the `later` closure runs after the with-block exits: its access
+        # must be flagged even though it is lexically inside the block
+        # (reported against the defining method)
+        assert _lines_with(found, "guarded-by",
+                           "read in bad_closure_in_with")
+
+    def test_holds_call_site_rule(self, found):
+        assert _lines_with(found, "guarded-by",
+                           "bad_call_without_lock() calls it without")
+        assert not _lines_with(found, "guarded-by", "ok_call_with_lock")
+
+    def test_trailing_annotation_does_not_leak(self, found):
+        assert _lines_with(found, "guarded-by", "bad_touch_a")
+        assert not _lines_with(found, "guarded-by", "ok_touch_b")
+
+    def test_module_global_closure_reset(self, found):
+        # review finding: the module-global checker must reset the held
+        # set at closure boundaries exactly like the class checker
+        assert _lines_with(found, "guarded-by",
+                           "module global _mod_registry")
+        assert not any("ok_module_locked" in f.message for f in found)
+
+    def test_loop_confined(self, found):
+        assert _lines_with(found, "loop-confined", "bad_thread_primitive")
+        assert _lines_with(found, "loop-confined", "bad_sleep")
+
+    def test_loop_confined_covers_init(self, found):
+        # review finding: a confined class's __init__ is not exempt
+        assert _lines_with(found, "loop-confined", "__init__")
+
+    def test_expected_totals(self, found):
+        # exactly the seeded violations, nothing else.  6 guarded-by:
+        # bad_unlocked_read, bad_unlocked_write, bad_closure_in_with,
+        # bad_call_without_lock (call-site rule), bad_module_closure,
+        # bad_touch_a.  3 loop-confined: Confined.__init__ sleep,
+        # bad_thread_primitive, bad_sleep.
+        by_rule = {}
+        for f in found:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert len(by_rule.get("guarded-by", [])) == 6, found
+        assert len(by_rule.get("loop-confined", [])) == 3, found
+
+
+class TestLockOrder:
+    def test_cycle_detected(self, tmp_path):
+        mods, _ = load_modules([os.path.join(FIXTURES,
+                                             "seeded_lock_order.py")])
+        lockfile = str(tmp_path / "lock_order.json")
+        found = lock_order.check(mods, record=True, path=lockfile)
+        cyc = _lines_with(found, "lock-order", "cycle")
+        assert cyc and "Engine._alock" in cyc[0].message \
+            and "Engine._block" in cyc[0].message
+
+    def test_call_resolution_edge_recorded(self, tmp_path):
+        mods, _ = load_modules([os.path.join(FIXTURES,
+                                             "seeded_lock_order.py")])
+        lockfile = str(tmp_path / "lock_order.json")
+        lock_order.record(mods, path=lockfile)
+        edges = lock_order.load_sanctioned(lockfile)
+        assert any(a.endswith("_reg_lock") and b.endswith("Engine._alock")
+                   for a, b in edges), edges
+
+    def test_unsanctioned_edge_fails_until_recorded(self, tmp_path):
+        mods, _ = load_modules([os.path.join(FIXTURES,
+                                             "seeded_lock_order.py")])
+        lockfile = str(tmp_path / "empty.json")
+        with open(lockfile, "w") as f:
+            json.dump({"edges": []}, f)
+        found = lock_order.check(mods, path=lockfile)
+        assert _lines_with(found, "lock-order", "unsanctioned lock nesting")
+
+
+class TestBlockingCalls:
+    @pytest.fixture(scope="class")
+    def found(self):
+        mods, _ = load_modules([FIXTURES])
+        from tpuraft.analysis import blocking_calls
+        return blocking_calls.check(mods)
+
+    def test_lock_held_contexts(self, found):
+        assert _lines_with(found, "blocking-call",
+                           "time.sleep() while holding _lock")
+        assert _lines_with(found, "blocking-call",
+                           "untimed fut.result()")
+
+    def test_timed_result_clean(self, found):
+        assert not any("ok_timed_result" in f.message or f.line in
+                       _def_lines("seeded_blocking.py",
+                                  "ok_timed_result_under_lock")
+                       for f in found)
+
+    def test_plain_sync_helper_clean(self, found):
+        assert not any(f.line in _def_lines("seeded_blocking.py",
+                                            "ok_sleep_no_context")
+                       for f in found)
+
+    def test_coroutine_sleep_flagged_result_not(self, found):
+        assert any(f.line in _def_lines("seeded_blocking.py",
+                                        "bad_sleep_in_coroutine")
+                   for f in found)
+        # .result() on a done task in a coroutine is idiomatic asyncio
+        assert not any(f.line in _def_lines("seeded_blocking.py",
+                                            "ok_result_of_done_task")
+                       for f in found)
+
+    def test_executor_reference_clean(self, found):
+        assert not any(f.line in _def_lines("seeded_blocking.py",
+                                            "ok_executor_reference")
+                       for f in found)
+
+    def test_lambda_body_not_lock_context(self, found):
+        # review finding: run_in_executor(None, lambda: time.sleep(...))
+        # under a lock is the sanctioned OFF-loop pattern — clean
+        assert not any(f.line in _def_lines("seeded_blocking.py",
+                                            "ok_lambda_off_loop")
+                       for f in found)
+
+    def test_async_with_lock_context(self, found):
+        # review finding: 'async with <lock>' counts as lock-held — the
+        # wedged-waiter class under the asyncio node lock must be caught
+        assert _lines_with(found, "blocking-call",
+                           "untimed fut.result() (wedged-waiter class: "
+                           "pass timeout=) while holding _alock")
+
+    def test_socket_under_lock(self, found):
+        assert _lines_with(found, "blocking-call", "server_sock.accept")
+
+    def test_fsm_class_contexts(self, found):
+        assert len([f for f in found
+                    if "FSM apply path" in f.message]) >= 2
+
+    def test_tick_plane_contexts(self, found):
+        ticks = [f for f in found if "tick-plane" in f.message]
+        assert len(ticks) == 2 and all("ops" in f.path for f in ticks)
+
+
+class TestFutureLeaks:
+    @pytest.fixture(scope="class")
+    def found(self):
+        return _findings("seeded_future_leak.py",
+                         rules={"future-leak"})
+
+    def test_straight_line_completion_flagged(self, found):
+        assert _lines_with(found, "future-leak",
+                           "bad_straight_line_completion")
+
+    def test_never_completed_flagged(self, found):
+        assert _lines_with(found, "future-leak", "bad_never_completed")
+
+    def test_annassign_creation_flagged(self, found):
+        # review finding: the annotated form (fut: asyncio.Future = ...)
+        # must not exempt the rule — the tree uses it (tcp.py)
+        assert _lines_with(found, "future-leak",
+                           "bad_annotated_straight_line")
+
+    def test_covered_and_escaping_clean(self, found):
+        assert len(found) == 3, found  # ONLY the three seeded violations
+
+
+def _def_lines(fixture: str, fn_name: str) -> range:
+    import ast
+    with open(os.path.join(FIXTURES, fixture)) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == fn_name:
+            return range(node.lineno, node.end_lineno + 1)
+    raise AssertionError(f"{fn_name} not in {fixture}")
+
+
+# ---- wire-schema drift (fixture pair: v1 recorded, v2 drifted) --------------
+
+
+_WIRE_V1 = '''
+from dataclasses import dataclass, field
+from tpuraft.rpc.messages import register_message
+
+@dataclass
+class Ping:
+    term: int
+    name: str = ""
+
+@dataclass
+class Pong:
+    term: int
+
+register_message(200, Ping)
+register_message(201, Pong)
+'''
+
+_WIRE_V2_BREAKING = '''
+from dataclasses import dataclass, field
+from tpuraft.rpc.messages import register_message
+
+@dataclass
+class Ping:
+    term: int
+    epoch: int          # INSERTED mid-struct: wire-breaking
+    name: str = ""
+
+@dataclass
+class Pong:
+    term: int
+    extra: bytes        # new TRAILING field but NO default: breaking
+
+register_message(200, Ping)
+register_message(201, Pong)
+'''
+
+_WIRE_V2_COMPAT = '''
+from dataclasses import dataclass, field
+from tpuraft.rpc.messages import register_message
+
+@dataclass
+class Ping:
+    term: int
+    name: str = ""
+    lease_ms: int = 0   # trailing + defaulted: compatible, needs --record
+
+@dataclass
+class Pong:
+    term: int
+
+register_message(200, Ping)
+register_message(201, Pong)
+'''
+
+
+class TestWireSchema:
+    def _mods(self, tmp_path, src):
+        p = tmp_path / "wire_fixture.py"
+        p.write_text(src)
+        mods, _ = load_modules([str(p)])
+        return mods
+
+    def test_clean_when_recorded(self, tmp_path):
+        mods = self._mods(tmp_path, _WIRE_V1)
+        lockfile = str(tmp_path / "wire.lock.json")
+        assert wire_schema.check(mods, record=True, path=lockfile) == []
+        assert wire_schema.check(mods, path=lockfile) == []
+
+    def test_breaking_drift_caught(self, tmp_path):
+        lockfile = str(tmp_path / "wire.lock.json")
+        wire_schema.record(self._mods(tmp_path, _WIRE_V1), path=lockfile)
+        found = wire_schema.check(self._mods(tmp_path, _WIRE_V2_BREAKING),
+                                  path=lockfile)
+        msgs = "\n".join(f.message for f in found)
+        assert "insertion/reorder" in msgs         # Ping.epoch mid-struct
+        assert "no default" in msgs                # Pong.extra trailing
+
+    def test_compatible_extension_requires_record(self, tmp_path):
+        lockfile = str(tmp_path / "wire.lock.json")
+        wire_schema.record(self._mods(tmp_path, _WIRE_V1), path=lockfile)
+        found = wire_schema.check(self._mods(tmp_path, _WIRE_V2_COMPAT),
+                                  path=lockfile)
+        assert len(found) == 1 and "compatible extension" in found[0].message
+        # --record clears it
+        mods = self._mods(tmp_path, _WIRE_V2_COMPAT)
+        assert wire_schema.check(mods, record=True, path=lockfile) == []
+
+    def test_removal_caught(self, tmp_path):
+        lockfile = str(tmp_path / "wire.lock.json")
+        wire_schema.record(self._mods(tmp_path, _WIRE_V1), path=lockfile)
+        only_ping = _WIRE_V1.replace("register_message(201, Pong)", "")
+        found = wire_schema.check(self._mods(tmp_path, only_ping),
+                                  path=lockfile)
+        assert any("removed" in f.message for f in found)
+
+    def test_new_tid_requires_record(self, tmp_path):
+        lockfile = str(tmp_path / "wire.lock.json")
+        wire_schema.record(self._mods(tmp_path, _WIRE_V1), path=lockfile)
+        plus = _WIRE_V1 + (
+            "\n@dataclass\nclass Probe:\n    n: int = 0\n\n"
+            "register_message(202, Probe)\n")
+        found = wire_schema.check(self._mods(tmp_path, plus), path=lockfile)
+        assert any("new message type 202" in f.message for f in found)
+
+
+class TestWaiverSelfBypass:
+    def test_allow_waiver_cannot_silence_reasonless_waivers(self, tmp_path):
+        # review finding: 'allow(waiver)' must not suppress the
+        # reasonless-waiver finding it annotates
+        p = tmp_path / "sneaky.py"
+        p.write_text(
+            "# graftcheck: allow(waiver)\n"
+            "def f():\n"
+            "    return 1  # graftcheck: allow(guarded-by)\n")
+        mods, _ = load_modules([str(p)])
+        found = run_checkers(mods)
+        assert any(f.rule == "waiver" and "no justification" in f.message
+                   for f in found), found
+
+
+class TestSubsetRuns:
+    def test_targeted_lint_does_not_report_phantom_removals(self):
+        # review finding: linting a path that registers no messages must
+        # not diff the full lockfile as 56 'removed' findings
+        mods, _ = load_modules(
+            [os.path.join(REPO, "tpuraft", "core", "ballot_box.py")])
+        found = wire_schema.check(mods)
+        assert found == [], found
+
+
+# ---- 2. the meta-test: committed lockfile == live registry ------------------
+
+
+class TestCommittedSchemaMatchesLiveRegistry:
+    @pytest.fixture(scope="class")
+    def live(self):
+        # importing these populates the full registry
+        import tpuraft.rheakv.kv_service      # noqa: F401
+        import tpuraft.rheakv.pd_messages     # noqa: F401
+        import tpuraft.rpc.cli_messages       # noqa: F401
+        from tpuraft.rpc.messages import _MSG_TYPES
+        # the lint gate covers tpuraft/ — example/test code (e.g.
+        # examples/counter.py, imported by pytest collection) may
+        # register demo types that the committed schema rightly omits
+        return {tid: cls for tid, cls in _MSG_TYPES.items()
+                if cls.__module__.startswith("tpuraft.")}
+
+    @pytest.fixture(scope="class")
+    def lock(self):
+        lock = wire_schema.load_lock()
+        assert lock is not None, "wire_schema.lock.json missing — run " \
+            "`python -m tpuraft.analysis --record`"
+        return lock
+
+    def test_same_tids(self, live, lock):
+        assert set(live) == set(lock)
+
+    def test_same_classes_and_fields(self, live, lock):
+        for tid, cls in live.items():
+            entry = lock[tid]
+            assert entry["cls"] == cls.__name__, tid
+            live_fields = dataclasses.fields(cls)
+            locked = entry["fields"]
+            assert [f.name for f in live_fields] \
+                == [f["name"] for f in locked], cls
+            for lf, kf in zip(live_fields, locked):
+                has_default = (lf.default is not dataclasses.MISSING
+                               or lf.default_factory is not dataclasses.MISSING)
+                assert has_default == (kf["default"] is not None), \
+                    f"{cls.__name__}.{lf.name}: default presence drifted"
+
+    def test_trailing_default_invariant_holds_live(self, live):
+        # the decode contract itself: once a field has a default, every
+        # LATER field must too (otherwise decode's trailing-fill breaks)
+        for tid, cls in live.items():
+            seen_default = False
+            for f in dataclasses.fields(cls):
+                has = (f.default is not dataclasses.MISSING
+                       or f.default_factory is not dataclasses.MISSING)
+                assert not (seen_default and not has), \
+                    f"{cls.__name__}.{f.name} (tid {tid}): non-default " \
+                    f"field after a defaulted one"
+                seen_default = seen_default or has
+
+
+# ---- 3. the whole-tree gate -------------------------------------------------
+
+
+class TestTreeGate:
+    def test_tree_is_clean_and_fast(self):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpuraft.analysis"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        dt = time.monotonic() - t0
+        assert proc.returncode == 0, \
+            f"graftcheck found violations:\n{proc.stdout}"
+        # the ~10s lint budget (ISSUE 7); generous headroom for slow CI
+        assert dt < 30, f"lint took {dt:.1f}s"
+
+    def test_lock_order_file_current(self):
+        mods, _ = load_modules([os.path.join(REPO, "tpuraft")])
+        graph = lock_order.derive_graph(mods)
+        sanctioned = lock_order.load_sanctioned()
+        assert set(graph) <= sanctioned, \
+            "lock_order.json stale — review + `python -m tpuraft.analysis" \
+            " --record`"
+
+    def test_every_waiver_has_a_reason(self):
+        mods, _ = load_modules([os.path.join(REPO, "tpuraft")])
+        for m in mods:
+            for w in m.waivers:
+                assert w.reason, f"{m.rel}:{w.line}: allow({w.rule}) " \
+                    f"without justification"

@@ -1,0 +1,37 @@
+"""graftcheck: project-invariant static analysis for the Python plane.
+
+PAPER.md §6 wires race detection and sanitizers into the native engines
+(``make san``); this package is the equivalent gate for the ~20k-line
+Python plane — five AST-based checkers for the defect classes the chaos
+harness kept catching *dynamically* (PR 2's storage lock races and
+wedged future waiters, PR 3's wire-format trailing-default drift):
+
+  guarded-by     fields annotated ``# guarded-by: <lock>`` are only
+                 touched under ``with self.<lock>`` (checkers/guarded_by)
+  loop-confined  classes annotated ``# graftcheck: loop-confined`` never
+                 reach for threading primitives (checkers/guarded_by)
+  lock-order     the static lock-acquisition graph is acyclic and a
+                 subset of the sanctioned partial order committed in
+                 ``lock_order.json`` (checkers/lock_order)
+  wire-schema    every ``register_message`` dataclass matches the
+                 committed ``wire_schema.lock.json`` — no field
+                 insertion/reorder/removal, new fields only trailing
+                 with defaults (checkers/wire_schema)
+  blocking-call  no ``time.sleep`` / blocking socket IO / untimed
+                 ``Future.result()`` in tick-plane code (``ops/``), FSM
+                 apply paths, coroutines, or while holding a lock
+                 (checkers/blocking_calls)
+  future-leak    functions that create AND complete a future locally
+                 complete it on every path — try/except/finally
+                 coverage (checkers/future_leaks)
+
+Run ``python -m tpuraft.analysis`` (or ``make lint``); intentional wire
+or lock-order changes are re-recorded with ``--record`` after review.
+Escapes: ``# graftcheck: allow(<rule>) — <reason>`` on the offending
+line (or on a ``def`` line to waive the whole function); a waiver with
+no reason is itself a finding.
+"""
+
+from tpuraft.analysis.core import Finding, Module, load_modules, run_checkers
+
+__all__ = ["Finding", "Module", "load_modules", "run_checkers"]

@@ -1,0 +1,254 @@
+"""Shared graftcheck infrastructure: module loading, comment/waiver
+extraction, findings, and the checker registry.
+
+Everything here is pure stdlib (``ast`` + ``tokenize``) and import-free
+with respect to the analyzed code — the whole-tree lint must stay under
+~10s and must not drag jax into a lint run.  The one exception is the
+wire-schema *meta-test* (tests/test_analysis.py), which imports the live
+registry to prove the AST extraction faithful.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# rule ids, in report order
+RULES = (
+    "guarded-by",
+    "loop-confined",
+    "lock-order",
+    "wire-schema",
+    "blocking-call",
+    "future-leak",
+    "waiver",
+)
+
+_ALLOW_RE = re.compile(
+    r"#\s*graftcheck:\s*allow\(([a-z-]+)\)\s*(?:[—–-]+\s*(.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Waiver:
+    rule: str
+    line: int
+    reason: str
+
+
+class Module:
+    """One parsed source file: AST + per-line comments + waivers."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        # line -> comment text (tokenize is string-literal-safe, unlike
+        # scanning lines for '#').  A comment annotates the statement it
+        # TRAILS, or — only when it owns its whole line — the statement
+        # below it; a trailing comment must never leak onto the next
+        # statement (``self.a = 1  # guarded-by: _lock`` followed by
+        # ``self.b = 2`` does not annotate b).
+        self.comments: dict[int, str] = {}
+        self.standalone_comments: set[int] = set()
+        src_lines = source.splitlines()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    line = tok.start[0]
+                    self.comments[line] = tok.string
+                    if not src_lines[line - 1][:tok.start[1]].strip():
+                        self.standalone_comments.add(line)
+        except tokenize.TokenError:
+            pass  # ast.parse succeeded; a tokenize edge case loses comments only
+        self.waivers: list[Waiver] = []
+        for line, text in self.comments.items():
+            m = _ALLOW_RE.search(text)
+            if m:
+                self.waivers.append(
+                    Waiver(m.group(1), line, (m.group(2) or "").strip()))
+        # def-line waivers cover the whole function body for that rule
+        self._fn_waivers: list[tuple[int, int, str]] = []  # (lo, hi, rule)
+        by_line = {w.line: w for w in self.waivers}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = by_line.get(node.lineno)
+                if w is None and node.lineno - 1 in self.standalone_comments:
+                    w = by_line.get(node.lineno - 1)
+                if w is not None:
+                    self._fn_waivers.append(
+                        (node.lineno, node.end_lineno or node.lineno, w.rule))
+
+    def comment_at_or_above(self, line: int) -> str:
+        """Trailing comment on ``line``, else a STANDALONE comment on the
+        line above (the two sanctioned annotation placements)."""
+        c = self.comments.get(line)
+        if c:
+            return c
+        if line - 1 in self.standalone_comments:
+            return self.comments[line - 1]
+        return ""
+
+    def waived(self, rule: str, line: int) -> bool:
+        for w in self.waivers:
+            if w.rule == rule and (
+                    w.line == line
+                    or (w.line == line - 1
+                        and w.line in self.standalone_comments)):
+                return True
+        return any(lo <= line <= hi and r == rule
+                   for lo, hi, r in self._fn_waivers)
+
+    def check_waiver_reasons(self) -> list[Finding]:
+        """A waiver with no written justification is itself a finding —
+        the escape hatch must leave a review trail (no silent
+        suppression)."""
+        out = []
+        for w in self.waivers:
+            if not w.reason:
+                out.append(Finding(
+                    "waiver", self.rel, w.line,
+                    f"allow({w.rule}) carries no justification — write "
+                    f"'# graftcheck: allow({w.rule}) — <reason>'"))
+            if w.rule not in RULES:
+                out.append(Finding(
+                    "waiver", self.rel, w.line,
+                    f"allow({w.rule}) names an unknown rule "
+                    f"(known: {', '.join(r for r in RULES if r != 'waiver')})"))
+        return out
+
+
+def repo_root() -> str:
+    """The directory containing the ``tpuraft`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
+
+
+def iter_py_files(roots: list[str]) -> list[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def load_modules(roots: list[str]) -> tuple[list[Module], list[Finding]]:
+    mods, findings = [], []
+    base = repo_root()
+    for path in iter_py_files(roots):
+        rel = os.path.relpath(path, base)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            mods.append(Module(path, rel, src))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "waiver", rel, e.lineno or 0, f"unparsable: {e.msg}"))
+        except (OSError, UnicodeDecodeError, ValueError) as e:
+            # unreadable/non-UTF-8 source must surface as a finding, not
+            # crash the gate with a raw traceback
+            findings.append(Finding(
+                "waiver", rel, 0, f"unreadable: {e!r}"))
+    return mods, findings
+
+
+def run_checkers(mods: list[Module], record: bool = False,
+                 rules: set[str] | None = None) -> list[Finding]:
+    """Run every checker over the loaded modules.  ``record`` rewrites
+    the committed lockfiles (wire_schema.lock.json, lock_order.json)
+    from the live tree before verifying."""
+    from tpuraft.analysis import (blocking_calls, future_leaks, guarded_by,
+                                  lock_order, wire_schema)
+
+    findings: list[Finding] = []
+    for m in mods:
+        findings.extend(m.check_waiver_reasons())
+    findings.extend(guarded_by.check(mods))
+    findings.extend(lock_order.check(mods, record=record))
+    findings.extend(wire_schema.check(mods, record=record))
+    findings.extend(blocking_calls.check(mods))
+    findings.extend(future_leaks.check(mods))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    # drop waived findings last: waivers apply uniformly to every rule
+    # EXCEPT the waiver rule itself — 'allow(waiver)' must not be able
+    # to silence the reasonless-waiver finding, or the no-silent-
+    # suppression guarantee is one comment away from defeat
+    findings = [f for f in findings
+                if f.rule == "waiver" or not _waived(mods, f)]
+    order = {r: i for i, r in enumerate(RULES)}
+    findings.sort(key=lambda f: (f.path, f.line, order.get(f.rule, 99)))
+    return findings
+
+
+def _waived(mods: list[Module], f: Finding) -> bool:
+    for m in mods:
+        if m.rel == f.path:
+            return m.waived(f.rule, f.line)
+    return False
+
+
+# ---- small AST helpers shared by checkers -----------------------------------
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name for Name/Attribute chains ('self._lock', 'a.b.c');
+    '' when the expression is not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+@dataclass
+class ClassInfo:
+    module: Module
+    node: ast.ClassDef
+    methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = field(
+        default_factory=dict)
+
+
+def iter_classes(mod: Module):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            info = ClassInfo(mod, node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[item.name] = item
+            yield info

@@ -39,6 +39,8 @@ def commit_point(match: dict[PeerId, int], conf: Configuration,
     return min(new_q, order_stat(old_conf.peers))
 
 
+# graftcheck: loop-confined — commit_at/update_conf run on the node's
+# event loop; the engine-backed TpuBallotBox keeps the same contract
 class BallotBox:
     def __init__(self, on_committed: Callable[[int], None]):
         self._on_committed = on_committed  # FSMCaller#onCommitted

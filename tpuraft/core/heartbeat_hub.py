@@ -88,6 +88,8 @@ if TYPE_CHECKING:
 LOG = logging.getLogger(__name__)
 
 
+# graftcheck: loop-confined — one hub per NodeManager, driven by its
+# loop's clock task / engine tick; counters and lease maps are lockless
 class HeartbeatHub:
     def __init__(self) -> None:
         # (id(replicator)) -> replicator; grouped by endpoint per tick so

@@ -88,6 +88,7 @@ class _VoteCtx:
         return new_ok and old_ok
 
 
+# graftcheck: loop-confined
 class TimerControl:
     """Reference-parity control plane: per-group RepeatedTimers + scalar
     tallies (``NodeImpl``'s electionTimer / voteTimer / stepDownTimer and
@@ -231,11 +232,17 @@ class Node:
         self._ballot_box_factory = ballot_box_factory or BallotBox
         self.metrics = MetricRegistry(options.enable_metrics)
 
-        self.state = State.UNINITIALIZED
-        self.current_term = 0
-        self.leader_id: PeerId = EMPTY_PEER
-        self.voted_for: PeerId = EMPTY_PEER
-        self.conf_entry = ConfigurationEntry()
+        # Protocol state below is guarded-by the node lock in WRITE mode
+        # (graftcheck guarded-by): every rebind happens under
+        # ``async with self._lock`` (or in a helper annotated
+        # ``holds(_lock)``); single reads on the owning event loop are
+        # safe without it — the lock serializes multi-await critical
+        # sections, not loop-atomic reads.
+        self.state = State.UNINITIALIZED        # guarded-by: _lock (writes)
+        self.current_term = 0                   # guarded-by: _lock (writes)
+        self.leader_id: PeerId = EMPTY_PEER     # guarded-by: _lock (writes)
+        self.voted_for: PeerId = EMPTY_PEER     # guarded-by: _lock (writes)
+        self.conf_entry = ConfigurationEntry()  # guarded-by: _lock (writes)
 
         self.log_manager: LogManager = None  # type: ignore[assignment]
         self.fsm_caller: FSMCaller = None  # type: ignore[assignment]
@@ -253,16 +260,16 @@ class Node:
         self._note_append_start = None  # replica-plane hooks (init())
         self._note_attested = None
         self._snapshot_timer: Optional[RepeatedTimer] = None
-        self._last_leader_timestamp = time.monotonic()
+        self._last_leader_timestamp = time.monotonic()  # guarded-by: _lock (writes)
         # index of the first entry appended in THIS leadership term (the
         # election no-op); reads are unsafe until it commits
-        self._term_first_index: int = 0
-        self._conf_ctx: Optional["_ConfigurationCtx"] = None
+        self._term_first_index: int = 0         # guarded-by: _lock (writes)
+        self._conf_ctx: Optional["_ConfigurationCtx"] = None  # guarded-by: _lock (writes)
         # chaos-harness hook: called as listener(node, stage) on every
         # _ConfigurationCtx stage transition (catching_up/joint/stable/
         # aborted) — lets a nemesis land a seeded crash mid-stage
         self.conf_stage_listener: Optional[Callable[["Node", str], None]] = None
-        self._transfer_deadline: float = 0.0
+        self._transfer_deadline: float = 0.0    # guarded-by: _lock (writes)
         self._shutdown_event = asyncio.Event()
         self._wakeup_candidate: Optional[PeerId] = None
         # priority election [1.3+] (reference: NodeImpl targetPriority /
@@ -270,13 +277,14 @@ class Node:
         # current target skips election rounds; the target decays after
         # repeated skipped rounds so the group still converges when all
         # high-priority nodes are dead
-        self.target_priority: int = ElectionPriority.DISABLED
-        self._election_round: int = 0
+        self.target_priority: int = ElectionPriority.DISABLED  # guarded-by: _lock (writes)
+        self._election_round: int = 0           # guarded-by: _lock (writes)
 
     # ======================================================================
     # lifecycle
     # ======================================================================
 
+    # graftcheck: allow(guarded-by) — init-time: completes before any RPC handler or timer can race it
     async def init(self) -> bool:
         opts = self.options
         # meta
@@ -434,7 +442,10 @@ class Node:
         self.ballot_box.close()
         self._meta.shutdown()
         describer.unregister(self)
-        self.state = State.SHUTDOWN
+        # SHUTTING (set under the lock above) already refuses every other
+        # writer, and a shutdown must never queue behind a straggler
+        # holding the lock (a wedged holder would wedge join() with it)
+        self.state = State.SHUTDOWN  # graftcheck: allow(guarded-by) — terminal write; SHUTTING already excludes all other writers
         self._shutdown_event.set()
 
     async def join(self) -> None:
@@ -537,7 +548,7 @@ class Node:
                     and self.current_term == term:
                 self._commit_at_self(last_id.index)
 
-    def _commit_at_self(self, index: int) -> None:
+    def _commit_at_self(self, index: int) -> None:  # graftcheck: holds(_lock)
         self.ballot_box.commit_at(
             self.server_id, index, self.conf_entry.conf, self.conf_entry.old_conf)
 
@@ -680,7 +691,7 @@ class Node:
 
     # -- priority election [1.3+] ------------------------------------------
 
-    def _refresh_target_priority(self) -> None:
+    def _refresh_target_priority(self) -> None:  # graftcheck: holds(_lock)
         """Target = max priority among current voters (incl. self).
         Reference: NodeImpl#getMaxPriorityOfNodes on conf / leader change."""
         prios = [p.priority for p in
@@ -690,7 +701,7 @@ class Node:
         self.target_priority = max(prios) if prios else ElectionPriority.DISABLED
         self._election_round = 0
 
-    def _allow_launch_election(self) -> bool:
+    def _allow_launch_election(self) -> bool:  # graftcheck: holds(_lock)
         """Gate an election round by priority (reference:
         NodeImpl#allowLaunchElection).  Caller holds the lock."""
         prio = self.server_id.priority
@@ -776,7 +787,7 @@ class Node:
         t = asyncio.ensure_future(direct())
         t.add_done_callback(lambda tt: tt.cancelled() or tt.exception())
 
-    async def _pre_vote(self) -> None:
+    async def _pre_vote(self) -> None:  # graftcheck: holds(_lock)
         """Pre-vote: probe electability WITHOUT bumping term (symmetric-
         partition tolerance — reference: NodeImpl#preVote)."""
         if self.log_manager.last_snapshot_id().index > 0 and \
@@ -814,7 +825,7 @@ class Node:
                     pre_vote=True)
                 self._send_vote(p, req, on_resp)
 
-    async def _elect_self(self) -> None:
+    async def _elect_self(self) -> None:  # graftcheck: holds(_lock)
         """Real election: term+1, vote for self, solicit votes.
         Caller must hold the lock."""
         conf, old_conf = self.conf_entry.conf, self.conf_entry.old_conf
@@ -891,7 +902,7 @@ class Node:
         timeout (the stepDownTimer analog)."""
         await self._check_dead_nodes()
 
-    async def _become_leader(self) -> None:
+    async def _become_leader(self) -> None:  # graftcheck: holds(_lock)
         """Caller holds the lock; we are CANDIDATE with a vote quorum."""
         self.state = State.LEADER
         self.leader_id = self.server_id
@@ -949,6 +960,7 @@ class Node:
             if self.is_leader() and self.current_term == term:
                 self._commit_at_self(index)
 
+    # graftcheck: holds(_lock)
     async def _step_down(self, term: int, status: Status,
                          new_leader: PeerId = EMPTY_PEER) -> None:
         """Caller holds the lock (reference: NodeImpl#stepDown)."""
@@ -1205,7 +1217,7 @@ class Node:
                 term=self.current_term, success=True,
                 last_log_index=lm.last_log_index())
 
-    def _refresh_conf_from_log(self) -> None:
+    def _refresh_conf_from_log(self) -> None:  # graftcheck: holds(_lock)
         last = self.log_manager.conf_manager.last()
         if last.conf.is_empty():
             # no conf anywhere in log/snapshot: if ours came from a log
@@ -1226,7 +1238,7 @@ class Node:
         # conf entry with another leader's — adopt the replacement.
         self._apply_conf_entry(last)
 
-    def _apply_conf_entry(self, entry: ConfigurationEntry) -> None:
+    def _apply_conf_entry(self, entry: ConfigurationEntry) -> None:  # graftcheck: holds(_lock)
         self.conf_entry = entry
         self.ballot_box.update_conf(entry.conf, entry.old_conf)
         self._refresh_target_priority()
@@ -1423,6 +1435,8 @@ class Node:
         return f"Node<{self.group_id}/{self.server_id}>"
 
 
+# graftcheck: loop-confined — every method runs under the node lock on
+# the node's loop (see class docstring termination discipline)
 class _ConfigurationCtx:
     """Membership-change state machine: CATCHING_UP -> JOINT -> STABLE.
 

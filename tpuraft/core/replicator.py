@@ -51,6 +51,8 @@ def _consume(t: "asyncio.Task") -> None:
         t.exception()
 
 
+# graftcheck: loop-confined — no lock: every field below is touched only
+# on the owning node's event loop (wake/pump/response tasks)
 class Replicator:
     def __init__(self, node, peer: PeerId):
         self._node = node
@@ -552,6 +554,7 @@ class _DirectSender:
             self._task = None
 
 
+# graftcheck: loop-confined
 class ReplicatorGroup:
     """All replicators of one leader node (reference: ReplicatorGroupImpl)."""
 

@@ -47,6 +47,8 @@ def _consume(t: "asyncio.Task") -> None:
         t.exception()
 
 
+# graftcheck: loop-confined — the queue/lane state is only touched by
+# submit_* calls and drain tasks on the owning process's event loop
 class EndpointSender:
     """Batches every pending protocol send to one destination endpoint.
 
@@ -334,6 +336,7 @@ async def sequential_appends(rep, endpoint: str, reqs: list,
     await rep.on_batch_responses(acks)
 
 
+# graftcheck: loop-confined
 class SendPlane:
     """All endpoint senders of one process endpoint (lives on the
     NodeManager, like the HeartbeatHub)."""

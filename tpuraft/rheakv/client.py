@@ -81,6 +81,7 @@ class BatchingOptions:
     max_store_inflight: int = 4
 
 
+# graftcheck: loop-confined
 class _Batcher:
     """Coalesces items queued in one loop iteration into chunked flushes.
 
@@ -123,6 +124,7 @@ class _Batcher:
             for i in range(0, len(batch), self._max)])
 
 
+# graftcheck: loop-confined
 class _StoreSender:
     """One batched ``kv_command_batch`` sender per store endpoint — the
     serving-plane analog of the send plane's EndpointSender: a bounded
@@ -215,11 +217,26 @@ class _StoreSender:
             return
         client.batch_rpcs += 1
         client.batch_items += len(batch)
+        if len(resp.items) != len(batch):
+            # a short (or over-long) reply must FAIL the batch, not zip-
+            # truncate: unmatched futures would otherwise never resolve
+            # and their callers wedge forever (the send plane applies the
+            # same len(acks) != len(items) guard)
+            st = Status.error(
+                RaftError.EINTERNAL,
+                f"kv_command_batch reply carried {len(resp.items)} items "
+                f"for {len(batch)} requests")
+            for _r, _p, _b, fut in batch:
+                if not fut.done():
+                    fut.set_result(RheaKVError(st))
+            return
         for (region, peer, _b, fut), blob in zip(batch, resp.items):
             if not fut.done():
                 fut.set_result(client._decode_outcome(region, peer, blob))
 
 
+# graftcheck: loop-confined — route table, batchers and store senders
+# are all touched from the client's event loop only
 class RheaKVStore:
     def __init__(self, pd_client: PlacementDriverClient, transport,
                  timeout_ms: float = 5000, max_retries: int = 8,

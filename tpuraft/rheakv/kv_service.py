@@ -203,6 +203,8 @@ _WRITE_OPS = {
 }
 
 
+# graftcheck: loop-confined — handlers run on the store's RPC loop;
+# counters are lockless by that confinement
 class KVCommandProcessor:
     """Registered as methods ``kv_command`` (one op, one region) and
     ``kv_command_batch`` (store-grouped: many regions' ops in one RPC,

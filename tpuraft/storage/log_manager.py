@@ -33,6 +33,9 @@ class _FlushReq:
     future: asyncio.Future
 
 
+# graftcheck: loop-confined — single-writer discipline (see module
+# docstring): storage IO hops to executor threads, the manager's own
+# state never does
 class LogManager:
     def __init__(
         self,

@@ -328,22 +328,23 @@ class FileLogStorage(LogStorage):
 
     def __init__(self, dir_path: str, segment_max_bytes: int | None = None):
         self._dir = dir_path
-        self._segments: list[_Segment] = []
-        self._first = 1
+        self._segments: list[_Segment] = []     # guarded-by: _lock
+        self._first = 1                         # guarded-by: _lock
         self._seg_max = segment_max_bytes or self.SEGMENT_MAX_BYTES
-        self._conf_indexes: list[int] = []
+        self._conf_indexes: list[int] = []      # guarded-by: _lock
         # synced frontier (active_segment_first_index, size): the bytes
         # PROVEN on disk by a completed fsync.  The persisted watermark
         # (`synced` file) only ever records this value, so it can never
         # run ahead of durability (stale-HIGH), which would turn a
         # legitimate torn tail into a false CorruptLogError.
-        self._synced = (-1, 0)
+        self._synced = (-1, 0)                  # guarded-by: _lock
         # guards _segments and file handles: the event loop reads (get_entry)
         # while the LogManager flusher appends/truncates in executor threads
         self._lock = threading.RLock()
 
     # -- lifecycle ----------------------------------------------------------
 
+    # graftcheck: allow(guarded-by) — init-time: the LogManager flusher that shares these fields does not exist yet
     def init(self) -> None:
         os.makedirs(self._dir, exist_ok=True)
         self._load_meta()
@@ -489,7 +490,7 @@ class FileLogStorage(LogStorage):
     def _watermark_path(self) -> str:
         return os.path.join(self._dir, "synced")
 
-    def _load_watermark(self) -> tuple[int, int]:
+    def _load_watermark(self) -> tuple[int, int]:  # graftcheck: holds(_lock)
         # CRC-guarded (see load_crc_watermark): garbage degrades to
         # (-1, 0) = nothing provably durable, which is always safe
         vals = load_crc_watermark(self._watermark_path(), 16)
@@ -497,21 +498,21 @@ class FileLogStorage(LogStorage):
             return (-1, 0)
         return struct.unpack("<qq", vals)
 
-    def _save_watermark(self, sync: bool = False) -> None:
+    def _save_watermark(self, sync: bool = False) -> None:  # graftcheck: holds(_lock)
         save_crc_watermark(self._watermark_path(), self._dir,
                            struct.pack("<qq", *self._synced), sync)
 
     def _meta_path(self) -> str:
         return os.path.join(self._dir, "meta")
 
-    def _load_meta(self) -> None:
+    def _load_meta(self) -> None:  # graftcheck: holds(_lock)
         try:
             with open(self._meta_path(), "rb") as f:
                 self._first = struct.unpack("<q", f.read(8))[0]
         except FileNotFoundError:
             self._first = 1
 
-    def _save_meta(self) -> None:
+    def _save_meta(self) -> None:  # graftcheck: holds(_lock)
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "wb") as f:
             f.write(struct.pack("<q", self._first))
@@ -526,7 +527,7 @@ class FileLogStorage(LogStorage):
     def _conf_path(self) -> str:
         return os.path.join(self._dir, "conf.idx")
 
-    def _load_conf_indexes(self) -> None:
+    def _load_conf_indexes(self) -> None:  # graftcheck: holds(_lock)
         self._conf_indexes = []
         try:
             with open(self._conf_path(), "rb") as f:
@@ -541,7 +542,7 @@ class FileLogStorage(LogStorage):
             if first <= i <= last
         ]
 
-    def _rewrite_conf_indexes(self) -> None:
+    def _rewrite_conf_indexes(self) -> None:  # graftcheck: holds(_lock)
         tmp = self._conf_path() + ".tmp"
         with open(tmp, "wb") as f:
             f.write(b"".join(struct.pack("<q", i) for i in self._conf_indexes))
@@ -551,12 +552,14 @@ class FileLogStorage(LogStorage):
         _fsync_dir(self._dir)
 
     def configuration_indexes(self) -> list[int]:
-        return list(self._conf_indexes)
+        with self._lock:
+            return list(self._conf_indexes)
 
     # -- queries ------------------------------------------------------------
 
     def first_log_index(self) -> int:
-        return self._first
+        with self._lock:
+            return self._first
 
     def last_log_index(self) -> int:
         with self._lock:
@@ -564,7 +567,7 @@ class FileLogStorage(LogStorage):
                 return self._first - 1
             return self._segments[-1].last_index
 
-    def _find_segment(self, index: int) -> Optional[_Segment]:
+    def _find_segment(self, index: int) -> Optional[_Segment]:  # graftcheck: holds(_lock)
         lo, hi = 0, len(self._segments) - 1
         while lo <= hi:
             mid = (lo + hi) // 2

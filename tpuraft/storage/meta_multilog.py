@@ -88,10 +88,11 @@ class MetaJournal:
         # _sync_lock -> _lock, never the reverse; stage() takes only
         # _lock so staging never stalls behind a flush.
         self._sync_lock = threading.Lock()
-        self._values: dict[bytes, tuple[int, bytes]] = {}
-        self._f = None
-        self._size = 0
-        self._synced = 0  # bytes proven durable by a completed fsync
+        self._values: dict[bytes, tuple[int, bytes]] = {}  # guarded-by: _lock
+        self._f = None      # guarded-by: _lock
+        self._size = 0      # guarded-by: _lock
+        # bytes proven durable by a completed fsync
+        self._synced = 0    # guarded-by: _lock
         self._refs = 0
         self.sync_count = 0
         self.save_count = 0
@@ -114,10 +115,11 @@ class MetaJournal:
         vals = load_crc_watermark(self._wm_path(), 8)
         return struct.unpack("<q", vals)[0] if vals is not None else 0
 
-    def _save_wm(self, sync: bool) -> None:
+    def _save_wm(self, sync: bool) -> None:  # graftcheck: holds(_lock)
         save_crc_watermark(self._wm_path(), self.dir,
                            struct.pack("<q", self._synced), sync)
 
+    # graftcheck: allow(guarded-by) — construction-time: runs inside __init__, before the journal is shared
     def _open(self) -> None:
         wm = self._load_wm()
         exists = os.path.exists(self._path())
@@ -267,7 +269,7 @@ class MetaJournal:
 # -- process-level registry (one journal per directory), like multilog -------
 
 _journals_lock = threading.Lock()
-_journals: dict[str, MetaJournal] = {}
+_journals: dict[str, MetaJournal] = {}  # guarded-by: _journals_lock
 
 
 def get_journal(dir_path: str) -> MetaJournal:
@@ -315,9 +317,9 @@ class MultiRaftMetaStorage(RaftMetaStorage):
         self._jnl = get_journal(self._dir)
         self.term, self.voted_for = self._jnl.get(self._group)
 
-    def _save(self) -> None:
+    def _save(self, term: int, voted_for: PeerId) -> None:
         assert self._jnl is not None, "init() first"
-        self._jnl.stage(self._group, self.term, self.voted_for)
+        self._jnl.stage(self._group, term, voted_for)
         self._jnl.sync()
 
     async def save_async(self, term: int, voted_for: PeerId) -> None:

@@ -27,7 +27,7 @@ _FMT = struct.Struct("<qI")  # term, crc of (term||votedFor str)
 # the crash-consistency harness legitimately rolls the directory back
 # to a durable-only image, which an in-memory registry would fight).
 _paths_guard = threading.Lock()
-_path_locks: dict[str, threading.Lock] = {}
+_path_locks: dict[str, threading.Lock] = {}  # guarded-by: _paths_guard
 
 
 def _path_lock(path: str) -> threading.Lock:
@@ -67,7 +67,10 @@ class RaftMetaStorage:
     def set_term_and_voted_for(self, term: int, voted_for: PeerId) -> None:
         self.term = term
         self.voted_for = voted_for
-        self._save()
+        # pass the values explicitly: _save may run on an executor thread
+        # while the event loop rebinds the mirror fields for a NEWER save
+        # — re-reading self.term there could persist a torn pair
+        self._save(term, voted_for)
 
     def set_term(self, term: int) -> None:
         self.set_term_and_voted_for(term, self.voted_for)
@@ -90,9 +93,8 @@ class RaftMetaStorage:
         except (OSError, struct.error, UnicodeDecodeError):
             return -1, ""
 
-    def _save(self) -> None:
-        term = self.term
-        voted_s = "" if self.voted_for.is_empty() else str(self.voted_for)
+    def _save(self, term: int, voted_for: PeerId) -> None:
+        voted_s = "" if voted_for.is_empty() else str(voted_for)
         voted = voted_s.encode()
         path = self._path()
         with _path_lock(os.path.abspath(path)):
@@ -136,5 +138,5 @@ class MemoryRaftMetaStorage(RaftMetaStorage):
     def init(self) -> None:
         pass
 
-    def _save(self) -> None:
+    def _save(self, term: int, voted_for: PeerId) -> None:
         pass
