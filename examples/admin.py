@@ -14,7 +14,11 @@ Commands:
     transfer <peer>           transfer leadership to <peer>
     add-peer <peer>           add a voter
     remove-peer <peer>        remove a voter
-    change-peers <p1,p2,...>  arbitrary membership change
+    add-witness <peer>        add a WITNESS voter (votes, stores no
+                              log payload, never leads — geo 2+1)
+    remove-witness <peer>     remove a witness voter
+    change-peers <p1,p2,...>  arbitrary membership change (tokens may
+                              carry /witness or /learner suffixes)
     add-learners <p1,...>     add read-only replicas
     remove-learners <p1,...>  remove read-only replicas
     reset-learners <p1,...>   replace the learner set atomically
@@ -65,10 +69,13 @@ async def run(args) -> int:
             print(leader)
         elif cmd == "peers":
             full = await cli.get_configuration(args.group, conf)
-            print("voters:", ",".join(str(p) for p in full.peers))
+            print("voters:", ",".join(
+                f"{p}/witness" if full.is_witness(p) else str(p)
+                for p in full.peers))
             if full.learners:
                 print("learners:", ",".join(str(p) for p in full.learners))
-        elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer"):
+        elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer",
+                     "add-witness", "remove-witness"):
             if len(args.command) < 2:
                 print(f"{cmd} needs a peer argument", file=sys.stderr)
                 return 2
@@ -79,6 +86,10 @@ async def run(args) -> int:
                 st = await cli.transfer_leader(args.group, conf, peer)
             elif cmd == "add-peer":
                 st = await cli.add_peer(args.group, conf, peer)
+            elif cmd == "add-witness":
+                st = await cli.add_witness(args.group, conf, peer)
+            elif cmd == "remove-witness":
+                st = await cli.remove_witness(args.group, conf, peer)
             else:
                 st = await cli.remove_peer(args.group, conf, peer)
             rc = _report(st)
@@ -130,6 +141,7 @@ def main() -> None:
     ap.add_argument("command", nargs="+",
                     help="leader | peers | snapshot <peer> | transfer <peer>"
                          " | add-peer <peer> | remove-peer <peer>"
+                         " | add-witness <peer> | remove-witness <peer>"
                          " | change-peers <p1,p2,...>"
                          " | add-learners <p1,...> | remove-learners <p1,...>"
                          " | reset-learners <p1,...>")

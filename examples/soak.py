@@ -33,6 +33,18 @@ store-kill-while-quiescent nemesis action: a store leading hibernating
 groups is killed, and its dependents must wake on store-lease expiry
 and elect within the normal fault-detection envelope — with the
 history still linearizable.
+
+``--geo N`` shapes the fabric through a seeded NetworkTopology
+(tpuraft/rpc/topology.py): stores tag round-robin into N zones,
+inter-zone links get ASYMMETRIC WAN latency + jitter + loss, and the
+nemesis menu gains zone-partition (one-way half the time),
+wan-degrade (latency x6, +1% loss) and link-flap actions — which heal
+via heal_topology() and so compose with (never stomp) the noise
+actions' heal().  ``--witness`` additionally makes the last store a
+WITNESS member of every region: it votes and acks payload-stripped
+appends, never campaigns, never serves reads; after EVERY fault (and
+at the end) the soak asserts witness safety — no witness ever led,
+opened a ballot window, or journaled a payload byte.
 """
 
 from __future__ import annotations
@@ -51,6 +63,7 @@ from tpuraft.rheakv.client import RheaKVStore
 from tpuraft.rheakv.metadata import Region
 from tpuraft.rheakv.pd_client import FakePlacementDriverClient
 from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+from tpuraft.rpc.topology import build_geo_topology
 from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
 from tpuraft.util.linearizability import History, check_history
 from tpuraft.util.nemesis import (
@@ -104,19 +117,40 @@ class SoakCluster(_BaseSoakCluster):
     n_regions > 1 splits the keyspace into that many raft groups per
     store (region k owns [k%06d, (k+1)%06d)); engine=True gives every
     store a MultiRaftEngine protocol plane + multilog shared journal —
-    the configuration the G>=1K chaos soak (VERDICT r3 #6) runs."""
+    the configuration the G>=1K chaos soak (VERDICT r3 #6) runs.
+
+    geo_zones > 0 tags stores round-robin into that many zones and
+    shapes every link through a seeded NetworkTopology (intra-zone
+    near-zero, inter-zone WAN latency+jitter+loss) — the CD-Raft
+    regime.  witness=True makes the LAST store a witness member of
+    every region (2 data + 1 witness at 3 stores)."""
 
     def __init__(self, n_stores: int, data_path: str, n_regions: int = 1,
                  engine: bool = False, election_timeout_ms: int = 400,
-                 quiesce_after_rounds: int = 0):
+                 quiesce_after_rounds: int = 0, geo_zones: int = 0,
+                 witness: bool = False, geo_seed: int = 0):
         super().__init__(data_path)
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
         self.election_timeout_ms = election_timeout_ms
         self.engine = engine
         self.quiesce_after_rounds = quiesce_after_rounds
+        self.geo_zones = geo_zones
+        self.witness = witness
+        self.topology = None
+        if geo_zones > 0:
+            self.topology = build_geo_topology(
+                self.endpoints, geo_zones, seed=geo_seed)
+            self.net.set_topology(self.topology)
+            from tpuraft.util import describer
+
+            describer.register(self.topology)
+        peers = list(self.endpoints)
+        if witness:
+            # last store = witness voter of every region (metadata-only)
+            peers = peers[:-1] + [peers[-1] + "/witness"]
         if n_regions <= 1:
-            self.regions = [Region(id=1, peers=list(self.endpoints))]
+            self.regions = [Region(id=1, peers=peers)]
         else:
             def bkey(k):
                 return b"k%06d" % k
@@ -124,8 +158,13 @@ class SoakCluster(_BaseSoakCluster):
             self.regions = [
                 Region(id=k + 1, start_key=bkey(k) if k else b"",
                        end_key=bkey(k + 1) if k + 1 < n_regions else b"",
-                       peers=list(self.endpoints))
+                       peers=list(peers))
                 for k in range(n_regions)]
+
+    def zone_of(self, ep: str) -> str:
+        if self.topology is None:
+            return ""
+        return self.topology.zone_of(ep)
 
     async def start_store(self, ep: str) -> None:
         server = RpcServer(ep)
@@ -135,6 +174,8 @@ class SoakCluster(_BaseSoakCluster):
         extra = {}
         if self.quiesce_after_rounds:
             extra["quiesce_after_rounds"] = self.quiesce_after_rounds
+        if self.geo_zones:
+            extra["zone"] = self.zone_of(ep)
         raft_engine = None
         if self.engine:
             from tpuraft.core.engine import MultiRaftEngine
@@ -174,6 +215,9 @@ class SoakCluster(_BaseSoakCluster):
         self.net.set_delay_ms(delay_ms)
         self.net.set_duplicate_rate(dup)
         self.net.set_reorder(reorder, reorder_ms)
+
+    def heal_topology(self) -> None:
+        self.net.heal_topology()
 
 
 class NativeSoakCluster(_BaseSoakCluster):
@@ -550,8 +594,31 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    power_loss: bool = False,
                    churn: bool = False,
                    quiesce: bool = False,
-                   kv_batching: bool = False) -> dict:
+                   kv_batching: bool = False,
+                   geo: int = 0,
+                   witness: bool = False) -> dict:
     rng = random.Random(seed)
+    if geo and transport != "inproc":
+        raise ValueError(
+            "--geo shapes the in-proc fabric's NetworkTopology; the "
+            "native fabric takes per-store FaultInjectingTransport "
+            "topologies (wire them explicitly)")
+    if geo == 1:
+        raise ValueError(
+            "--geo needs at least 2 zones (zone partitions and "
+            "link flaps are inter-zone faults)")
+    if witness and not geo:
+        raise ValueError("--witness rides the geo scenario (--geo N)")
+    if witness and churn:
+        raise ValueError(
+            "--witness fixes the last store as a witness member; "
+            "--churn's random add/remove would fight that placement — "
+            "run them separately")
+    if witness and engine:
+        raise ValueError(
+            "--witness needs timer-mode stores: the engine's device "
+            "ballot plane is not witness-aware yet (StoreEngine would "
+            "refuse at boot)")
     if quiesce and (transport != "inproc" or not engine):
         raise ValueError(
             "--quiesce hibernates engine-driven groups (TimerControl "
@@ -577,7 +644,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         c = SoakCluster(n_stores, data_path, n_regions=n_regions,
                         engine=engine,
                         election_timeout_ms=election_timeout_ms,
-                        quiesce_after_rounds=4 if quiesce else 0)
+                        quiesce_after_rounds=4 if quiesce else 0,
+                        geo_zones=geo, witness=witness, geo_seed=seed)
     chaos = {}
     try:
         if power_loss:
@@ -595,7 +663,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
-            kv_batching)
+            kv_batching, geo, witness)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -607,7 +675,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
                           chaos, churn=False, quiesce=False,
-                          kv_batching=False) -> dict:
+                          kv_batching=False, geo=0, witness=False) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -833,6 +901,64 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             await churn_driver.check_invariants()
         return _check
 
+    # -- geo fault surface (--geo): topology-shaped events that compose
+    # with (and heal independently of) the nemesis noise above ----------------
+    topo = getattr(c, "topology", None)
+
+    async def zone_partition():
+        """Cut one whole zone off (one-way half the time — the classic
+        asymmetric WAN failure)."""
+        zone = rng.choice(topo.zones())
+        one_way = rng.random() < 0.5
+        say(f"  nemesis: zone-partition {zone} "
+            f"({'one-way' if one_way else 'both ways'})")
+        topo.partition_zone(zone, one_way=one_way)
+
+    async def wan_degrade():
+        """Brown out every inter-zone link: latency x6, +1% loss."""
+        topo.degrade_wan(latency_x=6.0, extra_loss=0.01, bandwidth_x=1.0)
+
+    async def link_flap():
+        zones = topo.zones() if topo is not None else []
+        if len(zones) < 2:
+            raise SkipFault
+        za, zb = rng.sample(zones, 2)
+        topo.flap(za, zb, period_s=0.4, duty=0.6)
+
+    async def heal_topology():
+        c.heal_topology()
+
+    def witness_nodes():
+        if not witness:
+            return []
+        wep = c.endpoints[-1]
+        store = c.stores.get(wep)
+        if store is None:
+            return []
+        return [eng.node for eng in
+                (store.get_region_engine(r.id) for r in c.regions)
+                if eng is not None and eng.node is not None]
+
+    async def witness_safety_check():
+        """After every fault heals: a witness must never have led or
+        advanced a ballot of its own — the witness-majority-must-not-
+        commit invariant, asserted live through the whole drive."""
+        for node in witness_nodes():
+            assert not node.is_leader(), \
+                f"witness {node} became leader under chaos"
+            assert node.ballot_box.pending_index == 0, \
+                f"witness {node} opened a leader ballot window"
+
+    def with_witness_check(existing):
+        if not witness:
+            return existing
+
+        async def _check():
+            if existing is not None:
+                await existing()
+            await witness_safety_check()
+        return _check
+
     if churn:
         churn_driver = MembershipChurn(c, sampled_regions[0], rng, say)
 
@@ -864,6 +990,22 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           quiescent_store_restart,
                           dwell_s=max(2.5, 3.0 * eto_s), weight=1.5,
                           check=with_conf_check(None)))
+    if topo is not None:
+        eto_s = getattr(c, "election_timeout_ms", 400) / 1000.0
+        actions += [
+            # dwell past fail-over so elections actually run ACROSS the
+            # shaped WAN while a zone is dark
+            NemesisAction("zone-partition", zone_partition, heal_topology,
+                          dwell_s=max(1.2, 3.0 * eto_s), weight=1.5),
+            NemesisAction("wan-degrade", wan_degrade, heal_topology,
+                          dwell_s=1.0, weight=1.0),
+            NemesisAction("link-flap", link_flap, heal_topology,
+                          dwell_s=0.8, weight=1.0),
+        ]
+    if witness:
+        # EVERY fault's post-heal probe also asserts witness safety
+        for a in actions:
+            a.check = with_witness_check(a.check)
 
     workers = [asyncio.ensure_future(worker(i)) for i in range(5)]
     try:
@@ -910,6 +1052,21 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         if quiesce:
             result["store_kills_while_quiescent"] = len(quiesce_kill_counts)
             result["quiescent_groups_at_kill"] = quiesce_kill_counts
+        if topo is not None:
+            result["geo_zones"] = geo
+            result["topology"] = dict(topo.counters)
+        if witness:
+            await witness_safety_check()   # final sweep, aborts on breach
+            result["witness_safe"] = True
+            stripped = 0
+            for node in witness_nodes():
+                for i in range(node.log_manager.first_log_index(),
+                               node.log_manager.last_log_index() + 1):
+                    e = node.log_manager.get_entry(i)
+                    assert e is None or e.data == b"" or e.type.value == 2, \
+                        f"witness journaled a payload at index {i}"
+                    stripped += 1
+            result["witness_journal_entries_checked"] = stripped
         if not rep.ok:
             result["violation"] = str(rep)
         if dump_history and not rep.ok:
@@ -996,6 +1153,18 @@ def main() -> None:
                          "killed, and its dependents must elect via "
                          "store-lease expiry within the normal "
                          "fault-detection envelope")
+    ap.add_argument("--geo", type=int, default=0, metavar="ZONES",
+                    help="geo scenario: tag stores round-robin into this "
+                         "many zones and shape every link through a "
+                         "seeded NetworkTopology (asymmetric WAN latency "
+                         "+ jitter + loss); adds zone-partition, "
+                         "wan-degrade and link-flap to the nemesis menu")
+    ap.add_argument("--witness", action="store_true",
+                    help="(with --geo) the last store joins every region "
+                         "as a WITNESS: votes + metadata-only journal, "
+                         "never leads; witness safety (never leader, "
+                         "never a ballot window, no payload journaled) "
+                         "is asserted after every fault")
     ap.add_argument("--kv-batching", action="store_true",
                     help="drive load through the batching client: ops "
                          "coalesce into store-grouped kv_command_batch "
@@ -1015,7 +1184,9 @@ def main() -> None:
                                   power_loss=args.power_loss,
                                   churn=args.churn,
                                   quiesce=args.quiesce,
-                                  kv_batching=args.kv_batching))
+                                  kv_batching=args.kv_batching,
+                                  geo=args.geo,
+                                  witness=args.witness))
     import json
 
     print(json.dumps(result))

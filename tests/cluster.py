@@ -78,11 +78,15 @@ class TestCluster:
                  snapshot_interval_secs: int = 0,
                  coalesce_heartbeats: bool = False,
                  log_scheme: str = "file",
-                 meta_scheme: str = "file"):
+                 meta_scheme: str = "file",
+                 witness_idx: tuple = ()):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
-        self.conf = Configuration(list(self.peers))
+        # witness_idx: indices of peers that are WITNESS voters (vote +
+        # ack metadata appends, store no payload, never campaign)
+        witnesses = [self.peers[i] for i in witness_idx]
+        self.conf = Configuration(list(self.peers), witnesses=witnesses)
         self.tmp_path = tmp_path
         self.election_timeout_ms = election_timeout_ms
         self.snapshot = snapshot
@@ -132,6 +136,7 @@ class TestCluster:
         # 0 = only on-demand snapshots (the default for tests)
         opts.snapshot.interval_secs = self.snapshot_interval_secs
         opts.raft_options.coalesce_heartbeats = self.coalesce_heartbeats
+        opts.witness = self.conf.is_witness(peer)
         return opts
 
     async def start_all(self) -> None:

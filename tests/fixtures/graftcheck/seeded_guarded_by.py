@@ -91,3 +91,11 @@ class Confined:
 
     def bad_sleep(self):
         time.sleep(0.1)                 # VIOLATION: loop-confined
+
+
+# graftcheck: loop-confined — the marker sits on the FIRST line of a
+# multi-line annotation comment (the common in-tree shape); the checker
+# must scan the whole contiguous block, not just the line above
+class ConfinedMultiLineAnnotation:
+    def bad_sleep_multiline(self):
+        time.sleep(0.1)                 # VIOLATION: loop-confined

@@ -68,8 +68,12 @@ def oracle_commit_index(
 # invariant check (examples/soak.py, which can't import tests/) shares
 # ONE implementation with this oracle — re-exported here for the tests
 from tpuraft.util.quorum import (  # noqa: F401  (re-export)
+    every_majority_has_data_peer,
     joint_quorums_intersect,
+    majorities,
     majorities_intersect,
+    witness_minority,
+    witness_only_majorities,
 )
 
 

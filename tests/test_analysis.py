@@ -90,6 +90,13 @@ class TestGuardedBy:
         assert _lines_with(found, "loop-confined", "bad_thread_primitive")
         assert _lines_with(found, "loop-confined", "bad_sleep")
 
+    def test_loop_confined_multiline_annotation_registers(self, found):
+        # regression: the marker on the FIRST line of a wrapped
+        # multi-line comment above the class used to be invisible
+        # (single-line lookback) — every such annotation in the tree
+        # was dead
+        assert _lines_with(found, "loop-confined", "bad_sleep_multiline")
+
     def test_loop_confined_covers_init(self, found):
         # review finding: a confined class's __init__ is not exempt
         assert _lines_with(found, "loop-confined", "__init__")
@@ -98,13 +105,13 @@ class TestGuardedBy:
         # exactly the seeded violations, nothing else.  6 guarded-by:
         # bad_unlocked_read, bad_unlocked_write, bad_closure_in_with,
         # bad_call_without_lock (call-site rule), bad_module_closure,
-        # bad_touch_a.  3 loop-confined: Confined.__init__ sleep,
-        # bad_thread_primitive, bad_sleep.
+        # bad_touch_a.  4 loop-confined: Confined.__init__ sleep,
+        # bad_thread_primitive, bad_sleep, bad_sleep_multiline.
         by_rule = {}
         for f in found:
             by_rule.setdefault(f.rule, []).append(f)
         assert len(by_rule.get("guarded-by", [])) == 6, found
-        assert len(by_rule.get("loop-confined", [])) == 3, found
+        assert len(by_rule.get("loop-confined", [])) == 4, found
 
 
 class TestLockOrder:

@@ -279,3 +279,186 @@ async def test_inproc_network_duplication_and_reordering():
     assert seen != [0, 1, 2, 3], \
         "in-proc reorder_rate=1.0 delivered strictly in order"
     net.set_reorder(0.0)
+
+
+# ---------------------------------------------------------------------------
+# NetworkTopology: per-link geo shaping + heal()/heal_topology() split
+# ---------------------------------------------------------------------------
+
+
+def _geo_topology(seed=0, clock=None):
+    from tpuraft.rpc.topology import LinkProfile, NetworkTopology
+
+    kw = {"seed": seed}
+    if clock is not None:
+        kw["clock"] = clock
+    topo = NetworkTopology(**kw)
+    topo.set_zone("a:1", "z0")
+    topo.set_zone("b:1", "z1")
+    topo.set_zone("c:1", "z1")
+    topo.set_link("z0", "z1", LinkProfile(latency_ms=20.0), symmetric=False)
+    topo.set_link("z1", "z0", LinkProfile(latency_ms=5.0), symmetric=False)
+    return topo
+
+
+def test_topology_asymmetric_latency_and_zone_lookup():
+    topo = _geo_topology()
+    assert topo.zone_of("a:1") == "z0" and topo.zone_of("c:1") == "z1"
+    d_fwd, drop_fwd = topo.plan("a:1", "b:1")
+    d_rev, drop_rev = topo.plan("b:1", "a:1")
+    assert not drop_fwd and not drop_rev
+    assert abs(d_fwd - 0.020) < 1e-9, "z0->z1 must take the 20ms row"
+    assert abs(d_rev - 0.005) < 1e-9, "z1->z0 must take the ASYMMETRIC 5ms row"
+    # intra-zone rides the (zero) default link
+    d_local, _ = topo.plan("b:1", "c:1")
+    assert d_local == 0.0
+
+
+def test_topology_one_way_zone_partition_and_degrade():
+    topo = _geo_topology()
+    topo.partition_zone("z0", one_way=True)
+    _, dropped = topo.plan("a:1", "b:1")
+    assert dropped, "z0 outbound must drop under one-way partition"
+    _, dropped_in = topo.plan("b:1", "a:1")
+    assert not dropped_in, "one-way partition must let inbound flow"
+    topo.heal_events()
+    _, dropped = topo.plan("a:1", "b:1")
+    assert not dropped
+    # degrade-WAN multiplies inter-zone latency, base shape untouched
+    topo.degrade_wan(latency_x=10.0, extra_loss=0.0)
+    d, _ = topo.plan("a:1", "b:1")
+    assert abs(d - 0.200) < 1e-9
+    topo.heal_events()
+    d, _ = topo.plan("a:1", "b:1")
+    assert abs(d - 0.020) < 1e-9
+
+
+def test_topology_bandwidth_bucket_queues_bursts():
+    now = [0.0]
+    topo = _geo_topology(clock=lambda: now[0])
+    from tpuraft.rpc.topology import LinkProfile
+
+    # 8 kbps = 1000 bytes/s: a 500-byte frame serializes in 0.5s
+    topo.set_link("z0", "z1", LinkProfile(bandwidth_kbps=8.0))
+    d1, _ = topo.plan("a:1", "b:1", nbytes=500)
+    d2, _ = topo.plan("a:1", "b:1", nbytes=500)
+    assert abs(d1 - 0.5) < 1e-9
+    assert abs(d2 - 1.0) < 1e-9, "second frame queues behind the first"
+    now[0] += 2.0  # bucket drains with wall time
+    d3, _ = topo.plan("a:1", "b:1", nbytes=500)
+    assert abs(d3 - 0.5) < 1e-9
+    assert topo.counters["shaped_bytes"] == 1500
+
+
+def test_topology_flap_square_wave():
+    now = [0.0]
+    from tpuraft.rpc.topology import NetworkTopology
+
+    topo = NetworkTopology(seed=3, clock=lambda: now[0])
+    topo.set_zone("a:1", "z0")
+    topo.set_zone("b:1", "z1")
+    topo.flap("z0", "z1", period_s=1.0, duty=0.5)
+    # scan a full period: must see BOTH up and down phases
+    outcomes = set()
+    for i in range(10):
+        now[0] = i * 0.1
+        _, dropped = topo.plan("a:1", "b:1")
+        outcomes.add(dropped)
+    assert outcomes == {True, False}, "flap must alternate up/down"
+    topo.heal_events()
+    now[0] = 0.35
+    for i in range(10):
+        now[0] += 0.1
+        assert topo.plan("a:1", "b:1")[1] is False
+
+
+async def test_fault_transport_heal_does_not_stomp_topology():
+    """The satellite contract: nemesis-layer heal() and topology
+    shaping compose.  heal() clears blocks but leaves topology events;
+    heal_topology() clears topology events but leaves blocks."""
+    inner = _EchoTransport()
+    inner.endpoint = "a:1"
+    t = FaultInjectingTransport(inner, seed=2)
+    topo = _geo_topology()
+    t.set_topology(topo)
+    topo.partition_zone("z0", one_way=True)
+    t.block("c:1")
+
+    async def dropped(dst):
+        try:
+            await t.call(dst, "m", 0, timeout_ms=5)
+            return False
+        except RpcError:
+            return True
+
+    assert await dropped("b:1")          # topology partition
+    assert await dropped("c:1")          # nemesis block (c is z1: also
+    #                                      partitioned — check after heal)
+    t.heal()                             # nemesis heal...
+    assert await dropped("b:1"), "heal() must NOT clear the zone partition"
+    t.heal_topology()                    # ...then topology heal
+    assert not await dropped("b:1")
+    # now only the nemesis block could remain — heal() already cleared
+    # it; re-block and verify heal_topology leaves it alone
+    t.block("c:1")
+    topo.partition_zone("z0", one_way=True)
+    t.heal_topology()
+    assert await dropped("c:1"), "heal_topology() must NOT clear blocks"
+    t.unblock("c:1")
+    assert not await dropped("c:1")
+
+
+async def test_inproc_network_topology_and_heal_split():
+    """Same composition contract on the in-proc fabric the soak uses."""
+    from tpuraft.rpc.transport import InProcNetwork, RpcServer
+
+    net = InProcNetwork()
+    server = RpcServer("b:1")
+    server.register("echo", _async_identity)
+    net.bind(server)
+    topo = _geo_topology()
+    net.set_topology(topo)
+    t0 = asyncio.get_running_loop().time()
+    assert await net.call("a:1", "b:1", "echo", 7, timeout_ms=500) == 7
+    assert asyncio.get_running_loop().time() - t0 >= 0.018, \
+        "inter-zone call must pay the 20ms base latency"
+    topo.partition_zone("z0", one_way=True)
+    net.partition_one_way({"x:1"}, {"b:1"})
+    try:
+        await net.call("a:1", "b:1", "echo", 8, timeout_ms=20)
+        raise AssertionError("partitioned zone answered")
+    except RpcError:
+        pass
+    net.heal()      # nemesis heal keeps the zone partition
+    try:
+        await net.call("a:1", "b:1", "echo", 9, timeout_ms=20)
+        raise AssertionError("heal() cleared the topology partition")
+    except RpcError:
+        pass
+    net.heal_topology()
+    assert await net.call("a:1", "b:1", "echo", 10, timeout_ms=500) == 10
+
+
+async def _async_identity(req):
+    return req
+
+
+def test_topology_seeded_determinism_and_describe():
+    from tpuraft.rpc.topology import LinkProfile, NetworkTopology
+
+    def run(seed):
+        topo = NetworkTopology(seed=seed)
+        topo.set_zone("a:1", "z0")
+        topo.set_zone("b:1", "z1")
+        topo.set_link("z0", "z1",
+                      LinkProfile(latency_ms=1.0, jitter_ms=5.0, loss=0.3))
+        return [topo.plan("a:1", "b:1") for _ in range(50)], topo
+
+    outs1, topo = run(11)
+    outs2, _ = run(11)
+    outs3, _ = run(12)
+    assert outs1 == outs2, "same seed must replay byte-identically"
+    assert outs1 != outs3
+    assert any(d for _, d in outs1) and any(not d for _, d in outs1)
+    text = topo.describe()
+    assert "zone z0" in text and "counters" in text and "loss=0.3" in text

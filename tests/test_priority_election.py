@@ -1,0 +1,138 @@
+"""Priority election under geo failure: decay convergence when the
+high-priority zone dies, and priority RE-election (leadership handed
+back) after it heals.
+
+Reference anchors: NodeImpl#allowLaunchElection / targetPriority decay
+(PAPER.md §1 priority election as the SOFAJRaft locality lever);
+the transfer-back is this repo's geo extension
+(RaftOptions.priority_transfer_rounds) — a leader elected via decay
+returns leadership to the preferred zone once it is healthy again.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tests.cluster import TestCluster
+from tpuraft.conf import Configuration
+from tpuraft.entity import PeerId
+
+
+def _priority_cluster(prios, witness_idx=(), **kw):
+    c = TestCluster(len(prios), tmp_path=None, **kw)
+    c.peers = [PeerId("127.0.0.1", 5000 + i, 0, pr)
+               for i, pr in enumerate(prios)]
+    witnesses = [c.peers[i] for i in witness_idx]
+    c.conf = Configuration(list(c.peers), witnesses=witnesses)
+    return c
+
+
+async def _wait_leader_priority(c, want_priority, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    leader = None
+    while time.monotonic() < deadline:
+        try:
+            leader = await c.wait_leader(timeout_s=2.0)
+        except TimeoutError:
+            continue
+        if leader.server_id.priority == want_priority:
+            return leader
+        await asyncio.sleep(0.1)
+    raise AssertionError(
+        f"no leader with priority {want_priority} in {timeout_s}s "
+        f"(last leader: {leader and leader.server_id})")
+
+
+@pytest.mark.asyncio
+async def test_low_priority_wins_after_high_priority_node_dies():
+    """The decay path end-to-end: the high-priority LEADER dies mid-run
+    (not merely never started), survivors' target stays at the dead
+    node's priority until the decay gap lets the 40-node through."""
+    c = _priority_cluster([80, 40, 20], election_timeout_ms=150)
+    await c.start_all()
+    try:
+        leader = await _wait_leader_priority(c, 80)
+        await c.apply_ok(leader, b"pre-kill")
+        await c.stop(leader.server_id)
+        # survivors: target 80 decays (gap = max(10, 80//5) = 16:
+        # 80 -> 64 -> 48 -> 32 lets the 40-node campaign)
+        new_leader = await _wait_leader_priority(c, 40)
+        # commits still flow under the decayed leadership
+        st = await c.apply_ok(new_leader, b"post-decay")
+        assert st.is_ok()
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_leadership_transfers_back_after_high_priority_heals():
+    """Priority RE-election: once the priority-80 node restarts,
+    catches up, and acks for priority_transfer_rounds stepdown rounds,
+    the decayed (40) leader hands leadership back — leadership returns
+    to the preferred zone instead of sticking where the decay left it."""
+    c = _priority_cluster([80, 40, 20], election_timeout_ms=150)
+    await c.start_all()
+    try:
+        leader = await _wait_leader_priority(c, 80)
+        high = leader.server_id
+        await c.apply_ok(leader, b"v1")
+        await c.stop(high)
+        low_leader = await _wait_leader_priority(c, 40)
+        st = await c.apply_ok(low_leader, b"v2")
+        assert st.is_ok()
+        # the high-priority zone heals
+        await c.start(high)
+        healed = await _wait_leader_priority(c, 80, timeout_s=20.0)
+        assert healed.server_id == high
+        assert low_leader.metrics.counters.get("priority-transfers", 0) >= 1
+        st = await c.apply_ok(healed, b"v3")
+        assert st.is_ok()
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_priority_transfer_disabled_keeps_decayed_leader():
+    c = _priority_cluster([80, 40, 20], election_timeout_ms=150)
+    await c.start_all()
+    try:
+        for n in c.nodes.values():
+            n.options.raft_options.priority_transfer_rounds = 0
+        leader = await _wait_leader_priority(c, 80)
+        high = leader.server_id
+        await c.stop(high)
+        low_leader = await _wait_leader_priority(c, 40)
+        await c.start(high)
+        # restarted node must NOT depose: no transfer, and its own
+        # campaign is barred by the live leader's lease.  Give it a few
+        # election timeouts to (not) act.
+        await asyncio.sleep(1.2)
+        assert low_leader.is_leader(), \
+            "priority_transfer_rounds=0 must leave the decayed leader"
+        assert low_leader.metrics.counters.get("priority-transfers", 0) == 0
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_priority_never_raises_target():
+    """A witness's priority must not gate data replicas' elections: the
+    witness never campaigns, so a high witness priority raising the
+    target would only delay every real candidate behind pointless decay
+    rounds."""
+    # witness has the HIGHEST priority on purpose
+    c = _priority_cluster([90, 40, 20], witness_idx=(0,),
+                          election_timeout_ms=150)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader(timeout_s=10.0)
+        # the 40-node must win immediately (target = max over DATA
+        # voters = 40), without a single decay round against the 90
+        assert leader.server_id.priority == 40
+        for n in c.nodes.values():
+            assert n.target_priority == 40, (
+                f"{n}: witness priority leaked into target "
+                f"({n.target_priority})")
+    finally:
+        await c.stop_all()

@@ -1,0 +1,670 @@
+"""Witness replicas: quorum math by enumeration, metadata-only
+journal, election safety, snapshot-install skip, and the
+witness-majority-must-not-commit case.
+
+A witness votes and acks appends but stores no log payload — the geo
+topology's cheap vote (2 data + 1 witness commits at quorum 2 without
+a third full data copy).  Safety rests on three independent layers,
+each tested here: config validation (witnesses a strict minority, so
+every majority contains a data replica — enumerated), witnesses never
+campaign (a witness-only partition side can never elect, hence never
+commit), and the ballot box clamping the commit point to the best DATA
+replica's match (defense in depth).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tests.cluster import TestCluster
+from tpuraft.conf import Configuration
+from tpuraft.core.ballot_box import commit_point
+from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
+from tpuraft.util.quorum import (
+    every_majority_has_data_peer,
+    joint_quorums_intersect,
+    majorities,
+    witness_minority,
+    witness_only_majorities,
+)
+
+
+def _p(i: int) -> PeerId:
+    return PeerId("127.0.0.1", 5000 + i)
+
+
+# ---------------------------------------------------------------------------
+# quorum math by enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_witness_minority_rule_by_enumeration():
+    """For every voter-set size up to 7 and every witness count: the
+    config rule (witnesses < quorum, >=1 data voter) holds exactly when
+    every enumerated majority contains a data replica."""
+    for n in range(1, 8):
+        voters = [_p(i) for i in range(n)]
+        for w in range(0, n + 1):
+            witnesses = voters[:w]
+            rule = witness_minority(voters, witnesses)
+            enumerated = every_majority_has_data_peer(voters, witnesses)
+            if w == 0:
+                assert rule and enumerated
+                continue
+            if rule:
+                assert enumerated, (n, w)
+                assert witness_only_majorities(voters, witnesses) == []
+            # the interesting direction: every rejected config has a
+            # witness-only majority OR no data voter at all
+            if not rule and w < n:
+                assert not enumerated or w >= n // 2 + 1, (n, w)
+
+
+def test_witness_geo_shapes_are_valid():
+    """The ISSUE's two target shapes pass validation: 2+1 (3-zone) and
+    4+1 (5-zone '2.5-replica')."""
+    for n_data, n_wit in [(2, 1), (4, 1), (3, 2), (4, 3)]:
+        voters = [_p(i) for i in range(n_data + n_wit)]
+        witnesses = voters[n_data:]
+        conf = Configuration(list(voters), witnesses=list(witnesses))
+        expect = witness_minority(voters, witnesses)
+        assert conf.is_valid() == expect, (n_data, n_wit)
+        if expect:
+            assert every_majority_has_data_peer(voters, witnesses)
+    # all-witness and witness-majority confs are rejected
+    assert not Configuration([_p(0)], witnesses=[_p(0)]).is_valid()
+    assert not Configuration([_p(0), _p(1), _p(2)],
+                             witnesses=[_p(1), _p(2)]).is_valid()
+
+
+def test_witness_joint_consensus_intersection():
+    """Joint consensus with witnesses on either side keeps quorum
+    intersection (witnesses are ordinary voters in the math), verified
+    by enumeration of every dual quorum."""
+    old = [_p(0), _p(1), _p(2)]            # 2 data + 1 witness
+    new = [_p(0), _p(1), _p(3), _p(4), _p(5)]  # 4 data + 1 witness
+    assert joint_quorums_intersect(old, new)
+    # and every dual quorum still contains a data peer when the
+    # witness sets respect the minority rule on both sides
+    wits = {_p(2), _p(5)}
+    for qo in majorities(old):
+        for qn in majorities(new):
+            assert (qo | qn) - wits, "dual quorum with no data replica"
+
+
+def test_ballot_clamps_commit_to_best_data_match():
+    """Defense in depth: witness acks alone must never advance the
+    commit point past what a data replica stored — even if a buggy
+    path fed the ballot witness rows without the leader's own."""
+    a, b, w1, w2 = _p(0), _p(1), _p(2), _p(3)
+    conf = Configuration([a, b, w1, w2, _p(4)], witnesses=[w1, w2])
+    # witness acks race ahead of every data replica
+    match = {w1: 9, w2: 9, a: 2, b: 1}
+    pt = commit_point(match, conf, Configuration())
+    assert pt == 2, f"commit point {pt} ran past the best data match"
+    # once a data replica catches up, the majority stat rules again
+    match[a] = 9
+    assert commit_point(match, conf, Configuration()) == 9
+    # joint mode: the clamp covers both sides' data peers
+    old = Configuration([a, b, w1], witnesses=[w1])
+    assert commit_point({w1: 5, a: 3, b: 5}, conf, old) <= 5
+
+
+# ---------------------------------------------------------------------------
+# live clusters
+# ---------------------------------------------------------------------------
+
+
+async def _wait(cond, timeout_s=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.03)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.mark.asyncio
+async def test_two_plus_one_commits_at_majority_cost():
+    """2 data + 1 witness: with the data FOLLOWER partitioned away, the
+    leader + witness quorum keeps committing — the witness's ack buys
+    availability without a third data copy.  The witness's journal
+    holds payload-free entries throughout."""
+    c = TestCluster(3, witness_idx=(2,), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        assert not leader.options.witness, "witness must never lead"
+        witness_peer = c.peers[2]
+        follower = next(p for p in c.peers[:2]
+                        if p != leader.server_id)
+        st = await c.apply_ok(leader, b"before")
+        assert st.is_ok()
+        # partition the data follower: quorum = {leader, witness}
+        c.net.partition({follower.endpoint},
+                        {leader.server_id.endpoint, witness_peer.endpoint})
+        st = await asyncio.wait_for(c.apply_ok(leader, b"during"), 5.0)
+        assert st.is_ok(), "leader+witness majority must commit"
+        # the witness journaled METADATA only
+        wnode = c.nodes[witness_peer]
+        await _wait(lambda: wnode.ballot_box.last_committed_index
+                    >= leader.ballot_box.last_committed_index - 1,
+                    msg="witness commit catch-up")
+        for i in range(1, wnode.log_manager.last_log_index() + 1):
+            e = wnode.log_manager.get_entry(i)
+            if e is not None and e.type == EntryType.DATA:
+                assert e.data == b"", \
+                    f"witness stored a payload at index {i}"
+        c.net.heal()
+        st = await c.apply_ok(leader, b"after")
+        assert st.is_ok()
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_votes_but_never_campaigns():
+    """Kill the leader of a 2+1 group: the surviving DATA node must win
+    (the witness grants its vote) and the witness itself must never
+    become leader or candidate."""
+    from tpuraft.core.node import State
+
+    c = TestCluster(3, witness_idx=(2,), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        await c.apply_ok(leader, b"v")
+        wnode = c.nodes[c.peers[2]]
+        await c.stop(leader.server_id)
+        new_leader = await c.wait_leader(timeout_s=8.0)
+        assert not new_leader.options.witness
+        assert new_leader.server_id != c.peers[2]
+        assert wnode.state not in (State.LEADER, State.CANDIDATE,
+                                   State.TRANSFERRING)
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_metadata_vote_protects_committed_entries():
+    """Commit at {leader, witness} while the data follower lags, then
+    kill the leader: the witness's metadata log is newer than the
+    lagging follower's, so its vote REFUSES the follower — the group
+    stalls (unavailable) instead of electing a leader that would lose
+    the acked entry.  Restarting the old leader recovers both
+    availability and the entry: witness safety through quorum
+    intersection with a metadata-only journal."""
+    c = TestCluster(3, witness_idx=(2,), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        lagger = next(p for p in c.peers[:2] if p != leader.server_id)
+        witness_peer = c.peers[2]
+        st = await c.apply_ok(leader, b"shared")
+        assert st.is_ok()
+        # lagger partitioned: the next commit lands on {leader, witness}
+        c.net.partition({lagger.endpoint},
+                        {leader.server_id.endpoint, witness_peer.endpoint})
+        st = await asyncio.wait_for(c.apply_ok(leader, b"acked"), 5.0)
+        assert st.is_ok()
+        committed = leader.ballot_box.last_committed_index
+        # leader dies; partition heals: survivors = lagging data + witness
+        await c.stop(leader.server_id)
+        c.net.heal()
+        lag_node = c.nodes[lagger]
+        wnode = c.nodes[witness_peer]
+        # the lagger keeps campaigning but the witness must refuse — no
+        # leader may emerge for several election timeouts
+        await asyncio.sleep(2.0)
+        assert not lag_node.is_leader(), (
+            "a lagging data node was elected over the witness's newer "
+            "metadata log — acked entry lost")
+        assert not wnode.is_leader()
+        # old leader returns: group recovers WITH the entry
+        await c.start(leader.server_id)
+        recovered = await c.wait_leader(timeout_s=10.0)
+        await _wait(lambda: recovered.ballot_box.last_committed_index
+                    >= committed, msg="committed entry recovery")
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_majority_partition_never_commits():
+    """The ISSUE's safety case: a partition isolating the data replicas
+    leaves a witness-majority side — it must NOT commit (witnesses
+    never campaign, so that side can never even elect).  The config
+    (1 data + 2 witnesses) is deliberately INVALID by the minority rule
+    — the runtime layers must hold even when the config gate was
+    bypassed."""
+    c = TestCluster(3, witness_idx=(1, 2), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()   # the only data node
+        assert leader.server_id == c.peers[0]
+        st = await c.apply_ok(leader, b"v")
+        assert st.is_ok()
+        committed = leader.ballot_box.last_committed_index
+        # isolate the data replica: the witness side holds 2/3 votes
+        c.net.isolate(leader.server_id.endpoint)
+        await asyncio.sleep(2.0)   # many election timeouts
+        w1, w2 = c.nodes[c.peers[1]], c.nodes[c.peers[2]]
+        assert not w1.is_leader() and not w2.is_leader(), \
+            "witness-majority side elected a leader"
+        assert w1.ballot_box.last_committed_index <= committed
+        assert w2.ballot_box.last_committed_index <= committed
+        # the cut-off data leader steps down on dead quorum: no side
+        # commits (unavailable, never unsafe)
+        await _wait(lambda: not leader.is_leader(), timeout_s=5.0,
+                    msg="isolated leader step-down")
+        c.net.heal()
+        recovered = await c.wait_leader(timeout_s=10.0)
+        assert recovered.server_id == c.peers[0]
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_crash_restart_metadata_journal(tmp_path):
+    """Durable witness restart: the witness comes back from its
+    metadata-only journal (no payload bytes on disk), rejoins, and
+    resumes acking — the leader's replicator re-matches it at the
+    tail."""
+    c = TestCluster(3, witness_idx=(2,), tmp_path=tmp_path,
+                    election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        for i in range(5):
+            st = await c.apply_ok(leader, b"w%d" % i)
+            assert st.is_ok()
+        wp = c.peers[2]
+        await c.stop(wp)
+        for i in range(5, 8):
+            st = await c.apply_ok(leader, b"w%d" % i)
+            assert st.is_ok()
+        await c.start(wp)
+        wnode = c.nodes[wp]
+        leader = await c.wait_leader()
+        tail = leader.log_manager.last_log_index()
+        await _wait(lambda: wnode.log_manager.last_log_index() >= tail,
+                    msg="witness re-catch-up")
+        for i in range(1, wnode.log_manager.last_log_index() + 1):
+            e = wnode.log_manager.get_entry(i)
+            if e is not None and e.type == EntryType.DATA:
+                assert e.data == b"", f"payload survived restart at {i}"
+        # and the restarted witness keeps the quorum liveness: kill the
+        # data follower, the leader + restarted witness still commit
+        follower = next(p for p in c.peers[:2] if p != leader.server_id)
+        await c.stop(follower)
+        st = await asyncio.wait_for(c.apply_ok(leader, b"post"), 5.0)
+        assert st.is_ok()
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_snapshot_install_skip(tmp_path):
+    """A witness that fell behind the leader's compacted log catches up
+    via a META-ONLY install: no state files cross the wire (the
+    install-snapshot-witness-skips counter ticks, get_file is never
+    called), and replication resumes from the snapshot point."""
+    c = TestCluster(3, witness_idx=(2,), tmp_path=tmp_path,
+                    snapshot=True, election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        for i in range(6):
+            st = await c.apply_ok(leader, b"a%d" % i)
+            assert st.is_ok()
+        wp = c.peers[2]
+        await c.stop(wp)
+        for i in range(6, 12):
+            st = await c.apply_ok(leader, b"b%d" % i)
+            assert st.is_ok()
+        st = await leader.snapshot()
+        assert st.is_ok(), str(st)
+        assert leader.log_manager.first_log_index() > 1, "no compaction"
+        await c.drain_sends_to(leader, wp.endpoint)
+        # count get_file RPCs at the leader's endpoint from now on
+        get_files = []
+        leader_server = c.managers[leader.server_id].server
+        orig = leader_server._handlers.get("get_file")
+
+        async def counting_get_file(req):
+            get_files.append(req)
+            return await orig(req)
+
+        leader_server.register("get_file", counting_get_file)
+        await c.start(wp)
+        wnode = c.nodes[wp]
+        await _wait(lambda: wnode.log_manager.last_snapshot_id().index
+                    >= leader.log_manager.last_snapshot_id().index,
+                    timeout_s=10.0, msg="witness meta-only install")
+        assert wnode.metrics.counters.get(
+            "install-snapshot-witness-skips", 0) >= 1
+        assert not get_files, \
+            "witness install downloaded state files over the wire"
+        # replication resumes past the snapshot point
+        st = await c.apply_ok(leader, b"tail")
+        assert st.is_ok()
+        tail = leader.log_manager.last_log_index()
+        await _wait(lambda: wnode.log_manager.last_log_index() >= tail,
+                    msg="witness post-install replication")
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_add_and_remove_witness_through_membership_change():
+    """Joint-consensus add of a witness: catch-up ships payload-
+    stripped entries, the committed conf carries the witness flag on
+    every node, and removal prunes it cleanly."""
+    c = TestCluster(4, election_timeout_ms=200)
+    # only the first three are initial voters; the fourth joins as a
+    # witness via change_peers
+    c.conf = Configuration(list(c.peers[:3]))
+    await c.start_all()
+    try:
+        # the 4th node must run in witness mode from boot
+        d = c.peers[3]
+        assert not c.nodes[d].is_leader()
+        c.nodes[d].options.witness = True
+        leader = await c.wait_leader()
+        for i in range(4):
+            st = await c.apply_ok(leader, b"x%d" % i)
+            assert st.is_ok()
+        st = await asyncio.wait_for(leader.add_peer(d, witness=True), 10.0)
+        assert st.is_ok(), str(st)
+        for n in c.nodes.values():
+            if n.conf_entry.conf.contains(d):
+                assert n.conf_entry.conf.is_witness(d), \
+                    f"{n}: witness flag lost through the conf change"
+        # catch-up + steady-state replication stayed payload-free
+        dnode = c.nodes[d]
+        await _wait(lambda: dnode.log_manager.last_log_index()
+                    >= leader.log_manager.last_log_index(),
+                    msg="witness catch-up")
+        for i in range(1, dnode.log_manager.last_log_index() + 1):
+            e = dnode.log_manager.get_entry(i)
+            if e is not None and e.type == EntryType.DATA:
+                assert e.data == b"", f"witness got a payload at {i}"
+        assert leader.metrics.counters.get("witness-stripped-bytes", 0) > 0
+        # conf entries survive the wire with the flag (decode check)
+        tail_conf = leader.log_manager.conf_manager.last()
+        assert tail_conf.conf.is_witness(d)
+        # remove again
+        st = await asyncio.wait_for(leader.remove_peer(d), 10.0)
+        assert st.is_ok(), str(st)
+        assert not leader.conf_entry.conf.contains(d)
+        assert d not in leader.conf_entry.conf.witnesses
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_witness_refuses_reads_and_transfers():
+    from tpuraft.core.read_only import ReadIndexError
+    from tpuraft.errors import RaftError
+
+    c = TestCluster(3, witness_idx=(2,), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        st = await c.apply_ok(leader, b"v")
+        assert st.is_ok()
+        wnode = c.nodes[c.peers[2]]
+        with pytest.raises(ReadIndexError):
+            await wnode.read_index()
+        st = await leader.transfer_leadership_to(c.peers[2])
+        assert st.raft_error == RaftError.EINVAL, \
+            "transfer to a witness must be refused"
+        # leader-side reads still confirm through the witness's acks
+        idx = await leader.read_index()
+        assert idx >= 1
+    finally:
+        await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# wire format: trailing-defaulted extensions, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_conf_entry_wire_roundtrip_and_backcompat():
+    """LogEntry CONFIGURATION codec: witness lists ride as a TRAILING
+    extension of the peers blob — witness-free entries are
+    byte-identical to the pre-witness format, and a pre-witness blob
+    decodes with witnesses=None."""
+    peers = [_p(0), _p(1), _p(2)]
+    e = LogEntry(type=EntryType.CONFIGURATION, id=LogId(5, 2),
+                 peers=list(peers), witnesses=[peers[2]])
+    got = LogEntry.decode(e.encode())
+    assert got.peers == peers and got.witnesses == [peers[2]]
+    assert got.old_witnesses is None
+
+    plain = LogEntry(type=EntryType.CONFIGURATION, id=LogId(5, 2),
+                     peers=list(peers))
+    # the no-witness encoding carries exactly 4 lists (old format):
+    # strip header, check the blob parses as the OLD 4-list algorithm
+    # with nothing left over
+    import struct
+
+    from tpuraft.entity import _HDR
+
+    blob = plain.encode()
+    (_m, _t, _r, _term, _idx, plen, _n2, _dl, _crc) = _HDR.unpack_from(blob)
+    peers_blob = blob[_HDR.size:_HDR.size + plen]
+    off = 0
+    for _ in range(4):   # the OLD decoder's fixed 4-list loop
+        (n,) = struct.unpack_from("<h", peers_blob, off)
+        off += 2
+        for _ in range(max(0, n)):
+            (slen,) = struct.unpack_from("<H", peers_blob, off)
+            off += 2 + slen
+    assert off == len(peers_blob), \
+        "witness-free entry grew bytes an old decoder would miss"
+    # and an old decoder reading a WITNESS entry stops after 4 lists
+    # with only the trailing witness lists left — by construction the
+    # new lists are appended after the old 4, so the old parse above
+    # would land exactly at the witness tail (ignored)
+    assert LogEntry.decode(plain.encode()).witnesses is None
+
+
+def test_cli_and_pd_messages_decode_old_frames():
+    """Old-format (pre-witness / pre-zone) frames must decode on a new
+    receiver with the trailing fields at their defaults — and a NEW
+    frame decoded by an OLD receiver (simulated by a field-trimmed
+    clone) must yield the old fields intact."""
+    from dataclasses import dataclass, field, fields
+
+    from tpuraft.rheakv.pd_messages import (
+        StoreHeartbeatBatchRequest,
+        StoreHeartbeatRequest,
+    )
+    from tpuraft.rpc.cli_messages import (
+        AddPeerRequest,
+        ChangePeersRequest,
+        GetPeersResponse,
+    )
+    from tpuraft.rpc import messages as M
+
+    cases = [
+        # (new message, names of the trailing new fields)
+        (ChangePeersRequest(group_id="g", peer_id="p",
+                            new_peers=["a:1", "b:1"],
+                            new_witnesses=["b:1"]), ["new_witnesses"]),
+        (GetPeersResponse(peers=["a:1", "b:1"], witnesses=["b:1"]),
+         ["witnesses"]),
+        (AddPeerRequest(group_id="g", peer_id="p", adding="c:1",
+                        witness=True), ["witness"]),
+        (StoreHeartbeatRequest(store_id=7, endpoint="a:1", zone="z1"),
+         ["zone"]),
+        (StoreHeartbeatBatchRequest(store_id=7, endpoint="a:1",
+                                    zone="z2"), ["zone"]),
+    ]
+    for msg, new_fields in cases:
+        cls = type(msg)
+        tid = M._TYPE_ID[cls]
+        wire = M.encode_message(msg)
+        # direction 1: OLD sender -> NEW receiver.  An old sender's
+        # frame is the new frame minus the trailing fields' bytes;
+        # build it by encoding a default-field copy of the message.
+        old_style = cls(**{f.name: getattr(msg, f.name)
+                           for f in fields(cls)
+                           if f.name not in new_fields})
+        old_wire_len = len(M.encode_message(old_style)) - sum(
+            _encoded_len(getattr(old_style, nf)) for nf in new_fields)
+        got = M.decode_message(wire[:old_wire_len])
+        for f in fields(cls):
+            if f.name in new_fields:
+                assert getattr(got, f.name) == getattr(old_style, f.name)
+            else:
+                assert getattr(got, f.name) == getattr(msg, f.name)
+        # direction 2: NEW sender -> OLD receiver.  Simulate the old
+        # receiver by swapping in a clone class without the new fields;
+        # its decode must stop cleanly, ignoring the trailing bytes.
+        clone = dataclass(type("Old" + cls.__name__, (), {
+            "__annotations__": {
+                f.name: f.type for f in fields(cls)
+                if f.name not in new_fields},
+            **{f.name: (f.default if f.default is not M._MISSING
+                        else (field(default_factory=f.default_factory)
+                              if f.default_factory is not M._MISSING
+                              else M._MISSING))
+               for f in fields(cls) if f.name not in new_fields
+               and (f.default is not M._MISSING
+                    or f.default_factory is not M._MISSING)},
+        }))
+        try:
+            M._MSG_TYPES[tid] = clone
+            old_got = M.decode_message(wire)
+            for f in fields(clone):
+                assert getattr(old_got, f.name) == getattr(msg, f.name), \
+                    f"{cls.__name__}.{f.name} corrupted on old receiver"
+        finally:
+            M._MSG_TYPES[tid] = cls
+
+
+def _encoded_len(v) -> int:
+    """Wire length of one trailing field's default-valued encoding."""
+    import struct as _s
+
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, int):
+        return 8
+    if isinstance(v, str):
+        return 2 + len(v.encode())
+    if isinstance(v, list):
+        return 4 + sum(2 + len(x.encode()) for x in v)
+    raise TypeError(type(v))
+
+
+def test_snapshot_meta_witness_lists_backcompat():
+    from tpuraft.rpc.messages import SnapshotMeta
+
+    meta = SnapshotMeta(last_included_index=9, last_included_term=2,
+                        peers=["a:1", "b:1", "c:1"], witnesses=["c:1"])
+    got = SnapshotMeta.decode(meta.encode())
+    assert got == meta
+    plain = SnapshotMeta(last_included_index=9, last_included_term=2,
+                         peers=["a:1", "b:1"])
+    blob = plain.encode()
+    # zoneless/witness-free meta keeps the old 4-list byte format
+    assert SnapshotMeta.decode(blob) == plain
+    # pre-witness decoder compatibility: the blob ends exactly after
+    # the 4 old lists (no trailing bytes an old reader would choke on)
+    import struct
+
+    off = 16
+    for _ in range(4):
+        (n,) = struct.unpack_from("<H", blob, off)
+        off += 2
+        for _ in range(n):
+            (sl,) = struct.unpack_from("<H", blob, off)
+            off += 2 + sl
+    assert off == len(blob)
+
+
+def test_store_meta_zone_blob_backcompat():
+    from tpuraft.rheakv.pd_messages import decode_store_meta, \
+        encode_store_meta
+
+    new = encode_store_meta(5, "1.2.3.4:80", "zone-a")
+    assert decode_store_meta(new) == (5, "1.2.3.4:80", "zone-a")
+    old = encode_store_meta(5, "1.2.3.4:80")           # zoneless: old bytes
+    assert decode_store_meta(old) == (5, "1.2.3.4:80", "")
+    # old reader (fixed-offset parse) on a NEW blob still reads id+ep
+    import struct
+
+    (sid,) = struct.unpack_from("<q", new, 0)
+    (n,) = struct.unpack_from("<H", new, 8)
+    assert (sid, new[10:10 + n].decode()) == (5, "1.2.3.4:80")
+
+
+@pytest.mark.asyncio
+async def test_runtime_added_witness_adopts_witness_mode():
+    """Review finding: a PLAIN-booted node added via add-witness used
+    to keep its real FSM (applying payload-stripped entries = silent
+    divergence) and could still campaign.  The committed conf is now
+    the truth: on applying a conf entry that flags it, the node adopts
+    witness mode — null FSM, campaign/read/transfer gates closed."""
+    from tpuraft.core.state_machine import WitnessStateMachine
+
+    c = TestCluster(4, election_timeout_ms=200)
+    c.conf = Configuration(list(c.peers[:3]))
+    await c.start_all()
+    try:
+        d = c.peers[3]
+        dnode = c.nodes[d]
+        assert not dnode.options.witness, "sanity: plain boot"
+        leader = await c.wait_leader()
+        for i in range(3):
+            st = await c.apply_ok(leader, b"r%d" % i)
+            assert st.is_ok()
+        st = await asyncio.wait_for(leader.add_peer(d, witness=True), 10.0)
+        assert st.is_ok(), str(st)
+        await _wait(lambda: dnode.options.witness, timeout_s=5.0,
+                    msg="witness adoption from the committed conf")
+        assert isinstance(dnode.options.fsm, WitnessStateMachine)
+        assert isinstance(dnode.fsm_caller._fsm, WitnessStateMachine)
+        # and its journal holds no payloads from here on
+        st = await c.apply_ok(leader, b"post-adopt")
+        assert st.is_ok()
+        tail = leader.log_manager.last_log_index()
+        await _wait(lambda: dnode.log_manager.last_log_index() >= tail,
+                    msg="post-adoption replication")
+    finally:
+        await c.stop_all()
+
+
+@pytest.mark.asyncio
+async def test_in_place_witness_role_conversion_rejected():
+    """Promoting a witness to data voter in place would serve from a
+    payload-less journal; demoting a data voter to witness leaves it a
+    stale full journal — both are EINVAL (remove, wipe, re-add)."""
+    from tpuraft.errors import RaftError
+
+    c = TestCluster(3, witness_idx=(2,), election_timeout_ms=200)
+    await c.start_all()
+    try:
+        leader = await c.wait_leader()
+        # witness -> data (drop the flag, keep the peer)
+        promote = Configuration(list(c.peers))
+        st = await leader.change_peers(promote)
+        assert st.raft_error == RaftError.EINVAL, str(st)
+        assert "conversion" in st.error_msg
+        # data -> witness (flag an existing data follower)
+        follower = next(p for p in c.peers[:2] if p != leader.server_id)
+        demote = Configuration(list(c.peers),
+                               witnesses=[c.peers[2], follower])
+        st = await leader.change_peers(demote)
+        assert st.raft_error == RaftError.EINVAL, str(st)
+        # the legal path still works: remove then re-add in the new role
+        st = await asyncio.wait_for(leader.remove_peer(c.peers[2]), 10.0)
+        assert st.is_ok(), str(st)
+        assert not leader.conf_entry.conf.witnesses
+    finally:
+        await c.stop_all()

@@ -104,6 +104,22 @@ class Module:
             return self.comments[line - 1]
         return ""
 
+    def comment_block_above(self, line: int) -> str:
+        """The whole CONTIGUOUS standalone-comment block ending just
+        above ``line``, joined top-down — class-level annotations are
+        routinely written as multi-line comments whose marker sits on
+        the FIRST line (``# graftcheck: loop-confined — because...``
+        wrapped over two lines), which ``comment_at_or_above``'s
+        single-line lookback silently missed: every multi-line
+        loop-confined annotation in the tree was dead on arrival."""
+        trailing = self.comments.get(line)
+        lines: list[str] = [trailing] if trailing else []
+        cur = line - 1
+        while cur in self.standalone_comments:
+            lines.append(self.comments[cur])
+            cur -= 1
+        return "\n".join(reversed(lines))
+
     def waived(self, rule: str, line: int) -> bool:
         for w in self.waivers:
             if w.rule == rule and (

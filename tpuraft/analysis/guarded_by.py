@@ -136,7 +136,7 @@ def _check_class(mod: Module, cls) -> list[Finding]:
     fields = _collect_fields(mod, cls)
     holds = _holds_locks(mod, cls, fields)
     loop_confined = bool(
-        _LOOP_CONFINED_RE.search(mod.comment_at_or_above(cls.node.lineno))
+        _LOOP_CONFINED_RE.search(mod.comment_block_above(cls.node.lineno))
         or (cls.node.body and isinstance(cls.node.body[0], ast.Expr)
             and isinstance(cls.node.body[0].value, ast.Constant)
             and isinstance(cls.node.body[0].value.value, str)

@@ -17,14 +17,28 @@ from tpuraft.entity import LogId, PeerId
 
 @dataclass
 class Configuration:
-    """A voter set plus optional learner (read-only replica) set."""
+    """A voter set plus optional learner (read-only replica) set.
+
+    **Witnesses** are VOTERS flagged in ``witnesses`` (a subset of
+    ``peers``): they vote and ack appends — so every quorum computation
+    over ``peers`` covers them transparently — but they store only log
+    METADATA (payload-stripped appends), never campaign, and never
+    serve reads.  A geo topology gets majority-cost commits without a
+    full extra data copy (2 data + 1 witness = quorum 2).  Safety rests
+    on two invariants checked in :meth:`is_valid` and enumerated in
+    tests/oracle.py: at least one non-witness voter exists (leaders are
+    always data replicas), and witnesses stay a strict minority so
+    every majority contains a data replica.
+    """
 
     peers: list[PeerId] = field(default_factory=list)
     learners: list[PeerId] = field(default_factory=list)
+    witnesses: list[PeerId] = field(default_factory=list)  # subset of peers
 
     @staticmethod
     def parse(conf_str: str) -> "Configuration":
-        """Parse ``"ip:port,ip:port:idx,..."``; learners suffixed ``/learner``."""
+        """Parse ``"ip:port,ip:port:idx,..."``; learners suffixed
+        ``/learner``, witness voters suffixed ``/witness``."""
         conf = Configuration()
         for tok in conf_str.split(","):
             tok = tok.strip()
@@ -32,12 +46,17 @@ class Configuration:
                 continue
             if tok.endswith("/learner"):
                 conf.learners.append(PeerId.parse(tok[: -len("/learner")]))
+            elif tok.endswith("/witness"):
+                p = PeerId.parse(tok[: -len("/witness")])
+                conf.peers.append(p)
+                conf.witnesses.append(p)
             else:
                 conf.peers.append(PeerId.parse(tok))
         return conf
 
     def copy(self) -> "Configuration":
-        return Configuration(list(self.peers), list(self.learners))
+        return Configuration(list(self.peers), list(self.learners),
+                             list(self.witnesses))
 
     def is_empty(self) -> bool:
         return not self.peers
@@ -45,10 +64,29 @@ class Configuration:
     def contains(self, peer: PeerId) -> bool:
         return peer in self.peers
 
+    def is_witness(self, peer: PeerId) -> bool:
+        return peer in self.witnesses
+
+    def data_peers(self) -> list[PeerId]:
+        """Voters that hold full log payloads (quorum durability)."""
+        w = set(self.witnesses)
+        return [p for p in self.peers if p not in w]
+
     def is_valid(self) -> bool:
-        """Voter and learner sets must be disjoint; no duplicate peers."""
+        """Voter and learner sets must be disjoint; no duplicate peers.
+        Witness invariants: witnesses ⊆ peers, at least one data voter
+        exists, and witnesses are a strict MINORITY of the voter set
+        (< quorum) so every majority contains a data replica — the rule
+        is THE enumeration-verified ``util.quorum.witness_minority``
+        (one predicate: the verified function IS the enforced one)."""
+        from tpuraft.util.quorum import witness_minority
+
         s = set(self.peers)
-        return len(s) == len(self.peers) and not (s & set(self.learners))
+        if len(s) != len(self.peers) or (s & set(self.learners)):
+            return False
+        if len(set(self.witnesses)) != len(self.witnesses):
+            return False
+        return witness_minority(s, self.witnesses)
 
     def quorum(self) -> int:
         return len(self.peers) // 2 + 1
@@ -64,12 +102,14 @@ class Configuration:
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Configuration):
             return NotImplemented
-        return set(self.peers) == set(other.peers) and set(self.learners) == set(
-            other.learners
-        )
+        return (set(self.peers) == set(other.peers)
+                and set(self.learners) == set(other.learners)
+                and set(self.witnesses) == set(other.witnesses))
 
     def __str__(self) -> str:
-        toks = [str(p) for p in sorted(self.peers)]
+        w = set(self.witnesses)
+        toks = [f"{p}/witness" if p in w else str(p)
+                for p in sorted(self.peers)]
         toks += [f"{p}/learner" for p in sorted(self.learners)]
         return ",".join(toks)
 

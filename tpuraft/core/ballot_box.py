@@ -25,7 +25,19 @@ from tpuraft.errors import Status
 
 def commit_point(match: dict[PeerId, int], conf: Configuration,
                  old_conf: Configuration) -> int:
-    """Scalar mirror of ops.ballot.joint_quorum_match_index."""
+    """Scalar mirror of ops.ballot.joint_quorum_match_index — PLUS the
+    witness data-clamp the device kernel does not have (which is why
+    StoreEngine refuses witness confs on engine-backed stores).
+
+    Witness-aware: witnesses are ordinary voters in the order statistic
+    (they ack metadata appends), but the commit point is additionally
+    CLAMPED to the best DATA replica's match — an index no data voter
+    has stored must never commit, however many witness acks it holds.
+    Normally a no-op (the leader is always a data replica and its own
+    match row covers the tail), so this is defense in depth against a
+    witness-only quorum certifying payload-free commits (the ISSUE's
+    witness-majority-must-not-commit case, enumerated in
+    tests/test_witness.py against util/quorum.py)."""
 
     def order_stat(peers: list[PeerId]) -> int:
         vals = sorted((match.get(p, 0) for p in peers), reverse=True)
@@ -34,9 +46,13 @@ def commit_point(match: dict[PeerId, int], conf: Configuration,
         return vals[len(peers) // 2]  # q-th largest, q = n//2+1
 
     new_q = order_stat(conf.peers)
-    if old_conf.is_empty():
-        return new_q
-    return min(new_q, order_stat(old_conf.peers))
+    if not old_conf.is_empty():
+        new_q = min(new_q, order_stat(old_conf.peers))
+    if conf.witnesses or old_conf.witnesses:
+        data = set(conf.data_peers()) | set(old_conf.data_peers())
+        data_best = max((match.get(p, 0) for p in data), default=0)
+        new_q = min(new_q, data_best)
+    return new_q
 
 
 # graftcheck: loop-confined — commit_at/update_conf run on the node's

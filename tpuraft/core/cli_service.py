@@ -114,14 +114,16 @@ class CliProcessors:
                  else node.list_peers())
         return GetPeersResponse(
             peers=[str(p) for p in peers],
-            learners=[str(p) for p in node.list_learners()])
+            learners=[str(p) for p in node.list_learners()],
+            witnesses=[str(p) for p in node.conf_entry.conf.witnesses])
 
     async def _add_peer(self, req: AddPeerRequest) -> CliResponse:
         node, err = self._leader_node(req)
         if err:
             return err
         old = [str(p) for p in node.list_peers()]
-        st = await node.add_peer(PeerId.parse(req.adding))
+        st = await node.add_peer(PeerId.parse(req.adding),
+                                 witness=bool(getattr(req, "witness", False)))
         resp = self._from_status(st, node)
         resp.old_peers = old
         return resp
@@ -142,7 +144,8 @@ class CliProcessors:
             return err
         old = [str(p) for p in node.list_peers()]
         conf = Configuration([PeerId.parse(p) for p in req.new_peers],
-                             [PeerId.parse(p) for p in req.new_learners])
+                             [PeerId.parse(p) for p in req.new_learners],
+                             [PeerId.parse(p) for p in req.new_witnesses])
         st = await node.change_peers(conf)
         resp = self._from_status(st, node)
         resp.old_peers = old
@@ -156,7 +159,8 @@ class CliProcessors:
             return CliResponse(code=int(RaftError.ENOENT),
                                msg=f"no node for group {req.group_id} here")
         conf = Configuration([PeerId.parse(p) for p in req.new_peers],
-                             [PeerId.parse(p) for p in req.new_learners])
+                             [PeerId.parse(p) for p in req.new_learners],
+                             [PeerId.parse(p) for p in req.new_witnesses])
         st = await node.reset_peers(conf)
         return self._from_status(st, node)
 
@@ -245,10 +249,12 @@ class CliService:
 
     async def get_configuration(self, group_id: str, conf: Configuration
                                 ) -> Configuration:
-        """Voters AND learners in one round trip."""
+        """Voters, learners AND witness flags in one round trip."""
         resp = await self._peers_rpc(group_id, conf, False)
-        return Configuration([PeerId.parse(p) for p in resp.peers],
-                             [PeerId.parse(p) for p in resp.learners])
+        return Configuration(
+            [PeerId.parse(p) for p in resp.peers],
+            [PeerId.parse(p) for p in resp.learners],
+            [PeerId.parse(p) for p in getattr(resp, "witnesses", [])])
 
     async def _peers_rpc(self, group_id: str, conf: Configuration,
                          only_alive: bool) -> GetPeersResponse:
@@ -280,11 +286,25 @@ class CliService:
     # -- admin ops -----------------------------------------------------------
 
     async def add_peer(self, group_id: str, conf: Configuration,
-                       peer: PeerId) -> Status:
+                       peer: PeerId, witness: bool = False) -> Status:
         return await self._leader_op(
             group_id, conf, "cli_add_peer",
             lambda leader: AddPeerRequest(
-                group_id=group_id, peer_id=str(leader), adding=str(peer)))
+                group_id=group_id, peer_id=str(leader), adding=str(peer),
+                witness=witness))
+
+    async def add_witness(self, group_id: str, conf: Configuration,
+                          peer: PeerId) -> Status:
+        """Add a WITNESS voter: votes + acks metadata appends, stores no
+        log payload, never leads — a 2+1 geo topology's cheap third
+        vote (docs/operations.md "Geo deployment runbook")."""
+        return await self.add_peer(group_id, conf, peer, witness=True)
+
+    async def remove_witness(self, group_id: str, conf: Configuration,
+                             peer: PeerId) -> Status:
+        """Remove a witness voter (same wire op as remove_peer; named
+        for operator symmetry with add_witness)."""
+        return await self.remove_peer(group_id, conf, peer)
 
     async def remove_peer(self, group_id: str, conf: Configuration,
                           peer: PeerId) -> Status:
@@ -300,16 +320,19 @@ class CliService:
             lambda leader: ChangePeersRequest(
                 group_id=group_id, peer_id=str(leader),
                 new_peers=[str(p) for p in new_conf.peers],
-                new_learners=[str(p) for p in new_conf.learners]))
+                new_learners=[str(p) for p in new_conf.learners],
+                new_witnesses=[str(p) for p in new_conf.witnesses]))
 
     async def reset_peers(self, group_id: str, peer: PeerId,
                           new_conf: Configuration) -> Status:
         """Directly reset one peer's conf (dangerous; quorum-loss recovery)."""
         resp = await self._transport.call(
             peer.endpoint, "cli_reset_peers",
-            ResetPeersRequest(group_id=group_id, peer_id=str(peer),
-                              new_peers=[str(p) for p in new_conf.peers],
-                              new_learners=[str(p) for p in new_conf.learners]),
+            ResetPeersRequest(
+                group_id=group_id, peer_id=str(peer),
+                new_peers=[str(p) for p in new_conf.peers],
+                new_learners=[str(p) for p in new_conf.learners],
+                new_witnesses=[str(p) for p in new_conf.witnesses]),
             self._opts.timeout_ms)
         return Status(resp.code, resp.msg)
 
@@ -365,7 +388,9 @@ class CliService:
         """
         if not balance_group_ids:
             return Status.OK()
-        peers = list(conf.peers)  # voters only — learners can't lead
+        # voters only — learners can't lead; witnesses vote but can
+        # never lead either, so they are not balancing targets
+        peers = [p for p in conf.peers if not conf.is_witness(p)]
         if not peers:
             return Status.error(RaftError.EINVAL, "empty conf")
         expected = (len(balance_group_ids) + len(peers) - 1) // len(peers)

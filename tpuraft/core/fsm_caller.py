@@ -46,6 +46,13 @@ class FSMCaller:
         self.on_configuration_applied: Optional[
             Callable[[LogEntry], Awaitable[None]]] = None
 
+    def replace_fsm(self, fsm: StateMachine) -> None:
+        """Witness adoption (Node._adopt_witness_mode): swap the user
+        FSM for the null witness FSM.  Runs on the node loop between
+        queue drains; events already queued simply land on the new FSM
+        — their payloads are stripped/irrelevant on a witness."""
+        self._fsm = fsm
+
     async def init(self, bootstrap_id: LogId) -> None:
         self.last_applied_index = bootstrap_id.index
         self.last_applied_term = bootstrap_id.term
@@ -252,7 +259,8 @@ class FSMCaller:
                 else:
                     if e.type == EntryType.CONFIGURATION:
                         conf = Configuration(list(e.peers or []),
-                                             list(e.learners or []))
+                                             list(e.learners or []),
+                                             list(e.witnesses or []))
                         try:
                             await self._fsm.on_configuration_committed(conf)
                         except Exception:

@@ -279,6 +279,9 @@ class Node:
         # high-priority nodes are dead
         self.target_priority: int = ElectionPriority.DISABLED  # guarded-by: _lock (writes)
         self._election_round: int = 0           # guarded-by: _lock (writes)
+        # priority RE-election (geo): consecutive stepdown-timer rounds a
+        # healthy higher-priority voter has been caught up and acking
+        self._priority_transfer_rounds: int = 0  # guarded-by: _lock (writes)
 
     # ======================================================================
     # lifecycle
@@ -287,6 +290,18 @@ class Node:
     # graftcheck: allow(guarded-by) — init-time: completes before any RPC handler or timer can race it
     async def init(self) -> bool:
         opts = self.options
+        if opts.initial_conf.is_witness(self.server_id):
+            # the operator's conf string flags THIS node '/witness'
+            # (e.g. --peers a,b,c/witness on a bare server): adopt the
+            # role without a separate flag — the conf is the truth
+            opts.witness = True
+        if opts.witness:
+            # a witness journals metadata only: whatever FSM the hosting
+            # engine wired (a KV store's) must never see the payload-
+            # stripped entries — shadow it with the null witness FSM
+            from tpuraft.core.state_machine import WitnessStateMachine
+
+            opts.fsm = WitnessStateMachine()
         # meta
         if opts.raft_meta_uri.startswith("file://"):
             self._meta = RaftMetaStorage(opts.raft_meta_uri[len("file://"):],
@@ -367,6 +382,12 @@ class Node:
             self.conf_entry = ConfigurationEntry(
                 LogId(0, 0), opts.initial_conf.copy())
 
+        if not opts.witness and (
+                self.conf_entry.conf.is_witness(self.server_id)
+                or self.conf_entry.old_conf.is_witness(self.server_id)):
+            # restart of a runtime-adopted witness whose operator did
+            # not pass the boot flag: the LOG's conf is the truth
+            self._adopt_witness_mode()
         self.ballot_box.update_conf(self.conf_entry.conf,
                                     self.conf_entry.old_conf)
         self._refresh_target_priority()
@@ -406,9 +427,11 @@ class Node:
 
         describer.register(self)
 
-        # single-voter group elects itself immediately
+        # single-voter group elects itself immediately (a witness never
+        # self-elects — it never campaigns at all)
         if (self.conf_entry.conf.peers == [self.server_id]
-                and self.conf_entry.old_conf.is_empty()):
+                and self.conf_entry.old_conf.is_empty()
+                and not opts.witness):
             async with self._lock:
                 await self._elect_self()
         return True
@@ -477,7 +500,8 @@ class Node:
             f"  commit: {self.ballot_box.last_committed_index}"
             f"  applied: {self.fsm_caller.last_applied_index}"
             f"  pending: {self.ballot_box.pending_index}",
-            f"  target_priority: {self.target_priority}",
+            f"  target_priority: {self.target_priority}"
+            + ("  witness: true" if self.options.witness else ""),
         ]
         rows = self.replicators.progress()
         if rows:
@@ -605,6 +629,12 @@ class Node:
                                     "membership change in progress")
             if not self.conf_entry.conf.contains(peer):
                 return Status.error(RaftError.EINVAL, f"{peer} not in conf")
+            if self.conf_entry.conf.is_witness(peer):
+                # a witness can never lead (metadata-only journal, null
+                # FSM) — refusing here keeps TimeoutNow from ever being
+                # aimed at one
+                return Status.error(
+                    RaftError.EINVAL, f"{peer} is a witness (cannot lead)")
             r = self.replicators.get(peer)
             if r is None:
                 return Status.error(RaftError.EINVAL, f"no replicator for {peer}")
@@ -692,18 +722,29 @@ class Node:
     # -- priority election [1.3+] ------------------------------------------
 
     def _refresh_target_priority(self) -> None:  # graftcheck: holds(_lock)
-        """Target = max priority among current voters (incl. self).
-        Reference: NodeImpl#getMaxPriorityOfNodes on conf / leader change."""
+        """Target = max priority among current DATA voters (incl. self).
+        Reference: NodeImpl#getMaxPriorityOfNodes on conf / leader change.
+        Witness voters are excluded: they never campaign, so their
+        priority raising the bar would only delay real candidates."""
+        witnesses = set(self.conf_entry.conf.witnesses) \
+            | set(self.conf_entry.old_conf.witnesses)
         prios = [p.priority for p in
-                 set(self.conf_entry.conf.peers)
-                 | set(self.conf_entry.old_conf.peers)
-                 | {self.server_id}]
+                 (set(self.conf_entry.conf.peers)
+                  | set(self.conf_entry.old_conf.peers)
+                  | {self.server_id}) - witnesses]
         self.target_priority = max(prios) if prios else ElectionPriority.DISABLED
         self._election_round = 0
 
     def _allow_launch_election(self) -> bool:  # graftcheck: holds(_lock)
         """Gate an election round by priority (reference:
         NodeImpl#allowLaunchElection).  Caller holds the lock."""
+        if self.options.witness:
+            # a witness NEVER campaigns (the NOT_ELECTED contract): it
+            # holds no payloads, so leading would serve reads/commits
+            # from a metadata-only journal.  Witness-majority partitions
+            # therefore can never elect, hence never commit — the
+            # witness-safety property tests/test_witness.py proves.
+            return False
         prio = self.server_id.priority
         if prio == ElectionPriority.DISABLED:
             return True
@@ -927,6 +968,8 @@ class Node:
             learners=list(self.conf_entry.conf.learners) or None,
             old_peers=list(self.conf_entry.old_conf.peers) or None,
             old_learners=list(self.conf_entry.old_conf.learners) or None,
+            witnesses=list(self.conf_entry.conf.witnesses) or None,
+            old_witnesses=list(self.conf_entry.old_conf.witnesses) or None,
         )
         term = self.current_term
         last_id = self.log_manager.stage_leader_entries([conf_entry], term)
@@ -1014,6 +1057,51 @@ class Node:
                     self.current_term,
                     Status.error(RaftError.ERAFTTIMEDOUT,
                                  "quorum unreachable within election timeout"))
+                return
+            self._maybe_priority_transfer()
+
+    def _maybe_priority_transfer(self) -> None:  # graftcheck: holds(_lock)
+        """Priority RE-election (geo): a leader elected via target-
+        priority decay (its zone's high-priority nodes were dead) hands
+        leadership BACK once a higher-priority voter is healthy again —
+        alive, caught up through the commit point, for
+        ``priority_transfer_rounds`` consecutive stepdown-timer rounds.
+        Leadership returns to the preferred (traffic-local) zone after
+        it heals instead of sticking wherever the decay left it."""
+        rounds = self.options.raft_options.priority_transfer_rounds
+        my = self.server_id.priority
+        if (rounds <= 0 or my == ElectionPriority.DISABLED
+                or self.state != State.LEADER
+                or self._conf_ctx is not None
+                or not self.conf_entry.old_conf.is_empty()):
+            self._priority_transfer_rounds = 0
+            return
+        conf = self.conf_entry.conf
+        witnesses = set(conf.witnesses)
+        candidates = [p for p in conf.peers
+                      if p != self.server_id and p.priority > my
+                      and p not in witnesses]
+        if not candidates:
+            self._priority_transfer_rounds = 0
+            return
+        best = max(candidates, key=lambda p: p.priority)
+        alive = set(self._ctrl.alive_peers())
+        r = self.replicators.get(best)
+        if (best not in alive or r is None
+                or r.match_index < self.ballot_box.last_committed_index):
+            self._priority_transfer_rounds = 0
+            return
+        self._priority_transfer_rounds += 1
+        if self._priority_transfer_rounds < rounds:
+            return
+        self._priority_transfer_rounds = 0
+        LOG.info("%s priority re-election: transferring leadership to "
+                 "higher-priority %s", self, best)
+        self.metrics.counter("priority-transfers")
+        # transfer_leadership_to takes the node lock itself — schedule it
+        # (it re-validates leadership/conf state under the lock)
+        t = asyncio.ensure_future(self.transfer_leadership_to(best))
+        t.add_done_callback(lambda tt: tt.cancelled() or tt.exception())
 
     def leader_lease_is_valid(self) -> bool:
         """For LEASE_BASED reads: a quorum acked within lease window."""
@@ -1180,9 +1268,18 @@ class Node:
 
             if self._note_append_start is not None:
                 self._note_append_start(req.term)
+            entries = list(req.entries)
+            if self.options.witness:
+                # metadata-only journal: strip any payload that still
+                # arrived full (a mixed-fleet leader that predates
+                # witness-aware stripping) — CRC-verify the wire blob
+                # FIRST so a corrupt frame can't journal bad metadata
+                from tpuraft.entity import strip_entry_payload
+
+                entries = [strip_entry_payload(e) for e in entries]
             try:
                 ok = await lm.append_entries_follower(
-                    req.prev_log_index, req.prev_log_term, list(req.entries))
+                    req.prev_log_index, req.prev_log_term, entries)
             except RaftException as e:
                 # conflict below the applied index: this replica's state
                 # machine has diverged from the leader's committed log —
@@ -1242,6 +1339,30 @@ class Node:
         self.conf_entry = entry
         self.ballot_box.update_conf(entry.conf, entry.old_conf)
         self._refresh_target_priority()
+        if not self.options.witness and (
+                entry.conf.is_witness(self.server_id)
+                or entry.old_conf.is_witness(self.server_id)):
+            self._adopt_witness_mode()
+
+    def _adopt_witness_mode(self) -> None:  # graftcheck: holds(_lock)
+        """The committed conf flags THIS node a witness but it was not
+        booted as one (runtime ``add-witness`` against a plain-booted
+        node): adopt the role now — swap in the null FSM and raise the
+        flag every witness gate (campaign / TimeoutNow / reads)
+        consults.  Whatever the real FSM applied during catch-up
+        (payload-stripped entries) is quarantined: witness state is
+        never served, and a witness can never be elected over, so the
+        divergence is unobservable.  Prefer booting the process with
+        the '/witness' conf suffix so the role holds from the first
+        applied entry."""
+        from tpuraft.core.state_machine import WitnessStateMachine
+
+        LOG.warning("%s adopting WITNESS mode from the committed conf "
+                    "(boot flag was missing — start this node with a "
+                    "'/witness' peer suffix)", self)
+        self.options.witness = True
+        self.options.fsm = WitnessStateMachine()
+        self.fsm_caller.replace_fsm(self.options.fsm)
 
     async def handle_timeout_now(self, req: TimeoutNowRequest
                                  ) -> TimeoutNowResponse:
@@ -1250,6 +1371,11 @@ class Node:
         async with self._lock:
             if req.term != self.current_term or self.state != State.FOLLOWER:
                 return TimeoutNowResponse(term=self.current_term, success=False)
+            if self.options.witness:
+                # never campaigns — even on an explicit transfer nudge
+                # (a mixed-fleet leader that missed the witness flag)
+                return TimeoutNowResponse(term=self.current_term,
+                                          success=False)
             await self._elect_self()
             return TimeoutNowResponse(term=self.current_term, success=True)
 
@@ -1281,11 +1407,13 @@ class Node:
     # membership change (reference: ConfigurationCtx — SURVEY.md §3.1)
     # ======================================================================
 
-    async def add_peer(self, peer: PeerId) -> Status:
+    async def add_peer(self, peer: PeerId, witness: bool = False) -> Status:
         new_conf = self.conf_entry.conf.copy()
         if new_conf.contains(peer):
             return Status.error(RaftError.EEXISTS, f"{peer} already in conf")
         new_conf.peers.append(peer)
+        if witness:
+            new_conf.witnesses.append(peer)
         return await self.change_peers(new_conf)
 
     async def remove_peer(self, peer: PeerId) -> Status:
@@ -1293,7 +1421,22 @@ class Node:
         if not new_conf.contains(peer):
             return Status.error(RaftError.ENOENT, f"{peer} not in conf")
         new_conf.peers.remove(peer)
+        if peer in new_conf.witnesses:
+            new_conf.witnesses.remove(peer)
         return await self.change_peers(new_conf)
+
+    def peer_is_witness(self, peer: PeerId) -> bool:
+        """Is ``peer`` a witness in the current conf OR in an in-flight
+        membership change's target conf?  The ctx check matters during
+        CATCHING_UP: a freshly added witness is not in conf yet, but its
+        catch-up stream must already be payload-stripped — shipping the
+        full log to a metadata-only replica wastes exactly the WAN
+        bytes witnesses exist to save."""
+        e = self.conf_entry
+        if e.conf.is_witness(peer) or e.old_conf.is_witness(peer):
+            return True
+        ctx = self._conf_ctx
+        return ctx is not None and ctx.new_conf.is_witness(peer)
 
     async def add_learners(self, learners: list[PeerId]) -> Status:
         new_conf = self.conf_entry.conf.copy()
@@ -1329,6 +1472,20 @@ class Node:
                     f"(stage={self._conf_ctx.stage}); retry")
             if not new_conf.is_valid():
                 return Status.error(RaftError.EINVAL, f"invalid conf {new_conf}")
+            cur = self.conf_entry.conf
+            converted = [p for p in new_conf.peers if cur.contains(p)
+                         and cur.is_witness(p) != new_conf.is_witness(p)]
+            if converted:
+                # in-place witness<->data conversion is UNSAFE both
+                # ways: a witness promoted to data voter serves from a
+                # payload-less journal; a data voter demoted to witness
+                # keeps a stale full journal the commit clamp would
+                # trust.  Remove, wipe, re-add in the new role.
+                return Status.error(
+                    RaftError.EINVAL,
+                    f"in-place witness/data role conversion of "
+                    f"{[str(p) for p in converted]}: remove the peer, "
+                    f"wipe its storage, then re-add it in the new role")
             if new_conf == self.conf_entry.conf:
                 return Status.OK()
             ctx = _ConfigurationCtx(self, self.conf_entry.conf.copy(), new_conf)
@@ -1546,6 +1703,9 @@ class _ConfigurationCtx:
             learners=list(self.new_conf.learners) or None,
             old_learners=(list(self.old_conf.learners) or None)
             if in_joint else None,
+            witnesses=list(self.new_conf.witnesses) or None,
+            old_witnesses=(list(self.old_conf.witnesses) or None)
+            if in_joint else None,
         )
         term = node.current_term
         last_id = node.log_manager.stage_leader_entries([entry], term)
@@ -1572,6 +1732,7 @@ class _ConfigurationCtx:
                     type=EntryType.CONFIGURATION,
                     peers=list(self.new_conf.peers),
                     learners=list(self.new_conf.learners) or None,
+                    witnesses=list(self.new_conf.witnesses) or None,
                 )
                 term = node.current_term
                 last_id = node.log_manager.stage_leader_entries([stable], term)

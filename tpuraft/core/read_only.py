@@ -53,6 +53,14 @@ class ReadOnlyService:
         FSM has applied through I.  Reading local state after this is
         linearizable."""
         node = self._node
+        if node.options.witness:
+            # a witness is NEVER a read target: its FSM holds no state
+            # (payload-stripped journal), so a "linearizable" local read
+            # would return nothing at all.  Clients route reads to data
+            # replicas; this guard catches whatever slips through.
+            raise _read_error(
+                RaftError.EPERM,
+                "witness replica stores no state (not a read target)")
         if node.is_leader():
             idx = await self.leader_confirm_read_index()
         else:

@@ -34,7 +34,7 @@ import logging
 import time
 from typing import Optional
 
-from tpuraft.entity import PeerId
+from tpuraft.entity import PeerId, strip_entry_payload
 from tpuraft.errors import RaftError
 from tpuraft.rpc.messages import (
     AppendEntriesRequest,
@@ -215,8 +215,20 @@ class Replicator:
                                          ropts.max_body_size)
                 if not entries:
                     break
-                reqs.append(self._build_request(prev_index, prev_term,
-                                                entries))
+                if self._peer_is_witness():
+                    # payload-stripped appends: the witness journals
+                    # (index, term) only — a geo witness costs metadata
+                    # bytes on the WAN, not the full log stream
+                    stripped = [strip_entry_payload(e) for e in entries]
+                    saved = sum(len(e.data) for e in entries)
+                    if saved:
+                        node.metrics.counter("witness-stripped-bytes",
+                                             saved)
+                    reqs.append(self._build_request(prev_index, prev_term,
+                                                    stripped))
+                else:
+                    reqs.append(self._build_request(prev_index, prev_term,
+                                                    entries))
                 self._inflight.append((prev_index, len(entries),
                                        node.current_term))
                 next_index += len(entries)
@@ -229,6 +241,9 @@ class Replicator:
             self.inflight_peak = len(self._inflight)
         self._pending = True
         self._sender.submit_append(self, reqs)
+
+    def _peer_is_witness(self) -> bool:
+        return self._node.peer_is_witness(self.peer)
 
     def _build_request(self, prev_index: int, prev_term: int,
                        entries: list) -> AppendEntriesRequest:

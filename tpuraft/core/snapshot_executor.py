@@ -129,6 +129,8 @@ class SnapshotExecutor:
                 old_peers=[str(p) for p in conf_entry.old_conf.peers],
                 learners=[str(p) for p in conf_entry.conf.learners],
                 old_learners=[str(p) for p in conf_entry.old_conf.learners],
+                witnesses=[str(p) for p in conf_entry.conf.witnesses],
+                old_witnesses=[str(p) for p in conf_entry.old_conf.witnesses],
             )
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self._storage.commit, writer, meta)
@@ -226,8 +228,47 @@ class SnapshotExecutor:
         finally:
             self.installing = False
 
+    async def _load_committed_install(self, meta: SnapshotMeta,
+                                      path: str) -> bool:
+        """Shared tail of BOTH install paths (full file copy and the
+        witness meta-only skip): load the committed snapshot dir into
+        the FSM queue, then adopt id/conf/commit under the node lock —
+        one copy of the state-mutation protocol, so a future change
+        cannot drift between the two."""
+        node = self._node
+        reader = SnapshotReader(path)
+        fut = await node.fsm_caller.on_snapshot_load(reader)
+        if not await fut:
+            LOG.error("%s on_snapshot_load failed during install", node)
+            return False
+        snap_id = LogId(meta.last_included_index, meta.last_included_term)
+        self.last_snapshot_id = snap_id
+        conf = _conf_from_meta(meta)
+        async with node._lock:
+            await node.log_manager.set_snapshot(snap_id, conf)
+            node.conf_entry = conf
+            node.ballot_box.update_conf(conf.conf, conf.old_conf)
+            node.ballot_box.set_last_committed_index(snap_id.index)
+        node.metrics.counter("install-snapshot-received")
+        LOG.info("%s loaded installed snapshot at %s", node, snap_id)
+        return True
+
     async def _do_install(self, req: InstallSnapshotRequest) -> bool:
         node = self._node
+        loop = asyncio.get_running_loop()
+        if node.options.witness:
+            # WITNESS SKIP: a witness holds no FSM state, so there is
+            # nothing to download — commit an EMPTY local snapshot at
+            # the leader's meta (the compaction point + conf), load it
+            # through the null FSM (advances the applied index), and
+            # reset the metadata journal there.  A lagging geo witness
+            # catches up in one meta-sized RPC instead of a full state
+            # transfer over the WAN.
+            writer = self._storage.create()
+            path = await loop.run_in_executor(
+                None, self._storage.commit, writer, req.meta)
+            node.metrics.counter("install-snapshot-witness-skips")
+            return await self._load_committed_install(req.meta, path)
         # parse uri: remote://<endpoint>/<reader_id>
         rest = req.uri[len("remote://"):]
         endpoint, _, rid = rest.partition("/")
@@ -273,26 +314,9 @@ class SnapshotExecutor:
         except (RpcError, ValueError, IOError) as e:
             LOG.warning("%s snapshot copy failed: %s", node, e)
             return False
-        loop = asyncio.get_running_loop()
         path = await loop.run_in_executor(
             None, self._storage.commit, writer, meta)
-        reader = SnapshotReader(path)
-        fut = await node.fsm_caller.on_snapshot_load(reader)
-        ok = await fut
-        if not ok:
-            LOG.error("%s on_snapshot_load failed during install", node)
-            return False
-        snap_id = LogId(meta.last_included_index, meta.last_included_term)
-        self.last_snapshot_id = snap_id
-        conf = _conf_from_meta(meta)
-        async with node._lock:
-            await node.log_manager.set_snapshot(snap_id, conf)
-            node.conf_entry = conf
-            node.ballot_box.update_conf(conf.conf, conf.old_conf)
-            node.ballot_box.set_last_committed_index(snap_id.index)
-        node.metrics.counter("install-snapshot-received")
-        LOG.info("%s loaded installed snapshot at %s", node, snap_id)
-        return True
+        return await self._load_committed_install(meta, path)
 
 
 class _ChunkAdapter:
@@ -332,8 +356,10 @@ def _conf_from_meta(meta: SnapshotMeta) -> ConfigurationEntry:
         id=LogId(meta.last_included_index, meta.last_included_term),
         conf=Configuration(
             [PeerId.parse(p) for p in meta.peers],
-            [PeerId.parse(p) for p in meta.learners]),
+            [PeerId.parse(p) for p in meta.learners],
+            [PeerId.parse(p) for p in meta.witnesses]),
         old_conf=Configuration(
             [PeerId.parse(p) for p in meta.old_peers],
-            [PeerId.parse(p) for p in meta.old_learners]),
+            [PeerId.parse(p) for p in meta.old_learners],
+            [PeerId.parse(p) for p in meta.old_witnesses]),
     )

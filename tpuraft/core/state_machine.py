@@ -111,3 +111,28 @@ class StateMachine:
 
 # the reference ships an adapter with no-op defaults; ours IS the base class
 StateMachineAdapter = StateMachine
+
+
+# graftcheck: loop-confined — FSMCaller runs every callback serialized
+# on the node's event loop
+class WitnessStateMachine(StateMachine):
+    """The null FSM a WITNESS node runs: a witness journals log
+    METADATA only (its incoming appends are payload-stripped), so there
+    is nothing to apply and nothing to snapshot — the applied index
+    still advances through the FSMCaller (commit bookkeeping, log
+    compaction), and snapshots commit empty so prefix truncation keeps
+    the metadata journal bounded.  ``Node.init`` installs this
+    automatically when ``NodeOptions.witness`` is set, shadowing
+    whatever FSM the hosting engine wired (a KV store's FSM applying a
+    stripped entry would corrupt state)."""
+
+    async def on_apply(self, it: Iterator) -> None:
+        while it.valid():      # consume: payloads were stripped upstream
+            it.next()
+
+    async def on_snapshot_save(self, writer, done: Callable[[Status], None]
+                               ) -> None:
+        done(Status.OK())      # empty snapshot: meta-only compaction point
+
+    async def on_snapshot_load(self, reader) -> bool:
+        return True            # nothing to load; meta advances the log

@@ -136,6 +136,14 @@ class LogEntry:
     old_peers: Optional[list[PeerId]] = None
     learners: Optional[list[PeerId]] = None
     old_learners: Optional[list[PeerId]] = None
+    # witness voters (subset of peers/old_peers) — TRAILING extension of
+    # the peers blob: entries without witnesses encode bit-identically
+    # to the pre-witness format, and an old decoder reading a
+    # witness-bearing entry ignores the trailing lists (the witness
+    # degrades to a plain voter on old replicas — safe: quorum math is
+    # identical, only the payload-stripping optimization is lost)
+    witnesses: Optional[list[PeerId]] = None
+    old_witnesses: Optional[list[PeerId]] = None
 
     # -- codec ---------------------------------------------------------------
 
@@ -149,11 +157,13 @@ class LogEntry:
         if cached is not None and cached[0] == self.id:
             return cached[1]
         if (self.peers is None and self.old_peers is None
-                and self.learners is None and self.old_learners is None):
+                and self.learners is None and self.old_learners is None
+                and self.witnesses is None and self.old_witnesses is None):
             peers_blob = b""  # DATA/NO_OP fast path (the hot case)
         else:
             peers_blob = _encode_peer_lists(
-                self.peers, self.old_peers, self.learners, self.old_learners
+                self.peers, self.old_peers, self.learners,
+                self.old_learners, self.witnesses, self.old_witnesses
             )
         crc = zlib.crc32(self.data)
         crc = zlib.crc32(peers_blob, crc)
@@ -200,12 +210,13 @@ class LogEntry:
             peers_blob = raw[off: off + peers_len]
             if verify and zlib.crc32(peers_blob, zlib.crc32(data)) != crc:
                 raise ValueError(f"log entry crc mismatch at index {index}")
-            peers, old_peers, learners, old_learners = \
-                _decode_peer_lists(peers_blob)
+            (peers, old_peers, learners, old_learners,
+             witnesses, old_witnesses) = _decode_peer_lists(peers_blob)
         else:
             if verify and zlib.crc32(data) != crc:
                 raise ValueError(f"log entry crc mismatch at index {index}")
             peers = old_peers = learners = old_learners = None
+            witnesses = old_witnesses = None
         # direct construction (object.__new__): the dataclass __init__'s
         # 7-kwarg dispatch was measurable at replication rates
         etype_m = _ETYPES.get(etype)
@@ -223,6 +234,8 @@ class LogEntry:
         e.old_peers = old_peers
         e.learners = learners
         e.old_learners = old_learners
+        e.witnesses = witnesses
+        e.old_witnesses = old_witnesses
         # pre-seed the encode cache with the exact source blob: the
         # entry re-encodes bit-identically (follower staging to the
         # journal, leader fan-out) without paying the codec again
@@ -260,7 +273,9 @@ class LogEntry:
 
     def encoded_size(self) -> int:
         return _HDR.size + len(
-            _encode_peer_lists(self.peers, self.old_peers, self.learners, self.old_learners)
+            _encode_peer_lists(self.peers, self.old_peers, self.learners,
+                               self.old_learners, self.witnesses,
+                               self.old_witnesses)
         ) + len(self.data)
 
     def is_configuration(self) -> bool:
@@ -268,8 +283,16 @@ class LogEntry:
 
 
 def _encode_peer_lists(*lists: Optional[list[PeerId]]) -> bytes:
+    """Encode up to 6 peer lists (peers, old_peers, learners,
+    old_learners[, witnesses, old_witnesses]).  The witness pair is a
+    TRAILING extension: omitted entirely when both are None, so
+    witness-free entries keep the exact pre-witness byte format (old
+    decoders read 4 lists and ignore any trailing bytes)."""
     if all(l is None for l in lists):
         return b""
+    base, tail = lists[:4], lists[4:]
+    if all(l is None for l in tail):
+        lists = base
     out = bytearray()
     for l in lists:
         if l is None:
@@ -284,10 +307,14 @@ def _encode_peer_lists(*lists: Optional[list[PeerId]]) -> bytes:
 
 def _decode_peer_lists(blob: bytes):
     if not blob:
-        return None, None, None, None
+        return None, None, None, None, None, None
     lists: list[Optional[list[PeerId]]] = []
     off = 0
-    for _ in range(4):
+    for _ in range(6):
+        if len(lists) >= 4 and off >= len(blob):
+            # pre-witness entry: trailing lists default to None
+            lists.append(None)
+            continue
         (n,) = struct.unpack_from("<h", blob, off)
         off += 2
         if n < 0:
@@ -301,6 +328,20 @@ def _decode_peer_lists(blob: bytes):
             off += slen
         lists.append(cur)
     return tuple(lists)  # type: ignore[return-value]
+
+
+def strip_entry_payload(e: LogEntry) -> LogEntry:
+    """Witness replication: a DATA entry's payload is replaced by an
+    empty body, keeping (index, term) — the witness's metadata-only
+    journal stores exactly what elections and quorum intersection need.
+    CONFIGURATION entries (and their peer lists) pass through whole:
+    membership IS metadata.  The wire blob's deferred CRC is verified
+    first, so a corrupt frame cannot launder bad metadata into the
+    journal via the strip."""
+    if e.type != EntryType.DATA or not e.data:
+        return e
+    e.verify_crc()
+    return LogEntry(type=e.type, id=e.id, data=b"")
 
 
 @dataclass

@@ -77,6 +77,13 @@ class RaftOptions:
     # by after a node skips consecutive election rounds (reference:
     # RaftOptions#decayPriorityGap)
     decay_priority_gap: int = 10
+    # priority RE-election (geo): a leader whose own priority sits below
+    # a healthy higher-priority voter's hands leadership back once that
+    # voter has been caught up and acking for this many consecutive
+    # step-down-timer rounds — so leadership returns to the preferred
+    # zone after it heals instead of sticking where the decay left it.
+    # 0 disables.  Only engages when the leader's priority is ENABLED.
+    priority_transfer_rounds: int = 2
     # lease safety margin: leader lease = election_timeout * ratio
     leader_lease_time_ratio: float = 0.9
 
@@ -170,6 +177,11 @@ class NodeOptions:
     snapshot_uri: str = ""       # empty = snapshots disabled
     disable_cli: bool = False
     enable_metrics: bool = True
+    # witness replica: this node votes and acks appends but stores log
+    # METADATA only (payload-stripped entries, null FSM, never
+    # campaigns, never serves reads).  Set automatically by StoreEngine
+    # when the node's own peer is '/witness'-flagged in the region conf.
+    witness: bool = False
     catchup_margin: int = 1000   # membership-change catch-up threshold (entries)
     raft_options: RaftOptions = field(default_factory=RaftOptions)
     tick: TickOptions = field(default_factory=TickOptions)

@@ -294,7 +294,10 @@ class RheaKVStore:
         camp on one store) — same coverage contract as _endpoints_for:
         one attempt cycle must be able to reach the real leader even
         when the cached hint is stale."""
-        voters = [p for p in region.peers if not p.endswith("/learner")]
+        # witnesses can never lead: probing one as a leader candidate is
+        # a guaranteed EPERM bounce (they forward nothing)
+        voters = [p for p in region.peers if not p.endswith("/learner")
+                  and not p.endswith("/witness")]
         if not voters:
             return [region.peers[0]] if region.peers else []
         k = attempt % len(voters)
@@ -542,10 +545,13 @@ class RheaKVStore:
 
         Learner replicas (``/learner``-suffixed peers — read-only, never
         leaders) go last: they can only serve by forwarding, so they are
-        a fallback when no voter answers, not a first hop.
+        a fallback when no voter answers, not a first hop.  Witness
+        voters (``/witness``) are skipped entirely: they never lead and
+        hold no data to serve or forward from.
         """
         eps = []
-        voters = [p for p in region.peers if not p.endswith("/learner")]
+        voters = [p for p in region.peers if not p.endswith("/learner")
+                  and not p.endswith("/witness")]
         leader = self._leaders.get(region.id)
         if leader and leader in voters:
             eps.append(leader)
@@ -554,9 +560,10 @@ class RheaKVStore:
         return eps
 
     def _read_endpoints_for(self, region: Region) -> list[str]:
-        """Round-robin over ALL replicas (voters, learners, leader alike)
-        for read-only ops under read_preference='any'."""
-        peers = list(region.peers)
+        """Round-robin over the DATA replicas (voters, learners, leader
+        alike) for read-only ops under read_preference='any' — witness
+        replicas hold no state and are never read targets."""
+        peers = [p for p in region.peers if not p.endswith("/witness")]
         cur = self._read_rr.get(region.id, region.id)
         self._read_rr[region.id] = cur + 1
         return [peers[(cur + i) % len(peers)] for i in range(len(peers))]

@@ -94,11 +94,16 @@ class Region:
 
 @dataclass
 class StoreMeta:
-    """One storage process: endpoint + the regions it hosts."""
+    """One storage process: endpoint + the regions it hosts.
+
+    ``zone`` is the store's failure-domain label (geo deployment):
+    the PD spreads leaders across zones and operators place witnesses
+    by it.  Empty = unlabeled (single-zone legacy deployments)."""
 
     id: int = 0
     endpoint: str = ""
     regions: list[Region] = field(default_factory=list)
+    zone: str = ""
 
 
 @dataclass

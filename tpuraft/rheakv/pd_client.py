@@ -54,7 +54,8 @@ class PlacementDriverClient:
         legacy PD leader would stay cold forever (it cannot ask for a
         resync the way the batch protocol can)."""
         meta = StoreMeta(id=meta.id, endpoint=meta.endpoint,
-                         regions=[r.copy() for (r, _l, _k) in deltas])
+                         regions=[r.copy() for (r, _l, _k) in deltas],
+                         zone=meta.zone)
         await self.store_heartbeat(meta)
         instructions: list = []
         for region, leader, keys in deltas:
@@ -147,10 +148,10 @@ class RemotePlacementDriverClient(PlacementDriverClient):
         for blob in resp.stores:
             import struct
 
-            (sid,) = struct.unpack_from("<q", blob, 0)
-            (n,) = struct.unpack_from("<H", blob, 8)
-            ep = bytes(blob[10:10 + n]).decode()
-            out.append(StoreMeta(id=sid, endpoint=ep))
+            from tpuraft.rheakv.pd_messages import decode_store_meta
+
+            sid, ep, zone = decode_store_meta(blob)
+            out.append(StoreMeta(id=sid, endpoint=ep, zone=zone))
         return out
 
     async def report_split(self, parent: Region, child: Region) -> None:
@@ -164,7 +165,8 @@ class RemotePlacementDriverClient(PlacementDriverClient):
 
         await self._call("pd_store_heartbeat", StoreHeartbeatRequest(
             store_id=meta.id, endpoint=meta.endpoint,
-            regions=[r.encode() for r in meta.regions]))
+            regions=[r.encode() for r in meta.regions],
+            zone=meta.zone))
 
     async def region_heartbeat(self, region: Region, leader: str,
                                metrics: Optional[dict] = None) -> list:
@@ -195,7 +197,7 @@ class RemotePlacementDriverClient(PlacementDriverClient):
             store_id=meta.id, endpoint=meta.endpoint,
             deltas=[encode_region_delta(r.encode(), leader, keys)
                     for (r, leader, keys) in deltas],
-            full=full)
+            full=full, zone=meta.zone)
         try:
             resp = await self._call("pd_store_heartbeat_batch", req)
         except RpcError as e:

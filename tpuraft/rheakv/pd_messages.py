@@ -53,6 +53,9 @@ class StoreHeartbeatRequest:
     store_id: int
     endpoint: str
     regions: list[bytes] = field(default_factory=list)  # Region encodings
+    # trailing extension (geo): the store's zone label; old senders
+    # decode to "" (unlabeled)
+    zone: str = ""
 
 
 @_pd(145)
@@ -117,6 +120,8 @@ class StoreHeartbeatBatchRequest:
     # approximate keys, Region encoding)
     deltas: list[bytes] = field(default_factory=list)
     full: bool = False
+    # trailing extension (geo): the store's zone label
+    zone: str = ""
 
 
 @_pd(153)
@@ -172,6 +177,28 @@ class Instruction:
         return Instruction(kind, rid, nrid, blob[19:19 + n].decode())
 
 
-def encode_store_meta(store_id: int, endpoint: str) -> bytes:
+def encode_store_meta(store_id: int, endpoint: str, zone: str = "") -> bytes:
+    """Store-meta blob; the zone block is a TRAILING extension written
+    only when a zone is set, so zoneless metas keep the old byte format
+    and old decoders ignore a labeled meta's tail (each meta travels as
+    its own length-delimited blob, so trailing bytes are safe)."""
     ep = endpoint.encode()
-    return struct.pack("<q", store_id) + struct.pack("<H", len(ep)) + ep
+    out = struct.pack("<q", store_id) + struct.pack("<H", len(ep)) + ep
+    if zone:
+        zb = zone.encode()
+        out += struct.pack("<H", len(zb)) + zb
+    return out
+
+
+def decode_store_meta(blob: bytes) -> tuple[int, str, str]:
+    """Returns (store_id, endpoint, zone); zone defaults to "" for
+    pre-zone blobs (tolerant trailing decode)."""
+    (sid,) = struct.unpack_from("<q", blob, 0)
+    (n,) = struct.unpack_from("<H", blob, 8)
+    ep = bytes(blob[10:10 + n]).decode()
+    off = 10 + n
+    zone = ""
+    if off + 2 <= len(blob):
+        (zn,) = struct.unpack_from("<H", blob, off)
+        zone = bytes(blob[off + 2:off + 2 + zn]).decode()
+    return sid, ep, zone

@@ -44,6 +44,7 @@ from tpuraft.rheakv.pd_messages import (
     ReportSplitResponse,
     StoreHeartbeatRequest,
     StoreHeartbeatResponse,
+    decode_store_meta,
     encode_store_meta,
 )
 
@@ -67,6 +68,23 @@ def _cmd(kind: int, payload: bytes = b"") -> bytes:
 class _StoreRecord:
     store_id: int
     endpoint: str
+    zone: str = ""   # geo failure-domain label ("" = unlabeled)
+
+
+def _peer_endpoint(peer_str: str) -> str:
+    """Peer string ('ip:port[:idx[:prio]][/learner|/witness]') -> endpoint."""
+    return ":".join(peer_str.split("/", 1)[0].split(":")[:2])
+
+
+def zone_leader_histogram(region_leaders: dict[int, str],
+                          zones: dict[str, str]) -> dict[str, int]:
+    """Leaders per zone — computed ONCE per heartbeat batch and shared
+    across every pick_transfer_target call in the request."""
+    counts: dict[str, int] = {}
+    for ep in region_leaders.values():
+        z = zones.get(_peer_endpoint(ep), "")
+        counts[z] = counts.get(z, 0) + 1
+    return counts
 
 
 class PDMetadataFSM(StateMachine):
@@ -105,10 +123,8 @@ class PDMetadataFSM(StateMachine):
         (kind,) = struct.unpack_from("<B", data, 0)
         payload = data[1:]
         if kind == _CMD_STORE_UPSERT:
-            (sid,) = struct.unpack_from("<q", payload, 0)
-            (n,) = struct.unpack_from("<H", payload, 8)
-            ep = payload[10:10 + n].decode()
-            self.stores[ep] = _StoreRecord(sid, ep)
+            sid, ep, zone = decode_store_meta(payload)
+            self.stores[ep] = _StoreRecord(sid, ep, zone)
             return True
         if kind == _CMD_REGION_UPSERT:
             (ln,) = struct.unpack_from("<H", payload, 0)
@@ -171,6 +187,16 @@ class PDMetadataFSM(StateMachine):
         out += struct.pack("<I", len(self.pending_splits))
         for parent_id, child_id in self.pending_splits.items():
             out += struct.pack("<qq", parent_id, child_id)
+        # trailing (geo zones) — absent in pre-zone snapshots; store
+        # records above stay in the legacy zoneless format so old
+        # readers parse the stream unchanged
+        zoned = [(ep, rec.zone) for ep, rec in self.stores.items()
+                 if rec.zone]
+        out += struct.pack("<I", len(zoned))
+        for ep, zone in zoned:
+            epb, zb = ep.encode(), zone.encode()
+            out += struct.pack("<H", len(epb)) + epb
+            out += struct.pack("<H", len(zb)) + zb
         writer.write_file("pd_meta", bytes(out))
         done(Status.OK())
 
@@ -216,6 +242,20 @@ class PDMetadataFSM(StateMachine):
                 parent_id, child_id = struct.unpack_from("<qq", buf, off)
                 off += 16
                 self.pending_splits[parent_id] = child_id
+        if off + 4 <= len(buf):  # absent in pre-zone snapshots
+            (nz,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            for _ in range(nz):
+                (en,) = struct.unpack_from("<H", buf, off)
+                off += 2
+                ep = bytes(buf[off:off + en]).decode()
+                off += en
+                (zn,) = struct.unpack_from("<H", buf, off)
+                off += 2
+                zone = bytes(buf[off:off + zn]).decode()
+                off += zn
+                if ep in self.stores:
+                    self.stores[ep].zone = zone
         return True
 
 
@@ -279,13 +319,21 @@ class ClusterStatsManager:
 
     def pick_transfer_target(self, region: Region, leader_ep: str,
                              region_leaders: dict[int, str],
-                             cooldown_s: float) -> Optional[str]:
+                             cooldown_s: float,
+                             zones: Optional[dict[str, str]] = None,
+                             zone_counts: Optional[dict[str, int]] = None
+                             ) -> Optional[str]:
         """If ``leader_ep`` leads at least 2 more regions than the
         least-loaded peer of ``region``, return that peer as the
         transfer target (with a per-region cooldown so one imbalance
         doesn't spray repeated transfers).  Ties between equally-loaded
-        targets break on a per-region hash so concurrent decisions
-        spread across stores instead of herding onto the first one.
+        targets break FIRST on zone leader counts when store zone
+        labels are known (``zones``: endpoint -> zone) — leaders spread
+        across failure domains, not just across stores — then on a
+        per-region hash so concurrent decisions spread across stores
+        instead of herding onto the first one.  Witness replicas
+        (``/witness``-suffixed peers) can never lead and are never
+        targets, like learners.
 
         Decisions overlay the PENDING moves this manager already
         ordered but has not yet observed in ``region_leaders`` —
@@ -314,13 +362,26 @@ class ClusterStatsManager:
                 counts[src] = counts.get(src, 0) - 1
                 counts[dst] = counts.get(dst, 0) + 1
         my = counts.get(leader_ep, 0)
-        # learners are read-only replicas — never leadership targets
+        # learners are read-only replicas and witnesses hold no payload
+        # — neither can lead, so neither is a leadership target
         candidates = [p for p in region.peers
-                      if p != leader_ep and not p.endswith("/learner")]
+                      if p != leader_ep and not p.endswith("/learner")
+                      and not p.endswith("/witness")]
         if not candidates:
             return None
+        if zones and zone_counts is None:
+            # single-region path builds its own histogram; the BATCH
+            # heartbeat precomputes it once per request (an O(regions)
+            # scan here per region made the batch pass O(regions^2))
+            zone_counts = zone_leader_histogram(region_leaders, zones)
+
+        def zone_load(p: str) -> int:
+            if not zones:
+                return 0
+            return zone_counts.get(zones.get(_peer_endpoint(p), ""), 0)
+
         target = min(candidates,
-                     key=lambda p: (counts.get(p, 0),
+                     key=lambda p: (counts.get(p, 0), zone_load(p),
                                     hash((region.id, p)) & 0xffff))
         if my - counts.get(target, 0) < 2:
             return None
@@ -465,7 +526,7 @@ class PlacementDriverServer:
             return self._not_leader(ListStoresResponse)
         await node.read_index()
         return ListStoresResponse(
-            stores=[encode_store_meta(r.store_id, r.endpoint)
+            stores=[encode_store_meta(r.store_id, r.endpoint, r.zone)
                     for r in self.fsm.stores.values()])
 
     def _region_changed(self, region: Region, leader: str = "") -> bool:
@@ -488,11 +549,13 @@ class PlacementDriverServer:
         await self._maybe_seed()
         # only replicate *changes* — heartbeats repeat at 1s cadence and
         # must not grow the PD log when nothing moved
+        zone = getattr(req, "zone", "")
         cur = self.fsm.stores.get(req.endpoint)
-        if cur is None or cur.store_id != req.store_id:
+        if cur is None or cur.store_id != req.store_id \
+                or (zone and cur.zone != zone):
             await self._apply(_cmd(
                 _CMD_STORE_UPSERT,
-                encode_store_meta(req.store_id, req.endpoint)))
+                encode_store_meta(req.store_id, req.endpoint, zone)))
         for blob in req.regions:
             region = Region.decode(blob)
             if self._region_changed(region):
@@ -526,19 +589,27 @@ class PlacementDriverServer:
         if node is None or not node.is_leader():
             return self._not_leader(StoreHeartbeatBatchResponse)
         await self._maybe_seed()
+        zone = getattr(req, "zone", "")
         cur = self.fsm.stores.get(req.endpoint)
-        if cur is None or cur.store_id != req.store_id:
+        if cur is None or cur.store_id != req.store_id \
+                or (zone and cur.zone != zone):
             await self._apply(_cmd(
                 _CMD_STORE_UPSERT,
-                encode_store_meta(req.store_id, req.endpoint)))
+                encode_store_meta(req.store_id, req.endpoint, zone)))
         instructions: list[Instruction] = []
         reported: set[int] = set()
+        # zone bookkeeping is invariant across the batch: compute the
+        # endpoint->zone map and the leaders-per-zone histogram ONCE
+        # instead of per region (O(regions^2) on a 2K-region resync)
+        zones = self._store_zones()
+        zone_counts = zone_leader_histogram(
+            self.fsm.region_leaders, zones) if zones else None
         for blob in req.deltas:
             region_blob, leader, keys = decode_region_delta(blob)
             region = Region.decode(region_blob)
             reported.add(region.id)
             instructions.extend(await self._region_hb_core(
-                region, leader, keys))
+                region, leader, keys, zones, zone_counts))
         # policy pass over the store's UNREPORTED led regions: deltas
         # only flow when something changed, but split re-issue and
         # leader balancing are PD-side decisions that must keep running
@@ -553,7 +624,8 @@ class PlacementDriverServer:
                     PeerId.parse(leader).endpoint != req.endpoint:
                 continue
             instructions.extend(await self._region_hb_core(
-                region, leader, self.stats.last_keys(rid)))
+                region, leader, self.stats.last_keys(rid),
+                zones, zone_counts))
         term = node.current_term
         if req.full:
             self._batch_synced[req.endpoint] = term
@@ -565,10 +637,19 @@ class PlacementDriverServer:
             instructions=[i.encode() for i in instructions],
             need_full=need_full)
 
+    def _store_zones(self) -> dict[str, str]:
+        return {ep: rec.zone for ep, rec in self.fsm.stores.items()
+                if rec.zone}
+
     async def _region_hb_core(self, region: Region, leader: str,
-                              approximate_keys: int) -> list[Instruction]:
+                              approximate_keys: int,
+                              zones: Optional[dict] = None,
+                              zone_counts: Optional[dict] = None
+                              ) -> list[Instruction]:
         """Shared by the per-region and delta-batched paths: epoch-
-        guarded metadata upsert, stats, split/balance instructions."""
+        guarded metadata upsert, stats, split/balance instructions.
+        ``zones``/``zone_counts`` are precomputed ONCE per batch by the
+        batch handler (None = compute here, the single-region path)."""
         node = self.node
         if self._region_changed(region, leader):
             lp = leader.encode()
@@ -597,9 +678,12 @@ class PlacementDriverServer:
         elif self.opts.balance_leaders:
             self.stats.note_leadership(node.current_term,
                                        self.opts.transfer_cooldown_s)
+            if zones is None:
+                zones = self._store_zones()
             target = self.stats.pick_transfer_target(
                 region, leader, self.fsm.region_leaders,
-                cooldown_s=self.opts.transfer_cooldown_s)
+                cooldown_s=self.opts.transfer_cooldown_s,
+                zones=zones, zone_counts=zone_counts)
             if target is not None:
                 instructions.append(Instruction(
                     kind=Instruction.KIND_TRANSFER_LEADER,

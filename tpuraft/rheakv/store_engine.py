@@ -85,6 +85,10 @@ class StoreEngineOptions:
     # to a replica whose FSM predates it fails to apply and silently
     # diverges state — per-op entries stay wire/FSM-compatible both ways
     multi_op_entries: bool = True
+    # geo deployment: this store's zone (failure-domain) label.  Carried
+    # on PD heartbeats so the PD spreads leaders across zones; "" =
+    # unlabeled (single-zone legacy deployments)
+    zone: str = ""
 
 
 class StoreEngine:
@@ -244,7 +248,8 @@ class StoreEngine:
         # bare store identity directly — store_meta() would deep-copy
         # every region just for us to throw the list away each interval
         meta = StoreMeta(id=zlib.crc32(str(self.server_id).encode()),
-                         endpoint=self.server_id.endpoint, regions=[])
+                         endpoint=self.server_id.endpoint, regions=[],
+                         zone=self.opts.zone)
         instructions, need_full = await self.pd_client.store_heartbeat_batch(
             meta, deltas, full=full)
         # only now (RPC succeeded) do the fingerprints count as reported
@@ -291,16 +296,34 @@ class StoreEngine:
         sid = zlib.crc32(str(self.server_id).encode())
         return StoreMeta(id=sid,
                          endpoint=self.server_id.endpoint,
-                         regions=[r.copy() for r in self.list_regions()])
+                         regions=[r.copy() for r in self.list_regions()],
+                         zone=self.opts.zone)
 
     # -- node options for a region's raft group ------------------------------
 
     def make_node_options(self, region: Region, fsm) -> NodeOptions:
+        conf = Configuration.parse(",".join(region.peers))
         opts = NodeOptions(
             election_timeout_ms=self.opts.election_timeout_ms,
-            initial_conf=Configuration.parse(",".join(region.peers)),
+            initial_conf=conf,
             fsm=fsm,
         )
+        # '/witness'-flagged own peer: this store hosts the region as a
+        # WITNESS — metadata-only journal, null FSM, never campaigns
+        opts.witness = conf.is_witness(self.server_id)
+        if conf.witnesses and self.multi_raft_engine is not None:
+            # the device ballot plane (ops/ballot, TpuBallotBox) has no
+            # witness-aware commit clamp: witness rows would count as
+            # plain data matches on device, silently dropping the third
+            # safety layer (ballot_box.commit_point's data clamp).
+            # Refuse LOUDLY instead of running witness regions with
+            # weaker guarantees than documented.
+            raise ValueError(
+                f"region {region.id}: witness members "
+                f"{[str(p) for p in conf.witnesses]} on an engine-backed "
+                f"store — the [G, P] device ballot plane is not "
+                f"witness-aware yet (ROADMAP item 4); host witness "
+                f"regions on timer-mode stores (no MultiRaftEngine)")
         opts.raft_options.read_only_option = self.opts.read_only_option
         opts.raft_options.quiesce_after_rounds = \
             self.opts.quiesce_after_rounds

@@ -49,6 +49,9 @@ class GetPeersResponse:
     peers: list[str] = field(default_factory=list)
     learners: list[str] = field(default_factory=list)
     success: bool = True
+    # trailing extension (witness replicas): voters that are witnesses
+    # (subset of ``peers``); old clients ignore it
+    witnesses: list[str] = field(default_factory=list)
 
 
 @_cli(68)
@@ -56,6 +59,9 @@ class AddPeerRequest:
     group_id: str
     peer_id: str
     adding: str = ""
+    # trailing extension: add the voter as a WITNESS (metadata-only
+    # replica); old servers ignore the flag and add a full voter
+    witness: bool = False
 
 
 @_cli(69)
@@ -71,6 +77,8 @@ class ChangePeersRequest:
     peer_id: str
     new_peers: list[str] = field(default_factory=list)      # voters
     new_learners: list[str] = field(default_factory=list)
+    # trailing extension: which of new_peers are witnesses
+    new_witnesses: list[str] = field(default_factory=list)
 
 
 @_cli(71)
@@ -79,6 +87,8 @@ class ResetPeersRequest:
     peer_id: str
     new_peers: list[str] = field(default_factory=list)      # voters
     new_learners: list[str] = field(default_factory=list)
+    # trailing extension: which of new_peers are witnesses
+    new_witnesses: list[str] = field(default_factory=list)
 
 
 @_cli(72)

@@ -14,6 +14,15 @@ faults: **duplication** (the request is delivered and EXECUTED twice at
 the receiver; the duplicate's response is discarded — receiver handlers
 must be idempotent) and **bounded reordering** (a frame is held for a
 random bounded interval so later frames overtake it).
+
+Geo shaping: an attached :class:`~tpuraft.rpc.topology.NetworkTopology`
+adds per-link (zone x zone, per-direction) latency/jitter/loss/
+bandwidth on TOP of the global knobs.  The two fault layers compose and
+heal independently: :meth:`FaultInjectingTransport.heal` clears only
+the nemesis layer (per-destination blocks), while
+:meth:`heal_topology` clears only the topology's DYNAMIC events
+(degrades / zone partitions / flaps) — a nemesis action healing its
+noise can no longer stomp the standing WAN shape, and vice versa.
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import random
 from typing import Any, Optional
 
 from tpuraft.errors import RaftError, Status
+from tpuraft.rpc.topology import NetworkTopology
 from tpuraft.rpc.transport import RpcError, TransportBase
 
 
@@ -37,6 +47,9 @@ class FaultInjectingTransport(TransportBase):
         self.reorder_rate = 0.0
         self.reorder_max_delay_ms = 10.0
         self._blocked_dsts: set[str] = set()
+        # geo shaping: per-link latency/jitter/loss/bandwidth matrix;
+        # usually one shared topology across every store's transport
+        self.topology: Optional[NetworkTopology] = None
 
     # -- injection controls --------------------------------------------------
 
@@ -65,13 +78,29 @@ class FaultInjectingTransport(TransportBase):
     def unblock(self, dst: str) -> None:
         self._blocked_dsts.discard(dst)
 
+    def set_topology(self, topology: Optional[NetworkTopology]) -> None:
+        self.topology = topology
+
     def heal(self) -> None:
+        """Heal the NEMESIS layer only: per-destination blocks.  The
+        topology's standing shape AND its dynamic events survive — a
+        noise action's heal must not silently flatten the WAN."""
         self._blocked_dsts.clear()
+
+    def heal_topology(self) -> None:
+        """Heal the TOPOLOGY layer only: clears dynamic events
+        (degrades / zone partitions / flaps) on the attached topology;
+        the base zone matrix and any nemesis-layer blocks stay."""
+        if self.topology is not None:
+            self.topology.heal_events()
 
     # -- transport surface ---------------------------------------------------
 
     async def call(self, dst: str, method: str, request: Any,
                    timeout_ms: Optional[float] = None) -> Any:
+        if self.topology is not None:
+            await self.topology.traverse(self.endpoint, dst, request,
+                                         timeout_ms)
         if self.reorder_rate > 0 and self._rng.random() < self.reorder_rate:
             # hold THIS frame so later-submitted frames overtake it
             await asyncio.sleep(

@@ -52,11 +52,20 @@ class SnapshotMeta:
     old_peers: list[str] = field(default_factory=list)
     learners: list[str] = field(default_factory=list)
     old_learners: list[str] = field(default_factory=list)
+    # TRAILING extension (witness replicas): omitted when empty, so a
+    # witness-free meta encodes bit-identically to the old format and
+    # an old decoder ignores the trailing lists of a new one
+    witnesses: list[str] = field(default_factory=list)
+    old_witnesses: list[str] = field(default_factory=list)
 
     def encode(self) -> bytes:
         out = bytearray(_I64.pack(self.last_included_index))
         out += _I64.pack(self.last_included_term)
-        for lst in (self.peers, self.old_peers, self.learners, self.old_learners):
+        lists = [self.peers, self.old_peers, self.learners,
+                 self.old_learners]
+        if self.witnesses or self.old_witnesses:
+            lists += [self.witnesses, self.old_witnesses]
+        for lst in lists:
             out += _U16.pack(len(lst))
             for s in lst:
                 out += _pack_str(s)
@@ -68,7 +77,10 @@ class SnapshotMeta:
         idx, term = _I64.unpack_from(buf, 0)[0], _I64.unpack_from(buf, 8)[0]
         off = 16
         lists = []
-        for _ in range(4):
+        for i in range(6):
+            if i >= 4 and off >= len(buf):
+                lists.append([])  # pre-witness meta: trailing defaults
+                continue
             (n,) = _U16.unpack_from(buf, off)
             off += 2
             cur = []

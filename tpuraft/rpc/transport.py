@@ -119,6 +119,10 @@ class InProcNetwork:
         self.reorder_rate: float = 0.0
         self.reorder_max_delay_ms: float = 10.0
         self._rng = random.Random(0)
+        # geo shaping: a NetworkTopology (tpuraft/rpc/topology.py) adds
+        # per-link zone x zone latency/jitter/loss/bandwidth on top of
+        # the global knobs; healed separately via heal_topology()
+        self.topology = None
 
     # -- server registry -----------------------------------------------------
 
@@ -150,7 +154,19 @@ class InProcNetwork:
         self.partition({endpoint}, others)
 
     def heal(self) -> None:
+        """Heal the NEMESIS layer only (partitions); the topology's
+        shape and dynamic events survive — see heal_topology()."""
         self._blocked_pairs.clear()
+
+    def set_topology(self, topology) -> None:
+        self.topology = topology
+
+    def heal_topology(self) -> None:
+        """Clear the topology's DYNAMIC events (degrades / zone
+        partitions / flaps); nemesis partitions and the base zone
+        matrix stay."""
+        if self.topology is not None:
+            self.topology.heal_events()
 
     def stop_endpoint(self, endpoint: str) -> None:
         self._down.add(endpoint)
@@ -179,6 +195,8 @@ class InProcNetwork:
 
     async def call(self, src: str, dst: str, method: str, request: Any,
                    timeout_ms: float) -> Any:
+        if self.topology is not None:
+            await self.topology.traverse(src, dst, request, timeout_ms)
         if self.reorder_rate and self._rng.random() < self.reorder_rate:
             await asyncio.sleep(
                 self._rng.uniform(0.0, self.reorder_max_delay_ms) / 1000.0)

@@ -314,8 +314,11 @@ class LogManager:
 
         ce = ConfigurationEntry(
             id=e.id,
-            conf=Configuration(list(e.peers or []), list(e.learners or [])),
-            old_conf=Configuration(list(e.old_peers or []), list(e.old_learners or [])),
+            conf=Configuration(list(e.peers or []), list(e.learners or []),
+                               list(e.witnesses or [])),
+            old_conf=Configuration(list(e.old_peers or []),
+                                   list(e.old_learners or []),
+                                   list(e.old_witnesses or [])),
         )
         self.conf_manager.add(ce)
 

@@ -40,6 +40,44 @@ def majorities_intersect(a: Iterable, b: Iterable) -> bool:
     return need_a + need_b > len(a & b)
 
 
+def witness_minority(voters: Iterable, witnesses: Iterable) -> bool:
+    """Config rule for witness voters: witnesses must be a strict
+    minority (< quorum) of the voter set, with at least one data voter.
+    Guarantees — verified by :func:`every_majority_has_data_peer` —
+    that EVERY majority contains at least one payload-holding replica,
+    so no quorum can certify a commit that exists on zero data copies.
+    """
+    voters, witnesses = set(voters), set(witnesses)
+    if not witnesses:
+        return True
+    if not witnesses <= voters or witnesses == voters:
+        return False
+    return len(witnesses) < len(voters) // 2 + 1
+
+
+def every_majority_has_data_peer(voters: Iterable,
+                                 witnesses: Iterable) -> bool:
+    """Enumerate EVERY majority of ``voters`` and check each contains
+    at least one non-witness (data) member — the witness-safety quorum
+    condition (a majority made of witnesses alone could ack a commit
+    held on zero data replicas)."""
+    witnesses = set(witnesses)
+    return all(m - witnesses for m in majorities(voters))
+
+
+def witness_only_majorities(voters: Iterable,
+                            witnesses: Iterable) -> list[frozenset]:
+    """Majorities containing NO data replica — each is a quorum that
+    must never certify a commit.  Two independent mechanisms enforce
+    that: config validation (witness_minority makes this list empty for
+    valid confs) and, defense in depth, witnesses never campaign — a
+    commit quorum always contains the (data) leader, and the ballot box
+    additionally clamps the commit point to the data replicas' best
+    match (ballot_box.commit_point)."""
+    witnesses = set(witnesses)
+    return [m for m in majorities(voters) if not (m - witnesses)]
+
+
 def joint_quorums_intersect(old: Iterable, new: Iterable) -> bool:
     """A joint (C_old,new) decision takes a majority of BOTH sets.
     Verify by enumeration that every such dual quorum intersects every
