@@ -84,6 +84,14 @@ async def run_store(args) -> None:
     await engine.start()
     factory = engine.ballot_box_factory()
 
+    # store-wide SAFE read-confirmation amortizer: the batcher is
+    # engine-agnostic (it only needs nodes + replicators), so the raw
+    # protocol-plane bench exercises the same coalesced read fences the
+    # RheaKV stack serves through
+    from tpuraft.rheakv.store_engine import ReadConfirmBatcher
+
+    read_batcher = ReadConfirmBatcher()
+
     nodes = []
     for k in range(G):
         gid = f"g{k}"
@@ -109,6 +117,7 @@ async def run_store(args) -> None:
         manager.add(node)
         ok = await node.init()
         assert ok
+        node.read_only_service.attach_confirm_batcher(read_batcher)
         nodes.append(node)
 
     print("BOOTED", flush=True)
@@ -225,6 +234,94 @@ async def run_store(args) -> None:
             "rss_mb": round(
                 resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
             "asyncio_tasks": len(asyncio.all_tasks()),
+        }
+
+    async def measured_read_mix(duration: float, frac: float):
+        """Read/write-mix run: each in-flight slot is a read_index()
+        fence (probability ``frac``) or an apply batch.  Reads count as
+        ONE op each; the store-wide ReadConfirmBatcher coalesces every
+        led group's fences into shared beat-plane rounds."""
+        import random as _rnd
+
+        stop_at = time.monotonic() + duration
+        ok = [0]
+        errs = [0]
+        rlats: list[float] = []
+
+        async def drive(node):
+            batch = args.batch
+            sem = asyncio.Semaphore(args.window)
+            payload = b"x" * args.payload
+            rng = _rnd.Random(id(node) & 0xffff)
+
+            def batch_cb():
+                left = [batch]
+
+                def cb(st):
+                    if st.is_ok():
+                        ok[0] += 1
+                    else:
+                        errs[0] += 1
+                    left[0] -= 1
+                    if left[0] == 0:
+                        sem.release()
+                return cb
+
+            async def one_read(sample: bool):
+                t0 = time.perf_counter()
+                try:
+                    # bounded: a read wedged by churn must cost one slot
+                    # for a few seconds, not hang the whole phase
+                    await asyncio.wait_for(node.read_index(), 10.0)
+                    ok[0] += 1
+                    if sample:
+                        rlats.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 — election churn etc.
+                    errs[0] += 1
+                finally:
+                    sem.release()
+
+            pending = set()
+            i = 0
+            while time.monotonic() < stop_at:
+                if not node.is_leader():
+                    await asyncio.sleep(0.05)
+                    continue
+                await sem.acquire()
+                i += 1
+                if rng.random() < frac:
+                    fut = asyncio.ensure_future(one_read(i % 4 == 0))
+                else:
+                    cb = batch_cb()   # ONE shared countdown per batch
+                    tasks = [Task(data=payload, done=cb)
+                             for _ in range(batch)]
+                    fut = asyncio.ensure_future(node.apply_batch(tasks))
+                pending.add(fut)
+                fut.add_done_callback(pending.discard)
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            for _ in range(args.window):
+                try:
+                    await asyncio.wait_for(sem.acquire(), 5.0)
+                except asyncio.TimeoutError:
+                    break
+
+        t_start = time.monotonic()
+        await asyncio.gather(*(drive(n) for n in nodes))
+        elapsed = time.monotonic() - t_start
+        rlats.sort()
+        svc_totals: dict[str, int] = {}
+        for n in nodes:
+            for k, v in n.read_only_service.counters().items():
+                svc_totals[k] = svc_totals.get(k, 0) + v
+        return {
+            "ok": ok[0], "errs": errs[0], "elapsed": elapsed,
+            "read_frac": frac,
+            "read_p50_ms": round(rlats[len(rlats) // 2] * 1e3, 3)
+            if rlats else None,
+            "read_p99_ms": round(rlats[int(len(rlats) * 0.99)] * 1e3, 3)
+            if rlats else None,
+            "read_plane": dict(read_batcher.counters(), **svc_totals),
         }
 
     async def latency_probe(n_ops: int):
@@ -381,6 +478,9 @@ async def run_store(args) -> None:
                                                         ).print_stats(50)
             res["prof"] = path
             print("RESULT " + json.dumps(res), flush=True)
+        elif cmd[0] == "RMIX":
+            res = await measured_read_mix(float(cmd[1]), float(cmd[2]))
+            print("RESULT " + json.dumps(res), flush=True)
         elif cmd[0] == "LAT":
             res = await latency_probe(int(cmd[1]))
             print("RESULT " + json.dumps(res), flush=True)
@@ -432,6 +532,13 @@ def main() -> None:
     ap.add_argument("--meta", default="file", choices=["file", "memory"],
                     help="raft meta storage; 'memory' speeds up boot at "
                          "high G (meta is not in the commit-ack path)")
+    ap.add_argument("--read-mix", default="",
+                    help="comma-separated read fractions (e.g. "
+                         "'0.95,0.5'): after the write phase, run one "
+                         "read/write-mix phase per fraction — reads are "
+                         "read_index() fences amortized by the "
+                         "store-wide ReadConfirmBatcher; rows land in "
+                         "extra.read_mix of the JSON")
     ap.add_argument("--skip-brk", action="store_true",
                     help="skip the per-stage breakdown round")
     ap.add_argument("--dir", default="")
@@ -519,6 +626,24 @@ def main() -> None:
 
         round_all(f"GO {args.warmup}")          # warmup
         results = round_all(f"GO {args.duration}")
+        read_rows = []
+        for frac_s in [f for f in args.read_mix.split(",") if f]:
+            frac = float(frac_s)
+            rr = round_all(f"RMIX {args.duration} {frac}")
+            r_ok = sum(r["ok"] for r in rr)
+            r_el = max(r["elapsed"] for r in rr)
+            plane: dict = {}
+            for r in rr:
+                for k, v in r.get("read_plane", {}).items():
+                    plane[k] = plane.get(k, 0) + v
+            read_rows.append({
+                "read_frac": frac,
+                "ops_per_sec": round(r_ok / r_el, 1),
+                "errors": sum(r["errs"] for r in rr),
+                "read_p50_ms": [r["read_p50_ms"] for r in rr],
+                "read_p99_ms": [r["read_p99_ms"] for r in rr],
+                "read_plane": plane,
+            })
         lat = round_one(procs[0], "LAT 200")    # low-load single-group acks
         brk = (None if args.skip_brk
                else round_one(procs[0], "BRK 150"))  # per-stage breakdown
@@ -547,6 +672,7 @@ def main() -> None:
                 "underload_ack_p99_ms": [r["lat_p99_ms"] for r in results],
                 "lowload_single_group_ack": lat,
                 "ack_breakdown": brk,
+                "read_mix": read_rows,
                 "rss_mb_per_store": [r.get("rss_mb") for r in results],
                 "asyncio_tasks_per_store": [r.get("asyncio_tasks")
                                             for r in results],
@@ -562,7 +688,19 @@ def main() -> None:
             },
         }
         print(json.dumps(out))
-        with open(os.path.join(REPO, args.json_out), "w") as f:
+        path = os.path.join(REPO, args.json_out)
+        if os.path.exists(path):
+            # a fresh full run must not drop the bench-gate calibration
+            # keys (re-recorded separately via `bench_gate.py --record`)
+            try:
+                with open(path) as f:
+                    prev = json.load(f).get("extra", {})
+                for k, v in prev.items():
+                    if k.startswith("gate_"):
+                        out["extra"].setdefault(k, v)
+            except Exception:  # noqa: BLE001 — corrupt old file
+                pass
+        with open(path, "w") as f:
             json.dump(out, f, indent=1)
     finally:
         for p in procs:

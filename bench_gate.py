@@ -63,9 +63,11 @@ def _run_e2e_once(extra: dict, duration: float) -> float:
         return float(json.load(f)["value"])
 
 
-def _run_kv_once(extra: dict, duration: float) -> float:
+def _run_kv_once(extra: dict, duration: float,
+                 read_frac: float = -1.0) -> float:
     """One short bench_region_density run at the gate shape; returns
-    KV ops/s through the full serving stack."""
+    KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
+    the read-mix shape (the amortized read plane's regression row)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -74,6 +76,10 @@ def _run_kv_once(extra: dict, duration: float) -> float:
            "--duration", str(duration),
            "--election-timeout-ms", str(extra.get("gate_eto_ms", 1000)),
            "--json-out", out_path]
+    key = "row" if regions == 1024 else f"row_{regions}"
+    if read_frac >= 0:
+        cmd += ["--read-frac", str(read_frac)]
+        key += f"_r{int(round(read_frac * 100))}"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -81,7 +87,6 @@ def _run_kv_once(extra: dict, duration: float) -> float:
         raise RuntimeError(f"kv bench run failed (rc={rc})")
     with open(out_path) as f:
         data = json.load(f)
-    key = "row" if regions == 1024 else f"row_{regions}"
     row = data.get(key, {})
     if "ops_per_sec" not in row:
         raise RuntimeError(f"kv bench produced no {key}.ops_per_sec")
@@ -142,6 +147,8 @@ def main() -> int:
                            for _ in range(2))
             kv_best = max(_run_kv_once(kv_extra, duration)
                           for _ in range(2))
+            read_best = max(_run_kv_once(kv_extra, duration, read_frac=0.95)
+                            for _ in range(2))
         except RuntimeError as exc:
             print(f"bench-gate: {exc}")
             return 2
@@ -152,6 +159,7 @@ def main() -> int:
             json.dump(e2e_base, f, indent=1)
             f.write("\n")
         kv_extra["gate_kv_ops_per_sec"] = round(kv_best, 1)
+        kv_extra["gate_read_ops_per_sec"] = round(read_best, 1)
         kv_extra["gate_duration_s"] = duration
         kv_extra.setdefault("gate_regions", 128)
         kv_extra.setdefault("gate_eto_ms", 1000)
@@ -163,6 +171,8 @@ def main() -> int:
                               e2e_extra["gate_commits_per_sec"],
                           "gate_kv_ops_per_sec":
                               kv_extra["gate_kv_ops_per_sec"],
+                          "gate_read_ops_per_sec":
+                              kv_extra["gate_read_ops_per_sec"],
                           "duration_s": duration}))
         return 0
 
@@ -186,6 +196,22 @@ def main() -> int:
         rc, rep = _gate("kv_ops_per_sec",
                         float(kv_extra["gate_kv_ops_per_sec"]),
                         lambda: _run_kv_once(kv_extra, duration),
+                        threshold, retries)
+        worst = max(worst, rc)
+        reports.append(rep)
+    if "gate_read_ops_per_sec" not in kv_extra:
+        # the amortized read plane (ISSUE 10) needs its own regression
+        # row — a silent pass without a calibration would defeat it
+        print("bench-gate[kv_read_ops_per_sec]: no calibration "
+              "(run `python bench_gate.py --record`)")
+        worst = max(worst, 2)
+        reports.append({"gate": "kv_read_ops_per_sec", "verdict": "BROKEN",
+                        "error": "no gate_read_ops_per_sec calibration"})
+    else:
+        rc, rep = _gate("kv_read_ops_per_sec",
+                        float(kv_extra["gate_read_ops_per_sec"]),
+                        lambda: _run_kv_once(kv_extra, duration,
+                                             read_frac=0.95),
                         threshold, retries)
         worst = max(worst, rc)
         reports.append(rep)

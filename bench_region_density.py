@@ -103,6 +103,12 @@ async def run_config(args) -> dict:
                 f"{args.dir}/store{i}/kv", sync=False),
             heartbeat_interval_ms=1000,
         )
+        if args.lease_reads:
+            from tpuraft.options import ReadOnlyOption
+
+            opts.read_only_option = ReadOnlyOption.LEASE_BASED
+        if args.quiesce:
+            opts.quiesce_after_rounds = 4
         store = StoreEngine(opts, server, transport,
                             multi_raft_engine=engine,
                             pd_client=CountingPD(
@@ -150,15 +156,40 @@ async def run_config(args) -> dict:
     client = RheaKVStore(pd, InProcTransport(net, "kvclient:0"),
                          batching=BatchingOptions(
                              enabled=True,
-                             max_store_inflight=args.store_inflight))
+                             max_store_inflight=args.store_inflight),
+                         read_from=args.read_from)
     hb0 = (CountingPD.store_hbs, CountingPD.region_hbs,
            CountingPD.batch_hbs, CountingPD.delta_rows)
 
     ok = [0]
     errs = [0]
     lats: list[float] = []
-    stop_at = time.monotonic() + args.duration
     payload = b"v" * 32
+
+    # read-mix shapes (--read-frac >= 0): reads with that probability,
+    # writes otherwise; negative = the legacy 75/25 put/get mix.  A
+    # pure-read probe against a quiescent fleet (--read-frac 1
+    # --lease-reads --quiesce) additionally asserts hibernation holds.
+    read_frac = args.read_frac if args.read_frac >= 0 else 0.25
+    quiesced_before = woken_before = 0
+    if args.quiesce:
+        # seed every region once so groups have one committed entry,
+        # then wait for hibernation to take hold before the window
+        for k in range(0, R, max(1, R // 64)):
+            try:
+                await client.put(b"%06x/seed" % k, payload)
+            except Exception:
+                pass
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            quiesced_before = sum(int(e.quiescent.sum()) for e in engines)
+            if quiesced_before >= int(R * S * 0.9):
+                break
+            await asyncio.sleep(0.5)
+        woken_before = sum(
+            s.node_manager.heartbeat_hub.groups_woken for s in stores)
+
+    stop_at = time.monotonic() + args.duration
 
     async def worker(wid: int) -> None:
         r = random.Random(wid)
@@ -167,10 +198,10 @@ async def run_config(args) -> dict:
             key = k + b"/%04d" % r.randrange(100)
             t = time.perf_counter()
             try:
-                if r.random() < 0.75:
-                    await client.put(key, payload)
-                else:
+                if r.random() < read_frac:
                     await client.get(key)
+                else:
+                    await client.put(key, payload)
                 ok[0] += 1
                 lats.append(time.perf_counter() - t)
             except Exception:
@@ -182,9 +213,36 @@ async def run_config(args) -> dict:
     elapsed = time.monotonic() - t2
     hb1 = (CountingPD.store_hbs, CountingPD.region_hbs,
            CountingPD.batch_hbs, CountingPD.delta_rows)
+    # snapshot hibernation state BEFORE the stage probes: the write
+    # probe below legitimately wakes its target group
+    quiesced_after = sum(int(e.quiescent.sum()) for e in engines) \
+        if args.quiesce else 0
+    woken_after = sum(s.node_manager.heartbeat_hub.groups_woken
+                      for s in stores) if args.quiesce else 0
     lats.sort()
 
     stage = await stage_probe(client, stores, R)
+    read_stage = await read_stage_probe(client, stores) \
+        if read_frac > 0 else {}
+
+    # read-plane counters: store-wide confirm batching, per-batch fence
+    # dedupe, lease vs SAFE vs forwarded serve counts, engine lease lane
+    read_plane: dict = {}
+
+    def _acc(d: dict) -> None:
+        for k, v in d.items():
+            read_plane[k] = read_plane.get(k, 0) + v
+
+    for s in stores:
+        if s.read_batcher is not None:
+            _acc(s.read_batcher.counters())
+        _acc({"kv_read_fences": s.kv_processor.read_fences,
+              "kv_fenced_reads": s.kv_processor.fenced_reads})
+        for re in s._regions.values():
+            if re.node is not None:
+                _acc(re.node.read_only_service.counters())
+    _acc({"lease_lane_hits": sum(e.lease_lane_hits for e in engines),
+          "lease_lane_misses": sum(e.lease_lane_misses for e in engines)})
 
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     coalesced_flushes = sum(re.fsm.coalesced_flushes
@@ -217,6 +275,9 @@ async def run_config(args) -> dict:
         "asyncio_tasks": len(asyncio.all_tasks()),
         "workers": args.workers,
         "pace_ms": args.pace_ms,
+        "read_frac": round(read_frac, 2),
+        "read_from": args.read_from,
+        "lease_reads": bool(args.lease_reads),
         # serving-plane batching (ISSUE 6): store-grouped client RPCs +
         # server fan-out + FSM apply coalescing
         "kv_batch_rpcs_per_s": round(client.batch_rpcs / elapsed, 1),
@@ -235,7 +296,16 @@ async def run_config(args) -> dict:
         # the region store, submit=entry handed to the raft node,
         # apply_s/apply_e=FSM executed, ack=proposal future resolved
         "stage_marks_ms": stage,
+        # read-side attribution for one probe GET: queue → rpc →
+        # fence_s/fence_e (read_index confirmation incl. the store-wide
+        # batched round) → done (local serve + reply)
+        "read_stage_marks_ms": read_stage,
+        "read_plane": read_plane,
     }
+    if args.quiesce:
+        res["quiescent_replicas_before"] = quiesced_before
+        res["quiescent_replicas_after"] = quiesced_after
+        res["groups_woken_during_load"] = woken_after - woken_before
     print("RESULT " + json.dumps(res), flush=True)
     os._exit(0)  # 3R region engines: teardown is not the measurement
 
@@ -325,6 +395,59 @@ async def stage_probe(client, stores, R: int) -> dict:
     return {k: round((v - t0) * 1e3, 3) for k, v in marks.items()}
 
 
+async def read_stage_probe(client, stores) -> dict:
+    """One instrumented GET after the measured window: stamps the read
+    serving stages so the read-side bottleneck is attributable —
+    client-queue → rpc → read fence (ReadIndex confirmation, incl. the
+    store-wide batched round) → local serve → ack."""
+    import time as _t
+
+    target = None
+    for s in stores:
+        for re in s._regions.values():
+            if re.is_leader():
+                target = re
+                break
+        if target is not None:
+            break
+    if target is None or target.node is None:
+        return {}
+    marks: dict = {}
+    node = target.node
+    orig_ri, orig_call = node.read_index, client.transport.call
+
+    async def ri_mark():
+        marks.setdefault("fence_s", _t.perf_counter())
+        try:
+            return await orig_ri()
+        finally:
+            marks.setdefault("fence_e", _t.perf_counter())
+
+    async def call_mark(ep, method, req, timeout_ms=None):
+        if method.startswith("kv_command"):
+            marks.setdefault("rpc_s", _t.perf_counter())
+        try:
+            return await orig_call(ep, method, req, timeout_ms)
+        finally:
+            if method.startswith("kv_command"):
+                marks.setdefault("rpc_e", _t.perf_counter())
+
+    node.read_index = ri_mark
+    client.transport.call = call_mark
+    key = target.region.start_key + b"/read-probe"
+    t0 = _t.perf_counter()
+    marks["queue_s"] = t0
+    try:
+        await asyncio.wait_for(client.get(key), 30.0)
+        marks["done"] = _t.perf_counter()
+    except Exception:
+        return {}
+    finally:
+        node.read_index = orig_ri
+        client.transport.call = orig_call
+    return {k: round((v - t0) * 1e3, 3) for k, v in marks.items()}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--regions", type=int, default=1024)
@@ -336,6 +459,23 @@ def main() -> None:
     ap.add_argument("--store-inflight", type=int, default=4,
                     help="concurrent kv_command_batch RPCs per store "
                          "(BatchingOptions.max_store_inflight)")
+    ap.add_argument("--read-frac", type=float, default=-1.0,
+                    help="read/write-mix shape: GET with this probability "
+                         "(0.95 = the 95/5 row, 0.5 = 50/50, 1.0 = pure "
+                         "read); negative (default) = legacy 75/25 "
+                         "put/get mix")
+    ap.add_argument("--read-from",
+                    choices=["leader", "follower", "learner", "any"],
+                    default="leader",
+                    help="client read fan-out target (RheaKVStore "
+                         "read_from)")
+    ap.add_argument("--lease-reads", action="store_true",
+                    help="LEASE_BASED readIndex on the region groups "
+                         "(no per-read quorum round)")
+    ap.add_argument("--quiesce", action="store_true",
+                    help="enable group quiescence and assert a pure-read "
+                         "load leaves hibernated groups hibernated "
+                         "(reports wake counters)")
     ap.add_argument("--json-out", default="BENCH_REGIONS.json")
     ap.add_argument("--config", action="store_true",
                     help="internal: run one config in this process")
@@ -361,7 +501,13 @@ def main() -> None:
            "--workers", str(args.workers),
            "--pace-ms", str(args.pace_ms),
            "--election-timeout-ms", str(args.election_timeout_ms),
-           "--store-inflight", str(args.store_inflight)]
+           "--store-inflight", str(args.store_inflight),
+           "--read-frac", str(args.read_frac),
+           "--read-from", args.read_from]
+    if args.lease_reads:
+        cmd.append("--lease-reads")
+    if args.quiesce:
+        cmd.append("--quiesce")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     t0 = time.monotonic()
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
@@ -388,6 +534,12 @@ def main() -> None:
     key = "row" if args.regions == 1024 else f"row_{args.regions}"
     if args.workers != 24:   # non-default load shapes get their own row
         key += f"_w{args.workers}"
+    if args.read_frac >= 0:  # read-mix shapes: row_r95 / row_r50 / ...
+        key += f"_r{int(round(args.read_frac * 100))}"
+    if args.lease_reads:
+        key += "_lease"
+    if args.quiesce:
+        key += "_quiesce"
     out[key] = row
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

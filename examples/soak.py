@@ -65,7 +65,8 @@ from tpuraft.rheakv.pd_client import FakePlacementDriverClient
 from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
 from tpuraft.rpc.topology import build_geo_topology
 from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
-from tpuraft.util.linearizability import History, check_history
+from tpuraft.util.linearizability import (History, check_history,
+                                          check_stale_reads)
 from tpuraft.util.nemesis import (
     NemesisAction,
     SkipFault,
@@ -596,7 +597,9 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    quiesce: bool = False,
                    kv_batching: bool = False,
                    geo: int = 0,
-                   witness: bool = False) -> dict:
+                   witness: bool = False,
+                   read_mix: float = 0.0,
+                   read_from: str = "leader") -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
         raise ValueError(
@@ -663,7 +666,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
-            kv_batching, geo, witness)
+            kv_batching, geo, witness, read_mix, read_from)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -675,7 +678,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
 async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
                           chaos, churn=False, quiesce=False,
-                          kv_batching=False, geo=0, witness=False) -> dict:
+                          kv_batching=False, geo=0, witness=False,
+                          read_mix=0.0, read_from="leader") -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -693,7 +697,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
 
     kv = RheaKVStore(pd, c.client_transport(), max_retries=1,
                      batching=BatchingOptions(enabled=True)
-                     if kv_batching else None)
+                     if kv_batching else None,
+                     read_from=read_from)
     await kv.start()
 
     def say(*a):
@@ -714,13 +719,37 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         keys = [b"soak-%d" % i for i in range(n_keys)]
         sampled_regions = [1]
 
+    # read-mix mode (--read-mix FRAC): reads with probability FRAC,
+    # writes carry per-key MONOTONE sequence values with exactly ONE
+    # writer per key issuing in order — the shape the targeted
+    # no-stale-read assertion (check_stale_reads) requires on top of
+    # the full linearizability check
+    n_workers = 5
+    seq_counters = {k: itertools.count(1) for k in keys}
+    key_owner = {k: i % n_workers for i, k in enumerate(keys)}
+
+    def _seq_of(value) -> int:
+        if isinstance(value, bytes) and value[:1] == b"s":
+            try:
+                return int(value[1:])
+            except ValueError:
+                return -1
+        return -1
+
     async def worker(cid: int):
         n = 0
+        own_keys = [k for k in keys if key_owner[k] == cid]
         while not stop.is_set():
             n += 1
-            key = rng.choice(keys)
-            if n % 2 == 0:
-                val = b"c%d-%d" % (cid, n)
+            if read_mix > 0:
+                do_read = not own_keys or rng.random() < read_mix
+                key = rng.choice(keys if do_read else own_keys)
+            else:
+                do_read = n % 2 == 1
+                key = rng.choice(keys)
+            if not do_read:
+                val = (b"s%08d" % next(seq_counters[key])
+                       if read_mix > 0 else b"c%d-%d" % (cid, n))
                 tok = h.invoke(cid, "w", (key, val))
                 try:
                     await asyncio.wait_for(kv.put(key, val), 4.0)
@@ -1030,6 +1059,40 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             "faults": {a.name: a.applied for a in actions},
             "checker_s": round(check_s, 1),
         }
+        if read_mix > 0:
+            # targeted no-stale-read assertion (a read must observe
+            # every write acked before it was issued) on top of the
+            # full linearizability proof
+            stale = check_stale_reads(ops, _seq_of)
+            result["read_mix"] = read_mix
+            result["read_from"] = read_from
+            result["reads"] = sum(1 for o in ops if o.kind == "r")
+            result["stale_reads"] = len(stale)
+            if stale:
+                result["linearizable"] = False
+                result["stale_violations"] = stale[:5]
+        # read-plane counters: store-wide confirm batching, per-batch
+        # fence dedupe, lease vs SAFE vs forwarded serve counts, and
+        # (when spread) the client's fan-out distribution
+        read_plane: dict[str, int] = {}
+
+        def _acc(d: dict) -> None:
+            for k, v in d.items():
+                read_plane[k] = read_plane.get(k, 0) + v
+
+        for store in c.stores.values():
+            if getattr(store, "read_batcher", None) is not None:
+                _acc(store.read_batcher.counters())
+            _acc({"kv_read_fences": store.kv_processor.read_fences,
+                  "kv_fenced_reads": store.kv_processor.fenced_reads})
+            for re_ in store._regions.values():
+                node = re_.node
+                if node is not None:
+                    _acc(node.read_only_service.counters())
+        if any(read_plane.values()):
+            result["read_plane"] = read_plane
+        if read_from != "leader":
+            result["read_serves"] = dict(kv.read_serves)
         if chaos:
             injected: dict[str, int] = {}
             for cd in chaos.values():
@@ -1170,6 +1233,20 @@ def main() -> None:
                          "coalesce into store-grouped kv_command_batch "
                          "RPCs; linearizability is checked per op as "
                          "usual (batched items ack/apply atomically)")
+    ap.add_argument("--read-mix", type=float, default=0.0, metavar="FRAC",
+                    help="read-dominant workload: reads with this "
+                         "probability (e.g. 0.95), writes carry per-key "
+                         "monotone sequence values (one writer per key) "
+                         "so the checker additionally asserts NO STALE "
+                         "READ — a read must observe every write acked "
+                         "before it was issued — under the full nemesis "
+                         "menu")
+    ap.add_argument("--read-from",
+                    choices=["leader", "follower", "learner", "any"],
+                    default="leader",
+                    help="route GETs to this replica class (client "
+                         "read fan-out; follower/learner serve locally "
+                         "after a forwarded-ReadIndex fence)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -1186,7 +1263,9 @@ def main() -> None:
                                   quiesce=args.quiesce,
                                   kv_batching=args.kv_batching,
                                   geo=args.geo,
-                                  witness=args.witness))
+                                  witness=args.witness,
+                                  read_mix=args.read_mix,
+                                  read_from=args.read_from))
     import json
 
     print(json.dumps(result))

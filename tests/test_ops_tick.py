@@ -215,7 +215,7 @@ def test_numpy_twin_matches_device_tick_randomized():
                                                eng.lease_ms, eng.snap_ms))
         for field in ("commit_rel", "commit_advanced", "elected",
                       "election_due", "step_down", "hb_due",
-                      "lease_valid", "snap_due"):
+                      "lease_valid", "snap_due", "q_ack"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(dev_out, field)),
                 np.asarray(getattr(np_out, field)),

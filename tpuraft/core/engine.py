@@ -384,7 +384,19 @@ class EngineControl:
         return max(0.0, (self.engine.now_ms() - q) / 1000.0)
 
     def lease_valid(self) -> bool:
-        if (self.engine.now_ms() - self._quorum_ack_ms()
+        e = self.engine
+        # device lane fast path: the last tick's fused q_ack reduction
+        # (ops/tick.py lease_valid lane) is a LOWER bound on the current
+        # quorum-ack time — acks only ever arrive — so a lease check
+        # that passes against it is sound without copying+sorting the
+        # [P] row per read.  A miss (stale row, ack between ticks, or
+        # genuinely expired) falls back to the exact host-side check.
+        q = int(e.tick_q_ack[self.slot])
+        if q > _NEG_I32 and e.now_ms() - q < self._lease_ms:
+            e.lease_lane_hits += 1
+            return True
+        e.lease_lane_misses += 1
+        if (e.now_ms() - self._quorum_ack_ms()
                 < self._lease_ms):
             return True
         # quiescent leader: its per-group ack stream is suppressed, so
@@ -637,7 +649,7 @@ class _NpOutputs:
     """numpy TickOutputs twin (backend="numpy" fallback)."""
 
     __slots__ = ("commit_rel", "commit_advanced", "elected", "election_due",
-                 "step_down", "hb_due", "lease_valid", "snap_due")
+                 "step_down", "hb_due", "lease_valid", "snap_due", "q_ack")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -671,6 +683,14 @@ class MultiRaftEngine:
         # group's hb_due/election_due masks on device; liveness rides
         # the store-level lease (HeartbeatHub).  Host-owned like role.
         self.quiescent = np.zeros(g, bool)
+        # read plane: the last tick's fused q_ack reduction ([G] q-th
+        # newest voter ack, ms).  Acks only ever arrive, so a stale row
+        # is a LOWER bound on the true quorum-ack time — a lease check
+        # that passes against it is sound, and one that fails falls back
+        # to the exact host-side [P] sort (EngineControl.lease_valid).
+        self.tick_q_ack = np.full(g, _NEG_I32, np.int64)
+        self.lease_lane_hits = 0     # lease reads answered off the row
+        self.lease_lane_misses = 0   # fell back to the host-side sort
         # store-lease plumbing for QUIESCENT LEADER slots: endpoint ->
         # {slot: [cols]} of last_ack cells refreshed by one store-lease
         # ack from that endpoint (flattened index arrays cached per
@@ -743,6 +763,7 @@ class MultiRaftEngine:
         self.hb_deadline -= shift
         self.snap_deadline -= shift
         np.maximum(self.last_ack - shift, _NEG_I32, out=self.last_ack)
+        np.maximum(self.tick_q_ack - shift, _NEG_I32, out=self.tick_q_ack)
 
     # -- registry ------------------------------------------------------------
 
@@ -892,6 +913,7 @@ class MultiRaftEngine:
         self.elect_deadline = pad(self.elect_deadline)
         self.hb_deadline = pad(self.hb_deadline)
         self.last_ack = pad(self.last_ack, _NEG_I32)
+        self.tick_q_ack = pad(self.tick_q_ack, _NEG_I32)
         self.granted = pad(self.granted)
         self.self_col = pad(self.self_col, -1)
         self.has_ctrl = pad(self.has_ctrl)
@@ -927,6 +949,7 @@ class MultiRaftEngine:
         self.elect_deadline[s] = 0
         self.hb_deadline[s] = 0
         self.last_ack[s] = _NEG_I32
+        self.tick_q_ack[s] = _NEG_I32
         self.granted[s] = False
         self.quiescent[s] = False
         self.note_wake_leader(s)
@@ -971,6 +994,10 @@ class MultiRaftEngine:
             ovm[cols[peer]] = True
         self.voter_mask[slot] = vm
         self.old_voter_mask[slot] = ovm
+        # the cached read-plane q_ack was reduced over the OLD voter set;
+        # a shrunk conf can make it overstate the new quorum's freshness
+        # (no longer a lower bound) — drop it until the next tick
+        self.tick_q_ack[slot] = _NEG_I32
         if self.role[slot] == ROLE_LEADER:
             # grace window for peers ADDED mid-leadership (reference:
             # addReplicator stamps lastRpcSendTimestamp at start): a
@@ -1059,6 +1086,8 @@ class MultiRaftEngine:
                 f"quiescent={int(self.quiescent.sum())} "
                 f"quiesce_events={self.quiesce_events} "
                 f"wake_events={self.wake_events} "
+                f"lease_lane_hits={self.lease_lane_hits} "
+                f"lease_lane_misses={self.lease_lane_misses} "
                 f"eto_floor_ms={self._floor_applied_ms}>")
 
     # -- tick loop -----------------------------------------------------------
@@ -1115,7 +1144,7 @@ class MultiRaftEngine:
                 out_sh = TickOutputs(
                     commit_rel=row, commit_advanced=row, elected=row,
                     election_due=row, step_down=row, hb_due=row,
-                    lease_valid=row, snap_due=row)
+                    lease_valid=row, snap_due=row, q_ack=row)
                 self._tick_fn = jax.jit(
                     outputs_only,
                     in_shardings=(state_sh, scalar,
@@ -1275,6 +1304,10 @@ class MultiRaftEngine:
             out = self._np_tick(rel, commit_rel_now, now)
 
         self.ticks += 1
+        # publish the read-plane lane: the fused q_ack reduce is exactly
+        # what per-read lease checks need, and the row it replaces is a
+        # per-read [P] copy+sort on the hot GET path
+        np.copyto(self.tick_q_ack, np.asarray(out.q_ack))
         advanced = self._apply_commits(out)
         self._apply_protocol(out, now)
         return advanced
@@ -1353,6 +1386,7 @@ class MultiRaftEngine:
             lease_valid=is_leader & have_ack & (now - q_ack < self.lease_ms),
             snap_due=(self.role != ROLE_INACTIVE) & (self.snap_ms > 0)
             & (now >= self.snap_deadline),
+            q_ack=q_ack,
         )
 
     def _apply_commits(self, out) -> int:

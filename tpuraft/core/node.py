@@ -35,7 +35,7 @@ from tpuraft.entity import (
     Task,
 )
 from tpuraft.errors import RaftError, RaftException, Status
-from tpuraft.options import NodeOptions
+from tpuraft.options import NodeOptions, ReadOnlyOption
 from tpuraft.rpc.messages import (
     AppendEntriesRequest,
     AppendEntriesResponse,
@@ -1394,14 +1394,40 @@ class Node:
         return await self.snapshot_executor.handle_install_snapshot(req)
 
     async def handle_read_index(self, req: ReadIndexRequest) -> ReadIndexResponse:
-        """Follower-forwarded readIndex: only the leader serves it."""
+        """Follower-forwarded readIndex: only the leader serves it.  A
+        rejection carries this node's current leader hint (trailing wire
+        field) so the forwarder re-probes the real leader within its
+        attempt instead of surfacing a terminal error."""
         if not self.is_leader():
-            return ReadIndexResponse(index=0, success=False)
+            return ReadIndexResponse(index=0, success=False,
+                                     term=self.current_term,
+                                     leader_hint=str(self.leader_id)
+                                     if not self.leader_id.is_empty()
+                                     else "")
         try:
             idx = await self.read_only_service.leader_confirm_read_index()
-            return ReadIndexResponse(index=idx, success=True)
+            # LEASE mode serves the fence without any beat round, so the
+            # forwarding follower may sit on the committed ENTRIES but
+            # not the commit KNOWLEDGE until the next periodic beat (up
+            # to one heartbeat interval — observed as ~1s forwarded-read
+            # stalls in its local wait_applied).  Push one beat at it
+            # now; the beat's prev-log check makes the commit transfer
+            # safe where blindly adopting the bare index would not be
+            # (a divergent-tail follower must never commit its own
+            # stale entries at the leader's index).  SAFE mode skips
+            # this: its confirmation round just beat every follower.
+            if (self.options.raft_options.read_only_option
+                    == ReadOnlyOption.LEASE_BASED):
+                r = self.replicators.get(PeerId.parse(req.server_id))
+                if r is not None and r.match_index >= idx:
+                    t = asyncio.ensure_future(r.send_heartbeat())
+                    t.add_done_callback(
+                        lambda tt: tt.cancelled() or tt.exception())
+            return ReadIndexResponse(index=idx, success=True,
+                                     term=self.current_term)
         except Exception:
-            return ReadIndexResponse(index=0, success=False)
+            return ReadIndexResponse(index=0, success=False,
+                                     term=self.current_term)
 
     # ======================================================================
     # membership change (reference: ConfigurationCtx — SURVEY.md §3.1)

@@ -6,6 +6,19 @@ leader confirms its leadership for the batch (SAFE: one heartbeat quorum
 round; LEASE_BASED: check the clock lease), pins readIndex = commitIndex,
 then resolves once the FSM has applied up to it.  Followers forward to
 the leader and wait locally.
+
+Amortization layers (docs/operations.md "Read serving runbook"):
+- per group: concurrent readers of one group share one confirmation
+  round (``_join_round`` — the reference's batching);
+- per store: when a store-level confirm batcher is attached
+  (``tpuraft.rheakv.store_engine.ReadConfirmBatcher``), the SAFE quorum
+  confirmations of ALL led groups on the store coalesce into one
+  beat-plane round — one ``multi_beat_fast`` RPC per destination
+  endpoint carries every group's read fence, the same way the
+  HeartbeatHub amortizes idle beats;
+- lease reads (``ReadOnlyOption.LEASE_BASED``) skip the round entirely,
+  and on a HIBERNATING leader are served off the store-level liveness
+  lease without waking the group (quiescence composition).
 """
 
 from __future__ import annotations
@@ -14,6 +27,7 @@ import asyncio
 import logging
 from typing import Optional
 
+from tpuraft.entity import PeerId
 from tpuraft.errors import RaftError, Status
 from tpuraft.options import ReadOnlyOption
 from tpuraft.rpc.messages import ReadIndexRequest
@@ -32,6 +46,33 @@ class ReadOnlyService:
         # forward RPC serves every reader queued for that round)
         self._fwd_pending: list[asyncio.Future] = []
         self._fwd_task: Optional[asyncio.Task] = None
+        # store-level SAFE-confirmation amortizer (attached by the
+        # StoreEngine for region groups; None = per-group rounds)
+        self._confirm_batcher = None
+        # read-plane counters (surfaced via RaftRawKVStore/StoreEngine
+        # describe + the bench/soak stats lines)
+        self.reads_served = 0     # read_index() calls resolved
+        self.lease_serves = 0     # confirmed by the leader lease alone
+        self.safe_rounds = 0      # per-group SAFE heartbeat rounds run
+        self.batched_confirms = 0  # SAFE confirms amortized store-wide
+        self.fwd_rounds = 0       # forward RPCs sent (follower side)
+        self.fwd_redirects = 0    # leader-hint re-probes after rejection
+
+    def attach_confirm_batcher(self, batcher) -> None:
+        """Route this group's SAFE quorum confirmations through a
+        store-wide batcher (``ReadConfirmBatcher.confirm(node) ->
+        bool``) so confirmations of many groups share beat-plane RPCs."""
+        self._confirm_batcher = batcher
+
+    def counters(self) -> dict:
+        return {
+            "reads_served": self.reads_served,
+            "lease_serves": self.lease_serves,
+            "safe_rounds": self.safe_rounds,
+            "batched_confirms": self.batched_confirms,
+            "fwd_rounds": self.fwd_rounds,
+            "fwd_redirects": self.fwd_redirects,
+        }
 
     async def shutdown(self) -> None:
         for fut in self._pending + self._fwd_pending:
@@ -66,6 +107,7 @@ class ReadOnlyService:
         else:
             idx = await self._forward_to_leader()
         await node.fsm_caller.wait_applied(idx)
+        self.reads_served += 1
         return idx
 
     async def leader_confirm_read_index(self) -> int:
@@ -125,6 +167,16 @@ class ReadOnlyService:
                 if not fut.done():
                     fut.set_result(read_index)
 
+    def _effective_eto_ms(self) -> int:
+        """The ADOPTED election timeout: the engine's density floor may
+        have raised the node's timeout after construction (EngineControl.
+        _adopt_eto), and every read-side budget must track the adopted
+        value — a budget derived from a stale shorter timeout times out
+        forwarded reads on dense stores during the post-election no-op
+        window."""
+        ctrl_eto = getattr(self._node._ctrl, "_eto_ms", 0)
+        return max(int(ctrl_eto), self._node.options.election_timeout_ms)
+
     async def _leader_once(self) -> int:
         # a fresh leader briefly cannot serve reads (safety gate below);
         # WAIT for the term's no-op to apply — normally single-digit ms
@@ -137,7 +189,7 @@ class ReadOnlyService:
             try:
                 await asyncio.wait_for(
                     node.fsm_caller.wait_applied(node._term_first_index),
-                    node.options.election_timeout_ms / 2000.0)
+                    self._effective_eto_ms() / 2000.0)
             except asyncio.TimeoutError:
                 pass   # fall through: _confirm_once fails closed
         ok, read_index = await self._confirm_once()
@@ -149,14 +201,6 @@ class ReadOnlyService:
     async def _confirm_once(self) -> tuple[bool, int]:
         node = self._node
         read_index = node.ballot_box.last_committed_index
-        # a SAFE confirmation round beats the followers directly, and a
-        # beaten follower WAKES (note_activity) — the leader must wake
-        # with it or its hibernation outlives its followers' patience
-        # and they elect over it.  LEASE_BASED reads stay quiescent: the
-        # store-level lease already refreshes the leader's ack rows.
-        if node.options.raft_options.read_only_option != \
-                ReadOnlyOption.LEASE_BASED:
-            node._ctrl.note_activity()
         # SAFETY GATE: until this leader commits the first entry of its
         # OWN term (the election no-op), its lastCommittedIndex is a
         # follower-time carry-over that may LAG entries the previous
@@ -169,11 +213,30 @@ class ReadOnlyService:
             return False, read_index
         opt = node.options.raft_options.read_only_option
         if opt == ReadOnlyOption.LEASE_BASED and node.leader_lease_is_valid():
+            # served off the lease alone — NO quorum round, and no wake:
+            # a HIBERNATING leader's lease rides the store-level
+            # liveness lease (EngineControl.lease_valid consults
+            # store_lease_quorum_ok while quiescent), so a pure-read
+            # load leaves quiescent groups hibernated
+            self.lease_serves += 1
             return True, read_index
-        # SAFE: quorum heartbeat round
+        # SAFE quorum round (or the lease lapsed): the round beats the
+        # followers directly, and a beaten follower WAKES — the leader
+        # must wake with it or its hibernation outlives its followers'
+        # patience and they elect over it.  The wake sits HERE, after
+        # the lease check, so lease-served reads never un-hibernate the
+        # group (pre-fix: every SAFE-mode read woke it at the top).
+        node._ctrl.note_activity()
         voters = len(node.conf_entry.conf.peers)
         if voters <= 1:
             return node.is_leader(), read_index
+        if self._confirm_batcher is not None:
+            # store-wide amortization: this group's fence rides one
+            # beat-plane round shared with every other led group's
+            self.batched_confirms += 1
+            ok = await self._confirm_batcher.confirm(node)
+            return ok and node.is_leader(), read_index
+        self.safe_rounds += 1
         acks = 1 + await node.replicators.heartbeat_round()
         return acks >= voters // 2 + 1 and node.is_leader(), read_index
 
@@ -186,25 +249,52 @@ class ReadOnlyService:
                                       self._forward_once)
 
     async def _forward_once(self) -> int:
+        """One forward round: probe the believed leader; on a rejection
+        follow the responder's leader hint (trailing ReadIndexResponse
+        field) within the same round — bounded chain, each hop tried
+        once.  Exhaustion raises a RETRYABLE status (EAGAIN), never a
+        terminal EPERM: 'not the leader' resolves within ~an election
+        timeout, and the KV layer's retry engine probes the next
+        candidate store exactly like _store_candidates' coverage
+        contract promises."""
         node = self._node
-        leader = node.leader_id
-        if leader.is_empty():
-            raise _read_error(RaftError.EPERM, "no known leader")
-        req = ReadIndexRequest(
-            group_id=node.group_id,
-            server_id=str(node.server_id),
-            peer_id=str(leader),
-        )
-        try:
-            resp = await node.transport.read_index(
-                leader.endpoint, req,
-                timeout_ms=node.options.election_timeout_ms)
-        except RpcError as e:
-            raise _read_error(RaftError.ETIMEDOUT,
-                              f"readIndex forward to {leader} failed") from e
-        if not resp.success:
-            raise _read_error(RaftError.EPERM, "leader rejected readIndex")
-        return resp.index
+        target = node.leader_id
+        if target.is_empty():
+            raise _read_error(RaftError.EAGAIN, "no known leader")
+        tried: set[str] = set()
+        last = "no known leader"
+        while target is not None and not target.is_empty() \
+                and str(target) not in tried and len(tried) < 3:
+            tried.add(str(target))
+            req = ReadIndexRequest(
+                group_id=node.group_id,
+                server_id=str(node.server_id),
+                peer_id=str(target),
+            )
+            self.fwd_rounds += 1
+            try:
+                resp = await node.transport.read_index(
+                    target.endpoint, req,
+                    timeout_ms=self._effective_eto_ms())
+            except RpcError as e:
+                raise _read_error(
+                    RaftError.ETIMEDOUT,
+                    f"readIndex forward to {target} failed") from e
+            if resp.success:
+                return resp.index
+            hint = getattr(resp, "leader_hint", "")
+            last = (f"{target} rejected readIndex"
+                    + (f"; hinted {hint}" if hint else ""))
+            target = None
+            if hint:
+                try:
+                    hinted = PeerId.parse(hint)
+                except Exception:  # noqa: BLE001 — malformed hint
+                    hinted = None
+                if hinted is not None and hinted != node.server_id:
+                    self.fwd_redirects += 1
+                    target = hinted
+        raise _read_error(RaftError.EAGAIN, f"readIndex forward: {last}")
 
 
 class ReadIndexError(Exception):

@@ -121,6 +121,11 @@ class TickOutputs:
     hb_due: jnp.ndarray         # bool [G] leader heartbeat due this tick
     lease_valid: jnp.ndarray    # bool [G] leader lease currently valid (for reads)
     snap_due: jnp.ndarray       # bool [G] snapshot interval elapsed (any role)
+    q_ack: jnp.ndarray          # int32 [G] q-th newest voter ack time (the
+    # lease_valid lane's raw input, NEG_INF when no data) — the host keeps
+    # the last tick's row as a LOWER bound on the current quorum-ack time,
+    # so per-read lease checks (ReadOnlyOption.LEASE_BASED) answer off the
+    # fused reduction instead of re-sorting a [P] row per read
 
 
 def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
@@ -216,6 +221,7 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         hb_due=hb_due,
         lease_valid=lease_valid,
         snap_due=snap_due,
+        q_ack=q_ack,
     )
     return new_state, outputs
 

@@ -70,7 +70,7 @@ def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
 
     out_outputs = TickOutputs(
         commit_rel=row, commit_advanced=row, elected=row, election_due=row,
-        step_down=row, hb_due=row, lease_valid=row, snap_due=row)
+        step_down=row, hb_due=row, lease_valid=row, snap_due=row, q_ack=row)
     params_sharding = TickParams(scalar, scalar, scalar, scalar)
     return jax.jit(
         raft_tick,

@@ -142,8 +142,12 @@ class _StoreSender:
         self._task: Optional[asyncio.Task] = None
         self._lanes: set = set()   # in-flight send tasks
 
-    def submit(self, region: Region, peer: str, op: KVOperation
-               ) -> asyncio.Future:
+    def submit(self, region: Region, peer: str, op: KVOperation,
+               spread: bool = False) -> asyncio.Future:
+        """``spread=True`` marks a read routed OFF the leader (read_from
+        follower/learner fan-out): its outcome must not touch the
+        leader cache — a follower serving (or bouncing) a read says
+        nothing about who leads."""
         fut = asyncio.get_running_loop().create_future()
         # encode HERE, not in the send path: a malformed op (bad key
         # type) must fail its OWN caller, never poison the unrelated
@@ -156,7 +160,7 @@ class _StoreSender:
             fut.set_result(RheaKVError(Status.error(
                 RaftError.EINVAL, f"malformed op: {e!r}")))
             return fut
-        self._q.append((region, peer, blob, fut))
+        self._q.append((region, peer, blob, fut, spread))
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._drain())
         return fut
@@ -184,14 +188,14 @@ class _StoreSender:
             await self._send(batch)
         except Exception as e:  # noqa: BLE001 — fail THIS batch only
             st = Status.error(RaftError.EINTERNAL, f"batch send: {e!r}")
-            for _r, _p, _op, fut in batch:
-                if not fut.done():
-                    fut.set_result(RheaKVError(st))
+            for row in batch:
+                if not row[3].done():
+                    row[3].set_result(RheaKVError(st))
 
     async def _send(self, batch: list) -> None:
         client = self._client
         req = KVCommandBatchRequest(
-            items=[blob for _r, _p, blob, _f in batch])
+            items=[blob for _r, _p, blob, _f, _s in batch])
         try:
             resp = await client.transport.call(
                 self.endpoint, "kv_command_batch", req, client.timeout_ms)
@@ -205,13 +209,14 @@ class _StoreSender:
                     *(client._call_region_outcome(
                         region,
                         KVOperation.decode(decode_batch_item(blob)[3]))
-                      for region, _p, blob, _f in batch))
-                for (_r, _p, _b, fut), out in zip(batch, outs):
+                      for region, _p, blob, _f, _s in batch))
+                for (_r, _p, _b, fut, _s), out in zip(batch, outs):
                     if not fut.done():
                         fut.set_result(out)
                 return
-            for region, _p, _b, fut in batch:   # dead store: retryable
-                client._leaders.pop(region.id, None)
+            for region, _p, _b, fut, spread in batch:  # dead store:
+                if not spread:                         # retryable
+                    client._leaders.pop(region.id, None)
                 if not fut.done():
                     fut.set_result(_Retry(status=e.status))
             return
@@ -226,13 +231,14 @@ class _StoreSender:
                 RaftError.EINTERNAL,
                 f"kv_command_batch reply carried {len(resp.items)} items "
                 f"for {len(batch)} requests")
-            for _r, _p, _b, fut in batch:
-                if not fut.done():
-                    fut.set_result(RheaKVError(st))
+            for row in batch:
+                if not row[3].done():
+                    row[3].set_result(RheaKVError(st))
             return
-        for (region, peer, _b, fut), blob in zip(batch, resp.items):
+        for (region, peer, _b, fut, spread), blob in zip(batch, resp.items):
             if not fut.done():
-                fut.set_result(client._decode_outcome(region, peer, blob))
+                fut.set_result(client._decode_outcome(region, peer, blob,
+                                                      spread=spread))
 
 
 # graftcheck: loop-confined — route table, batchers and store senders
@@ -242,22 +248,36 @@ class RheaKVStore:
                  timeout_ms: float = 5000, max_retries: int = 8,
                  retry_interval_ms: float = 50,
                  batching: Optional[BatchingOptions] = None,
-                 read_preference: str = "leader"):
+                 read_preference: str = "leader",
+                 read_from: str = ""):
         if read_preference not in ("leader", "any"):
             raise ValueError(f"read_preference {read_preference!r} "
                              "(must be 'leader' or 'any')")
+        # read_from: where GETs (and other read-only ops) are served —
+        #   "leader"   (default) leader store, batched with writes;
+        #   "follower" nearest non-leader voter (local serve after a
+        #              forwarded-ReadIndex fence), batched per store;
+        #   "learner"  learner read replicas first (PR 2's membership
+        #              learners as real read capacity), batched;
+        #   "any"      legacy round-robin over ALL data replicas via the
+        #              per-op path (read_preference="any" alias).
+        # Witness replicas hold no state and are never read targets.
+        if read_from == "":
+            read_from = "any" if read_preference == "any" else "leader"
+        if read_from not in ("leader", "follower", "learner", "any"):
+            raise ValueError(f"read_from {read_from!r} (must be 'leader', "
+                             "'follower', 'learner' or 'any')")
         self.pd = pd_client
         self.transport = transport
         self.route_table = RegionRouteTable()
         self.timeout_ms = timeout_ms
         self.max_retries = max_retries
         self.retry_interval_ms = retry_interval_ms
-        # "any": spread read-only ops round-robin over ALL replicas —
-        # followers and learners serve them linearizably by forwarding
-        # the readIndex barrier to the leader and waiting for local
-        # apply (core read path; no reference counterpart — RheaKV
-        # routes every read through the leader)
-        self.read_preference = read_preference
+        self.read_from = read_from
+        # legacy alias (pre-read_from callers introspect this)
+        self.read_preference = "any" if read_from == "any" else "leader"
+        # read fan-out observability: who actually SERVED spread reads
+        self.read_serves = {"leader": 0, "follower": 0, "learner": 0}
         self._read_rr: dict[int, int] = {}   # region id -> rotation cursor
         # region id -> endpoint of the last known leader's store
         self._leaders: dict[int, str] = {}
@@ -319,24 +339,50 @@ class RheaKVStore:
         except RheaKVError as e:
             return e
 
-    def _decode_outcome(self, region: Region, peer: str, blob: bytes):
+    def _decode_outcome(self, region: Region, peer: str, blob: bytes,
+                        spread: bool = False):
         code, msg, result, meta = decode_batch_reply(blob)
         if code == 0:
-            self._leaders[region.id] = peer
+            if spread:
+                # fan-out observability — and NO leader-cache update: a
+                # follower/learner serving a read says nothing about
+                # who leads
+                self._note_read_serve(region, peer)
+            else:
+                self._leaders[region.id] = peer
             return ("ok", decode_result(result))
         st = Status(code, msg)
         self.batch_retries[code] = self.batch_retries.get(code, 0) + 1
         if code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
             if meta:
-                self.route_table.add_or_update(Region.decode(meta))
+                fresh = Region.decode(meta)
+                if spread and (fresh.epoch.version, fresh.epoch.conf_ver) \
+                        < (region.epoch.version, region.epoch.conf_ver):
+                    # a LAGGING replica (pre-split view): its meta is
+                    # useless and a sibling replica can still serve —
+                    # bounce to the next candidate, no route refresh
+                    return _Retry(status=st)
+                self.route_table.add_or_update(fresh)
             return _Retry(refresh=True, status=st)
         if code == ERR_NO_REGION:
-            self._leaders.pop(region.id, None)
+            if not spread:
+                self._leaders.pop(region.id, None)
             return _Retry(refresh=True, status=st)
         if code in _RETRYABLE_CODES:
-            self._leaders.pop(region.id, None)
+            if not spread:
+                self._leaders.pop(region.id, None)
             return _Retry(status=st)
         return RheaKVError(st)
+
+    def _note_read_serve(self, region: Region, peer: str) -> None:
+        """Classify which replica class served a spread read (fan-out
+        observability, read_serves counters)."""
+        if peer.endswith("/learner"):
+            self.read_serves["learner"] += 1
+        elif peer == self._leaders.get(region.id):
+            self.read_serves["leader"] += 1
+        else:
+            self.read_serves["follower"] += 1
 
     def _sender(self, endpoint: str) -> _StoreSender:
         s = self._senders.get(endpoint)
@@ -351,10 +397,18 @@ class RheaKVStore:
         the next candidate store WITHIN the cycle — the batch analog of
         _call_region probing every endpoint in one attempt, so a cold
         leader cache costs extra round trips, never the outer backoff
-        sleep."""
+        sleep.  Read-only ops under read_from=follower/learner route to
+        the nearest data replica instead of the leader store (served
+        there after a forwarded-ReadIndex fence), still riding the
+        store-grouped batch plane."""
+        spread = (self.read_from in ("follower", "learner")
+                  and op.op in _READONLY_OPS)
+        cands = (self._read_candidates(region, attempt) if spread
+                 else self._store_candidates(region, attempt))
         out = None
-        for peer in self._store_candidates(region, attempt):
-            out = await self._sender(_endpoint(peer)).submit(region, peer, op)
+        for peer in cands:
+            out = await self._sender(_endpoint(peer)).submit(
+                region, peer, op, spread=spread)
             if not self._batch_ok:
                 # the fleet downgraded mid-flight; the sender already
                 # served this item through the per-op path
@@ -376,7 +430,7 @@ class RheaKVStore:
         (see _call_region_outcome)."""
         def is_direct(region, op):
             return (not self._batch_ok
-                    or (self.read_preference == "any"
+                    or (self.read_from == "any"
                         and op.op in _READONLY_OPS))
 
         return list(await asyncio.gather(
@@ -561,20 +615,49 @@ class RheaKVStore:
 
     def _read_endpoints_for(self, region: Region) -> list[str]:
         """Round-robin over the DATA replicas (voters, learners, leader
-        alike) for read-only ops under read_preference='any' — witness
+        alike) for read-only ops under read_from='any' — witness
         replicas hold no state and are never read targets."""
         peers = [p for p in region.peers if not p.endswith("/witness")]
         cur = self._read_rr.get(region.id, region.id)
         self._read_rr[region.id] = cur + 1
         return [peers[(cur + i) % len(peers)] for i in range(len(peers))]
 
+    def _read_candidates(self, region: Region, attempt: int) -> list[str]:
+        """read_from='follower'|'learner' candidate ordering: the
+        preferred replica class first (rotated per region so fan-out
+        spreads), then the remaining data replicas as fallback — a
+        region with no replica of the preferred class still serves.
+        Witnesses are never read targets (no state to serve)."""
+        learners = [p for p in region.peers if p.endswith("/learner")]
+        voters = [p for p in region.peers if not p.endswith("/learner")
+                  and not p.endswith("/witness")]
+        leader = self._leaders.get(region.id)
+        followers = [p for p in voters if p != leader]
+        leader_tail = [leader] if leader in voters else []
+        if self.read_from == "learner":
+            pool, rest = learners, followers + leader_tail
+        else:
+            pool, rest = followers, leader_tail + learners
+        if not pool:
+            pool, rest = voters, learners
+        if not pool:
+            return [p for p in region.peers if not p.endswith("/witness")]
+        cur = self._read_rr.get(region.id, region.id)
+        self._read_rr[region.id] = cur + 1
+        k = (cur + attempt) % len(pool)
+        return pool[k:] + pool[:k] + [p for p in rest if p not in pool]
+
     async def _call_region(self, region: Region, op: KVOperation):
         """One attempt cycle over a region's stores; raises on hard error."""
         last_status = Status.error(RaftError.EAGAIN, "no store reachable")
-        spread_read = (self.read_preference == "any"
+        spread_read = (self.read_from != "leader"
                        and op.op in _READONLY_OPS)
-        eps = (self._read_endpoints_for(region) if spread_read
-               else self._endpoints_for(region))
+        if not spread_read:
+            eps = self._endpoints_for(region)
+        elif self.read_from == "any":
+            eps = self._read_endpoints_for(region)
+        else:
+            eps = self._read_candidates(region, 0)
         for ep_str in eps:
             # peers are PeerId strings; the store serves on ip:port
             endpoint = _endpoint(ep_str)
@@ -594,6 +677,8 @@ class RheaKVStore:
             if resp.code == 0:
                 if not spread_read:
                     self._leaders[region.id] = ep_str
+                else:
+                    self._note_read_serve(region, ep_str)
                 return decode_result(resp.result)
             if resp.code in (ERR_INVALID_EPOCH, ERR_KEY_OUT_OF_RANGE):
                 fresh = Region.decode(resp.region_meta)

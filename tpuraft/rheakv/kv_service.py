@@ -222,6 +222,10 @@ class KVCommandProcessor:
         self.batch_items = 0     # items carried inside them
         self.batch_regions = 0   # distinct regions proposed per batch, summed
         self.single_rpcs = 0     # legacy per-op kv_command RPCs served
+        # read plane: N batched GETs of one region cost ONE read_index
+        # fence (fenced_reads / read_fences = the amortization ratio)
+        self.read_fences = 0     # read_index barriers taken for batches
+        self.fenced_reads = 0    # read ops served under those barriers
 
     async def handle_list_regions(self, req: ListRegionsOnStoreRequest
                                   ) -> ListRegionsOnStoreResponse:
@@ -263,20 +267,11 @@ class KVCommandProcessor:
         try:
             if op.op in _WRITE_OPS:
                 result = await rs.apply(op)
-            elif op.op == KVOp.GET:
-                result = await rs.get(op.key)
-            elif op.op == KVOp.MULTI_GET:
-                keys = KVOperation.unpack_key_list(op.value)
-                got = await rs.multi_get(keys)
-                result = [(k, got[k]) for k in keys]
-            elif op.op == KVOp.CONTAINS_KEY:
-                result = await rs.contains_key(op.key)
-            elif op.op == KVOp.SCAN:
-                (limit, rv, reverse) = struct.unpack("<iBB", op.aux)
-                scan = rs.reverse_scan if reverse else rs.scan
-                result = await scan(op.key, op.value, limit, bool(rv))
             else:
-                return int(RaftError.EINVAL), f"bad op {op.op}", None
+                # ONE dispatch table for reads: fence here, then the
+                # same local-serve path the batched fast path uses
+                await rs.node.read_index()
+                return _serve_read_local(rs, op)
         except KVStoreError as e:
             return e.status.code, e.status.error_msg, None
         except (RpcError, ReadIndexError) as e:
@@ -348,19 +343,64 @@ class KVCommandProcessor:
                         replies[i] = encode_batch_reply(
                             int(RaftError.EINTERNAL), str(e))
 
-            async def run_read(i: int, op: KVOperation) -> None:
-                code, msg, result = await self._execute_op(rs, op)
-                replies[i] = (
-                    encode_batch_reply(0, result=encode_result(result))
-                    if code == 0 else encode_batch_reply(code, msg))
+            async def run_reads() -> None:
+                # ONE read fence for the whole region sub-batch: every
+                # read here was pinned before the fence's confirmation
+                # round started, so serving all of them at the fenced
+                # index is linearizable — and a kv_command_batch with N
+                # GETs for one region costs one confirmation, not N
+                try:
+                    await rs.node.read_index()
+                except (RpcError, ReadIndexError) as e:
+                    # keep the real (retryable) status per item
+                    for i, _ in reads:
+                        replies[i] = encode_batch_reply(e.status.code,
+                                                        e.status.error_msg)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    for i, _ in reads:
+                        replies[i] = encode_batch_reply(
+                            int(RaftError.EINTERNAL), str(e))
+                    return
+                self.read_fences += 1
+                self.fenced_reads += len(reads)
+                for i, op in reads:
+                    code, msg, result = _serve_read_local(rs, op)
+                    replies[i] = (
+                        encode_batch_reply(0, result=encode_result(result))
+                        if code == 0 else encode_batch_reply(code, msg))
 
             await asyncio.gather(
                 *([run_writes()] if writes else []),
-                *(run_read(i, op) for i, op in reads))
+                *([run_reads()] if reads else []))
 
         await asyncio.gather(*(run_region(rid, items)
                                for rid, items in groups.items()))
         return KVCommandBatchResponse(items=replies)
+
+
+def _serve_read_local(rs, op: KVOperation) -> tuple[int, str, object]:
+    """Serve one read-only op DIRECTLY off the local store — the caller
+    already holds the region's read fence (read_index + wait_applied),
+    so no per-op barrier is taken."""
+    try:
+        if op.op == KVOp.GET:
+            result = rs.store.get(op.key)
+        elif op.op == KVOp.MULTI_GET:
+            keys = KVOperation.unpack_key_list(op.value)
+            got = rs.store.multi_get(keys)
+            result = [(k, got[k]) for k in keys]
+        elif op.op == KVOp.CONTAINS_KEY:
+            result = rs.store.contains_key(op.key)
+        elif op.op == KVOp.SCAN:
+            (limit, rv, reverse) = struct.unpack("<iBB", op.aux)
+            scan = rs.store.reverse_scan if reverse else rs.store.scan
+            result = scan(op.key, op.value, limit, bool(rv))
+        else:
+            return int(RaftError.EINVAL), f"bad read op {op.op}", None
+    except Exception as e:  # noqa: BLE001
+        return int(RaftError.EINTERNAL), str(e), None
+    return 0, "", result
 
 
 _SINGLE_KEY_OPS = {

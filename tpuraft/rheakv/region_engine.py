@@ -53,6 +53,10 @@ class RegionEngine:
             self.group_id, se.server_id, opts, se.node_manager, se.transport,
             ballot_box_factory=se.ballot_box_factory())
         node = await self._group_service.start()
+        if se.read_batcher is not None:
+            # store-wide SAFE read amortization: this group's quorum
+            # confirmations ride the store's shared beat-plane rounds
+            node.read_only_service.attach_confirm_batcher(se.read_batcher)
         self.raft_store = RaftRawKVStore(
             node, se.raw_store, multi_entries=se.opts.multi_op_entries)
         LOG.info("region engine started: %s on %s", self.region,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -34,6 +35,8 @@ from tpuraft.rheakv.raw_store import (
     MetricsRawKVStore,
     RawKVStore,
 )
+from tpuraft.rpc.messages import BatchRequest, CompactBeat
+from tpuraft.rpc.transport import RpcError, is_no_method
 from tpuraft.util.metrics import MetricRegistry
 from tpuraft.rheakv.region_engine import RegionEngine
 
@@ -89,6 +92,275 @@ class StoreEngineOptions:
     # on PD heartbeats so the PD spreads leaders across zones; "" =
     # unlabeled (single-zone legacy deployments)
     zone: str = ""
+    # store-wide SAFE ReadIndex amortization: pending read confirmations
+    # of ALL led groups coalesce into one beat-plane round per window
+    # (ReadConfirmBatcher) instead of one quorum heartbeat round per
+    # group.  False = per-group rounds (the pre-batch behavior).
+    read_confirm_batching: bool = True
+
+
+class _GroupFence:
+    """One group's pending read fence inside a ReadConfirmBatcher round:
+    the (node, term) pinned at round build plus the ack tally.  Resolves
+    its futures True the moment a voter quorum (both configs while
+    joint) has acked IN TERM — stragglers then only delay other groups,
+    never this one's readers."""
+
+    __slots__ = ("node", "term", "futs", "new_peers", "old_peers", "acked")
+
+    def __init__(self, node, futs: list) -> None:
+        self.node = node
+        self.term = node.current_term
+        self.futs = futs
+        self.new_peers = set(node.conf_entry.conf.peers)
+        self.old_peers = set(node.conf_entry.old_conf.peers)
+        self.acked = {node.server_id}
+
+    def _quorum(self) -> bool:
+        ok_new = (len(self.acked & self.new_peers)
+                  >= len(self.new_peers) // 2 + 1)
+        if not self.old_peers:
+            return ok_new
+        # joint consensus: a read fence must prove leadership against
+        # BOTH quorums — a new-config-only majority may not intersect
+        # the electorate that could depose us mid-change
+        return ok_new and (len(self.acked & self.old_peers)
+                           >= len(self.old_peers) // 2 + 1)
+
+    def note_ack(self, peer) -> None:
+        node = self.node
+        if not node.is_leader() or node.current_term != self.term:
+            return  # deposed/re-elected mid-round: this fence is void
+        self.acked.add(peer)
+        if self._quorum():
+            self.resolve(True)
+
+    def resolve(self, ok: bool) -> None:
+        for fut in self.futs:
+            if not fut.done():
+                fut.set_result(ok)
+
+    @property
+    def done(self) -> bool:
+        return all(fut.done() for fut in self.futs)
+
+
+# graftcheck: loop-confined — one batcher per StoreEngine, driven from
+# the store's event loop; pending lists, fences and counters are
+# lockless by that confinement
+class ReadConfirmBatcher:
+    """Store-wide SAFE ReadIndex confirmation amortizer.
+
+    ``ReadOnlyService`` already batches the concurrent readers of ONE
+    group into one confirmation round; at region density that still
+    costs one quorum heartbeat round PER GROUP with pending reads.  This
+    batcher coalesces the pending SAFE confirmations of ALL led groups
+    on a store into one beat-plane round: each round packs every pending
+    group's read fence as a ``CompactBeat`` row and sends ONE
+    ``multi_beat_fast`` RPC per destination endpoint (exactly how the
+    HeartbeatHub amortizes idle beats), then tallies per-group in-term
+    acks.  A ``BeatAck(ok=True)`` proves the follower saw this node as
+    the leader of this term when it answered — the same leadership proof
+    an empty-AppendEntries ack carries — so the fence is SAFE, not
+    clock-dependent.  Deviating rows (term moved, follower restarted,
+    committed behind) get a classic full-semantics beat as the follow-up
+    and its in-term ack still counts.
+
+    Safety argument (docs/architecture.md "Read-fence batching"):
+    read_index is pinned BEFORE ``confirm()`` enqueues, every beat of a
+    round is built AFTER the round collected its batch, and a fence only
+    counts acks while ``(is_leader, term)`` still match the values
+    pinned at round build — so each reader's confirmation round-trip
+    strictly follows its invoke, which is the ReadIndex linearizability
+    requirement.  Rounds are windowed (``max_inflight_rounds``): one
+    dead endpoint's RPC timeout delays only its own round's stragglers,
+    not the store's whole read plane.
+    """
+
+    max_inflight_rounds = 4
+
+    def __init__(self) -> None:
+        self._pending: list = []   # (node, future)
+        self._task: Optional[asyncio.Task] = None
+        self._rounds_inflight: set = set()
+        self._fast_ok: dict[str, bool] = {}  # dst serves multi_beat_fast
+        # counters (describe() + bench/soak stats lines)
+        self.confirms = 0       # fences requested
+        self.rounds = 0         # store-wide rounds run
+        self.beat_rpcs = 0      # multi_beat_fast RPCs sent
+        self.beats = 0          # CompactBeat fence rows carried
+        self.classic_beats = 0  # classic per-peer follow-ups/fallbacks
+        self.failed = 0         # fences that ended unconfirmed
+        # gauges bound to the live counters (the HeartbeatHub idiom)
+        self.metrics = MetricRegistry()
+        for name in ("confirms", "rounds", "beat_rpcs", "beats",
+                     "classic_beats", "failed"):
+            self.metrics.gauge(f"read_batcher.{name}",
+                               lambda n=name: getattr(self, n))
+        self.metrics.gauge(
+            "read_batcher.reads_per_round",
+            lambda: self.confirms / self.rounds if self.rounds else 0.0)
+
+    def counters(self) -> dict:
+        return {
+            "read_confirms": self.confirms,
+            "read_rounds": self.rounds,
+            "read_beat_rpcs": self.beat_rpcs,
+            "read_beats": self.beats,
+            "read_classic_beats": self.classic_beats,
+            "read_failed": self.failed,
+        }
+
+    def describe(self) -> str:
+        amort = self.confirms / self.rounds if self.rounds else 0.0
+        return (f"ReadConfirmBatcher<confirms={self.confirms} "
+                f"rounds={self.rounds} reads_per_round={amort:.2f} "
+                f"beat_rpcs={self.beat_rpcs} beats={self.beats} "
+                f"classic={self.classic_beats} failed={self.failed}>")
+
+    async def confirm(self, node) -> bool:
+        """Enqueue one group's SAFE leadership fence; resolves True once
+        a voter quorum acked a beat of a round that started after this
+        call."""
+        self.confirms += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((node, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain())
+        return await fut
+
+    async def shutdown(self) -> None:
+        for _node, fut in self._pending:
+            if not fut.done():
+                fut.set_result(False)
+        self._pending.clear()
+        for t in list(self._rounds_inflight):
+            t.cancel()
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+
+    async def _drain(self) -> None:
+        # microtask hop: every fence enqueued by tasks runnable in this
+        # loop iteration joins the first round (the _Batcher idiom);
+        # then windowed rounds — a round stuck on a dead endpoint's
+        # timeout must not convoy later readers behind it
+        await asyncio.sleep(0)
+        while self._pending or self._rounds_inflight:
+            while self._pending \
+                    and len(self._rounds_inflight) < self.max_inflight_rounds:
+                batch, self._pending = self._pending, []
+                t = asyncio.ensure_future(self._round(batch))
+                self._rounds_inflight.add(t)
+                t.add_done_callback(self._reap_round)
+            if self._rounds_inflight:
+                await asyncio.wait(set(self._rounds_inflight),
+                                   return_when=asyncio.FIRST_COMPLETED)
+
+    def _reap_round(self, t: asyncio.Task) -> None:
+        self._rounds_inflight.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            LOG.warning("read-confirm round failed: %r", t.exception())
+
+    async def _round(self, batch: list) -> None:
+        """One store-wide round: build every pending group's fence beats
+        SYNCHRONOUSLY (no await between the is_leader check and the
+        build — the HeartbeatHub invariant), dispatch one RPC per
+        destination, tally."""
+        self.rounds += 1
+        groups: dict[int, _GroupFence] = {}
+        order: list[_GroupFence] = []
+        for node, fut in batch:
+            st = groups.get(id(node))
+            if st is None:
+                st = groups[id(node)] = _GroupFence(node, [fut])
+                order.append(st)
+            else:
+                st.futs.append(fut)
+        by_dst: dict[str, list] = {}
+        classic: list = []
+        try:
+            for st in order:
+                node = st.node
+                if not node.is_leader():
+                    st.resolve(False)
+                    continue
+                voters = st.new_peers | st.old_peers
+                committed = node.ballot_box.last_committed_index
+                for r in node.replicators.all():
+                    if r.peer not in voters:
+                        continue   # a learner's ack proves nothing
+                    if (r.peer_multi_hb and r._matched
+                            and self._fast_ok.get(r.peer.endpoint, True)):
+                        beat = CompactBeat(
+                            group_id=node.group_id,
+                            server_id=str(node.server_id),
+                            peer_id=str(r.peer),
+                            term=st.term,
+                            committed_index=min(committed, r.match_index))
+                        by_dst.setdefault(r.peer.endpoint, []
+                                          ).append((st, r, beat))
+                    else:
+                        classic.append((st, r))
+                st.note_ack(node.server_id)  # self-only quorum case
+            await asyncio.gather(
+                *(self._beat_dst(dst, rows) for dst, rows in by_dst.items()),
+                *(self._classic(st, r) for st, r in classic))
+        finally:
+            for st in order:
+                if not st.done:
+                    self.failed += 1
+                st.resolve(False)
+
+    async def _beat_dst(self, dst: str, rows: list) -> None:
+        node = rows[0][0].node
+        self.beat_rpcs += 1
+        self.beats += len(rows)
+        try:
+            resp = await node.transport.call(
+                dst, "multi_beat_fast",
+                BatchRequest(items=[b for _s, _r, b in rows]),
+                timeout_ms=node.options.election_timeout_ms // 2 or 1)
+        except RpcError as e:
+            if is_no_method(e):
+                # pre-beat-plane receiver: classic beats from now on
+                self._fast_ok[dst] = False
+                await asyncio.gather(
+                    *(self._classic(st, r) for st, r, _b in rows))
+            return  # silence: the fences just miss these acks
+        if len(resp.items) != len(rows):
+            # short/overlong reply reads as silence for the whole chunk
+            # (zip would pair acks with the wrong fences)
+            LOG.warning("read-fence multi_beat_fast %s: %d acks for %d "
+                        "beats", dst, len(resp.items), len(rows))
+            return
+        now = time.monotonic()
+        fallback: list = []
+        for (st, r, _b), ack in zip(rows, resp.items):
+            if getattr(ack, "ok", False):
+                # inline ack bookkeeping, exactly like the hub's fast
+                # path: the lease plane sees the (peer, when) write too
+                r.last_rpc_ack = now
+                st.node.on_peer_ack(r.peer, now)
+                st.note_ack(r.peer)
+            else:
+                fallback.append((st, r))
+        if fallback:
+            # full-semantics follow-up: ok=False may just mean the
+            # follower's committed lags (restart) — a classic beat still
+            # returns the in-term ack the fence needs, and handles a
+            # higher term via the normal step-down path
+            await asyncio.gather(*(self._classic(st, r)
+                                   for st, r in fallback))
+
+    async def _classic(self, st: _GroupFence, r) -> None:
+        self.classic_beats += 1
+        try:
+            ok = await r.send_heartbeat()
+        except Exception:  # noqa: BLE001 — one peer's beat only
+            return
+        if ok:
+            st.note_ack(r.peer)
 
 
 class StoreEngine:
@@ -102,6 +374,14 @@ class StoreEngine:
         self.node_manager = NodeManager(rpc_server)
         CliProcessors(self.node_manager)
         self.kv_processor = KVCommandProcessor(self)
+        # store-wide SAFE read-confirmation amortizer (attached to every
+        # region node's ReadOnlyService by RegionEngine.start)
+        self.read_batcher: Optional[ReadConfirmBatcher] = \
+            ReadConfirmBatcher() if opts.read_confirm_batching else None
+        if self.read_batcher is not None:
+            from tpuraft.util import describer
+
+            describer.register(self.read_batcher)
         self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
         raw: RawKVStore = opts.raw_store_factory()
         if opts.enable_kv_metrics:
@@ -161,6 +441,11 @@ class StoreEngine:
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
+        if self.read_batcher is not None:
+            from tpuraft.util import describer
+
+            describer.unregister(self.read_batcher)
+            await self.read_batcher.shutdown()
         for engine in list(self._regions.values()):
             await engine.shutdown()
         self._regions.clear()

@@ -183,6 +183,14 @@ class ReadIndexRequest:
 class ReadIndexResponse:
     index: int
     success: bool
+    # trailing read-plane extensions (wire-compatible: old decoders drop
+    # them, old encoders leave the defaults).  On a rejection
+    # (success=False) the responder reports its term and its current
+    # leader hint so the forwarding follower can re-probe the REAL
+    # leader inside the same attempt instead of failing the whole read
+    # batch with a terminal error (ReadOnlyService._forward_once).
+    term: int = 0
+    leader_hint: str = ""
 
 
 @dataclass

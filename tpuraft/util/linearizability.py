@@ -318,3 +318,81 @@ def check_history(history: History, initial=None) -> Report:
     keys = {k: check_register(v, initial=initial)
             for k, v in sorted(by_key.items())}
     return Report(all(r.ok for r in keys.values()), keys)
+
+
+# ---------------------------------------------------------------------------
+# targeted staleness assertion (read-mix soaks)
+# ---------------------------------------------------------------------------
+
+def check_stale_reads(ops: list[Op], seq_of) -> list[str]:
+    """Fast, targeted no-stale-read assertion for monotone single-writer
+    histories: a completed read must observe every write acked before
+    the read was ISSUED.
+
+    Requires the workload to write per-key monotonically increasing
+    sequence values with at most ONE writer per key issuing writes in
+    order (the read-mix soak's shape); ``seq_of(value) -> int`` extracts
+    the sequence (return -1 for None/garbage).  A read is stale iff its
+    observed sequence is below the highest sequence acked before its
+    invoke AND is not explained by a maybe-applied (pending) write that
+    could legally linearize later — a timed-out lower-seq write landing
+    in the log after its successor is linearizable, not stale.
+
+    Complements (does not replace) ``check_history``: the full checker
+    proves the whole history, this one gives an O(n log n) verdict with
+    a per-read violation message naming exactly which acked write the
+    read missed — and stays tractable at read volumes that would swamp
+    the exponential search.
+    """
+    import bisect
+
+    violations: list[str] = []
+    by_key: dict[bytes, list[Op]] = {}
+    for op in ops:
+        by_key.setdefault(op.key, []).append(op)
+    for key, key_ops in sorted(by_key.items()):
+        writes = [o for o in key_ops if o.kind == "w"]
+        acked = sorted((o for o in writes if o.ret is not None),
+                       key=lambda o: o.ret)
+        # prefix-max of (seq, op) over the ack-ordered writes: the floor
+        # for a read is one bisect on its invoke time, not a rescan of
+        # every acked write (keeps the checker O(n log n) on the
+        # read-heavy histories it exists for)
+        ack_rets: list[float] = []
+        prefix: list[tuple[int, Op]] = []
+        best_seq, best_op = -1, None
+        for w in acked:
+            s = seq_of(w.args[1])
+            if s > best_seq:
+                best_seq, best_op = s, w
+            ack_rets.append(w.ret)
+            prefix.append((best_seq, best_op))
+        # maybe-applied writes: seq -> earliest invoke (a pending write
+        # may legally linearize any time after its invoke)
+        pending_invoke: dict[int, float] = {}
+        for w in writes:
+            if w.ret is None:
+                s = seq_of(w.args[1])
+                if s not in pending_invoke or w.invoke < pending_invoke[s]:
+                    pending_invoke[s] = w.invoke
+        for read in key_ops:
+            if read.kind != "r" or read.ret is None:
+                continue
+            # highest sequence fully acked before this read was issued
+            i = bisect.bisect_right(ack_rets, read.invoke)
+            if i == 0:
+                continue
+            floor_seq, floor_op = prefix[i - 1]
+            got = seq_of(read.result)
+            if got >= floor_seq:
+                continue
+            # a maybe-applied write invoked before the read returned may
+            # legally linearize between the floor write and the read
+            if pending_invoke.get(got, float("inf")) <= read.ret:
+                continue
+            violations.append(
+                f"stale read on {key!r}: {read} observed seq {got} but "
+                f"{floor_op} (seq {floor_seq}) was acked "
+                f"{(read.invoke - floor_op.ret) * 1e3:.1f}ms before the "
+                f"read was issued")
+    return violations
