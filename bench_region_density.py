@@ -73,7 +73,8 @@ async def run_config(args) -> dict:
             CountingPD.region_hbs += 1
             return await super().region_heartbeat(region, leader, *a, **kw)
 
-        async def store_heartbeat_batch(self, meta, deltas, full=False):
+        async def store_heartbeat_batch(self, meta, deltas, full=False,
+                                        health=""):
             # count what a real PD would SEE: one RPC + its delta rows
             # (not the base class's legacy decomposition, which would
             # double-count every row as a per-region RPC)

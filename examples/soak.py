@@ -34,6 +34,15 @@ groups is killed, and its dependents must wake on store-lease expiry
 and elect within the normal fault-detection envelope — with the
 history still linearizable.
 
+``--gray`` adds FAIL-SLOW faults: a store's fsyncs stall or crawl
+(tpuraft/storage/fault.py latency injection), or one endpoint's links
+limp (NetworkTopology.degrade_endpoint) — the victim stays alive to
+every classic liveness check while everything it leads detonates in
+latency.  Store health scoring (tpuraft/util/health.py) must detect it
+from hot-path signals and EVACUATE leadership at a bounded rate; the
+run record counts evacuations, and a long drive with zero of them
+fails (gray_detection_ok).
+
 ``--geo N`` shapes the fabric through a seeded NetworkTopology
 (tpuraft/rpc/topology.py): stores tag round-robin into N zones,
 inter-zone links get ASYMMETRIC WAN latency + jitter + loss, and the
@@ -90,6 +99,22 @@ class _BaseSoakCluster:
         self.endpoints: list[str] = []
         self.regions: list[Region] = []
         self.stores: dict[str, StoreEngine] = {}
+        # counters of RETIRED engines: a killed/restarted store gets a
+        # fresh StoreEngine, and summing only live engines would erase
+        # e.g. every gray evacuation a later leader-kill happened to
+        # land on — exactly the composition --gray exists to test
+        self.retired_counters: dict[str, int] = {}
+
+    def _retire_counters(self, store: StoreEngine) -> None:
+        rc = self.retired_counters
+        rc["evacuations"] = rc.get("evacuations", 0) + store.evacuations
+        rc["shed_items"] = rc.get("shed_items", 0) \
+            + store.kv_processor.shed_items
+        if store.health is not None:
+            rc["health_evaluations"] = rc.get("health_evaluations", 0) \
+                + store.health.evaluations
+            rc["sick_rounds"] = rc.get("sick_rounds", 0) \
+                + store.health.level_counts["sick"]
 
     def _store_opts(self, ep: str, election_timeout_ms: int,
                     **extra) -> StoreEngineOptions:
@@ -196,6 +221,7 @@ class SoakCluster(_BaseSoakCluster):
         self.net.stop_endpoint(ep)
         store = self.stores.pop(ep, None)
         if store:
+            self._retire_counters(store)
             self.net.unbind(ep)
             await store.shutdown()
 
@@ -291,6 +317,7 @@ class NativeSoakCluster(_BaseSoakCluster):
         server = self._servers.pop(ep, None)
         ft = self._faults.pop(ep, None)
         if store:
+            self._retire_counters(store)
             await store.shutdown()
         if server:
             await server.stop()
@@ -599,7 +626,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    geo: int = 0,
                    witness: bool = False,
                    read_mix: float = 0.0,
-                   read_from: str = "leader") -> dict:
+                   read_from: str = "leader",
+                   gray: bool = False) -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
         raise ValueError(
@@ -638,6 +666,12 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             "in-proc fabric without --engine; the native multilog's "
             "fd-level I/O is crash-imaged by the dedicated harness "
             "(tests/test_storage_fault.py) instead")
+    if gray and (transport != "inproc" or engine):
+        raise ValueError(
+            "--gray injects fail-slow disk faults through the same "
+            "storage interposition as --power-loss: in-proc fabric, "
+            "no --engine (the multilog's fd-level fsyncs are out of "
+            "Python's reach)")
     if transport == "native":
         if n_regions > 1 or engine:
             raise ValueError("region-density soak runs on the in-proc "
@@ -651,22 +685,31 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                         geo_zones=geo, witness=witness, geo_seed=seed)
     chaos = {}
     try:
-        if power_loss:
+        if power_loss or gray:
             import os as _os
 
             from tpuraft.storage.fault import ChaosDir
 
-            # snapshots on: prefix compaction + snapshot commit must
-            # run UNDER the crash schedule, not just appends
-            c.snapshot_interval_secs = 10
+            if power_loss:
+                # snapshots on: prefix compaction + snapshot commit must
+                # run UNDER the crash schedule, not just appends
+                c.snapshot_interval_secs = 10
             for ep in c.endpoints:
                 ip, port = ep.rsplit(":", 1)
                 chaos[ep] = ChaosDir(
                     _os.path.join(data_path, f"{ip}_{port}")).install()
+        if gray and getattr(c, "topology", None) is None:
+            # slow-endpoint events need a topology even zoneless: a
+            # bare one shapes nothing until degrade_endpoint fires
+            from tpuraft.rpc.topology import NetworkTopology
+
+            c.topology = NetworkTopology(seed=seed)
+            c.net.set_topology(c.topology)
         return await _run_soak_inner(
             duration_s, n_keys, verbose, transport, dump_history,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
-            kv_batching, geo, witness, read_mix, read_from)
+            kv_batching, geo, witness, read_mix, read_from,
+            gray=gray, power_loss=power_loss)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -679,7 +722,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           dump_history, lease_reads, n_regions, rng, c,
                           chaos, churn=False, quiesce=False,
                           kv_batching=False, geo=0, witness=False,
-                          read_mix=0.0, read_from="leader") -> dict:
+                          read_mix=0.0, read_from="leader", gray=False,
+                          power_loss=False) -> dict:
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -698,7 +742,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
     kv = RheaKVStore(pd, c.client_transport(), max_retries=1,
                      batching=BatchingOptions(enabled=True)
                      if kv_batching else None,
-                     read_from=read_from)
+                     read_from=read_from,
+                     jitter_seed=rng.randrange(1 << 30))
     await kv.start()
 
     def say(*a):
@@ -894,7 +939,7 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         churn_driver.stage_crashes[target] = \
             churn_driver.stage_crashes.get(target, 0) + 1
         say(f"  nemesis: churn-crash landing in stage={target} on {ep}")
-        if chaos:
+        if chaos and power_loss:
             plan = chaos[ep].capture_crash(rng)
             churn_lost.append(ep)
             await c.stop_store(ep)
@@ -988,6 +1033,70 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             await witness_safety_check()
         return _check
 
+    # -- gray-failure fault surface (--gray): fail-slow, never fail-stop.
+    # One store's disk stalls / limps, or one endpoint's links crawl,
+    # while the store stays "alive" to every classic check — detection
+    # (HealthTracker) must score it, evacuation must move its leases,
+    # and the history must stay linearizable through it all. -----------------
+    gray_slowed: list[str] = []       # stores with an active disk fault
+    gray_limped: list[str] = []       # endpoints with an active limp
+
+    def _gray_victim():
+        up = [ep for ep in c.endpoints if ep in c.stores]
+        if not up:
+            raise SkipFault
+        # prefer a store that currently LEADS something — slowing an
+        # idle follower proves nothing about evacuation
+        leaders = [ep for ep in up
+                   if c.stores[ep].leader_region_ids()]
+        return rng.choice(leaders or up)
+
+    async def gray_disk_stall():
+        """Burst disk stall: every fsync pays 60-150ms on its thread."""
+        ep = _gray_victim()
+        say(f"  nemesis: gray disk-stall on {ep}")
+        chaos[ep].set_slow(fsync_ms=60, write_ms=5, jitter_ms=90,
+                           seed=rng.randrange(1 << 30))
+        gray_slowed.append(ep)
+
+    async def gray_slow_store():
+        """Sustained slow store: moderate disk latency + limping links
+        (the saturated-CPU shape — everything it does is a bit slow)."""
+        ep = _gray_victim()
+        say(f"  nemesis: gray slow-store on {ep}")
+        chaos[ep].set_slow(fsync_ms=25, write_ms=4, jitter_ms=20,
+                           seed=rng.randrange(1 << 30))
+        c.topology.degrade_endpoint(ep, latency_ms=20, jitter_ms=15)
+        gray_slowed.append(ep)
+        gray_limped.append(ep)
+
+    async def gray_stalled_fsync():
+        """Full fsync hang: nothing durably completes on the victim
+        until heal — the worst gray failure."""
+        ep = _gray_victim()
+        say(f"  nemesis: gray stalled-fsync on {ep}")
+        chaos[ep].stall_fsync()
+        gray_slowed.append(ep)
+
+    async def gray_slow_endpoint():
+        """One store's links limp while its zone stays healthy."""
+        up = [ep for ep in c.endpoints if ep in c.stores]
+        if not up:
+            raise SkipFault
+        ep = rng.choice(up)
+        say(f"  nemesis: gray slow-endpoint on {ep}")
+        c.topology.degrade_endpoint(ep, latency_ms=60, jitter_ms=40,
+                                    loss=0.01)
+        gray_limped.append(ep)
+
+    async def gray_heal():
+        while gray_slowed:
+            cd = chaos.get(gray_slowed.pop())
+            if cd is not None:
+                cd.heal_slow()
+        while gray_limped:
+            c.topology.heal_endpoint(gray_limped.pop())
+
     if churn:
         churn_driver = MembershipChurn(c, sampled_regions[0], rng, say)
 
@@ -1000,11 +1109,30 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         NemesisAction("drops+delays", noise_on, noise_off, dwell_s=0.8,
                       check=with_conf_check(None)),
     ]
-    if chaos:
+    if chaos and power_loss:
         actions.append(
             NemesisAction("power-loss", power_loss_kill,
                           power_loss_restart, dwell_s=0.6, weight=1.5,
                           check=with_conf_check(power_loss_ok)))
+    if gray:
+        # dwell long enough for the whole arc: EMAs cross thresholds,
+        # hysteresis worsens to SICK (~eval_interval x worsen_after),
+        # evacuation transfers fire, the client re-routes — all while
+        # the fault still holds
+        actions += [
+            NemesisAction("gray-disk-stall", gray_disk_stall, gray_heal,
+                          dwell_s=4.0, weight=1.5,
+                          check=with_conf_check(None)),
+            NemesisAction("gray-slow-store", gray_slow_store, gray_heal,
+                          dwell_s=4.0, weight=1.0,
+                          check=with_conf_check(None)),
+            NemesisAction("gray-stalled-fsync", gray_stalled_fsync,
+                          gray_heal, dwell_s=4.0, weight=1.0,
+                          check=with_conf_check(None)),
+            NemesisAction("gray-slow-endpoint", gray_slow_endpoint,
+                          gray_heal, dwell_s=3.0, weight=1.0,
+                          check=with_conf_check(None)),
+        ]
     if churn_driver is not None:
         actions.append(
             NemesisAction("churn-crash", churn_crash, churn_crash_restart,
@@ -1019,7 +1147,7 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           quiescent_store_restart,
                           dwell_s=max(2.5, 3.0 * eto_s), weight=1.5,
                           check=with_conf_check(None)))
-    if topo is not None:
+    if topo is not None and geo:
         eto_s = getattr(c, "election_timeout_ms", 400) / 1000.0
         actions += [
             # dwell past fail-over so elections actually run ACROSS the
@@ -1101,6 +1229,41 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             result["power_loss_crashes"] = sum(
                 cd.crash_count for cd in chaos.values())
             result["storage_injections"] = injected
+        if gray:
+            # gray-failure plane: injection counts + the detection /
+            # mitigation counters the acceptance criteria key on —
+            # >0 evacuations proves the SICK score fired AND moved
+            # leadership while the fault held
+            slow_inj: dict[str, int] = {}
+            for cd in chaos.values():
+                for k, v in cd.slow_counts.items():
+                    slow_inj[k] = slow_inj.get(k, 0) + v
+            # live engines + everything retired by kill/restart: a
+            # leader-kill landing on a store AFTER it evacuated must
+            # not erase the evacuations from the run record
+            rc = c.retired_counters
+            evac = rc.get("evacuations", 0) \
+                + sum(s.evacuations for s in c.stores.values())
+            shed = rc.get("shed_items", 0) \
+                + sum(s.kv_processor.shed_items
+                      for s in c.stores.values())
+            health_evals = rc.get("health_evaluations", 0) + sum(
+                s.health.evaluations for s in c.stores.values()
+                if s.health is not None)
+            sick_rounds = rc.get("sick_rounds", 0) + sum(
+                s.health.level_counts["sick"] for s in c.stores.values()
+                if s.health is not None)
+            result["gray"] = {
+                "slow_injections": slow_inj,
+                "health_evaluations": health_evals,
+                "sick_rounds": sick_rounds,
+                "evacuations": evac,
+                "shed_items": shed,
+            }
+            # a long gray drive that never evacuated means detection or
+            # mitigation is broken — fail the run, don't just log it
+            result["gray_detection_ok"] = (evac > 0
+                                           or duration_s < 120)
         if churn_driver is not None:
             result["membership"] = churn_driver.summary()
         # beat-plane + quiescence counters (HeartbeatHub.counters() via
@@ -1228,6 +1391,13 @@ def main() -> None:
                          "never leads; witness safety (never leader, "
                          "never a ballot window, no payload journaled) "
                          "is asserted after every fault")
+    ap.add_argument("--gray", action="store_true",
+                    help="gray-failure (fail-slow) nemesis menu: "
+                         "disk-stall, slow-store, stalled-fsync and "
+                         "slow-endpoint faults — the victim stays "
+                         "'alive' while limping; store health scoring "
+                         "must detect it and evacuate leadership "
+                         "(in-proc fabric, no --engine)")
     ap.add_argument("--kv-batching", action="store_true",
                     help="drive load through the batching client: ops "
                          "coalesce into store-grouped kv_command_batch "
@@ -1265,11 +1435,13 @@ def main() -> None:
                                   geo=args.geo,
                                   witness=args.witness,
                                   read_mix=args.read_mix,
-                                  read_from=args.read_from))
+                                  read_from=args.read_from,
+                                  gray=args.gray))
     import json
 
     print(json.dumps(result))
-    raise SystemExit(0 if result["linearizable"] else 1)
+    ok = result["linearizable"] and result.get("gray_detection_ok", True)
+    raise SystemExit(0 if ok else 1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,889 @@
+"""Gray-failure survival (ISSUE 11): fail-slow injection, store health
+scoring, leadership evacuation, serving-plane shedding.
+
+Seeded and deterministic throughout: the HealthTracker's hysteresis
+counts evaluation rounds (never wall-clock), the injection layers draw
+from seeded rngs, and the evacuation tests drive the scoring rounds by
+hand — byte-identical transitions on every run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import threading
+import time
+
+import pytest
+
+from tpuraft.util.health import (
+    DEGRADED,
+    HEALTHY,
+    SICK,
+    DiskLatencyProbe,
+    HealthOptions,
+    HealthTracker,
+)
+
+
+# ---------------------------------------------------------------------------
+# HealthTracker scoring: thresholds + hysteresis (seeded, deterministic)
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_worsens_fast_recovers_slow():
+    """Score transitions are evaluation-counted: worsen after 2
+    consecutive bad rounds, recover only after 5 consecutive good ones
+    — one writeback spike never flips the level, and a recovering
+    store must PROVE health before the mitigation brake releases."""
+    t = HealthTracker(HealthOptions(worsen_after=2, recover_after=5))
+    assert t.score() == HEALTHY
+    # one sick sample + one evaluation: still HEALTHY (hysteresis)
+    t.disk.note(0.5)
+    assert t.evaluate() == HEALTHY
+    # second consecutive sick round crosses worsen_after
+    assert t.evaluate() == SICK
+    assert t.cause == "disk"
+    # now recover the EMA below every threshold
+    for _ in range(60):
+        t.disk.note(0.0005)
+    # four good rounds: still SICK (recover_after=5)
+    for _ in range(4):
+        assert t.evaluate() == SICK
+    # the fifth releases
+    assert t.evaluate() == HEALTHY
+
+
+def test_degraded_level_does_not_reach_sick():
+    t = HealthTracker(HealthOptions(worsen_after=1))
+    for _ in range(20):
+        t.disk.note(0.05)   # 50ms: over degraded (25), under sick (120)
+    assert t.evaluate() == DEGRADED
+    for _ in range(10):
+        assert t.evaluate() == DEGRADED
+    assert t.score() == DEGRADED
+
+
+def test_disk_stall_detected_via_inflight_age():
+    """A fully hung fsync never completes a sample, so the EMA alone
+    would stay clean forever — the probe's in-flight age catches it."""
+    clock = [0.0]
+    t = HealthTracker(HealthOptions(worsen_after=2, disk_stall_ms=500.0),
+                      clock=lambda: clock[0])
+    # healthy history
+    for _ in range(10):
+        tok = t.disk.begin()
+        clock[0] += 0.001
+        t.disk.end(tok)
+    assert t.evaluate() == HEALTHY
+    # a flush begins... and never ends
+    t.disk.begin()
+    clock[0] += 0.3
+    assert t.evaluate() == HEALTHY      # under the stall bound
+    clock[0] += 0.3                     # 600ms in flight now
+    assert t.evaluate() == HEALTHY      # hysteresis round 1
+    assert t.evaluate() == SICK
+    assert t.cause == "stall"
+
+
+def test_apply_backlog_scores():
+    t = HealthTracker(HealthOptions(worsen_after=1, apply_degraded=100,
+                                    apply_sick=1000))
+    for _ in range(30):
+        t.note_apply_depth(400)
+    assert t.evaluate() == DEGRADED
+    for _ in range(30):
+        t.note_apply_depth(5000)
+    assert t.evaluate() == SICK
+    assert t.cause == "apply"
+
+
+def test_peer_scores_from_rtts():
+    t = HealthTracker(HealthOptions(worsen_after=2, peer_degraded_ms=50,
+                                    peer_sick_ms=250))
+    for _ in range(10):
+        t.note_peer_rtt("a:1", 0.005)   # 5ms: healthy
+        t.note_peer_rtt("b:1", 0.100)   # 100ms: degraded
+        t.note_peer_rtt("c:1", 0.400)   # 400ms: sick
+    for _ in range(3):
+        t.evaluate()
+    assert t.peer_score("a:1") == HEALTHY
+    assert t.peer_score("b:1") == DEGRADED
+    assert t.peer_score("c:1") == SICK
+    assert t.slow_peers() == ["b:1", "c:1"]
+    # an endpoint never heard from defaults healthy
+    assert t.peer_score("zz:9") == HEALTHY
+
+
+def test_disk_ema_fed_in_thread_only_not_by_round_waits():
+    """Regression (gray A/B bench): end-to-end round time includes
+    executor-queue wait, so one co-hosted store's slow disk saturating
+    the shared executor scored EVERY store sick and triggered a
+    mutual-evacuation leadership storm.  begin/end feed only the
+    stall-age signal; the EMA comes exclusively from note()'s in-thread
+    measurements."""
+    clock = [0.0]
+    p = DiskLatencyProbe(clock=lambda: clock[0])
+    tok = p.begin()
+    clock[0] += 5.0          # five seconds queued behind a neighbor
+    p.end(tok)
+    ema, age, n = p.snapshot()
+    assert n == 0 and ema == 0.0, \
+        "round wait must not contaminate the disk EMA"
+    p.note(0.002)
+    ema, _age, n = p.snapshot()
+    assert n == 1 and abs(ema - 2.0) < 1e-9
+
+
+async def test_sick_store_refuses_timeout_now():
+    """Regression (gray A/B bench): a SICK store must not ACCEPT
+    leadership — two slow stores evacuating at each other ping-ponged
+    every lease.  Refusing TimeoutNow is always safe: the transfer
+    times out and the old leader's watchdog resumes."""
+    from tpuraft.core.node import Node, State
+    from tpuraft.entity import PeerId
+    from tpuraft.options import NodeOptions
+    from tpuraft.rpc.messages import TimeoutNowRequest
+
+    t = HealthTracker(HealthOptions(worsen_after=1))
+    node = Node.__new__(Node)
+    node._lock = asyncio.Lock()
+    node.current_term = 3
+    node.state = State.FOLLOWER
+    node.options = NodeOptions(health=t)
+    node.group_id = "g"
+    node.server_id = PeerId.parse("127.0.0.1:9001")
+    req = TimeoutNowRequest(group_id="g", server_id="127.0.0.1:9002",
+                            peer_id="127.0.0.1:9001", term=3)
+    for _ in range(5):
+        t.disk.note(0.5)
+    t.evaluate()
+    assert t.score() == SICK
+    resp = await node.handle_timeout_now(req)
+    assert resp.success is False, "SICK store accepted leadership"
+
+
+def test_probe_is_thread_safe_under_concurrent_feeders():
+    """The disk probe is the one tracker piece fed from executor
+    threads (multilog fsync timing) — hammer it from 4 threads while
+    snapshotting."""
+    p = DiskLatencyProbe()
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            tok = p.begin()
+            p.end(tok)
+            p.note(0.001)
+
+    threads = [threading.Thread(target=feed) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(200):
+            ema, age, n = p.snapshot()
+            assert ema >= 0.0 and age >= 0.0
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    ema, age, n = p.snapshot()
+    assert n > 0
+
+
+# ---------------------------------------------------------------------------
+# fail-slow injection: ChaosDir latency faults
+# ---------------------------------------------------------------------------
+
+
+def test_chaosdir_set_slow_delays_fsync_and_write(tmp_path):
+    import os
+
+    from tpuraft.storage.fault import ChaosDir
+
+    root = tmp_path / "slow"
+    with ChaosDir(str(root)) as cd:
+        cd.set_slow(fsync_ms=40, write_ms=10, seed=7)
+        path = root / "f.bin"
+        t0 = time.perf_counter()
+        with open(str(path), "wb") as f:
+            f.write(b"x" * 64)
+            f.flush()
+            os.fsync(f.fileno())
+        dur = time.perf_counter() - t0
+        assert dur >= 0.045, f"latency injection missing ({dur * 1e3:.1f}ms)"
+        assert cd.slow_counts.get("fsync_slowed", 0) >= 1
+        assert cd.slow_counts.get("write_slowed", 0) >= 1
+        cd.heal_slow()
+        t0 = time.perf_counter()
+        with open(str(path), "wb") as f:
+            f.write(b"y" * 64)
+            f.flush()
+            os.fsync(f.fileno())
+        assert time.perf_counter() - t0 < 0.03, "heal_slow did not clear"
+
+
+def test_chaosdir_stall_fsync_blocks_until_heal(tmp_path):
+    import os
+
+    from tpuraft.storage.fault import ChaosDir
+
+    root = tmp_path / "stall"
+    with ChaosDir(str(root)) as cd:
+        path = root / "f.bin"
+        f = open(str(path), "wb")  # noqa: SIM115 — fsynced across threads
+        f.write(b"x")
+        f.flush()
+        cd.stall_fsync()
+        done = threading.Event()
+
+        def sync():
+            os.fsync(f.fileno())
+            done.set()
+
+        th = threading.Thread(target=sync)
+        th.start()
+        try:
+            assert not done.wait(0.15), "stalled fsync completed"
+            cd.heal_slow()
+            assert done.wait(2.0), "healed fsync still stuck"
+        finally:
+            cd.heal_slow()
+            th.join()
+            f.close()
+        assert cd.slow_counts.get("fsync_stalled", 0) == 1
+
+
+def test_chaosdir_uninstall_releases_stalled_fsync(tmp_path):
+    """A leaked stall must not wedge executor threads past the chaos
+    drive: uninstall() heals."""
+    import os
+
+    from tpuraft.storage.fault import ChaosDir
+
+    root = tmp_path / "leak"
+    cd = ChaosDir(str(root)).install()
+    path = root / "f.bin"
+    f = open(str(path), "wb")  # noqa: SIM115
+    f.write(b"x")
+    f.flush()
+    cd.stall_fsync()
+    done = threading.Event()
+    th = threading.Thread(target=lambda: (os.fsync(f.fileno()), done.set()))
+    th.start()
+    try:
+        assert not done.wait(0.1)
+        cd.uninstall()
+        assert done.wait(2.0), "uninstall did not release the stall"
+    finally:
+        th.join()
+        f.close()
+
+
+# ---------------------------------------------------------------------------
+# fail-slow injection: per-endpoint topology events
+# ---------------------------------------------------------------------------
+
+
+def test_topology_endpoint_degrade_both_directions_and_heal():
+    from tpuraft.rpc.topology import NetworkTopology
+
+    topo = NetworkTopology(seed=3)
+    topo.degrade_endpoint("a:1", latency_ms=50, jitter_ms=0)
+    # frames TOUCHING a:1 pay the limp, both directions
+    d1, drop1 = topo.plan("a:1", "b:1")
+    d2, drop2 = topo.plan("b:1", "a:1")
+    assert not drop1 and not drop2
+    assert d1 >= 0.05 and d2 >= 0.05
+    # frames between healthy endpoints are untouched
+    d3, _ = topo.plan("b:1", "c:1")
+    assert d3 == 0.0
+    assert topo.counters["ep_shaped"] == 2
+    topo.heal_events()
+    d4, _ = topo.plan("a:1", "b:1")
+    assert d4 == 0.0
+    assert not topo.endpoint_degraded("a:1")
+
+
+def test_topology_endpoint_limp_composes_with_zone_link():
+    """The endpoint limp is ADDITIVE on the zone link — one store can
+    crawl while its zone's base shape stays intact for its siblings."""
+    from tpuraft.rpc.topology import LinkProfile, NetworkTopology
+
+    topo = NetworkTopology(seed=5)
+    for ep, z in (("a:1", "z0"), ("b:1", "z0"), ("c:1", "z1")):
+        topo.set_zone(ep, z)
+    topo.set_link("z0", "z1", LinkProfile(latency_ms=10), symmetric=True)
+    topo.degrade_endpoint("a:1", latency_ms=100, jitter_ms=0)
+    d_limped, _ = topo.plan("a:1", "c:1")
+    d_healthy, _ = topo.plan("b:1", "c:1")
+    assert abs(d_healthy - 0.010) < 1e-9
+    assert abs(d_limped - 0.110) < 1e-9
+
+
+def test_topology_stall_endpoint_delivers_late_not_never():
+    from tpuraft.rpc.topology import NetworkTopology
+
+    topo = NetworkTopology(seed=1)
+    topo.stall_endpoint("a:1", stall_ms=800)
+    delay, dropped = topo.plan("b:1", "a:1")
+    assert not dropped, "stall must deliver (late), not drop"
+    assert delay >= 0.8
+
+
+def test_topology_endpoint_loss_seeded_replay():
+    from tpuraft.rpc.topology import NetworkTopology
+
+    def run(seed):
+        topo = NetworkTopology(seed=seed)
+        topo.degrade_endpoint("a:1", latency_ms=5, jitter_ms=5, loss=0.3)
+        return [topo.plan("a:1", "b:1") for _ in range(64)]
+
+    assert run(9) == run(9), "same seed must replay byte-identically"
+    assert run(9) != run(10)
+
+
+# ---------------------------------------------------------------------------
+# leadership evacuation: rate-bounded, hysteretic, health-target-aware
+# ---------------------------------------------------------------------------
+
+
+@contextlib.asynccontextmanager
+async def _kv_cluster(tmp_path, n_regions=4, **opt_overrides):
+    from tests.kv_cluster import KVTestCluster
+    from tpuraft.rheakv.metadata import Region
+
+    regions = [Region(id=k + 1,
+                      start_key=b"k%02d" % k if k else b"",
+                      end_key=b"k%02d" % (k + 1) if k + 1 < n_regions
+                      else b"")
+               for k in range(n_regions)]
+    c = KVTestCluster(n_stores=3, tmp_path=tmp_path, regions=regions)
+    # the gray knobs ride StoreEngineOptions; KVTestCluster builds them
+    # internally, so patch post-construction before start
+    orig = c.start_store
+
+    async def start_store(ep):
+        store = await orig(ep)
+        for k, v in opt_overrides.items():
+            setattr(store.opts, k, v)
+        return store
+
+    c.start_store = start_store
+    await c.start_all()
+    try:
+        yield c
+    finally:
+        await c.stop_all()
+
+
+async def _concentrate_leadership(c, ep, n_regions):
+    """Transfer every region's leadership onto store ``ep``."""
+    from tpuraft.entity import PeerId
+
+    target = PeerId.parse(ep)
+    for rid in range(1, n_regions + 1):
+        engine = await c.wait_region_leader(rid)
+        if engine.store_engine.server_id.endpoint == ep:
+            continue
+        st = await engine.node.transfer_leadership_to(target)
+        assert st.is_ok(), f"transfer of region {rid}: {st}"
+    # wait until the target actually leads everything
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if sorted(c.stores[ep].leader_region_ids()) == \
+                list(range(1, n_regions + 1)):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"leadership never concentrated on {ep}: "
+        f"{c.stores[ep].leader_region_ids()}")
+
+
+def _force_level(store, level: str) -> None:
+    """Deterministically drive the store's tracker to a level."""
+    ms = {HEALTHY: 0.0002, DEGRADED: 0.05, SICK: 0.4}[level]
+    for _ in range(40):
+        store.health.disk.note(ms)
+    for _ in range(max(store.health.opts.worsen_after,
+                       store.health.opts.recover_after) + 1):
+        store.health.evaluate()
+    assert store.health.score() == level
+
+
+async def test_evacuation_rate_bounded_and_cooldown(tmp_path):
+    """Acceptance criterion: a SICK store moves at most
+    ``evacuation_rate`` leaders per evaluation round, and a region it
+    just moved (or tried to) is cooled down for
+    ``evacuation_cooldown_rounds`` rounds."""
+    async with _kv_cluster(tmp_path, n_regions=4, evacuation_rate=2,
+                           evacuation_cooldown_rounds=100) as c:
+        ep0 = c.endpoints[0]
+        await _concentrate_leadership(c, ep0, 4)
+        store = c.stores[ep0]
+        _force_level(store, SICK)
+        # round 1: exactly evacuation_rate transfers
+        moved = await store._evacuate_leaders()
+        assert moved == 2
+        assert store.evacuations == 2
+        # round 2 (same _evac_round: cooldown horizon far ahead): the 2
+        # still-led regions move, the 2 cooled ones are skipped
+        moved = await store._evacuate_leaders()
+        assert moved == 2
+        assert store.evacuations == 4
+        # round 3: everything either moved or cooled — nothing happens
+        moved = await store._evacuate_leaders()
+        assert moved == 0
+        assert store.evacuations == 4
+
+
+async def test_degraded_recovering_store_keeps_its_leaders(tmp_path):
+    """Acceptance criterion (no flapping): a store that went SICK,
+    evacuated, and is now RECOVERING through DEGRADED keeps the leaders
+    it still holds — the health loop only evacuates at SICK, and the
+    recover_after hysteresis keeps a noisy store from oscillating."""
+    async with _kv_cluster(tmp_path, n_regions=4, evacuation_rate=1,
+                           health_eval_interval_ms=40) as c:
+        ep0 = c.endpoints[0]
+        await _concentrate_leadership(c, ep0, 4)
+        store = c.stores[ep0]
+        _force_level(store, SICK)
+        # let the REAL health loop evacuate at its bounded rate
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and store.evacuations == 0:
+            store.health.disk.note(0.4)   # fault still active
+            await asyncio.sleep(0.05)
+        assert store.evacuations > 0, "SICK store never evacuated"
+        # the disk recovers: good samples drive the score to DEGRADED
+        # territory and beyond — while DEGRADED, NO further evacuation
+        for _ in range(40):
+            store.health.disk.note(0.05)   # degraded-level latency
+        for _ in range(store.health.opts.recover_after + 2):
+            store.health.evaluate()
+        assert store.health.score() == DEGRADED
+        evac_before = store.evacuations
+        led_before = store.leader_region_ids()
+        feed_until = time.monotonic() + 1.5
+        while time.monotonic() < feed_until:
+            store.health.disk.note(0.05)   # still degraded, recovering
+            await asyncio.sleep(0.03)
+        assert store.evacuations == evac_before, \
+            "DEGRADED-but-recovering store evacuated (flapping)"
+        assert store.leader_region_ids() == led_before, \
+            "DEGRADED store lost leaders it should have kept"
+
+
+async def test_evacuation_targets_healthiest_peer(tmp_path):
+    """The transfer target skips peers the tracker scores SICK and
+    prefers HEALTHY over DEGRADED."""
+    async with _kv_cluster(tmp_path, n_regions=1) as c:
+        ep0, ep1, ep2 = c.endpoints
+        await _concentrate_leadership(c, ep0, 1)
+        store = c.stores[ep0]
+        engine = store.get_region_engine(1)
+
+        def feed_until(pred, feeds):
+            # the LIVE hub keeps folding real (fast) beat RTTs into the
+            # same EMAs, so keep feeding until the score holds
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline and not pred():
+                for ep, rtt in feeds:
+                    for _ in range(8):
+                        store.health.note_peer_rtt(ep, rtt)
+                store.health.evaluate()
+            assert pred(), {e: store.health.peer_score(e)
+                            for e in (ep1, ep2)}
+
+        # score ep1 SICK while ep2 stays no worse than DEGRADED — and
+        # tolerate a loaded host where the hub's REAL beat RTTs shove
+        # ep2 over the sick bound transiently: keep feeding until the
+        # intended state holds at the instant of the pick
+        deadline = time.monotonic() + 10
+        target = None
+        while time.monotonic() < deadline and target is None:
+            for _ in range(8):
+                store.health.note_peer_rtt(ep1, 0.400)
+                store.health.note_peer_rtt(ep2, 0.100)
+            store.health.evaluate()
+            if store.health.peer_score(ep1) == SICK \
+                    and store.health.peer_score(ep2) != SICK:
+                target = store._pick_evacuation_target(engine)
+            await asyncio.sleep(0)
+        assert target is not None, \
+            {e: store.health.peer_score(e) for e in (ep1, ep2)}
+        assert target.endpoint == ep2, \
+            "must pick the non-sick peer over the sick one"
+        # with BOTH peers sick there is no target at all
+        feed_until(lambda: store.health.peer_score(ep1) == SICK
+                   and store.health.peer_score(ep2) == SICK,
+                   [(ep1, 0.400), (ep2, 0.400)])
+        assert store._pick_evacuation_target(engine) is None
+
+
+# ---------------------------------------------------------------------------
+# serving-plane degradation: shed instead of queue
+# ---------------------------------------------------------------------------
+
+
+async def test_sick_store_sheds_batches_with_retry_after(tmp_path):
+    from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+    from tpuraft.rheakv.kv_service import (
+        ERR_STORE_BUSY,
+        KVCommandBatchRequest,
+        decode_batch_reply,
+        encode_batch_item,
+    )
+
+    async with _kv_cluster(tmp_path, n_regions=1,
+                           shed_backlog_items=8) as c:
+        engine = await c.wait_region_leader(1)
+        store = engine.store_engine
+        region = engine.region
+        item = encode_batch_item(
+            1, region.epoch.conf_ver, region.epoch.version,
+            KVOperation(KVOp.PUT, b"k", b"v").encode())
+        # healthy: no shed, whatever the backlog
+        store.kv_processor.inflight_items = 10_000
+        resp = await store.kv_processor.handle_batch(
+            KVCommandBatchRequest(items=[item]))
+        code, _m, _r, _g = decode_batch_reply(resp.items[0])
+        assert code == 0
+        # SICK + backlog over the bound: per-item EBUSY + retry-after,
+        # nothing admitted to the propose pipe
+        store.kv_processor.inflight_items = 10_000
+        _force_level(store, SICK)
+        resp = await store.kv_processor.handle_batch(
+            KVCommandBatchRequest(items=[item, item]))
+        for blob in resp.items:
+            code, msg, _r, _g = decode_batch_reply(blob)
+            assert code == ERR_STORE_BUSY
+            assert "retry-after-ms=" in msg
+        assert store.kv_processor.shed_items == 2
+        assert store.kv_processor.inflight_items == 10_000  # untouched
+        # SICK but the pipe is empty: still serves (deadline-aware —
+        # shed only once queueing would add the fatal wait)
+        store.kv_processor.inflight_items = 0
+        resp = await store.kv_processor.handle_batch(
+            KVCommandBatchRequest(items=[item]))
+        code, _m, _r, _g = decode_batch_reply(resp.items[0])
+        assert code == 0
+
+
+def test_client_treats_shed_bounce_as_retryable():
+    from tpuraft.rheakv.client import RheaKVStore, _Retry
+    from tpuraft.rheakv.kv_service import ERR_STORE_BUSY, encode_batch_reply
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    region = Region(id=1, peers=["127.0.0.1:9001", "127.0.0.1:9002"])
+    kv = RheaKVStore(FakePlacementDriverClient([region]), transport=None)
+    kv._leaders[1] = "127.0.0.1:9001"
+    out = kv._decode_outcome(
+        region, "127.0.0.1:9001",
+        encode_batch_reply(ERR_STORE_BUSY,
+                           "store sick: shedding (retry-after-ms=250)"))
+    assert isinstance(out, _Retry)
+    assert 1 not in kv._leaders, \
+        "a shedding store's leader hint must drop (evacuation moves it)"
+
+
+# ---------------------------------------------------------------------------
+# client: jittered backoff + slow-replica read routing
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_jitter_is_seeded_and_bounded():
+    from tpuraft.rheakv.client import RheaKVStore
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    def series(seed):
+        kv = RheaKVStore(FakePlacementDriverClient([]), transport=None,
+                         retry_interval_ms=100, jitter_seed=seed)
+        return [kv._backoff_s(a) for a in range(8)]
+
+    s1, s2, s3 = series(7), series(7), series(8)
+    assert s1 == s2, "same seed must give the same backoff series"
+    assert s1 != s3
+    for attempt, val in enumerate(s1):
+        base = 0.1 * (attempt + 1)
+        assert 0.5 * base <= val < 1.5 * base
+
+
+def test_read_candidates_route_off_slow_replicas():
+    from tpuraft.rheakv.client import RheaKVStore
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    peers = ["127.0.0.1:9001", "127.0.0.1:9002", "127.0.0.1:9003"]
+    region = Region(id=1, peers=list(peers))
+    kv = RheaKVStore(FakePlacementDriverClient([region]), transport=None,
+                     read_from="follower")
+    kv._leaders[1] = peers[0]
+    # endpoint 9002 observed slow (gray), the others fast
+    kv._ep_lat_ms = {"127.0.0.1:9001": 2.0, "127.0.0.1:9002": 300.0,
+                     "127.0.0.1:9003": 3.0}
+    for attempt in range(6):
+        cands = kv._read_candidates(region, attempt)
+        followers = [c for c in cands if c != peers[0]]
+        assert followers[-1] == peers[1] or peers[1] not in followers[:1], \
+            f"slow follower probed first: {cands}"
+        assert cands.index(peers[2]) < cands.index(peers[1]), \
+            f"slow replica not deprioritized: {cands}"
+    # with no latency data the rotation is untouched
+    kv._ep_lat_ms = {}
+    seen_first = {kv._read_candidates(region, 0)[0] for _ in range(6)}
+    assert len(seen_first) > 1, "rotation must still spread"
+
+
+def test_any_mode_reads_also_route_off_slow_replicas():
+    from tpuraft.rheakv.client import RheaKVStore
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    peers = ["127.0.0.1:9001", "127.0.0.1:9002", "127.0.0.1:9003"]
+    region = Region(id=1, peers=list(peers))
+    kv = RheaKVStore(FakePlacementDriverClient([region]), transport=None,
+                     read_from="any")
+    kv._ep_lat_ms = {"127.0.0.1:9001": 2.0, "127.0.0.1:9002": 250.0,
+                     "127.0.0.1:9003": 3.0}
+    for _ in range(6):
+        eps = kv._read_endpoints_for(region)
+        assert eps[-1] == peers[1], \
+            f"'any' fan-out did not push the gray replica last: {eps}"
+
+
+def test_ep_latency_ema_not_fed_by_shed_bounces():
+    """Review finding: a SICK store's instant ERR_STORE_BUSY bounces
+    must not drag its latency EMA back under the slow floor — only
+    SERVED replies feed the EMA."""
+    from tpuraft.rheakv.client import RheaKVStore, _StoreSender
+    from tpuraft.rheakv.kv_operation import KVOp, KVOperation
+    from tpuraft.rheakv.kv_service import (
+        ERR_STORE_BUSY,
+        KVCommandBatchResponse,
+        encode_batch_reply,
+    )
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    class ShedTransport:
+        async def call(self, dst, method, request, timeout_ms=None):
+            bounce = encode_batch_reply(ERR_STORE_BUSY, "shedding")
+            return KVCommandBatchResponse(
+                items=[bounce] * len(request.items))
+
+    region = Region(id=1, peers=["127.0.0.1:9001"])
+    kv = RheaKVStore(FakePlacementDriverClient([region]),
+                     transport=ShedTransport())
+    kv._ep_lat_ms["127.0.0.1:9001"] = 300.0   # learned while limping
+
+    async def run():
+        sender = _StoreSender(kv, "127.0.0.1:9001")
+        fut = sender.submit(region, "127.0.0.1:9001",
+                            KVOperation(KVOp.PUT, b"k", b"v"))
+        await asyncio.wait_for(fut, 2.0)
+
+    asyncio.run(run())
+    assert kv._ep_lat_ms["127.0.0.1:9001"] == 300.0, \
+        "shed bounce fed the EMA and erased the gray signal"
+
+
+# ---------------------------------------------------------------------------
+# PD: SICK-aware placement + drain
+# ---------------------------------------------------------------------------
+
+
+def _stats(cooldown=0.0):
+    from tpuraft.rheakv.pd_server import ClusterStatsManager
+
+    s = ClusterStatsManager(split_threshold_keys=0)
+    s._grace_until = 0.0
+    return s
+
+
+def test_pd_never_targets_a_sick_store():
+    from tpuraft.rheakv.metadata import Region
+
+    s = _stats()
+    region = Region(id=1, peers=["a:1", "b:1", "c:1"])
+    leaders = {1: "a:1", 2: "a:1", 3: "a:1", 4: "a:1"}
+    # without health, b or c gets the move (a leads 4, they lead 0)
+    t = s.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0)
+    assert t in ("b:1", "c:1")
+    # with b SICK, the move lands on c (fresh manager: no cooldown)
+    s2 = _stats()
+    t = s2.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0,
+                                health={"b:1": "sick"})
+    assert t == "c:1"
+    # everyone else sick: nowhere to go
+    s3 = _stats()
+    t = s3.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0,
+                                health={"b:1": "sick", "c:1": "sick"})
+    assert t is None
+
+
+def test_pd_drains_sick_leader_without_imbalance():
+    """Balanced leader counts normally suppress transfers (< 2 diff);
+    a SICK leader store is drained anyway — onto a healthy peer."""
+    from tpuraft.rheakv.metadata import Region
+
+    s = _stats()
+    region = Region(id=1, peers=["a:1", "b:1", "c:1"])
+    leaders = {1: "a:1", 2: "b:1", 3: "c:1"}   # perfectly balanced
+    assert s.pick_transfer_target(region, "a:1", leaders,
+                                  cooldown_s=10.0) is None
+    s2 = _stats()
+    t = s2.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0,
+                                health={"a:1": "sick"})
+    assert t in ("b:1", "c:1"), "sick leader must drain"
+    # degraded peers lose the tie to healthy ones during a drain
+    s3 = _stats()
+    t = s3.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0,
+                                health={"a:1": "sick", "b:1": "degraded"})
+    assert t == "c:1"
+    # and the cooldown still paces repeated drains of one region
+    t = s3.pick_transfer_target(region, "a:1", leaders, cooldown_s=10.0,
+                                health={"a:1": "sick"})
+    assert t is None, "drain must respect the per-region cooldown"
+
+
+async def test_pre_health_pd_client_override_still_heartbeats(tmp_path):
+    """API compat: a PD-client subclass whose store_heartbeat_batch
+    predates the health kwarg must keep receiving heartbeats (probed by
+    signature at construction) — the naive call would raise TypeError
+    into the retry loop and silently starve the PD forever."""
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+    from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+    from tpuraft.rpc.transport import (
+        InProcNetwork,
+        InProcTransport,
+        RpcServer,
+    )
+
+    class LegacyPD(FakePlacementDriverClient):
+        batches = 0
+
+        async def store_heartbeat_batch(self, meta, deltas, full=False):
+            LegacyPD.batches += 1
+            return [], False
+
+    ep = "127.0.0.1:6777"
+    net = InProcNetwork()
+    server = RpcServer(ep)
+    net.bind(server)
+    net.start_endpoint(ep)
+    store = StoreEngine(
+        StoreEngineOptions(server_id=ep,
+                           initial_regions=[Region(id=1, peers=[ep])],
+                           heartbeat_interval_ms=30),
+        server, InProcTransport(net, ep),
+        pd_client=LegacyPD([]))
+    assert store._pd_health_kwarg is False
+    await store.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and LegacyPD.batches == 0:
+            await asyncio.sleep(0.05)
+        assert LegacyPD.batches > 0, \
+            "legacy PD client never received a heartbeat"
+    finally:
+        await store.shutdown()
+
+
+def test_pd_server_tracks_and_clears_store_health():
+    from tpuraft.rheakv.pd_server import PlacementDriverServer
+
+    srv = PlacementDriverServer.__new__(PlacementDriverServer)
+    srv._store_health = {}
+    srv._note_store_health("a:1", "sick")
+    assert srv._store_health == {"a:1": "sick"}
+    srv._note_store_health("a:1", "healthy")
+    assert srv._store_health == {"a:1": "healthy"}
+    # "" = store stopped reporting scores: never leave a stale verdict
+    srv._note_store_health("a:1", "")
+    assert srv._store_health == {}
+
+
+# ---------------------------------------------------------------------------
+# node: SICK election gate (bounded deferral, then liveness wins)
+# ---------------------------------------------------------------------------
+
+
+def test_sick_store_defers_elections_boundedly():
+    from tpuraft.core.node import Node
+    from tpuraft.entity import ElectionPriority, PeerId
+    from tpuraft.options import NodeOptions
+
+    t = HealthTracker(HealthOptions(worsen_after=1))
+    node = Node.__new__(Node)
+    node.options = NodeOptions(health=t, sick_election_rounds=2)
+    node.server_id = PeerId.parse("127.0.0.1:9001")
+    node._sick_election_skips = 0
+    node._election_round = 0
+    node.target_priority = ElectionPriority.DISABLED
+    # healthy: elections run
+    assert node._allow_launch_election() is True
+    # sick: defer exactly sick_election_rounds rounds...
+    for _ in range(5):
+        t.disk.note(0.5)
+    t.evaluate()
+    assert t.score() == SICK
+    assert node._allow_launch_election() is False
+    assert node._allow_launch_election() is False
+    # ...then liveness wins (every peer may be worse off)
+    assert node._allow_launch_election() is True
+    # recovery resets the skip budget
+    for _ in range(60):
+        t.disk.note(0.0002)
+    for _ in range(t.opts.recover_after + 1):
+        t.evaluate()
+    assert t.score() == HEALTHY
+    assert node._allow_launch_election() is True
+    assert node._sick_election_skips == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a slow disk on a leader is detected through REAL signals
+# ---------------------------------------------------------------------------
+
+
+async def test_slow_disk_scores_sick_through_real_flush_path(tmp_path):
+    """No synthetic samples: ChaosDir latency on the leader store's
+    data dir, real KV writes, and the tracker must reach SICK from the
+    LogManager's own flush timing."""
+    import os
+
+    from tpuraft.storage.fault import ChaosDir
+
+    # interposition must be live BEFORE the stores open their files
+    # (files opened earlier are not tracked), so install for every
+    # store dir up front and arm only the leader's
+    chaos = {}
+    for i in range(3):
+        ep = f"127.0.0.1:{6000 + i}"
+        ip, port = ep.rsplit(":", 1)
+        chaos[ep] = ChaosDir(
+            os.path.join(str(tmp_path), f"{ip}_{port}")).install()
+    try:
+        async with _kv_cluster(tmp_path, n_regions=1,
+                               health_eval_interval_ms=60) as c:
+            engine = await c.wait_region_leader(1)
+            store = engine.store_engine
+            cd = chaos[store.server_id.endpoint]
+            cd.set_slow(fsync_ms=200, write_ms=10, seed=3)
+            deadline = time.monotonic() + 12
+            while time.monotonic() < deadline \
+                    and store.health.score() != SICK:
+                try:
+                    await asyncio.wait_for(
+                        engine.raft_store.put(b"k", b"v"), 2.0)
+                except Exception:
+                    pass   # slow is the point
+                await asyncio.sleep(0.02)
+            cd.heal_slow()   # let shutdown proceed at disk speed
+            assert store.health.score() == SICK, store.health.describe()
+            assert store.health.cause in ("disk", "stall")
+    finally:
+        for cd in chaos.values():
+            cd.uninstall()

@@ -309,8 +309,13 @@ async def test_balancer_cooldown_survives_pd_failover():
     from tpuraft.rheakv.pd_messages import (Instruction,
                                             RegionHeartbeatRequest)
 
+    # every time budget below DERIVES from this one knob — fixed
+    # sleeps made the test fail ~2/5 under host load (the 1.5s
+    # no-retransfer window kept asserting past the 3s grace whenever
+    # the event loop lagged)
+    cooldown_s = 3.0
     async with pd_cluster(balance_leaders=True,
-                          transfer_cooldown_s=3.0) as c:
+                          transfer_cooldown_s=cooldown_s) as c:
         await c.wait_pd_leader()
 
         regions = {
@@ -330,23 +335,25 @@ async def test_balancer_cooldown_survives_pd_failover():
                             for b in resp.instructions]
             return []
 
+        async def beat_until_transfer(budget_s: float):
+            """Poll all regions until a transfer is ordered; budget is
+            derived from the configured cooldown, not a magic sleep."""
+            deadline = time.monotonic() + budget_s
+            while time.monotonic() < deadline:
+                for rid in regions:
+                    for i in await beat(rid, ep0):
+                        if i.kind == Instruction.KIND_TRANSFER_LEADER:
+                            return (rid, i.target_peer)
+                await asyncio.sleep(min(0.1, cooldown_s / 20))
+            return None
+
         # pile 4 regions' leadership onto endpoint 0 in the replicated
         # leader map; keep beating until the balancer's startup grace
-        # passes and it orders a transfer for region 41
+        # (one cooldown from first leadership) passes and it orders a
+        # transfer
         ep0 = c.endpoints[0]
-        ordered = None
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and ordered is None:
-            for rid in regions:
-                for i in await beat(rid, ep0):
-                    if i.kind == Instruction.KIND_TRANSFER_LEADER:
-                        ordered = (rid, i.target_peer)
-                        break
-                if ordered:
-                    break
-            await asyncio.sleep(0.1)
+        ordered = await beat_until_transfer(6 * cooldown_s + 10)
         assert ordered is not None, "balancer never ordered a transfer"
-        moved_rid = ordered[0]
 
         # PD leader dies right after ordering the move
         leader = await c.wait_pd_leader()
@@ -356,23 +363,30 @@ async def test_balancer_cooldown_survives_pd_failover():
         # the moved region still heartbeats from ep0 (the store has not
         # executed the transfer yet): the NEW leader's fresh stats would
         # re-order the move instantly pre-fix; the post-failover grace
-        # must suppress every transfer for one full cooldown
+        # must suppress every transfer for one full cooldown.  The
+        # grace clock starts at the FIRST post-failover policy beat, so
+        # t0 taken before that beat is a safe lower bound — and each
+        # round only ASSERTS if it finished inside cooldown/2 of t0
+        # (a host-load stall past the window stops checking instead of
+        # asserting against an expired grace).
         t0 = time.monotonic()
-        while time.monotonic() - t0 < 1.5:
+        checked_rounds = 0
+        while time.monotonic() - t0 < 0.5 * cooldown_s:
+            round_ins = []
             for rid in regions:
-                ins = await beat(rid, ep0)
+                round_ins.append((rid, await beat(rid, ep0)))
+            if time.monotonic() - t0 >= 0.5 * cooldown_s:
+                break  # this round overran the safe window: inconclusive
+            for rid, ins in round_ins:
                 kinds = [i.kind for i in ins]
                 assert Instruction.KIND_TRANSFER_LEADER not in kinds, \
                     f"immediate re-transfer of region {rid} after failover"
-            await asyncio.sleep(0.2)
+            checked_rounds += 1
+            await asyncio.sleep(min(0.2, cooldown_s / 15))
+        assert checked_rounds > 0, \
+            "host too slow to observe the grace window at all"
 
         # after the grace window the balancer resumes
-        resumed = False
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and not resumed:
-            for rid in regions:
-                for i in await beat(rid, ep0):
-                    if i.kind == Instruction.KIND_TRANSFER_LEADER:
-                        resumed = True
-            await asyncio.sleep(0.1)
-        assert resumed, "balancer never resumed after the grace window"
+        resumed = await beat_until_transfer(6 * cooldown_s + 10)
+        assert resumed is not None, \
+            "balancer never resumed after the grace window"

@@ -414,6 +414,84 @@ async def test_batcher_joint_conf_requires_both_quorums():
         "a new-config-only majority must not confirm a joint-conf fence"
 
 
+class _StallTransport(_BatchTransport):
+    """multi_beat_fast stub where one destination is STALLED (not
+    dead): its RPCs block on an event and only answer after release —
+    the gray-failure shape a timeout never sees in time."""
+
+    def __init__(self, stalled: set[str]):
+        super().__init__()
+        self.stalled = stalled
+        self.release = asyncio.Event()
+
+    async def call(self, dst, method, request, timeout_ms=None):
+        if dst in self.stalled:
+            await self.release.wait()
+        return await super().call(dst, method, request, timeout_ms)
+
+
+async def test_batcher_stalled_endpoint_delays_only_its_own_round():
+    """The max_inflight_rounds windowing claim, proven under a STALLED
+    (not dead) endpoint: the round whose destination stalls keeps only
+    ITS stragglers waiting — fences for groups on healthy endpoints
+    submitted afterwards keep resolving round after round, they never
+    convoy behind the stalled RPC."""
+    from tpuraft.rheakv.store_engine import ReadConfirmBatcher
+
+    slow_voters = [PeerId.parse("127.0.0.1:7801"),
+                   PeerId.parse("127.0.0.1:7898"),
+                   PeerId.parse("127.0.0.1:7899")]
+    fast_voters = _voters(7810)
+    transport = _StallTransport({p.endpoint for p in slow_voters[1:]})
+    slow_node = _batcher_node("slow", transport, slow_voters)
+    b = ReadConfirmBatcher()
+
+    stalled_fut = asyncio.ensure_future(b.confirm(slow_node))
+    await asyncio.sleep(0.05)   # round 1 is now in flight, stalled
+    assert not stalled_fut.done()
+
+    # healthy-endpoint fences submitted AFTER the stall keep resolving
+    for i in range(5):
+        fast_node = _batcher_node(f"fast{i}", transport, fast_voters)
+        ok = await asyncio.wait_for(b.confirm(fast_node), 1.0)
+        assert ok, f"healthy fence {i} failed behind a stalled round"
+    assert not stalled_fut.done(), "stalled round resolved early?"
+
+    transport.release.set()
+    assert await asyncio.wait_for(stalled_fut, 2.0) is True
+    assert b.rounds >= 6
+
+
+async def test_batcher_window_bounds_concurrent_stalled_rounds():
+    """With max_inflight_rounds stalled rounds already in flight, the
+    NEXT fence waits for a slot (bounded task pileup) — and gets it the
+    moment any round completes."""
+    from tpuraft.rheakv.store_engine import ReadConfirmBatcher
+
+    voters_sets = [[PeerId.parse(f"127.0.0.1:{7900 + 10 * i}"),
+                    PeerId.parse(f"127.0.0.1:{7901 + 10 * i}"),
+                    PeerId.parse(f"127.0.0.1:{7902 + 10 * i}")]
+                   for i in range(5)]
+    stalled_eps = {p.endpoint for vs in voters_sets[:4] for p in vs[1:]}
+    transport = _StallTransport(stalled_eps)
+    b = ReadConfirmBatcher()
+    assert b.max_inflight_rounds == 4
+    stalled = []
+    for i in range(4):
+        node = _batcher_node(f"s{i}", transport, voters_sets[i])
+        stalled.append(asyncio.ensure_future(b.confirm(node)))
+        await asyncio.sleep(0.02)   # one round each, all stalled
+    assert len(b._rounds_inflight) == 4
+    fast_node = _batcher_node("fast", transport, voters_sets[4])
+    waiting = asyncio.ensure_future(b.confirm(fast_node))
+    await asyncio.sleep(0.05)
+    assert not waiting.done(), "5th round ran past the window bound"
+    transport.release.set()   # frees the stalled rounds -> slot opens
+    assert await asyncio.wait_for(waiting, 2.0) is True
+    for fut in stalled:
+        assert await asyncio.wait_for(fut, 2.0) is True
+
+
 # ---------------------------------------------------------------------------
 # integration: fence dedupe + batcher through the KV stack
 # ---------------------------------------------------------------------------

@@ -499,10 +499,11 @@ def test_cli_and_pd_messages_decode_old_frames():
          ["witnesses"]),
         (AddPeerRequest(group_id="g", peer_id="p", adding="c:1",
                         witness=True), ["witness"]),
-        (StoreHeartbeatRequest(store_id=7, endpoint="a:1", zone="z1"),
-         ["zone"]),
+        (StoreHeartbeatRequest(store_id=7, endpoint="a:1", zone="z1",
+                               health="sick"), ["zone", "health"]),
         (StoreHeartbeatBatchRequest(store_id=7, endpoint="a:1",
-                                    zone="z2"), ["zone"]),
+                                    zone="z2", health="degraded"),
+         ["zone", "health"]),
     ]
     for msg, new_fields in cases:
         cls = type(msg)

@@ -23,11 +23,17 @@ LOG = logging.getLogger(__name__)
 
 class FSMCaller:
     def __init__(self, fsm: StateMachine, log_manager, apply_batch: int = 32,
-                 on_error: Optional[Callable[[Status], Awaitable[None]]] = None):
+                 on_error: Optional[Callable[[Status], Awaitable[None]]] = None,
+                 health=None):
         self._fsm = fsm
         self._lm = log_manager
         self._apply_batch = apply_batch
         self._node_on_error = on_error
+        # gray-failure signal: committed-minus-applied depth, reported
+        # to the store's HealthTracker on every commit advance — a
+        # saturated/slow FSM shows up as a growing backlog long before
+        # client timeouts do
+        self._health = health
         self.last_applied_index = 0
         self.last_applied_term = 0
         self._committed_index = 0
@@ -90,6 +96,8 @@ class FSMCaller:
         if index <= self._committed_index:
             return
         self._committed_index = index
+        if self._health is not None:
+            self._health.note_apply_depth(index - self.last_applied_index)
         self._enqueue(("committed", index))
 
     def on_leader_start(self, term: int) -> None:

@@ -141,6 +141,10 @@ class HeartbeatHub:
         # peer's beats instead of sending our own
         self.groups_quiesced = 0
         self.groups_woken = 0
+        # gray-failure signal sink: the hosting store's HealthTracker
+        # (set by StoreEngine).  Every beat RPC the hub already sends
+        # doubles as a per-endpoint RTT probe — no extra traffic.
+        self.health = None
         from tpuraft.util import describer
         from tpuraft.util.metrics import MetricRegistry
 
@@ -570,6 +574,7 @@ class HeartbeatHub:
         node = reps[0]._node
         self.rpcs_sent += 1
         self.fast_beats_sent += len(items)
+        t0 = time.monotonic()
         try:
             resp = await node.transport.call(
                 dst, "multi_beat_fast", BatchRequest(items=items),
@@ -582,6 +587,8 @@ class HeartbeatHub:
                 self.fast_fallbacks += len(reps)
                 self.pulse(reps)
             return  # else: silence — dead-node detection, as direct
+        if self.health is not None:
+            self.health.note_peer_rtt(dst, time.monotonic() - t0)
         if len(resp.items) != len(items):
             # short/overlong response: zip would silently drop trailing
             # replicators' acks — treat the whole chunk as deviating
@@ -638,6 +645,7 @@ class HeartbeatHub:
         node = reps[0]._node
         self.rpcs_sent += 1
         self.beats_sent += len(frames)
+        t0 = time.monotonic()
         try:
             # half-election-timeout budget, like the direct heartbeat
             # path: with the inflight-chunk skip, a lost request must
@@ -649,6 +657,8 @@ class HeartbeatHub:
                 timeout_ms=node.options.election_timeout_ms // 2 or 1)
         except RpcError:
             return  # no acks: dead-node detection sees silence, as direct
+        if self.health is not None:
+            self.health.note_peer_rtt(dst, time.monotonic() - t0)
         if len(resp.acks) != len(frames):
             # a short ack list must read as silence for the WHOLE chunk
             # (dead-node detection semantics), not as acks for whichever

@@ -282,6 +282,11 @@ class Node:
         # priority RE-election (geo): consecutive stepdown-timer rounds a
         # healthy higher-priority voter has been caught up and acking
         self._priority_transfer_rounds: int = 0  # guarded-by: _lock (writes)
+        # gray failures: election rounds this node skipped because its
+        # own store scored SICK (options.health) — a slow store should
+        # not WIN elections, but liveness demands it may still campaign
+        # once every healthy peer had its chance
+        self._sick_election_skips: int = 0      # guarded-by: _lock (writes)
 
     # ======================================================================
     # lifecycle
@@ -342,6 +347,7 @@ class Node:
             max_logs_in_memory=opts.raft_options.max_logs_in_memory,
             max_logs_in_memory_bytes=(
                 opts.raft_options.max_logs_in_memory_bytes),
+            health=opts.health,
         )
         await self.log_manager.init()
 
@@ -360,7 +366,8 @@ class Node:
         self.fsm_caller = FSMCaller(
             opts.fsm, self.log_manager,
             apply_batch=opts.raft_options.apply_batch,
-            on_error=self._on_fsm_error)
+            on_error=self._on_fsm_error,
+            health=opts.health)
         self.fsm_caller.on_configuration_applied = self._on_configuration_applied
 
         # snapshot subsystem
@@ -745,6 +752,24 @@ class Node:
             # therefore can never elect, hence never commit — the
             # witness-safety property tests/test_witness.py proves.
             return False
+        from tpuraft.util.health import SICK
+
+        health = self.options.health
+        if (health is not None and self.options.sick_election_rounds > 0
+                and health.score() == SICK):
+            # gray-failure election gate: a SICK store skips rounds so
+            # a healthy peer wins instead — but only boundedly, or a
+            # cluster whose every store is slow could never elect.
+            # Mirrors the priority-decay shape below: defer, then
+            # concede to liveness.
+            self._sick_election_skips += 1
+            if self._sick_election_skips <= self.options.sick_election_rounds:
+                LOG.info("%s deferring election: local store is SICK "
+                         "(round %d/%d)", self, self._sick_election_skips,
+                         self.options.sick_election_rounds)
+                return False
+        else:
+            self._sick_election_skips = 0
         prio = self.server_id.priority
         if prio == ElectionPriority.DISABLED:
             return True
@@ -1374,6 +1399,20 @@ class Node:
             if self.options.witness:
                 # never campaigns — even on an explicit transfer nudge
                 # (a mixed-fleet leader that missed the witness flag)
+                return TimeoutNowResponse(term=self.current_term,
+                                          success=False)
+            from tpuraft.util.health import SICK
+
+            health = self.options.health
+            if health is not None and health.score() == SICK:
+                # gray-failure guard: a SICK store must not ACCEPT
+                # leadership either — without this, two slow stores
+                # evacuating at each other ping-pong every lease (the
+                # mutual-evacuation storm the gray A/B bench caught).
+                # Always safe: a refused transfer just times out and
+                # the old leader's watchdog resumes.
+                LOG.info("%s refusing TimeoutNow: local store is SICK",
+                         self)
                 return TimeoutNowResponse(term=self.current_term,
                                           success=False)
             await self._elect_self()

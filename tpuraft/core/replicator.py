@@ -465,12 +465,19 @@ class Replicator:
         if not node.is_leader():
             return False
         req = self.build_heartbeat_request()
+        t0 = time.monotonic()
         try:
             resp = await node.transport.append_entries(
                 self.peer.endpoint, req,
                 timeout_ms=node.options.election_timeout_ms // 2 or 1)
         except RpcError:
             return False
+        health = node.options.health
+        if health is not None:
+            # gray-failure signal: the beat's RTT scores the PEER's
+            # endpoint — a limping follower shows up here long before
+            # it goes silent
+            health.note_peer_rtt(self.peer.endpoint, time.monotonic() - t0)
         return await self.process_heartbeat_response(resp)
 
     # -- catch-up (membership change) ----------------------------------------

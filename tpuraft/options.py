@@ -185,6 +185,16 @@ class NodeOptions:
     catchup_margin: int = 1000   # membership-change catch-up threshold (entries)
     raft_options: RaftOptions = field(default_factory=RaftOptions)
     tick: TickOptions = field(default_factory=TickOptions)
+    # store-level gray-failure tracker (tpuraft.util.health.
+    # HealthTracker), shared by every node the hosting store runs: the
+    # LogManager feeds its disk probe, the FSMCaller its apply depth,
+    # heartbeat paths their peer RTTs, and the node's election gate
+    # consults the score.  None = no health scoring (bare nodes).
+    health: Optional[object] = None
+    # a SICK store skips this many consecutive election rounds before
+    # campaigning anyway (the liveness escape when every peer is worse
+    # off) — the election-priority face of gray-failure mitigation
+    sick_election_rounds: int = 2
 
 
 @dataclass

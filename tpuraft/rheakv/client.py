@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import uuid
 from dataclasses import dataclass
 from typing import Optional
@@ -24,6 +25,7 @@ from tpuraft.rheakv.kv_service import (
     ERR_INVALID_EPOCH,
     ERR_KEY_OUT_OF_RANGE,
     ERR_NO_REGION,
+    ERR_STORE_BUSY,
     KVCommandBatchRequest,
     KVCommandRequest,
     ListRegionsOnStoreRequest,
@@ -45,10 +47,14 @@ LOG = logging.getLogger(__name__)
 _READONLY_OPS = {KVOp.GET, KVOp.MULTI_GET, KVOp.CONTAINS_KEY, KVOp.SCAN}
 
 # not leader / electing / readIndex round timed out under load: worth
-# another attempt against a different store
+# another attempt against a different store.  ERR_STORE_BUSY is the
+# gray-failure SHED bounce (a SICK store failing fast instead of
+# queueing) — retryable, and by the jittered backoff later leadership
+# has usually evacuated to a healthy store.
 _RETRYABLE_CODES = {
     int(RaftError.EPERM), int(RaftError.EBUSY), int(RaftError.EAGAIN),
     int(RaftError.ERAFTTIMEDOUT), int(RaftError.ETIMEDOUT),
+    ERR_STORE_BUSY,
 }
 
 
@@ -196,6 +202,7 @@ class _StoreSender:
         client = self._client
         req = KVCommandBatchRequest(
             items=[blob for _r, _p, blob, _f, _s in batch])
+        t0 = asyncio.get_running_loop().time()
         try:
             resp = await client.transport.call(
                 self.endpoint, "kv_command_batch", req, client.timeout_ms)
@@ -222,6 +229,15 @@ class _StoreSender:
             return
         client.batch_rpcs += 1
         client.batch_items += len(batch)
+        # feed the endpoint EMA only when the store actually SERVED
+        # something: a SICK store's instant shed bounces (or a follower
+        # instantly answering EPERM) would otherwise read as "fast" and
+        # drag a gray endpoint's EMA back under the slow floor, undoing
+        # the routing signal the EMA exists for
+        if any(len(b) >= 8 and decode_batch_reply(b)[0] == 0
+               for b in resp.items):
+            client._note_ep_latency(self.endpoint,
+                                    asyncio.get_running_loop().time() - t0)
         if len(resp.items) != len(batch):
             # a short (or over-long) reply must FAIL the batch, not zip-
             # truncate: unmatched futures would otherwise never resolve
@@ -249,7 +265,8 @@ class RheaKVStore:
                  retry_interval_ms: float = 50,
                  batching: Optional[BatchingOptions] = None,
                  read_preference: str = "leader",
-                 read_from: str = ""):
+                 read_from: str = "",
+                 jitter_seed: Optional[int] = None):
         if read_preference not in ("leader", "any"):
             raise ValueError(f"read_preference {read_preference!r} "
                              "(must be 'leader' or 'any')")
@@ -273,7 +290,18 @@ class RheaKVStore:
         self.timeout_ms = timeout_ms
         self.max_retries = max_retries
         self.retry_interval_ms = retry_interval_ms
+        # seeded jitter on every outer retry backoff: a bounced
+        # 256-worker batch re-probing in lockstep is a synchronized
+        # retry herd that a gray (slow-but-alive) leader turns into a
+        # thundering retry storm — each sleep spreads over
+        # [0.5, 1.5) x the linear schedule instead
+        self._backoff_rng = random.Random(jitter_seed)
         self.read_from = read_from
+        # per-endpoint service latency EMA (ms): fed by every batch RPC
+        # and per-op call, consulted by the read fan-out so spread reads
+        # route OFF slow (gray) replicas — client-side mirror of the
+        # store-side per-peer health scores
+        self._ep_lat_ms: dict[str, float] = {}
         # legacy alias (pre-read_from callers introspect this)
         self.read_preference = "any" if read_from == "any" else "leader"
         # read fan-out observability: who actually SERVED spread reads
@@ -384,6 +412,43 @@ class RheaKVStore:
         else:
             self.read_serves["follower"] += 1
 
+    def _backoff_s(self, attempt: int) -> float:
+        """Outer retry backoff: linear schedule x seeded jitter in
+        [0.5, 1.5) — bounced herds spread instead of re-probing in
+        lockstep."""
+        return (self.retry_interval_ms * (attempt + 1)
+                * (0.5 + self._backoff_rng.random()) / 1000.0)
+
+    def _note_ep_latency(self, endpoint: str, dur_s: float) -> None:
+        ms = dur_s * 1000.0
+        cur = self._ep_lat_ms.get(endpoint)
+        self._ep_lat_ms[endpoint] = ms if cur is None \
+            else cur + 0.25 * (ms - cur)
+
+    def _order_by_speed(self, pool: list[str]) -> list[str]:
+        """Stable-partition a read-candidate pool: endpoints observed
+        SLOW (EMA > 3x the pool's fastest and over an absolute floor)
+        go last — spread reads route off gray replicas while the
+        rotation inside each partition keeps spreading load.
+
+        Self-healing: a demoted endpoint no longer serves, so it gets
+        no fresh samples and a frozen EMA would exile it FOREVER after
+        a healed transient limp.  Each demotion decays its stored EMA
+        slightly; after ~O(100) reads it drops under the floor, gets
+        re-probed, and one real sample either clears it or (alpha
+        0.25 on a still-slow reply) demotes it again within a few
+        reads — bounded re-probe cost, no permanent capacity loss."""
+        emas = [self._ep_lat_ms.get(_endpoint(p)) for p in pool]
+        known = [e for e in emas if e is not None]
+        if len(known) < 2:
+            return pool
+        floor = max(3.0 * min(known), 20.0)
+        fast = [p for p, e in zip(pool, emas) if e is None or e <= floor]
+        slow = [p for p, e in zip(pool, emas) if not (e is None or e <= floor)]
+        for p in slow:
+            self._ep_lat_ms[_endpoint(p)] *= 0.98
+        return fast + slow
+
     def _sender(self, endpoint: str) -> _StoreSender:
         s = self._senders.get(endpoint)
         if s is None:
@@ -489,8 +554,7 @@ class RheaKVStore:
             pending = retry
             if need_refresh:
                 await self._refresh_routes()
-            await asyncio.sleep(
-                self.retry_interval_ms * (attempt + 1) / 1000.0)
+            await asyncio.sleep(self._backoff_s(attempt))
         err = RheaKVError(last)
         for _, fut in pending:
             if not fut.done():
@@ -616,11 +680,14 @@ class RheaKVStore:
     def _read_endpoints_for(self, region: Region) -> list[str]:
         """Round-robin over the DATA replicas (voters, learners, leader
         alike) for read-only ops under read_from='any' — witness
-        replicas hold no state and are never read targets."""
+        replicas hold no state and are never read targets.  Like the
+        follower/learner fan-out, observed-slow (gray) endpoints drop
+        to the back of the rotation."""
         peers = [p for p in region.peers if not p.endswith("/witness")]
         cur = self._read_rr.get(region.id, region.id)
         self._read_rr[region.id] = cur + 1
-        return [peers[(cur + i) % len(peers)] for i in range(len(peers))]
+        rotated = [peers[(cur + i) % len(peers)] for i in range(len(peers))]
+        return self._order_by_speed(rotated)
 
     def _read_candidates(self, region: Region, attempt: int) -> list[str]:
         """read_from='follower'|'learner' candidate ordering: the
@@ -645,7 +712,11 @@ class RheaKVStore:
         cur = self._read_rr.get(region.id, region.id)
         self._read_rr[region.id] = cur + 1
         k = (cur + attempt) % len(pool)
-        return pool[k:] + pool[:k] + [p for p in rest if p not in pool]
+        rotated = pool[k:] + pool[:k]
+        # gray replicas last: observed-slow endpoints only serve when
+        # every faster candidate bounced (per-endpoint latency EMA)
+        return self._order_by_speed(rotated) \
+            + [p for p in rest if p not in pool]
 
     async def _call_region(self, region: Region, op: KVOperation):
         """One attempt cycle over a region's stores; raises on hard error."""
@@ -666,6 +737,7 @@ class RheaKVStore:
                 conf_ver=region.epoch.conf_ver,
                 version=region.epoch.version,
                 op_blob=op.encode())
+            t0 = asyncio.get_running_loop().time()
             try:
                 resp = await self.transport.call(endpoint, "kv_command", req,
                                                  self.timeout_ms)
@@ -675,6 +747,10 @@ class RheaKVStore:
                     self._leaders.pop(region.id, None)   # about the leader
                 continue
             if resp.code == 0:
+                # EMA only on served replies (an instant error bounce
+                # must not make a gray endpoint look fast again)
+                self._note_ep_latency(
+                    endpoint, asyncio.get_running_loop().time() - t0)
                 if not spread_read:
                     self._leaders[region.id] = ep_str
                 else:
@@ -723,9 +799,9 @@ class RheaKVStore:
                     await self._refresh_routes()
                 if r.status is not None:
                     last = r.status
-                # linear backoff: elections take a few election timeouts
-                await asyncio.sleep(
-                    self.retry_interval_ms * (attempt + 1) / 1000.0)
+                # linear backoff (jittered): elections take a few
+                # election timeouts, and lockstep re-probes would herd
+                await asyncio.sleep(self._backoff_s(attempt))
         raise RheaKVError(last)
 
     # ------------------------------------------------------------------
@@ -868,8 +944,7 @@ class RheaKVStore:
                     last = r.status
                 if r.refresh:
                     await self._refresh_routes()
-                await asyncio.sleep(
-                    self.retry_interval_ms * attempts / 1000.0)
+                await asyncio.sleep(self._backoff_s(attempts - 1))
                 continue
             if reverse:
                 if not region.start_key or (start and region.start_key <= start):

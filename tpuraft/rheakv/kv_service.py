@@ -222,6 +222,11 @@ class KVCommandProcessor:
         self.batch_items = 0     # items carried inside them
         self.batch_regions = 0   # distinct regions proposed per batch, summed
         self.single_rpcs = 0     # legacy per-op kv_command RPCs served
+        # serving-plane degradation (gray failures): items currently in
+        # the propose/apply pipe, and how many we bounced with EBUSY +
+        # retry-after because the store was SICK past the backlog bound
+        self.inflight_items = 0
+        self.shed_items = 0
         # read plane: N batched GETs of one region cost ONE read_index
         # fence (fenced_reads / read_fences = the amortization ratio)
         self.read_fences = 0     # read_index barriers taken for batches
@@ -284,12 +289,22 @@ class KVCommandProcessor:
 
     async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
         self.single_rpcs += 1
+        shed, retry_ms = self._se.should_shed()
+        if shed:
+            self.shed_items += 1
+            return KVCommandResponse(
+                code=ERR_STORE_BUSY,
+                msg=f"store sick: shedding (retry-after-ms={retry_ms})")
         rejected, engine, op = self._validate(
             req.region_id, req.conf_ver, req.version, req.op_blob)
         if rejected is not None:
             code, msg, meta = rejected
             return KVCommandResponse(code=code, msg=msg, region_meta=meta)
-        code, msg, result = await self._execute_op(engine.raft_store, op)
+        self.inflight_items += 1
+        try:
+            code, msg, result = await self._execute_op(engine.raft_store, op)
+        finally:
+            self.inflight_items -= 1
         if code:
             return KVCommandResponse(code=code, msg=msg)
         return KVCommandResponse(result=encode_result(result))
@@ -302,6 +317,27 @@ class KVCommandProcessor:
         through sequential ``kv_command`` handlers."""
         self.batch_rpcs += 1
         self.batch_items += len(req.items)
+        # serving-plane degradation: under a SICK local score with the
+        # pipe already backed up, SHED — a deadline-aware EBUSY with a
+        # retry-after hint beats queueing 256 workers behind a stalling
+        # disk into p99=inf (the client treats it as retryable and its
+        # jittered backoff spreads the re-offered load; by then
+        # evacuation has usually moved leadership off this store)
+        shed, retry_ms = self._se.should_shed()
+        if shed:
+            self.shed_items += len(req.items)
+            bounce = encode_batch_reply(
+                ERR_STORE_BUSY,
+                f"store sick: shedding (retry-after-ms={retry_ms})")
+            return KVCommandBatchResponse(items=[bounce] * len(req.items))
+        self.inflight_items += len(req.items)
+        try:
+            return await self._handle_batch_admitted(req)
+        finally:
+            self.inflight_items -= len(req.items)
+
+    async def _handle_batch_admitted(self, req: KVCommandBatchRequest
+                                     ) -> KVCommandBatchResponse:
         replies: list[bytes] = [b""] * len(req.items)
         groups: dict[int, list[tuple[int, KVOperation]]] = {}
         for i, blob in enumerate(req.items):

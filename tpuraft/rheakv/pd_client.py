@@ -30,7 +30,8 @@ class PlacementDriverClient:
     async def report_split(self, parent: Region, child: Region) -> None:
         pass
 
-    async def store_heartbeat(self, meta: StoreMeta) -> None:
+    async def store_heartbeat(self, meta: StoreMeta,
+                              health: str = "") -> None:
         pass
 
     async def region_heartbeat(self, region: Region, leader: str,
@@ -41,10 +42,12 @@ class PlacementDriverClient:
     async def store_heartbeat_batch(
             self, meta: StoreMeta,
             deltas: list[tuple[Region, str, int]],
-            full: bool = False) -> tuple[list, bool]:
+            full: bool = False, health: str = "") -> tuple[list, bool]:
         """Delta-batched reporting: ONE call per interval carrying only
         the CHANGED (region, leader, approximate_keys) rows.  Returns
-        (instructions, need_full).  Default: decompose into the legacy
+        (instructions, need_full).  ``health`` is the store's
+        self-reported gray-failure level (trailing wire field; "" on
+        stores without scoring).  Default: decompose into the legacy
         per-region calls — PD-less / legacy clients keep exact
         semantics while batch-aware clients override with one RPC.
         need_full is always True here: a legacy PD has no delta state
@@ -56,6 +59,9 @@ class PlacementDriverClient:
         meta = StoreMeta(id=meta.id, endpoint=meta.endpoint,
                          regions=[r.copy() for (r, _l, _k) in deltas],
                          zone=meta.zone)
+        # legacy decomposition deliberately DROPS health: the per-region
+        # protocol (and the subclasses that implement it) predates
+        # scoring, and a legacy PD has no drain policy to feed anyway
         await self.store_heartbeat(meta)
         instructions: list = []
         for region, leader, keys in deltas:
@@ -160,13 +166,14 @@ class RemotePlacementDriverClient(PlacementDriverClient):
         await self._call("pd_report_split", ReportSplitRequest(
             parent=parent.encode(), child=child.encode()))
 
-    async def store_heartbeat(self, meta: StoreMeta) -> None:
+    async def store_heartbeat(self, meta: StoreMeta,
+                              health: str = "") -> None:
         from tpuraft.rheakv.pd_messages import StoreHeartbeatRequest
 
         await self._call("pd_store_heartbeat", StoreHeartbeatRequest(
             store_id=meta.id, endpoint=meta.endpoint,
             regions=[r.encode() for r in meta.regions],
-            zone=meta.zone))
+            zone=meta.zone, health=health))
 
     async def region_heartbeat(self, region: Region, leader: str,
                                metrics: Optional[dict] = None) -> list:
@@ -183,7 +190,7 @@ class RemotePlacementDriverClient(PlacementDriverClient):
     async def store_heartbeat_batch(
             self, meta: StoreMeta,
             deltas: list[tuple[Region, str, int]],
-            full: bool = False) -> tuple[list, bool]:
+            full: bool = False, health: str = "") -> tuple[list, bool]:
         from tpuraft.rheakv.pd_messages import (
             Instruction,
             StoreHeartbeatBatchRequest,
@@ -192,19 +199,20 @@ class RemotePlacementDriverClient(PlacementDriverClient):
         from tpuraft.rpc.transport import RpcError, is_no_method
 
         if not self._batch_ok:
-            return await super().store_heartbeat_batch(meta, deltas, full)
+            return await super().store_heartbeat_batch(
+                meta, deltas, full, health=health)
         req = StoreHeartbeatBatchRequest(
             store_id=meta.id, endpoint=meta.endpoint,
             deltas=[encode_region_delta(r.encode(), leader, keys)
                     for (r, leader, keys) in deltas],
-            full=full, zone=meta.zone)
+            full=full, zone=meta.zone, health=health)
         try:
             resp = await self._call("pd_store_heartbeat_batch", req)
         except RpcError as e:
             if is_no_method(e):
                 self._batch_ok = False
                 return await super().store_heartbeat_batch(
-                    meta, deltas, full)
+                    meta, deltas, full, health=health)
             raise
         return ([Instruction.decode(b) for b in resp.instructions],
                 bool(getattr(resp, "need_full", False)))

@@ -56,6 +56,10 @@ class StoreHeartbeatRequest:
     # trailing extension (geo): the store's zone label; old senders
     # decode to "" (unlabeled)
     zone: str = ""
+    # trailing extension (gray failures): the store's self-reported
+    # health level ("healthy"/"degraded"/"sick"; "" = no scoring) —
+    # the PD stops placing leaders onto SICK stores and drains them
+    health: str = ""
 
 
 @_pd(145)
@@ -122,6 +126,9 @@ class StoreHeartbeatBatchRequest:
     full: bool = False
     # trailing extension (geo): the store's zone label
     zone: str = ""
+    # trailing extension (gray failures): self-reported health level
+    # ("" = store predates health scoring, treated as healthy)
+    health: str = ""
 
 
 @_pd(153)

@@ -321,7 +321,8 @@ class ClusterStatsManager:
                              region_leaders: dict[int, str],
                              cooldown_s: float,
                              zones: Optional[dict[str, str]] = None,
-                             zone_counts: Optional[dict[str, int]] = None
+                             zone_counts: Optional[dict[str, int]] = None,
+                             health: Optional[dict[str, str]] = None
                              ) -> Optional[str]:
         """If ``leader_ep`` leads at least 2 more regions than the
         least-loaded peer of ``region``, return that peer as the
@@ -341,7 +342,14 @@ class ClusterStatsManager:
         for every region and orders the whole imbalance moved at once,
         overshooting into a permanent oscillation (observed as
         (6,0,0) → (0,2,4) → (2,4,0) → ... thrash every cooldown
-        period)."""
+        period).
+
+        Gray failures (``health``: endpoint -> self-reported level):
+        a SICK store is never a transfer TARGET (moving leadership onto
+        a gray store helps nobody), DEGRADED stores lose ties, and a
+        SICK *leader* is DRAINED — the least-loaded healthy peer is
+        picked even when the usual >=2 leader-count imbalance is
+        absent (cooldown and post-failover grace still pace it)."""
         now = time.monotonic()
         if now < self._grace_until:
             return None  # post-failover grace (note_leadership)
@@ -362,11 +370,20 @@ class ClusterStatsManager:
                 counts[src] = counts.get(src, 0) - 1
                 counts[dst] = counts.get(dst, 0) + 1
         my = counts.get(leader_ep, 0)
+        health = health or {}
+        _H_RANK = {"": 0, "healthy": 0, "degraded": 1, "sick": 2}
+
+        def h_rank(p: str) -> int:
+            return _H_RANK.get(health.get(_peer_endpoint(p), ""), 0)
+
+        leader_sick = health.get(_peer_endpoint(leader_ep), "") == "sick"
         # learners are read-only replicas and witnesses hold no payload
-        # — neither can lead, so neither is a leadership target
+        # — neither can lead, so neither is a leadership target; a SICK
+        # store is excluded too (never place leaders onto gray stores)
         candidates = [p for p in region.peers
                       if p != leader_ep and not p.endswith("/learner")
-                      and not p.endswith("/witness")]
+                      and not p.endswith("/witness")
+                      and h_rank(p) < 2]
         if not candidates:
             return None
         if zones and zone_counts is None:
@@ -381,9 +398,10 @@ class ClusterStatsManager:
             return zone_counts.get(zones.get(_peer_endpoint(p), ""), 0)
 
         target = min(candidates,
-                     key=lambda p: (counts.get(p, 0), zone_load(p),
+                     key=lambda p: (h_rank(p), counts.get(p, 0),
+                                    zone_load(p),
                                     hash((region.id, p)) & 0xffff))
-        if my - counts.get(target, 0) < 2:
+        if not leader_sick and my - counts.get(target, 0) < 2:
             return None
         self._transfer_cooldown[region.id] = now + cooldown_s
         self._pending_moves[region.id] = (
@@ -437,6 +455,10 @@ class PlacementDriverServer:
         # store resyncs — deltas alone can't rebuild the key counts its
         # split/balance decisions read.
         self._batch_synced: dict[str, int] = {}
+        # gray-failure state (leader-local, ephemeral like ClusterStats
+        # — re-derived from heartbeats after failover): store endpoint
+        # -> self-reported health level ("healthy"/"degraded"/"sick")
+        self._store_health: dict[str, str] = {}
 
     @property
     def node(self):
@@ -550,6 +572,7 @@ class PlacementDriverServer:
         # only replicate *changes* — heartbeats repeat at 1s cadence and
         # must not grow the PD log when nothing moved
         zone = getattr(req, "zone", "")
+        self._note_store_health(req.endpoint, getattr(req, "health", ""))
         cur = self.fsm.stores.get(req.endpoint)
         if cur is None or cur.store_id != req.store_id \
                 or (zone and cur.zone != zone):
@@ -590,6 +613,7 @@ class PlacementDriverServer:
             return self._not_leader(StoreHeartbeatBatchResponse)
         await self._maybe_seed()
         zone = getattr(req, "zone", "")
+        self._note_store_health(req.endpoint, getattr(req, "health", ""))
         cur = self.fsm.stores.get(req.endpoint)
         if cur is None or cur.store_id != req.store_id \
                 or (zone and cur.zone != zone):
@@ -641,6 +665,14 @@ class PlacementDriverServer:
         return {ep: rec.zone for ep, rec in self.fsm.stores.items()
                 if rec.zone}
 
+    def _note_store_health(self, endpoint: str, health: str) -> None:
+        if health:
+            self._store_health[endpoint] = health
+        else:
+            # "" = the store runs no scoring (or predates it): unknown,
+            # treated healthy — never leave a stale SICK verdict behind
+            self._store_health.pop(endpoint, None)
+
     async def _region_hb_core(self, region: Region, leader: str,
                               approximate_keys: int,
                               zones: Optional[dict] = None,
@@ -675,7 +707,13 @@ class PlacementDriverServer:
             instructions.append(Instruction(
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
                 new_region_id=new_id))
-        elif self.opts.balance_leaders:
+        elif self.opts.balance_leaders or (
+                self._store_health.get(_peer_endpoint(leader)) == "sick"):
+            # the second arm is the gray-failure DRAIN: even with
+            # balancing off, a SICK leader store sheds its leases onto
+            # healthy peers (pick_transfer_target skips the >=2
+            # imbalance threshold for a sick source and never targets
+            # another sick store)
             self.stats.note_leadership(node.current_term,
                                        self.opts.transfer_cooldown_s)
             if zones is None:
@@ -683,7 +721,8 @@ class PlacementDriverServer:
             target = self.stats.pick_transfer_target(
                 region, leader, self.fsm.region_leaders,
                 cooldown_s=self.opts.transfer_cooldown_s,
-                zones=zones, zone_counts=zone_counts)
+                zones=zones, zone_counts=zone_counts,
+                health=self._store_health)
             if target is not None:
                 instructions.append(Instruction(
                     kind=Instruction.KIND_TRANSFER_LEADER,

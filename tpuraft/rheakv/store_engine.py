@@ -97,6 +97,34 @@ class StoreEngineOptions:
     # (ReadConfirmBatcher) instead of one quorum heartbeat round per
     # group.  False = per-group rounds (the pre-batch behavior).
     read_confirm_batching: bool = True
+    # -- gray-failure survival (fail-slow detection + mitigation) ------------
+    # score this store {HEALTHY, DEGRADED, SICK} from hot-path signals
+    # (append/fsync latency, peer ack RTTs, apply backlog — see
+    # tpuraft/util/health.py) and mitigate: a SICK self-score evacuates
+    # led groups' leadership at a bounded rate, and the KV serving plane
+    # sheds with EBUSY+retry-after instead of queueing behind a dying
+    # disk.  False = observe-only never (no tracker at all).
+    health_scoring: bool = True
+    # custom thresholds/hysteresis (None = HealthOptions defaults)
+    health_options: Optional[object] = None
+    # scoring cadence; hysteresis counts these rounds, so
+    # interval x worsen_after bounds detection latency
+    health_eval_interval_ms: int = 500
+    # SICK => proactively transfer led groups to the healthiest
+    # caught-up voter.  False = detect + shed only (operator drains).
+    evacuate_on_sick: bool = True
+    # at most this many transfers per evaluation round, so evacuation
+    # itself can never storm the cluster with elections
+    evacuation_rate: int = 2
+    # a region just transferred (or attempted) is left alone for this
+    # many evaluation rounds
+    evacuation_cooldown_rounds: int = 4
+    # serving-plane degradation: once SICK, kv_command_batch sheds with
+    # per-item EBUSY + retry-after when this many items are already in
+    # flight (0 = never shed).  A gray store fails fast instead of
+    # timing out 256 workers at p99=inf.
+    shed_backlog_items: int = 512
+    shed_retry_after_ms: int = 250
 
 
 class _GroupFence:
@@ -184,6 +212,16 @@ class ReadConfirmBatcher:
         self._task: Optional[asyncio.Task] = None
         self._rounds_inflight: set = set()
         self._fast_ok: dict[str, bool] = {}  # dst serves multi_beat_fast
+        # nudges the drain out of its completed-round wait when a NEW
+        # fence arrives with window slots free: without it, one STALLED
+        # (not dead) endpoint's round parked the drain on
+        # FIRST_COMPLETED and every later fence — healthy endpoints
+        # included — convoyed behind the stall until its RPC timed out
+        # (found by the gray-failure stalled-endpoint tests)
+        self._arrival = asyncio.Event()
+        # gray-failure signal sink (HealthTracker): every fence round's
+        # RPC doubles as a per-endpoint RTT probe
+        self.health = None
         # counters (describe() + bench/soak stats lines)
         self.confirms = 0       # fences requested
         self.rounds = 0         # store-wide rounds run
@@ -225,6 +263,7 @@ class ReadConfirmBatcher:
         self.confirms += 1
         fut = asyncio.get_running_loop().create_future()
         self._pending.append((node, fut))
+        self._arrival.set()
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._drain())
         return await fut
@@ -254,8 +293,17 @@ class ReadConfirmBatcher:
                 self._rounds_inflight.add(t)
                 t.add_done_callback(self._reap_round)
             if self._rounds_inflight:
-                await asyncio.wait(set(self._rounds_inflight),
-                                   return_when=asyncio.FIRST_COMPLETED)
+                # wake on a round completing OR a new fence arriving:
+                # with window slots free the new fence must start ITS
+                # OWN round now, not convoy behind a stalled endpoint's
+                self._arrival.clear()
+                arrival = asyncio.ensure_future(self._arrival.wait())
+                try:
+                    await asyncio.wait(
+                        set(self._rounds_inflight) | {arrival},
+                        return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    arrival.cancel()
 
     def _reap_round(self, t: asyncio.Task) -> None:
         self._rounds_inflight.discard(t)
@@ -316,6 +364,7 @@ class ReadConfirmBatcher:
         node = rows[0][0].node
         self.beat_rpcs += 1
         self.beats += len(rows)
+        t0 = time.monotonic()
         try:
             resp = await node.transport.call(
                 dst, "multi_beat_fast",
@@ -328,6 +377,8 @@ class ReadConfirmBatcher:
                 await asyncio.gather(
                     *(self._classic(st, r) for st, r, _b in rows))
             return  # silence: the fences just miss these acks
+        if self.health is not None:
+            self.health.note_peer_rtt(dst, time.monotonic() - t0)
         if len(resp.items) != len(rows):
             # short/overlong reply reads as silence for the whole chunk
             # (zip would pair acks with the wrong fences)
@@ -382,7 +433,26 @@ class StoreEngine:
             from tpuraft.util import describer
 
             describer.register(self.read_batcher)
+        # gray-failure plane: one HealthTracker per store, fed by the
+        # hot path (LogManager flush timing, beat-plane ack RTTs, FSM
+        # apply backlog) and acted on by the health loop below
+        self.health = None
+        self._health_task: Optional[asyncio.Task] = None
+        self._evac_round = 0                   # evaluation round counter
+        self._evac_cooldown: dict[int, int] = {}  # region -> round gate
+        self.evacuations = 0          # transfers triggered by SICK score
+        self.evacuation_rounds = 0    # eval rounds that attempted any
+        if opts.health_scoring:
+            from tpuraft.util import describer
+            from tpuraft.util.health import HealthTracker
+
+            self.health = HealthTracker(opts.health_options)
+            describer.register(self.health)
+            if self.read_batcher is not None:
+                self.read_batcher.health = self.health
         self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
+        if self.health is not None:
+            self.health.register_gauges(self.metrics)
         raw: RawKVStore = opts.raw_store_factory()
         if opts.enable_kv_metrics:
             raw = MetricsRawKVStore(raw, self.metrics)
@@ -403,6 +473,23 @@ class StoreEngine:
         self._pd_reported: dict[int, tuple] = {}
         self._pd_dirty: set[int] = set()
         self._pd_need_full = True
+        # does the PD client's store_heartbeat_batch accept health=?
+        # Probed from the signature (not by catching TypeError, which
+        # would also swallow bugs inside a real implementation): a
+        # pre-health subclass override reports without health — the
+        # alternative is the retry loop eating its TypeError forever
+        # and silently starving the PD of heartbeats.
+        self._pd_health_kwarg = True
+        if pd_client is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    pd_client.store_heartbeat_batch).parameters
+                self._pd_health_kwarg = "health" in params or any(
+                    p.kind == p.VAR_KEYWORD for p in params.values())
+            except (TypeError, ValueError):
+                pass  # unintrospectable callable: assume current API
         self.pd_batches_sent = 0     # observability (bench counters)
         self.pd_deltas_sent = 0
         self.pd_full_syncs = 0
@@ -411,6 +498,9 @@ class StoreEngine:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        if self.health is not None:
+            # beat-plane RPCs double as per-endpoint RTT probes
+            self.node_manager.heartbeat_hub.health = self.health
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.start()
         # batched-concurrent region boot: one region at a time serializes
@@ -433,14 +523,38 @@ class StoreEngine:
         if self.pd_client is not None:
             self._heartbeat_task = asyncio.ensure_future(
                 self._heartbeat_loop())
+        if self.health is not None:
+            self._wire_multilog_probe()
+            self._health_task = asyncio.ensure_future(self._health_loop())
         LOG.info("store engine %s up with %d regions", self.server_id,
                  len(self._regions))
+
+    def _wire_multilog_probe(self) -> None:
+        """multilog scheme: the shared group commit times every fsync
+        in its executor thread — feed those samples to the disk probe
+        (the LogManager's flush timing covers the file scheme)."""
+        if self.opts.log_scheme != "multilog" or not self.opts.data_path:
+            return
+        from tpuraft.storage.multilog import peek_engine
+
+        store_base = (f"{self.opts.data_path}/"
+                      f"{self.server_id.ip}_{self.server_id.port}")
+        eng = peek_engine(f"{store_base}/mlog")
+        if eng is not None:
+            eng.group_commit.health_probe = self.health.disk
 
     async def shutdown(self) -> None:
         self._started = False
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
+        if self._health_task is not None:
+            self._health_task.cancel()
+            self._health_task = None
+        if self.health is not None:
+            from tpuraft.util import describer
+
+            describer.unregister(self.health)
         if self.read_batcher is not None:
             from tpuraft.util import describer
 
@@ -459,6 +573,109 @@ class StoreEngine:
 
             _release_journal(self._meta_journal)
             self._meta_journal = None
+
+    # -- gray-failure survival: health loop + leadership evacuation ----------
+
+    async def _health_loop(self) -> None:
+        """Steady-cadence scoring (hysteresis counts these rounds) +
+        SICK-triggered mitigation.  Detection latency is bounded by
+        interval x worsen_after; evacuation is rate-bounded per round
+        so mitigation can never itself storm the cluster."""
+        from tpuraft.util.health import SICK
+
+        interval = self.opts.health_eval_interval_ms / 1000.0
+        while self._started:
+            try:
+                await asyncio.sleep(interval)
+                self._evac_round += 1
+                level = self.health.evaluate()
+                if level == SICK and self.opts.evacuate_on_sick:
+                    await self._evacuate_leaders()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — scoring must never die
+                LOG.exception("health loop round failed")
+
+    async def _evacuate_leaders(self) -> int:
+        """Proactive leadership evacuation: move up to
+        ``evacuation_rate`` led groups to the healthiest caught-up
+        voter this round.  Hysteretic by construction — only a SICK
+        (not DEGRADED) score reaches here, and the tracker's
+        recover_after rounds keep a recovering store from flapping
+        between evacuating and re-acquiring."""
+        done = 0
+        self.evacuation_rounds += 1
+        for rid in self.leader_region_ids():
+            if done >= max(1, self.opts.evacuation_rate):
+                break
+            if self._evac_cooldown.get(rid, 0) > self._evac_round:
+                continue
+            engine = self._regions.get(rid)
+            if engine is None or not engine.is_leader():
+                continue
+            target = self._pick_evacuation_target(engine)
+            if target is None:
+                continue
+            # cooldown on ATTEMPT, not success: a transfer that bounces
+            # (EBUSY mid-conf-change) must not be hammered every round
+            self._evac_cooldown[rid] = (
+                self._evac_round + max(1, self.opts.evacuation_cooldown_rounds))
+            st = await engine.transfer_leadership_to(target)
+            if st.is_ok():
+                done += 1
+                self.evacuations += 1
+                LOG.warning("gray-failure evacuation: region %d leadership "
+                            "-> %s (store %s is SICK: %s)", rid, target,
+                            self.server_id, self.health.cause)
+        return done
+
+    def _pick_evacuation_target(self, engine) -> Optional[PeerId]:
+        """Healthiest caught-up voter: witness-aware (never a target),
+        priority-aware (higher priority preferred), per-peer health
+        scores first (a SICK peer is never a target — evacuating onto
+        another gray store helps nobody), caught-up-ness required (the
+        transfer protocol would stall on a lagging target)."""
+        from tpuraft.util.health import DEGRADED, HEALTHY, SICK
+
+        node = engine.node
+        if node is None or node.state.value != "leader" \
+                or node._conf_ctx is not None:
+            return None
+        conf = node.conf_entry.conf
+        if not node.conf_entry.old_conf.is_empty():
+            return None  # mid-joint: let the change finish first
+        witnesses = set(conf.witnesses)
+        committed = node.ballot_box.last_committed_index
+        rank = {HEALTHY: 0, DEGRADED: 1, SICK: 2}
+        best = None
+        for p in conf.peers:
+            if p == node.server_id or p in witnesses:
+                continue
+            r = node.replicators.get(p)
+            if r is None or not r._matched or r.match_index < committed:
+                continue
+            score = self.health.peer_score(p.endpoint)
+            if score == SICK:
+                continue
+            key = (rank[score], -p.priority, -r.match_index)
+            if best is None or key < best[0]:
+                best = (key, p)
+        return best[1] if best else None
+
+    def should_shed(self) -> tuple[bool, int]:
+        """Serving-plane degradation gate (kv_service.handle_batch):
+        once this store is SICK and the propose/apply pipe already
+        holds ``shed_backlog_items``, new batch items bounce with
+        EBUSY + retry-after instead of queueing behind the dying disk.
+        Returns (shed?, retry_after_ms)."""
+        from tpuraft.util.health import SICK
+
+        if (self.health is None or self.opts.shed_backlog_items <= 0
+                or self.health.score() != SICK):
+            return False, 0
+        if self.kv_processor.inflight_items < self.opts.shed_backlog_items:
+            return False, 0
+        return True, self.opts.shed_retry_after_ms
 
     # -- PD heartbeats -------------------------------------------------------
 
@@ -535,8 +752,19 @@ class StoreEngine:
         meta = StoreMeta(id=zlib.crc32(str(self.server_id).encode()),
                          endpoint=self.server_id.endpoint, regions=[],
                          zone=self.opts.zone)
-        instructions, need_full = await self.pd_client.store_heartbeat_batch(
-            meta, deltas, full=full)
+        # health rides the heartbeat as a trailing wire field: the PD
+        # stops placing leaders onto SICK stores and drains them (a
+        # pre-health PD client override is probed at construction and
+        # reported to without the kwarg — see _pd_health_kwarg)
+        health = self.health.score() if self.health is not None else ""
+        if self._pd_health_kwarg:
+            instructions, need_full = \
+                await self.pd_client.store_heartbeat_batch(
+                    meta, deltas, full=full, health=health)
+        else:
+            instructions, need_full = \
+                await self.pd_client.store_heartbeat_batch(
+                    meta, deltas, full=full)
         # only now (RPC succeeded) do the fingerprints count as reported
         self.pd_batches_sent += 1
         self.pd_deltas_sent += len(deltas)
@@ -612,6 +840,11 @@ class StoreEngine:
         opts.raft_options.read_only_option = self.opts.read_only_option
         opts.raft_options.quiesce_after_rounds = \
             self.opts.quiesce_after_rounds
+        # gray-failure plane: every region node of this store feeds (and
+        # consults) the ONE store-level tracker — disk probe from its
+        # LogManager, apply depth from its FSMCaller, election gate from
+        # its _allow_launch_election
+        opts.health = self.health
         if self.opts.data_path:
             store_base = (f"{self.opts.data_path}/"
                           f"{self.server_id.ip}_{self.server_id.port}")

@@ -83,6 +83,11 @@ class NetworkTopology:
         self._degraded: dict[tuple[str, str], LinkProfile] = {}
         self._partitioned: set[tuple[str, str]] = set()   # one-way
         self._flaps: dict[tuple[str, str], _Flap] = {}
+        # per-ENDPOINT degrade (gray failures): one store's links limp —
+        # extra latency/jitter/loss ADDED to every frame touching the
+        # endpoint, both directions — while its zone stays healthy.
+        # endpoint -> (latency_ms, jitter_ms, loss)
+        self._ep_degraded: dict[str, tuple[float, float, float]] = {}
         # per-link bandwidth token bucket: link -> busy-until timestamp
         self._busy_until: dict[tuple[str, str], float] = {}
         self.counters: dict[str, int] = {
@@ -167,12 +172,38 @@ class NetworkTopology:
         if symmetric:
             self._flaps[(dst_zone, src_zone)] = f
 
+    def degrade_endpoint(self, endpoint: str, latency_ms: float = 25.0,
+                         jitter_ms: float = 10.0, loss: float = 0.0) -> None:
+        """Gray-failure verb: ONE endpoint's links limp (both
+        directions) while its zone — and every zone link — stays
+        healthy.  The classic fail-slow network shape: a saturated NIC/
+        CPU on one store adds latency to everything it touches, and no
+        zone-level check sees it."""
+        self._ep_degraded[endpoint] = (latency_ms, jitter_ms, loss)
+
+    def stall_endpoint(self, endpoint: str, stall_ms: float = 1500.0,
+                       loss: float = 0.0) -> None:
+        """Stalled (NOT dead) endpoint: frames to/from it are delivered
+        after ``stall_ms`` — long past any heartbeat cadence, short of
+        forever.  Distinct from a partition: acks eventually arrive, so
+        naive liveness checks keep passing while latency detonates."""
+        self.degrade_endpoint(endpoint, latency_ms=stall_ms, jitter_ms=0.0,
+                              loss=loss)
+
+    def heal_endpoint(self, endpoint: str) -> None:
+        self._ep_degraded.pop(endpoint, None)
+
+    def endpoint_degraded(self, endpoint: str) -> bool:
+        return endpoint in self._ep_degraded
+
     def heal_events(self) -> None:
-        """Clear every DYNAMIC event (degrades, partitions, flaps); the
-        base zone matrix — the deployment's real shape — stays."""
+        """Clear every DYNAMIC event (degrades, partitions, flaps,
+        endpoint limps); the base zone matrix — the deployment's real
+        shape — stays."""
         self._degraded.clear()
         self._partitioned.clear()
         self._flaps.clear()
+        self._ep_degraded.clear()
 
     # -- the consultation point ----------------------------------------------
 
@@ -195,12 +226,27 @@ class NetworkTopology:
                 self.counters["dropped_flap"] += 1
                 return 0.0, True
         prof = self.link(sz, dz)
-        if prof.loss > 0 and self._rng.random() < prof.loss:
+        # per-endpoint limp: additive on top of whatever the zone link
+        # says, applied once per degraded endpoint the frame touches
+        ep_lat = ep_jit = ep_loss = 0.0
+        for ep in (src_ep, dst_ep):
+            shape = self._ep_degraded.get(ep)
+            if shape is not None:
+                ep_lat += shape[0]
+                ep_jit += shape[1]
+                ep_loss = max(ep_loss, shape[2])
+        loss = max(prof.loss, ep_loss) if ep_loss else prof.loss
+        if loss > 0 and self._rng.random() < loss:
             self.counters["dropped_loss"] += 1
             return 0.0, True
-        delay = prof.latency_ms / 1000.0
+        delay = (prof.latency_ms + ep_lat) / 1000.0
         if prof.jitter_ms > 0:
             delay += self._rng.random() * prof.jitter_ms / 1000.0
+        if ep_jit > 0:
+            delay += self._rng.random() * ep_jit / 1000.0
+        if ep_lat > 0:
+            self.counters["ep_shaped"] = self.counters.get("ep_shaped",
+                                                           0) + 1
         if prof.bandwidth_kbps > 0:
             # token-bucket serialization: consecutive frames queue behind
             # the link's busy horizon, so a burst sees growing delays
@@ -256,6 +302,8 @@ class NetworkTopology:
                          f"{sorted(self._partitioned)}")
         if self._flaps:
             lines.append(f"  flapping: {sorted(self._flaps)}")
+        if self._ep_degraded:
+            lines.append(f"  endpoint-degraded: {sorted(self._ep_degraded)}")
         lines.append(f"  counters: {self.counters}")
         return "\n".join(lines)
 

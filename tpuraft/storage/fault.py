@@ -51,8 +51,10 @@ from __future__ import annotations
 
 import builtins
 import os
+import random
 import shutil
 import threading
+import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Optional
@@ -154,6 +156,7 @@ class FaultInjectingFile:
     # -- write-path interceptions -------------------------------------------
 
     def write(self, data):
+        self._owner._slow_sleep("write")
         pos = self._real.tell()
         n = self._real.write(data)
         self._owner._note_write(self._path, pos, pos + len(data))
@@ -339,6 +342,11 @@ class _Interposer:
             # the rename-lost crash outcome is only legal before this
             ent[1]._note_dir_fsync(ent[2])
             return None
+        # fail-slow injection: the sleep happens on the CALLING thread
+        # (executor threads for the storage planes), exactly where a
+        # real stalling disk would park it — durability modeling only
+        # proceeds once the "disk" comes back
+        ent._owner._slow_sleep("fsync")
         ent._owner._note_fsync(ent._path)
         return None      # modeled; skip the real (slow) fsync
 
@@ -386,6 +394,22 @@ class ChaosDir:
         self._files: dict[str, _PathState] = {}
         self.crash_count = 0
         self.injected: dict[str, int] = {}
+        # -- fail-slow injection (gray failures) -----------------------------
+        # per-call latency for fsync/write under this root, plus a full
+        # fsync hang: a stalling disk keeps the store "alive" to every
+        # liveness check while everything it leads limps.  Sleeps run on
+        # the CALLING thread (see _Interposer._fsync) — the executor
+        # threads a real slow disk would park.  Seeded jitter keeps
+        # drives replayable.
+        self._slow_fsync_ms = 0.0      # guarded-by: _lock
+        self._slow_write_ms = 0.0      # guarded-by: _lock
+        self._slow_jitter_ms = 0.0     # guarded-by: _lock
+        self._slow_rng = random.Random(0)  # guarded-by: _lock
+        # open = fsyncs proceed; cleared by stall_fsync() so every fsync
+        # under this root BLOCKS until heal_slow() (the hung-disk mode)
+        self._fsync_gate = threading.Event()
+        self._fsync_gate.set()
+        self.slow_counts: dict[str, int] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -402,10 +426,64 @@ class ChaosDir:
         return self
 
     def uninstall(self) -> None:
+        # release any thread parked on a stalled fsync: a leaked stall
+        # would wedge executor threads past the chaos drive's lifetime
+        self.heal_slow()
         _INTERPOSER.remove(self)
 
     def __enter__(self) -> "ChaosDir":
         return self.install()
+
+    # -- fail-slow injection (gray failures) ---------------------------------
+
+    def set_slow(self, fsync_ms: float = 0.0, write_ms: float = 0.0,
+                 jitter_ms: float = 0.0, seed: int = 0) -> None:
+        """Per-call latency: every fsync/write under the root sleeps
+        ``base + uniform(0, jitter)`` ms on its calling thread.  Use a
+        high fsync_ms for a burst disk stall, moderate values for the
+        sustained slow-disk mode; ``heal_slow`` clears everything."""
+        with self._lock:
+            self._slow_fsync_ms = fsync_ms
+            self._slow_write_ms = write_ms
+            self._slow_jitter_ms = jitter_ms
+            self._slow_rng = random.Random(seed)
+
+    def stall_fsync(self) -> None:
+        """Full fsync hang: every fsync under the root BLOCKS (on its
+        calling thread) until :meth:`heal_slow`.  The worst gray
+        failure — writes buffer, nothing durably completes, the store
+        answers everything that needs no disk."""
+        self._fsync_gate.clear()
+
+    def heal_slow(self) -> None:
+        """Clear all latency faults and release stalled fsyncs."""
+        with self._lock:
+            self._slow_fsync_ms = 0.0
+            self._slow_write_ms = 0.0
+            self._slow_jitter_ms = 0.0
+        self._fsync_gate.set()
+
+    def _slow_sleep(self, kind: str) -> None:
+        """Dispatcher hook (interposer fsync / wrapped write): apply the
+        configured latency OUTSIDE the model lock — sleeping under it
+        would stall event-loop readers behind the fake disk."""
+        if kind == "fsync" and not self._fsync_gate.is_set():
+            with self._lock:
+                self.slow_counts["fsync_stalled"] = \
+                    self.slow_counts.get("fsync_stalled", 0) + 1
+            self._fsync_gate.wait()
+            return
+        with self._lock:
+            base = self._slow_fsync_ms if kind == "fsync" \
+                else self._slow_write_ms
+            if base <= 0:
+                return  # jitter rides a configured base, never alone
+            delay = base
+            if self._slow_jitter_ms > 0:
+                delay += self._slow_rng.uniform(0.0, self._slow_jitter_ms)
+            self.slow_counts[f"{kind}_slowed"] = \
+                self.slow_counts.get(f"{kind}_slowed", 0) + 1
+        time.sleep(delay / 1000.0)
 
     def __exit__(self, *exc) -> bool:
         self.uninstall()

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 from collections import deque
 import logging
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -45,8 +46,16 @@ class LogManager:
         max_flush_batch: int = 256,
         max_logs_in_memory: int = 256,
         max_logs_in_memory_bytes: int = 256 * 1024,
+        health=None,
     ):
         self._storage = storage
+        # gray-failure signal: the store-level HealthTracker whose disk
+        # probe this flusher times every flush round into (append +
+        # fsync, executor queueing included — CPU saturation IS a gray
+        # signal).  The probe's begin/end also exposes the AGE of a
+        # still-in-flight flush, which is how a fully hung fsync is
+        # detected (it never completes a sample).
+        self._health = health
         self.conf_manager = conf_manager or ConfigurationManager()
         self._sync = sync
         self._max_flush_batch = max_flush_batch
@@ -353,12 +362,36 @@ class LogManager:
                     # classic storages block an executor thread
                     append_async = getattr(
                         self._storage, "append_entries_async", None)
-                    if append_async is not None:
-                        await append_async(entries, self._sync)
-                    else:
-                        await loop.run_in_executor(
-                            None, self._storage.append_entries, entries,
-                            self._sync)
+                    health = self._health
+                    tok = health.disk.begin() if health is not None else None
+                    try:
+                        if append_async is not None:
+                            # multilog: the group commit times its fsync
+                            # IN the executor thread and feeds the EMA
+                            # itself (StoreEngine wires the probe);
+                            # begin/end here covers only the stall age
+                            await append_async(entries, self._sync)
+                        elif health is not None:
+                            # time the append+fsync IN the executor
+                            # thread: end-to-end (awaited) duration
+                            # would fold in executor-queue wait, and a
+                            # co-hosted neighbor's slow disk must not
+                            # score THIS store's disk sick
+                            def _timed(entries=entries):
+                                t0 = time.perf_counter()
+                                self._storage.append_entries(entries,
+                                                             self._sync)
+                                return time.perf_counter() - t0
+
+                            dur = await loop.run_in_executor(None, _timed)
+                            health.disk.note(dur)
+                        else:
+                            await loop.run_in_executor(
+                                None, self._storage.append_entries, entries,
+                                self._sync)
+                    finally:
+                        if tok is not None:
+                            health.disk.end(tok)
                     self._stable_index = max(self._stable_index, entries[-1].id.index)
                     if self.on_stable is not None:
                         self.on_stable(self._stable_index)

@@ -137,6 +137,10 @@ class _GroupCommit:
         self._last_sync = 0.0                      # guarded-by: _lock
         # smoothed inline-sync cost (seconds)
         self._cost_ewma = 0.0                      # guarded-by: _lock
+        # gray-failure signal sink: a DiskLatencyProbe (util/health.py,
+        # itself lock-guarded) fed every measured fsync duration — set
+        # by the hosting StoreEngine; None = no health scoring
+        self.health_probe = None
 
     async def flush(self) -> None:
         # LOW-LOAD fast path (VERDICT r2 #3): the executor round costs
@@ -183,6 +187,9 @@ class _GroupCommit:
                     # path, a genuinely slow disk does (and keeps it
                     # banned while the ewma stays above the ceiling)
                     self._cost_ewma = 0.7 * self._cost_ewma + 0.3 * dur
+                probe = self.health_probe
+                if probe is not None:
+                    probe.note(dur)
             return
         await fut
 
@@ -222,6 +229,9 @@ class _GroupCommit:
                     # path too: this is how a banned fast path recovers
                     # (re-probing inline would block the loop)
                     self._cost_ewma = 0.7 * self._cost_ewma + 0.3 * dur
+                probe = self.health_probe
+                if probe is not None:
+                    probe.note(dur)
             except asyncio.CancelledError:
                 # this round's HOST loop is tearing down (asyncio.run
                 # cancels pending tasks at exit) — that is not an fsync
@@ -317,6 +327,15 @@ class MultiLogEngine:
 
 _engines_lock = threading.Lock()
 _engines: dict[str, MultiLogEngine] = {}  # guarded-by: _engines_lock
+
+
+def peek_engine(dir_path: str) -> Optional[MultiLogEngine]:
+    """The live engine for a directory WITHOUT taking a reference —
+    observability wiring (the StoreEngine attaching its health probe),
+    never ownership."""
+    key = os.path.realpath(dir_path)
+    with _engines_lock:
+        return _engines.get(key)
 
 
 def get_engine(dir_path: str, segment_max_bytes: int = 0) -> MultiLogEngine:

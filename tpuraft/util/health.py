@@ -1,0 +1,298 @@
+"""Store health scoring: gray-failure detection from hot-path signals.
+
+Fail-slow is the production failure mode the chaos harness never
+modeled: a store with a stalling disk or a saturated CPU stays "alive"
+to every existing check (it acks heartbeats, eventually) while every
+group it leads limps at 100x latency.  *CD-Raft* (PAPERS.md) treats
+degraded links as the normal case and routes around them;
+*Compartmentalization* isolates stages so one slow component cannot
+stall the rest — this module gives stores the same posture: score each
+store's health from signals the hot path ALREADY produces, and let the
+mitigation layers (leadership evacuation, read re-routing, serving-
+plane shedding — tpuraft/rheakv/store_engine.py, kv_service.py,
+pd_server.py) act on the score.
+
+Signals (no new RPCs, no polling probes):
+  - **disk**: append+fsync latency of every log flush round
+    (``LogManager._flush_loop`` times the storage call; the multilog
+    group-commit feeds its in-thread fsync duration) plus the AGE of a
+    still-in-flight flush — a fully hung fsync produces no completed
+    sample, so the EMA alone would never notice it;
+  - **peer RTT**: ack round-trip of every beat-plane RPC the
+    HeartbeatHub / ReadConfirmBatcher / classic heartbeat path already
+    sends, per destination endpoint;
+  - **apply backlog**: committed-minus-applied depth the FSMCaller
+    already tracks.
+
+Scoring is DETERMINISTIC given the same inputs: ``evaluate()`` folds
+the EMAs through fixed thresholds into {HEALTHY, DEGRADED, SICK} with
+evaluation-count hysteresis (a score only worsens after
+``worsen_after`` consecutive bad evaluations and only improves after
+``recover_after`` consecutive good ones), so one writeback spike never
+flaps leadership and a recovering store must PROVE health before the
+evacuation brake releases.  No wall-clock policy: hysteresis counts
+evaluation rounds, not seconds — a seeded test drives evaluate() by
+hand and gets byte-identical transitions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+SICK = "sick"
+
+_LEVELS = {HEALTHY: 0, DEGRADED: 1, SICK: 2}
+
+
+@dataclass
+class HealthOptions:
+    """Thresholds + hysteresis for one store's tracker.
+
+    Defaults target the same-host chaos/soak envelope (sub-ms healthy
+    fsyncs); production disks tune disk_* up.  See docs/operations.md
+    "Gray-failure runbook"."""
+
+    # disk: flush-round latency EMA (ms) — append + fsync, as observed
+    # by the LogManager flush loop / multilog group commit
+    disk_degraded_ms: float = 25.0
+    disk_sick_ms: float = 120.0
+    # a flush IN FLIGHT longer than this is a stall even with a clean
+    # EMA (a hung fsync completes no sample); scored SICK directly
+    disk_stall_ms: float = 500.0
+    # peer ack RTT EMA (ms): scores the PEER endpoint, not this store
+    peer_degraded_ms: float = 50.0
+    peer_sick_ms: float = 250.0
+    # apply backlog: committed-minus-applied entries (EMA) across groups
+    apply_degraded: float = 256.0
+    apply_sick: float = 2048.0
+    # hysteresis (evaluation rounds, not seconds): worsen fast, recover
+    # slowly — a DEGRADED-but-recovering store keeps its leaders
+    worsen_after: int = 2
+    recover_after: int = 5
+    # EMA smoothing factor for new samples
+    alpha: float = 0.25
+
+
+# Fed from EXECUTOR threads (FileLogStorage appends run off-loop; the
+# multilog group commit times its fsync in the executor) as well as the
+# event loop — the one piece of tracker state that genuinely crosses
+# threads, so it carries its own lock while the tracker stays
+# loop-confined.
+class DiskLatencyProbe:
+    """Append/fsync latency EMA + in-flight stall age for one store."""
+
+    def __init__(self, alpha: float = 0.25, clock=time.monotonic):
+        self._alpha = alpha
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ema_ms = 0.0            # guarded-by: _lock
+        self._samples = 0             # guarded-by: _lock
+        # flush begin timestamps keyed by token (in-flight rounds);
+        # a hung fsync never ends its token, and its AGE is the signal
+        self._inflight: dict[int, float] = {}   # guarded-by: _lock
+        self._next_token = 0          # guarded-by: _lock
+
+    def begin(self) -> int:
+        """A flush round started; returns the token for :meth:`end`.
+        begin/end feed ONLY the in-flight stall age — a hung fsync
+        completes no sample, and its growing age is the signal."""
+        with self._lock:
+            self._next_token += 1
+            tok = self._next_token
+            self._inflight[tok] = self._clock()
+            return tok
+
+    def end(self, token: int) -> None:
+        """The round completed (clears its stall-age token).  The EMA
+        is deliberately NOT fed here: end-to-end round time includes
+        executor-queue and event-loop wait, and in a co-hosted process
+        one store's genuinely slow disk saturating the shared executor
+        would score every OTHER store's disk sick too (observed as a
+        mutual-evacuation leadership storm in the gray A/B bench).
+        Feed the EMA with :meth:`note` from IN-THREAD measurements."""
+        with self._lock:
+            self._inflight.pop(token, None)
+
+    def note(self, dur_s: float) -> None:
+        """One completed disk op, measured IN the thread that did the
+        I/O (LogManager's executor wrapper, the multilog group-commit's
+        fsync timer) — the uncontaminated latency of THIS store's
+        disk."""
+        with self._lock:
+            self._note_locked(dur_s * 1000.0)
+
+    def _note_locked(self, ms: float) -> None:
+        if self._samples == 0:
+            self._ema_ms = ms
+        else:
+            self._ema_ms += self._alpha * (ms - self._ema_ms)
+        self._samples += 1
+
+    def snapshot(self) -> tuple[float, float, int]:
+        """(ema_ms, oldest_inflight_age_ms, samples) — one locked read."""
+        with self._lock:
+            age = 0.0
+            if self._inflight:
+                now = self._clock()
+                age = (now - min(self._inflight.values())) * 1000.0
+            return self._ema_ms, age, self._samples
+
+
+class _Hysteresis:
+    """Evaluation-count hysteresis around a raw level stream."""
+
+    __slots__ = ("level", "_pending", "_streak", "_up", "_down")
+
+    def __init__(self, worsen_after: int, recover_after: int):
+        self.level = HEALTHY
+        self._pending = HEALTHY
+        self._streak = 0
+        self._up = max(1, worsen_after)
+        self._down = max(1, recover_after)
+
+    def fold(self, raw: str) -> str:
+        if raw == self.level:
+            self._pending, self._streak = raw, 0
+            return self.level
+        if raw != self._pending:
+            self._pending, self._streak = raw, 0
+        self._streak += 1
+        need = self._up if _LEVELS[raw] > _LEVELS[self.level] else self._down
+        if self._streak >= need:
+            self.level = raw
+            self._streak = 0
+        return self.level
+
+
+# graftcheck: loop-confined — note_peer_rtt/note_apply_depth/evaluate
+# run on the owning store's event loop (hub acks, FSM caller, the
+# store's health task); only the disk probe above crosses threads
+class HealthTracker:
+    """One store's {HEALTHY, DEGRADED, SICK} score + per-peer scores."""
+
+    def __init__(self, opts: HealthOptions | None = None,
+                 clock=time.monotonic):
+        self.opts = opts or HealthOptions()
+        self.disk = DiskLatencyProbe(self.opts.alpha, clock=clock)
+        self._self_hyst = _Hysteresis(self.opts.worsen_after,
+                                      self.opts.recover_after)
+        # peer endpoint -> (rtt ema ms, samples, hysteresis)
+        self._peers: dict[str, list] = {}
+        self._apply_ema = 0.0
+        self._apply_samples = 0
+        self.evaluations = 0
+        # observability: evaluations that saw each level, raw cause of
+        # the current level ("disk" / "stall" / "apply" / "")
+        self.level_counts = {HEALTHY: 0, DEGRADED: 0, SICK: 0}
+        self.cause = ""
+
+    # -- signal intake -------------------------------------------------------
+
+    def note_peer_rtt(self, endpoint: str, rtt_s: float) -> None:
+        ent = self._peers.get(endpoint)
+        ms = rtt_s * 1000.0
+        if ent is None:
+            self._peers[endpoint] = [ms, 1, _Hysteresis(
+                self.opts.worsen_after, self.opts.recover_after)]
+            return
+        ent[0] += self.opts.alpha * (ms - ent[0])
+        ent[1] += 1
+
+    def note_apply_depth(self, depth: int) -> None:
+        self._apply_ema += self.opts.alpha * (depth - self._apply_ema)
+        self._apply_samples += 1
+
+    # -- scoring -------------------------------------------------------------
+
+    def _raw_self(self) -> tuple[str, str]:
+        o = self.opts
+        ema, stall_age, samples = self.disk.snapshot()
+        if stall_age >= o.disk_stall_ms:
+            return SICK, "stall"
+        level, cause = HEALTHY, ""
+        if samples:
+            if ema >= o.disk_sick_ms:
+                level, cause = SICK, "disk"
+            elif ema >= o.disk_degraded_ms:
+                level, cause = DEGRADED, "disk"
+        if self._apply_samples and _LEVELS[level] < _LEVELS[SICK]:
+            if self._apply_ema >= o.apply_sick:
+                level, cause = SICK, "apply"
+            elif self._apply_ema >= o.apply_degraded \
+                    and _LEVELS[level] < _LEVELS[DEGRADED]:
+                level, cause = DEGRADED, "apply"
+        return level, cause
+
+    def evaluate(self) -> str:
+        """One scoring round: fold the current EMAs through the
+        thresholds and the hysteresis; returns the (hysteretic) level.
+        Call at a steady cadence (the store's health task) — hysteresis
+        counts these calls, so cadence x worsen_after bounds detection
+        latency."""
+        self.evaluations += 1
+        raw, cause = self._raw_self()
+        level = self._self_hyst.fold(raw)
+        if level == raw:
+            self.cause = cause
+        self.level_counts[level] += 1
+        for ent in self._peers.values():
+            o = self.opts
+            if ent[0] >= o.peer_sick_ms:
+                praw = SICK
+            elif ent[0] >= o.peer_degraded_ms:
+                praw = DEGRADED
+            else:
+                praw = HEALTHY
+            ent[2].fold(praw)
+        return level
+
+    def score(self) -> str:
+        """Current hysteretic level (no new evaluation round)."""
+        return self._self_hyst.level
+
+    def peer_score(self, endpoint: str) -> str:
+        ent = self._peers.get(endpoint)
+        return ent[2].level if ent is not None else HEALTHY
+
+    def slow_peers(self) -> list[str]:
+        """Endpoints currently scored worse than HEALTHY."""
+        return sorted(ep for ep, ent in self._peers.items()
+                      if ent[2].level != HEALTHY)
+
+    # -- observability -------------------------------------------------------
+
+    def counters(self) -> dict:
+        ema, stall_age, samples = self.disk.snapshot()
+        return {
+            "health_level": _LEVELS[self.score()],
+            "health_evaluations": self.evaluations,
+            "health_disk_ema_ms": round(ema, 3),
+            "health_disk_inflight_ms": round(stall_age, 1),
+            "health_disk_samples": samples,
+            "health_apply_ema": round(self._apply_ema, 1),
+            "health_slow_peers": len(self.slow_peers()),
+        }
+
+    def register_gauges(self, metrics) -> None:
+        metrics.gauge("health.level", lambda: _LEVELS[self.score()])
+        metrics.gauge("health.disk_ema_ms",
+                      lambda: self.disk.snapshot()[0])
+        metrics.gauge("health.disk_inflight_ms",
+                      lambda: self.disk.snapshot()[1])
+        metrics.gauge("health.apply_ema", lambda: self._apply_ema)
+        metrics.gauge("health.slow_peers",
+                      lambda: float(len(self.slow_peers())))
+
+    def describe(self) -> str:
+        ema, stall_age, samples = self.disk.snapshot()
+        peers = ", ".join(
+            f"{ep}={ent[2].level}:{ent[0]:.1f}ms"
+            for ep, ent in sorted(self._peers.items())) or "-"
+        return (f"HealthTracker<{self.score()} cause={self.cause or '-'} "
+                f"disk_ema={ema:.2f}ms inflight={stall_age:.0f}ms "
+                f"samples={samples} apply_ema={self._apply_ema:.1f} "
+                f"evals={self.evaluations} peers=[{peers}]>")
