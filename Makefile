@@ -16,7 +16,11 @@
 #   make bench      -> the device-plane headline benchmark (one JSON line)
 #   make bench-gate -> short e2e + KV serving benches; fails on >20%
 #                      regression vs the committed BENCH_E2E.json /
-#                      BENCH_REGIONS.json calibrations
+#                      BENCH_REGIONS.json calibrations, plus the
+#                      tracing-overhead row: untraced rows enforce the
+#                      trace plane's zero-cost-when-disabled claim, and
+#                      a 5%-sampled tracing run must stay within 5% of
+#                      the same-session untraced measurement
 
 PY ?= python
 

@@ -2,7 +2,7 @@
 committed configurations must not regress by more than the threshold
 (default 20%).
 
-Two rows:
+Rows:
   e2e_commits_per_sec — a short `bench_e2e.py` run vs BENCH_E2E.json
   kv_ops_per_sec      — a short `bench_region_density.py` run (the full
                         RheaKV serving stack: batching client →
@@ -10,6 +10,15 @@ Two rows:
                         FSM apply) vs BENCH_REGIONS.json, so the
                         KV-vs-protocol throughput gap (ROADMAP item 1)
                         can't silently reopen.
+  kv_read_ops_per_sec — the 95/5 read-mix shape vs its calibration.
+  kv_ops_traced       — tracing-overhead gate: the untraced rows above
+                        run with the trace plane DISABLED (the
+                        zero-cost claim — any always-on cost regresses
+                        them vs calibration), and this row re-runs the
+                        kv shape with 5%-sampled tracing, which must
+                        stay within BENCH_GATE_TRACE_THRESHOLD
+                        (default 5%) of the same-session untraced
+                        measurement.
 
 The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
@@ -64,10 +73,13 @@ def _run_e2e_once(extra: dict, duration: float) -> float:
 
 
 def _run_kv_once(extra: dict, duration: float,
-                 read_frac: float = -1.0) -> float:
+                 read_frac: float = -1.0,
+                 trace_sample: float = 0.0) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
-    the read-mix shape (the amortized read plane's regression row)."""
+    the read-mix shape (the amortized read plane's regression row);
+    ``trace_sample > 0`` runs with product tracing sampling at that
+    rate (the tracing-overhead row)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -80,6 +92,8 @@ def _run_kv_once(extra: dict, duration: float,
     if read_frac >= 0:
         cmd += ["--read-frac", str(read_frac)]
         key += f"_r{int(round(read_frac * 100))}"
+    if trace_sample > 0:
+        cmd += ["--trace-sample", str(trace_sample)]
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -199,6 +213,24 @@ def main() -> int:
                         threshold, retries)
         worst = max(worst, rc)
         reports.append(rep)
+        # tracing-overhead row (observability plane): the untraced kv
+        # rows above ARE the zero-cost claim (tracing defaults off, so
+        # any always-on cost would regress them vs calibration); this
+        # row additionally bounds SAMPLED tracing at 5% of the same-
+        # session untraced measurement — same host phase, so shared-
+        # host noise largely cancels (retries absorb the rest)
+        if rep.get("verdict") == "OK":
+            trace_threshold = float(os.environ.get(
+                "BENCH_GATE_TRACE_THRESHOLD", "0.05"))
+            rc, trep = _gate(
+                "kv_ops_traced",
+                float(rep["measured"]),
+                lambda: _run_kv_once(kv_extra, duration,
+                                     trace_sample=0.05),
+                trace_threshold, retries)
+            worst = max(worst, rc)
+            trep["untraced"] = rep["measured"]
+            reports.append(trep)
     if "gate_read_ops_per_sec" not in kv_extra:
         # the amortized read plane (ISSUE 10) needs its own regression
         # row — a silent pass without a calibration would defeat it

@@ -190,6 +190,15 @@ async def run_config(args) -> dict:
         woken_before = sum(
             s.node_manager.heartbeat_hub.groups_woken for s in stores)
 
+    if args.trace_sample > 0:
+        # sampled product tracing through the measured window (the
+        # bench-gate overhead row drives this; seeded => same sampled
+        # op sequence run to run)
+        from tpuraft.util.trace import TRACER
+
+        TRACER.configure(enabled=True, sample_rate=args.trace_sample,
+                         seed=0)
+
     stop_at = time.monotonic() + args.duration
 
     async def worker(wid: int) -> None:
@@ -307,17 +316,78 @@ async def run_config(args) -> dict:
         res["quiescent_replicas_before"] = quiesced_before
         res["quiescent_replicas_after"] = quiesced_after
         res["groups_woken_during_load"] = woken_after - woken_before
+    if args.trace_sample > 0 or args.trace:
+        from tpuraft.util.trace import TRACER
+
+        res["trace"] = TRACER.stats()
+        if args.trace:
+            # perfetto-loadable export: the probe put/get traces (and
+            # any window-sampled ops still in the ring)
+            res["trace_file"] = args.trace
+            res["trace_spans"] = TRACER.export_chrome(args.trace)
     print("RESULT " + json.dumps(res), flush=True)
     os._exit(0)  # 3R region engines: teardown is not the measurement
 
 
-async def stage_probe(client, stores, R: int) -> dict:
-    """One instrumented put after the measured window: stamps each
-    serving-plane stage so the NEXT bottleneck is attributable —
-    client-queue → rpc → propose → quorum(submit→apply) → apply → ack."""
-    import time as _t
+# span name -> (start mark, end mark): the product trace plane's stage
+# spans rendered into the historical stage_marks_ms shape (relative ms
+# from the probe op's start).  One attribution implementation — the
+# bench reads what production emits instead of monkeypatching a twin.
+_SPAN_MARKS = {
+    "client_queue": ("queue_s", "sent"),
+    "kv_batch_rpc": ("rpc_s", "rpc_e"),
+    "kv_rpc": ("rpc_s", "rpc_e"),
+    "srv_validate": ("validate_s", "validate_e"),
+    "srv_propose": ("propose_s", "ack"),
+    "quorum_commit": ("submit", "quorum_e"),
+    "fsm_apply": ("apply_s", "apply_e"),
+    "srv_read_fence": ("fence_s", "fence_e"),
+    "srv_read_serve": ("serve_s", "serve_e"),
+}
 
-    # pick a region currently led in-process
+
+def _marks_from_spans(spans: list) -> dict:
+    """Fold one trace's spans into the stage-marks dict.  Leader-side
+    stages key off the proc that served the propose/fence; the flush
+    and follower stages land as flush_s/flush_e (leader store) and
+    fol_append_s/fol_append_e (first follower)."""
+    roots = [s for s in spans if s["name"] == "kv_op"]
+    if not roots:
+        return {}
+    root = roots[-1]
+    tid, t0 = root["trace_id"], root["ts_s"]
+    mine = [s for s in spans if s["trace_id"] == tid]
+
+    def rel(x: float) -> float:
+        return round((x - t0) * 1e3, 3)
+
+    marks = {"queue_s": 0.0, "done": rel(root["ts_s"] + root["dur_s"])}
+    leader_proc = next((s["proc"] for s in mine
+                        if s["name"] in ("srv_propose", "srv_read_fence")),
+                       None)
+    for s in mine:
+        name = s["name"]
+        if name == "log_flush":
+            pfx = "flush" if s["proc"] == leader_proc else "fol_flush"
+            marks.setdefault(f"{pfx}_s", rel(s["ts_s"]))
+            marks.setdefault(f"{pfx}_e", rel(s["ts_s"] + s["dur_s"]))
+        elif name == "follower_append":
+            marks.setdefault("fol_append_s", rel(s["ts_s"]))
+            marks.setdefault("fol_append_e", rel(s["ts_s"] + s["dur_s"]))
+        elif name == "fsm_apply" and s["proc"] != leader_proc:
+            continue  # follower applies happen off the ack path
+        elif name in _SPAN_MARKS:
+            a, b = _SPAN_MARKS[name]
+            marks.setdefault(a, rel(s["ts_s"]))
+            marks.setdefault(b, rel(s["ts_s"] + s["dur_s"]))
+    return marks
+
+
+async def _traced_probe(client, stores, op: str) -> dict:
+    """One fully-sampled probe op after the measured window, attributed
+    entirely by the PRODUCT trace plane (tpuraft/util/trace)."""
+    from tpuraft.util.trace import TRACER
+
     target = None
     for s in stores:
         for re in s._regions.values():
@@ -328,125 +398,37 @@ async def stage_probe(client, stores, R: int) -> dict:
             break
     if target is None:
         return {}
-    marks: dict = {}
-    rs, fsm, node = target.raft_store, target.fsm, target.node
-    orig_apply, orig_ab = rs.apply, node.apply_batch
-    orig_disp, orig_call = fsm._dispatch, client.transport.call
-
-    async def apply_mark(op):
-        marks.setdefault("propose_s", _t.perf_counter())
-        try:
-            return await orig_apply(op)
-        finally:
-            marks.setdefault("ack", _t.perf_counter())
-
-    async def ab_mark(tasks):
-        marks.setdefault("submit", _t.perf_counter())
-        return await orig_ab(tasks)
-
-    def disp_mark(op):
-        marks.setdefault("apply_s", _t.perf_counter())
-        try:
-            return orig_disp(op)
-        finally:
-            marks["apply_e"] = _t.perf_counter()
-
-    async def call_mark(ep, method, req, timeout_ms=None):
-        if method.startswith("kv_command"):
-            marks.setdefault("rpc_s", _t.perf_counter())
-        try:
-            return await orig_call(ep, method, req, timeout_ms)
-        finally:
-            if method.startswith("kv_command"):
-                marks.setdefault("rpc_e", _t.perf_counter())
-
-    rs.apply = apply_mark
-    rs._apply = apply_mark
-    node.apply_batch = ab_mark
-    fsm._dispatch = disp_mark
-    client.transport.call = call_mark
-    # the FSM coalescer flushes PUT runs without entering _dispatch;
-    # stamp its batch write too
-    store = fsm.store
-    orig_awb = store.apply_write_batch
-
-    def awb_mark(rows):
-        marks.setdefault("apply_s", _t.perf_counter())
-        try:
-            return orig_awb(rows)
-        finally:
-            marks["apply_e"] = _t.perf_counter()
-
-    store.apply_write_batch = awb_mark
+    # no reset: _marks_from_spans keys off the newest kv_op root, so
+    # window-sampled spans (--trace-sample) survive into the export
+    was_enabled, was_rate = TRACER.enabled, TRACER.sample_rate
+    TRACER.configure(enabled=True, sample_rate=1.0, seed=0)
     key = target.region.start_key + b"/stage-probe"
-    t0 = _t.perf_counter()
-    marks["queue_s"] = t0
     try:
-        await asyncio.wait_for(client.put(key, b"p"), 30.0)
-        marks["done"] = _t.perf_counter()
+        if op == "put":
+            await asyncio.wait_for(client.put(key, b"p"), 30.0)
+        else:
+            await asyncio.wait_for(client.get(key), 30.0)
     except Exception:
         return {}
     finally:
-        rs.apply = orig_apply
-        rs._apply = orig_apply
-        node.apply_batch = orig_ab
-        fsm._dispatch = orig_disp
-        client.transport.call = orig_call
-        store.apply_write_batch = orig_awb
-    return {k: round((v - t0) * 1e3, 3) for k, v in marks.items()}
+        TRACER.enabled = was_enabled
+        TRACER.sample_rate = was_rate
+    return _marks_from_spans(TRACER.spans())
+
+
+async def stage_probe(client, stores, R: int) -> dict:
+    """One traced put after the measured window: the product spans
+    attribute each serving-plane stage so the NEXT bottleneck is
+    addressable — client-queue → rpc → validate → propose →
+    flush/quorum → apply → ack (+ follower append/flush)."""
+    return await _traced_probe(client, stores, "put")
 
 
 async def read_stage_probe(client, stores) -> dict:
-    """One instrumented GET after the measured window: stamps the read
-    serving stages so the read-side bottleneck is attributable —
-    client-queue → rpc → read fence (ReadIndex confirmation, incl. the
-    store-wide batched round) → local serve → ack."""
-    import time as _t
-
-    target = None
-    for s in stores:
-        for re in s._regions.values():
-            if re.is_leader():
-                target = re
-                break
-        if target is not None:
-            break
-    if target is None or target.node is None:
-        return {}
-    marks: dict = {}
-    node = target.node
-    orig_ri, orig_call = node.read_index, client.transport.call
-
-    async def ri_mark():
-        marks.setdefault("fence_s", _t.perf_counter())
-        try:
-            return await orig_ri()
-        finally:
-            marks.setdefault("fence_e", _t.perf_counter())
-
-    async def call_mark(ep, method, req, timeout_ms=None):
-        if method.startswith("kv_command"):
-            marks.setdefault("rpc_s", _t.perf_counter())
-        try:
-            return await orig_call(ep, method, req, timeout_ms)
-        finally:
-            if method.startswith("kv_command"):
-                marks.setdefault("rpc_e", _t.perf_counter())
-
-    node.read_index = ri_mark
-    client.transport.call = call_mark
-    key = target.region.start_key + b"/read-probe"
-    t0 = _t.perf_counter()
-    marks["queue_s"] = t0
-    try:
-        await asyncio.wait_for(client.get(key), 30.0)
-        marks["done"] = _t.perf_counter()
-    except Exception:
-        return {}
-    finally:
-        node.read_index = orig_ri
-        client.transport.call = orig_call
-    return {k: round((v - t0) * 1e3, 3) for k, v in marks.items()}
+    """One traced GET after the measured window: client-queue → rpc →
+    read fence (ReadIndex confirmation incl. the store-wide batched
+    round) → local serve → ack, from the same product spans."""
+    return await _traced_probe(client, stores, "get")
 
 
 def main() -> None:
@@ -477,6 +459,16 @@ def main() -> None:
                     help="enable group quiescence and assert a pure-read "
                          "load leaves hibernated groups hibernated "
                          "(reports wake counters)")
+    ap.add_argument("--trace", default="",
+                    help="export a Chrome trace-event JSON "
+                         "(perfetto-loadable) of the traced ops to this "
+                         "path (the post-run stage-probe put/get at "
+                         "minimum; with --trace-sample also the "
+                         "window's sampled ops)")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="enable product tracing through the measured "
+                         "window at this sample rate (0 = off; the "
+                         "bench-gate overhead row uses 0.05)")
     ap.add_argument("--json-out", default="BENCH_REGIONS.json")
     ap.add_argument("--config", action="store_true",
                     help="internal: run one config in this process")
@@ -504,7 +496,10 @@ def main() -> None:
            "--election-timeout-ms", str(args.election_timeout_ms),
            "--store-inflight", str(args.store_inflight),
            "--read-frac", str(args.read_frac),
-           "--read-from", args.read_from]
+           "--read-from", args.read_from,
+           "--trace-sample", str(args.trace_sample)]
+    if args.trace:
+        cmd += ["--trace", os.path.abspath(args.trace)]
     if args.lease_reads:
         cmd.append("--lease-reads")
     if args.quiesce:

@@ -23,6 +23,9 @@ Commands:
     remove-learners <p1,...>  remove read-only replicas
     reset-learners <p1,...>   replace the learner set atomically
     reset-learners none       clear the learner set
+    metrics [endpoint]        scrape live metrics (Prometheus text)
+                              from one store (default: first peer that
+                              answers) over the admin transport
 """
 
 from __future__ import annotations
@@ -74,6 +77,23 @@ async def run(args) -> int:
                 for p in full.peers))
             if full.learners:
                 print("learners:", ",".join(str(p) for p in full.learners))
+        elif cmd == "metrics":
+            targets = ([args.command[1]] if len(args.command) > 1
+                       else [p.endpoint for p in conf.list_all()])
+            last_err = None
+            for ep in targets:
+                # a bare endpoint or a PeerId string both work
+                ep = ":".join(ep.split("/", 1)[0].split(":")[:2])
+                try:
+                    print(await cli.describe_metrics(ep), end="")
+                    break
+                except RpcError as e:
+                    last_err = e
+            else:
+                print(f"error: no store answered describe_metrics: "
+                      f"{last_err.status if last_err else '?'}",
+                      file=sys.stderr)
+                rc = 1
         elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer",
                      "add-witness", "remove-witness"):
             if len(args.command) < 2:
@@ -144,7 +164,7 @@ def main() -> None:
                          " | add-witness <peer> | remove-witness <peer>"
                          " | change-peers <p1,p2,...>"
                          " | add-learners <p1,...> | remove-learners <p1,...>"
-                         " | reset-learners <p1,...>")
+                         " | reset-learners <p1,...> | metrics [endpoint]")
     sys.exit(asyncio.run(run(ap.parse_args())))
 
 

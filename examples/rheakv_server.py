@@ -37,7 +37,8 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
                 data_path: str, transport_kind: str = "tcp",
                 store_kind: str = "memory",
                 pd_endpoints: list[str] | None = None,
-                log_scheme: str = "file") -> None:
+                log_scheme: str = "file",
+                metrics_port: int | None = None) -> None:
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -54,6 +55,7 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         data_path=data_path,
         election_timeout_ms=1000,
         log_scheme=log_scheme,
+        metrics_port=metrics_port,
     )
     if store_kind == "native":
         import os
@@ -70,7 +72,9 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
     engine = StoreEngine(opts, server, transport, pd_client=pd_client)
     await engine.start()
     print(f"rheakv store {endpoint} up "
-          f"({n_regions} regions, {len(stores)} stores)", flush=True)
+          f"({n_regions} regions, {len(stores)} stores)"
+          + (f", /metrics on :{engine.metrics_http_port}"
+             if engine.metrics_http_port else ""), flush=True)
     try:
         while True:
             await asyncio.sleep(3600)
@@ -107,6 +111,11 @@ def main() -> None:
                     help="comma-separated PD endpoints: heartbeat region "
                          "meta + stats there and execute its instructions "
                          "(splits, leader transfers)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus text at GET /metrics on this "
+                         "port (0 = ephemeral, printed at boot); "
+                         "omit = off — `admin.py metrics` still scrapes "
+                         "over the admin transport")
     args = ap.parse_args()
     stores = [s for s in args.stores.split(",") if s]
     if args.serve not in stores:
@@ -116,7 +125,8 @@ def main() -> None:
         asyncio.run(serve(args.serve, stores, args.regions, args.data,
                           args.transport, args.store,
                           [e for e in args.pd.split(",") if e] or None,
-                          log_scheme=args.log_scheme))
+                          log_scheme=args.log_scheme,
+                          metrics_port=args.metrics_port))
     except KeyboardInterrupt:
         pass
 

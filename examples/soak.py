@@ -627,7 +627,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    witness: bool = False,
                    read_mix: float = 0.0,
                    read_from: str = "leader",
-                   gray: bool = False) -> dict:
+                   gray: bool = False,
+                   trace: str = "") -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
         raise ValueError(
@@ -709,7 +710,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             duration_s, n_keys, verbose, transport, dump_history,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
             kv_batching, geo, witness, read_mix, read_from,
-            gray=gray, power_loss=power_loss)
+            gray=gray, power_loss=power_loss, trace=trace)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -723,7 +724,13 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           chaos, churn=False, quiesce=False,
                           kv_batching=False, geo=0, witness=False,
                           read_mix=0.0, read_from="leader", gray=False,
-                          power_loss=False) -> dict:
+                          power_loss=False, trace="") -> dict:
+    if trace:
+        # sampled product tracing through the whole drive; exported as
+        # perfetto-loadable JSON next to the result
+        from tpuraft.util.trace import TRACER
+
+        TRACER.configure(enabled=True, sample_rate=0.05, seed=0)
     if lease_reads:
         from tpuraft.options import ReadOnlyOption
 
@@ -1308,6 +1315,30 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                                    if isinstance(o.result, bytes)
                                    else o.result)}) + "\n")
             result["history_dump"] = dump_history
+        if trace:
+            from tpuraft.util.trace import TRACER
+
+            result["trace"] = TRACER.stats()
+            result["trace_file"] = trace
+            result["trace_spans"] = TRACER.export_chrome(trace)
+        # flight recorder: a failing run carries the protocol-event
+        # lead-up in its OWN report — no re-run with prints needed.
+        # note_anomaly snapshots the ring so later teardown events
+        # can't churn the incident context away.
+        if not result["linearizable"] \
+                or not result.get("gray_detection_ok", True):
+            from tpuraft.util.trace import RECORDER
+
+            RECORDER.note_anomaly(
+                "soak_failure",
+                ("oracle: " + result.get("violation", ""))[:200]
+                if not result["linearizable"]
+                else "gray detection never fired")
+            result["flight_recorder"] = RECORDER.dump(256)
+            result["recorder_anomalies"] = [
+                {"ts": a["ts"], "reason": a["reason"],
+                 "detail": a["detail"]}
+                for a in RECORDER.anomaly_report()]
         return result
     finally:
         # also on checker errors / cancellation: no leaked workers or
@@ -1417,6 +1448,10 @@ def main() -> None:
                     help="route GETs to this replica class (client "
                          "read fan-out; follower/learner serve locally "
                          "after a forwarded-ReadIndex fence)")
+    ap.add_argument("--trace", default="",
+                    help="enable sampled product tracing (5%% of ops) "
+                         "and export a perfetto-loadable Chrome trace "
+                         "JSON to this path at the end")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
@@ -1436,7 +1471,8 @@ def main() -> None:
                                   witness=args.witness,
                                   read_mix=args.read_mix,
                                   read_from=args.read_from,
-                                  gray=args.gray))
+                                  gray=args.gray,
+                                  trace=args.trace))
     import json
 
     print(json.dumps(result))

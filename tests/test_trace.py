@@ -1,0 +1,657 @@
+"""Trace plane: span recorder semantics, wire-context compatibility,
+perfetto export, the flight recorder, and the metrics exposition layer.
+
+Covers ISSUE 12's test satellites: ring bounds under churn, seeded
+sampling determinism, slow-op force-retention, trace-context wire
+compat BOTH directions (an old decoder sees a plain request), perfetto
+JSON schema validity, recorder dump-on-anomaly on a forced SICK
+transition — plus the end-to-end acceptance shape (one traced KV put =
+client + leader + follower spans joined by the trailing wire context)
+and the Prometheus exposition surfaces (metrics_text, the
+describe_metrics admin RPC, the HTTP listener).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from tpuraft.rpc.messages import (
+    AppendEntriesRequest,
+    decode_message,
+    encode_message,
+)
+from tpuraft.util.trace import (
+    RECORDER,
+    TRACER,
+    FlightRecorder,
+    Tracer,
+    adopt_entry_ctx,
+    entry_ctx,
+    pack_ctx,
+    unpack_ctx,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tracer():
+    """The tracer is a module singleton: every test starts disabled and
+    empty, and leaves it that way."""
+    TRACER.configure(enabled=False)
+    TRACER.reset()
+    yield
+    TRACER.configure(enabled=False)
+    TRACER.reset()
+
+
+# ---------------------------------------------------------------------------
+# span recorder semantics
+# ---------------------------------------------------------------------------
+
+
+def _run_op(t: Tracer, dur_s: float = 0.0, spans: int = 0) -> int:
+    tid = t.begin_op("op")
+    if tid:
+        import time
+
+        base = time.perf_counter()
+        for i in range(spans):
+            t.span(tid, f"stage{i}", base, base + 1e-6)
+        if dur_s:
+            # synthesize the duration by back-dating the staged start
+            t._staged[tid].t0 -= dur_s
+        t.end_op(tid)
+    return tid
+
+
+def test_disabled_tracer_is_inert():
+    t = Tracer()
+    assert t.begin_op() == 0
+    t.span(0, "x", 0.0, 1.0)
+    assert t.end_op(0) == 0.0
+    assert t.spans() == []
+    assert t.counters()["trace_ops_seen"] == 0
+
+
+def test_ring_bounds_under_churn():
+    t = Tracer().configure(enabled=True, sample_rate=1.0, seed=1, ring=64)
+    for _ in range(500):
+        _run_op(t, spans=3)
+    assert len(t.spans()) <= 64
+    c = t.stats()
+    assert c["trace_ring_spans"] <= 64
+    assert c["trace_ops_seen"] == 500
+    # the ring keeps the NEWEST spans
+    assert t.spans()[-1]["name"] in ("op", "stage2")
+
+
+def test_staging_bounded_and_abandoned_ops_evicted():
+    t = Tracer().configure(enabled=True, sample_rate=1.0, seed=1)
+    t._max_staged = 8
+    for _ in range(100):
+        t.begin_op()  # never ended
+    assert len(t._staged) <= 8
+
+
+def test_seeded_sampling_determinism():
+    a = Tracer().configure(enabled=True, sample_rate=0.3, seed=42,
+                           slow_trigger=False)
+    b = Tracer().configure(enabled=True, sample_rate=0.3, seed=42,
+                           slow_trigger=False)
+    sampled_a = [bool(_run_op(a)) for _ in range(200)]
+    sampled_b = [bool(_run_op(b)) for _ in range(200)]
+    assert sampled_a == sampled_b
+    assert 20 < sum(sampled_a) < 120  # ~30%
+    c = Tracer().configure(enabled=True, sample_rate=0.3, seed=7,
+                           slow_trigger=False)
+    assert [bool(_run_op(c)) for _ in range(200)] != sampled_a
+
+
+def test_slow_op_force_retention(monkeypatch):
+    """Unsampled ops drop — unless slower than the rolling p99 EMA.
+    A slow-retained op keeps its ROOT span (duration + slow flag);
+    child attribution exists only for sampled ops (the overhead gate's
+    budget: unsampled candidacy must cost a clock read, not a span
+    pipeline).  Durations come from a fake clock: back-dating t0 over
+    the real perf_counter adds the loop's wall time to every synthetic
+    duration, and one host stall past warmup reads as a real slow op."""
+    import tpuraft.util.trace as trace_mod
+
+    clock = [0.0]
+    monkeypatch.setattr(trace_mod, "_pc", lambda: clock[0])
+    t = Tracer().configure(enabled=True, sample_rate=0.0, seed=1)
+    t._warmup = 50
+    for i in range(100):                   # ~1ms steady state; the mild
+        tid = t.begin_op("op")             # decay keeps each dur strictly
+        clock[0] += 0.001 - i * 1e-7       # below the EMA, as a real
+        t.end_op(tid)                      # stream sits below its p99
+    assert t.spans() == []                 # nothing sampled => dropped
+    assert t.counters()["trace_ops_dropped"] == 100
+    tid = t.begin_op("op")                 # 500x the EMA
+    t.span(tid, "stage0", clock[0], clock[0])
+    t.span(tid, "stage1", clock[0], clock[0])
+    clock[0] += 0.5
+    t.end_op(tid)
+    spans = t.spans()
+    assert spans, "slow op must be force-retained"
+    assert {s["name"] for s in spans} == {"op"}   # root-only
+    root = spans[-1]
+    assert root["args"].get("slow") is True
+    assert root["dur_s"] >= 0.4
+    assert t.counters()["trace_ops_slow_retained"] == 1
+
+
+def test_sampled_ops_keep_child_spans():
+    t = Tracer().configure(enabled=True, sample_rate=1.0, seed=1)
+    _run_op(t, spans=2)
+    names = [s["name"] for s in t.spans()]
+    assert names.count("op") == 1
+    assert "stage0" in names and "stage1" in names
+
+
+def test_wire_ctx_masks_unsampled():
+    from tpuraft.util.trace import wire_ctx
+
+    assert wire_ctx(0) == 0
+    assert wire_ctx(0b101) == 0b101   # sampled rides the wire
+    assert wire_ctx(0b100) == 0       # slow-candidate stays local
+
+
+def test_remote_context_records_only_sampled():
+    """A remote process records a wire-borne context iff the sampled
+    bit is set (the slow-op trigger is client-local)."""
+    t = Tracer().configure(enabled=True, sample_rate=1.0, seed=1)
+    sampled_tid = 0b101   # seq 2, sampled
+    unsampled_tid = 0b100  # seq 2, not sampled
+    t.span(sampled_tid, "remote_stage", 0.0, 0.001, proc="storeX")
+    t.span(unsampled_tid, "remote_stage", 0.0, 0.001, proc="storeX")
+    spans = t.spans()
+    assert len(spans) == 1
+    assert spans[0]["trace_id"] == sampled_tid
+    assert spans[0]["proc"] == "storeX"
+
+
+# ---------------------------------------------------------------------------
+# trace-context wire helpers + compat both directions
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_ctx_roundtrip_and_zero_cost():
+    assert pack_ctx([0, 0, 0]) == b""          # untraced = no wire bytes
+    blob = pack_ctx([0, 7, 0, 9])
+    assert unpack_ctx(blob, 4) == [0, 7, 0, 9]
+    assert unpack_ctx(b"", 3) == [0, 0, 0]     # old sender
+    assert unpack_ctx(blob[:8], 4) == [0, 0, 0, 0]  # short blob = zeros
+
+
+def test_entry_ctx_adoption():
+    from tpuraft.entity import EntryType, LogEntry
+
+    entries = [LogEntry(type=EntryType.DATA, data=b"a"),
+               LogEntry(type=EntryType.DATA, data=b"b", trace_id=11)]
+    blob = entry_ctx(entries)
+    fresh = [LogEntry(type=EntryType.DATA, data=b"a"),
+             LogEntry(type=EntryType.DATA, data=b"b")]
+    adopt_entry_ctx(fresh, blob)
+    assert [e.trace_id for e in fresh] == [0, 11]
+    adopt_entry_ctx(fresh, b"")   # old sender: no-op
+    assert [e.trace_id for e in fresh] == [0, 11]
+
+
+def test_append_entries_trace_ctx_wire_compat_both_directions():
+    """AppendEntriesRequest gained a trailing trace_ctx.  Old frames
+    decode on new receivers with the default; a new frame is a strict
+    extension whose prefix an old decoder reads identically."""
+    from tpuraft.entity import EntryType, LogEntry
+
+    e = LogEntry(type=EntryType.DATA, data=b"payload")
+    e.id = e.id.__class__(3, 2)
+    new = AppendEntriesRequest(
+        group_id="g", server_id="a:1", peer_id="b:2", term=2,
+        prev_log_index=2, prev_log_term=2, committed_index=1,
+        entries=[e], trace_ctx=pack_ctx([5]))
+    wire = encode_message(new)
+    got = decode_message(wire)
+    assert got.trace_ctx == pack_ctx([5])
+    assert got.entries[0].data == b"payload"
+    # old sender -> new receiver: strip the trailing bytes field
+    # (4-byte length prefix + ctx payload); trace_ctx defaults
+    old_wire = wire[:-(4 + len(new.trace_ctx))]
+    old_got = decode_message(old_wire)
+    assert old_got.trace_ctx == b""
+    assert old_got.entries[0].data == b"payload"
+    # new -> old receiver: the old-format prefix is byte-identical, so
+    # an old decoder (which stops after entries) reads the same values
+    old_fmt = encode_message(AppendEntriesRequest(
+        group_id="g", server_id="a:1", peer_id="b:2", term=2,
+        prev_log_index=2, prev_log_term=2, committed_index=1,
+        entries=[e]))
+    assert wire[:len(old_wire)] == old_fmt[:len(old_wire)]
+
+
+def test_kv_batch_trace_ctx_wire_compat_both_directions():
+    from tpuraft.rheakv.kv_service import KVCommandBatchRequest
+
+    new = KVCommandBatchRequest(items=[b"item0", b"item1"],
+                                trace_ctx=pack_ctx([0, 9]))
+    wire = encode_message(new)
+    assert decode_message(wire) == new
+    old_wire = wire[:-(4 + len(new.trace_ctx))]
+    got = decode_message(old_wire)      # old sender -> new receiver
+    assert got.items == [b"item0", b"item1"]
+    assert got.trace_ctx == b""
+    # an untraced new frame differs from the old format only by the
+    # empty trailing field an old decoder never reads
+    untraced = encode_message(KVCommandBatchRequest(
+        items=[b"item0", b"item1"]))
+    assert untraced[:len(old_wire)] == old_wire
+
+
+# ---------------------------------------------------------------------------
+# perfetto export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_export_schema(tmp_path):
+    t = Tracer().configure(enabled=True, sample_rate=1.0, seed=1)
+    tid = t.begin_op("op", proc="client")
+    import time
+
+    base = time.perf_counter()
+    t.span(tid, "stage", base, base + 0.001, proc="store:x")
+    t.end_op(tid)
+    path = str(tmp_path / "trace.json")
+    n = t.export_chrome(path)
+    assert n == 2
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list)
+    x = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(x) == 2 and len(metas) == 2   # two procs named
+    for e in x:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid", "args"):
+            assert key in e
+        assert e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    # the two spans of one op share a tid row, on different pid rows
+    assert x[0]["tid"] == x[1]["tid"]
+    assert x[0]["pid"] != x[1]["pid"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_bounds_and_dump():
+    r = FlightRecorder(capacity=16)
+    for i in range(100):
+        r.record("step_down", f"g{i}", term=i)
+    assert len(r.events()) == 16
+    assert r.events_recorded == 100
+    text = r.dump()
+    assert "step_down" in text and "g99" in text
+    assert "flight recorder" in text
+
+
+def test_recorder_election_storm_anomaly():
+    r = FlightRecorder()
+    for _ in range(r.storm_threshold):
+        r.record("election_start", "cluster--1", term=1)
+    assert len(r.anomalies) == 1
+    snap = r.anomaly_report()[0]
+    assert snap["reason"] == "election_storm"
+    assert "cluster--1" in snap["detail"]
+    assert any("election_start" in line for line in snap["events"])
+    # a storm keeps raging within the window: ONE snapshot, not N
+    for _ in range(10):
+        r.record("election_start", "cluster--1", term=2)
+    assert len(r.anomalies) == 1
+
+
+def test_recorder_dump_on_forced_sick_transition():
+    """A SICK transition must record the health event AND snapshot the
+    ring (the lead-up survives churn)."""
+    from tpuraft.util.health import HealthOptions, HealthTracker, SICK
+
+    RECORDER.record("election_start", "lead-up-group", term=9)
+    opts = HealthOptions(worsen_after=2, recover_after=2)
+    h = HealthTracker(opts, label="store-under-test")
+    for _ in range(5):
+        h.disk.note(10.0)   # 10s fsyncs: raw SICK
+        assert h.evaluate() in ("healthy", "degraded", "sick")
+    assert h.score() == SICK
+    # the recorder is a process singleton and its anomaly buffer is
+    # BOUNDED — earlier chaos tests may have filled it with real
+    # election storms, so assert on the newest snapshot, not the count
+    dumps = RECORDER.anomaly_report()
+    assert dumps, "SICK transition must snapshot the ring"
+    snap = dumps[-1]
+    assert snap["reason"] == "sick_transition"
+    assert "store-under-test" in snap["detail"]
+    # the ring snapshot carries the lead-up event
+    assert any("lead-up-group" in line for line in snap["events"])
+    # the transition itself is an event too
+    kinds = [k for _ts, k, _g, _d in RECORDER.events()]
+    assert "health" in kinds
+
+
+def test_recorder_coalesces_flood_kinds():
+    """Request-rate kinds (shed, mass quiesce sweeps) must not evict
+    the ring: one leading-edge event per window, the rest counted."""
+    r = FlightRecorder(capacity=64)
+    for _ in range(500):
+        r.record_coalesced("shed", "s1", items=1)
+    evs = [e for e in r.events() if e[1] == "shed"]
+    assert len(evs) == 1
+    # windows are per (kind, group): another store's first shed must
+    # record immediately, not be swallowed by s1's window (its
+    # suppressed count would otherwise surface attributed to s1)
+    r.record_coalesced("shed", "s2", items=1)
+    assert len([e for e in r.events()
+                if e[1] == "shed" and e[2] == "s2"]) == 1
+    r._coalesce[("shed", "s1")][0] -= 2.0   # expire the window
+    r.record_coalesced("shed", "s1", items=1)
+    evs = [e for e in r.events() if e[1] == "shed" and e[2] == "s1"]
+    assert len(evs) == 2
+    assert evs[-1][3].get("suppressed") == 499
+    # sweep-shaped kinds (per_group=False): a hibernation sweep is
+    # thousands of DISTINCT groups each quiescing once — per-group
+    # windows would make every one a leading edge and flood the ring,
+    # so they share one window per kind
+    for i in range(500):
+        r.record_coalesced("quiesce", f"g{i}", per_group=False, role="x")
+    assert len([e for e in r.events() if e[1] == "quiesce"]) == 1
+
+
+def test_recorder_thread_safety():
+    r = FlightRecorder(capacity=256)
+    errs = []
+
+    def hammer(tag):
+        try:
+            for i in range(500):
+                r.record("evt", f"g{tag}", i=i)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert r.events_recorded == 2000
+
+
+# ---------------------------------------------------------------------------
+# metrics: histogram fixes + registry thread safety + prometheus text
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_ring_replaces_oldest_first():
+    from tpuraft.util.metrics import Histogram
+
+    h = Histogram(max_samples=4)
+    for v in (1, 2, 3, 4):
+        h.update(v)
+    h.update(5)   # must replace slot 0 (oldest), not skew to slot 1
+    assert sorted(h._samples) == [2, 3, 4, 5]
+    h.update(6)
+    assert sorted(h._samples) == [3, 4, 5, 6]
+
+
+def test_histogram_percentile_rounding():
+    from tpuraft.util.metrics import Histogram
+
+    h = Histogram()
+    for v in range(1, 101):   # 1..100
+        h.update(v)
+    assert h.percentile(99) == 99
+    assert h.percentile(50) == 50
+    assert h.percentile(100) == 100
+    small = Histogram()
+    for v in (10, 20, 30, 40):
+        small.update(v)
+    assert small.percentile(50) == 20     # 2nd of 4, not 3rd
+    assert small.percentile(99) == 40
+    one = Histogram()
+    one.update(7)
+    assert one.percentile(99) == 7
+
+
+def test_histogram_cached_sort_invalidation():
+    from tpuraft.util.metrics import Histogram
+
+    h = Histogram()
+    h.update(5)
+    assert h.percentile(50) == 5
+    h.update(1)   # must invalidate the cached sort
+    assert h.percentile(50) == 1
+    assert h.snapshot()["max"] == 5
+
+
+def test_metric_registry_thread_safety():
+    from tpuraft.util.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    errs = []
+
+    def hammer():
+        try:
+            for i in range(2000):
+                reg.counter("c")
+                reg.update("h", float(i % 50))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert reg.counters["c"] == 8000
+    assert reg.histograms["h"].count == 8000
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 8000
+
+
+def test_prometheus_text_rendering():
+    from tpuraft.util.metrics import Histogram, prometheus_text
+
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0):
+        h.update(v)
+    text = prometheus_text({"kv.batch-rpcs": 7}, {"regions": 3},
+                           {"flush_ms": h.snapshot()},
+                           labels={"store": "127.0.0.1:6000"})
+    assert 'tpuraft_kv_batch_rpcs{store="127.0.0.1:6000"} 7' in text
+    assert 'tpuraft_regions{store="127.0.0.1:6000"} 3' in text
+    assert '# TYPE tpuraft_kv_batch_rpcs counter' in text
+    assert 'quantile="0.99"' in text
+    assert 'tpuraft_flush_ms_count{store="127.0.0.1:6000"} 3' in text
+    # every sample line parses as name{labels} value
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+        assert name.startswith("tpuraft_")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one traced KV put spans client + leader + follower
+# ---------------------------------------------------------------------------
+
+
+async def _kv_cluster():
+    from tests.kv_cluster import KVTestCluster
+    from tpuraft.rheakv.client import BatchingOptions, RheaKVStore
+    from tpuraft.rheakv.pd_client import FakePlacementDriverClient
+
+    c = KVTestCluster(3)
+    await c.start_all()
+    pd = FakePlacementDriverClient(c.region_template)
+    kv = RheaKVStore(pd, c.client_transport(),
+                     batching=BatchingOptions(enabled=True))
+    await kv.start()
+    await c.wait_region_leader(1)
+    return c, kv
+
+
+async def test_traced_put_end_to_end(tmp_path):
+    """The acceptance shape: ONE traced put produces >= 7 stage spans
+    spanning the client, the leader store and at least one follower —
+    joined across 'processes' by the trailing wire context — and the
+    export is perfetto-loadable."""
+    c, kv = await _kv_cluster()
+    try:
+        assert await kv.put(b"warm", b"w")        # untraced warm-up
+        TRACER.configure(enabled=True, sample_rate=1.0, seed=0)
+        assert await kv.put(b"k1", b"v1")
+        # follower appends resolve off the ack path: give stragglers a
+        # beat to land their spans before asserting
+        for _ in range(50):
+            spans = TRACER.spans()
+            if sum(1 for s in spans
+                   if s["name"] == "follower_append") >= 1:
+                break
+            await asyncio.sleep(0.02)
+        TRACER.enabled = False
+        spans = TRACER.spans()
+        roots = [s for s in spans if s["name"] == "kv_op"]
+        assert roots, "root op span missing"
+        tid = roots[-1]["trace_id"]
+        mine = [s for s in spans if s["trace_id"] == tid]
+        assert len(mine) >= 7, [s["name"] for s in mine]
+        procs = {s["proc"] for s in mine}
+        names = {s["name"] for s in mine}
+        assert "client" in procs
+        store_procs = {p for p in procs if p.startswith("store:")}
+        assert len(store_procs) >= 2, procs  # leader + >=1 follower
+        for stage in ("client_queue", "kv_batch_rpc", "srv_validate",
+                      "srv_propose", "quorum_commit", "log_flush",
+                      "fsm_apply", "follower_append"):
+            assert stage in names, (stage, names)
+        path = str(tmp_path / "put.json")
+        TRACER.export_chrome(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert any(e["ph"] == "X" and e["name"] == "follower_append"
+                   for e in doc["traceEvents"])
+    finally:
+        TRACER.configure(enabled=False)
+        TRACER.reset()
+        await kv.shutdown()
+        await c.stop_all()
+
+
+async def test_traced_get_has_fence_and_serve_stages():
+    c, kv = await _kv_cluster()
+    try:
+        assert await kv.put(b"k1", b"v1")
+        TRACER.configure(enabled=True, sample_rate=1.0, seed=0)
+        TRACER.reset()
+        assert await kv.get(b"k1") == b"v1"
+        TRACER.enabled = False
+        names = {s["name"] for s in TRACER.spans()}
+        for stage in ("kv_op", "srv_read_fence", "srv_read_serve"):
+            assert stage in names, names
+    finally:
+        TRACER.configure(enabled=False)
+        TRACER.reset()
+        await kv.shutdown()
+        await c.stop_all()
+
+
+async def test_untraced_put_records_nothing():
+    """Zero-cost sanity: with the tracer disabled, a full serving-path
+    op leaves no spans, no staging, no wire context."""
+    c, kv = await _kv_cluster()
+    try:
+        assert await kv.put(b"k", b"v")
+        assert TRACER.spans() == []
+        assert TRACER._staged == {}
+        assert TRACER.counters()["trace_ops_seen"] == 0
+    finally:
+        await kv.shutdown()
+        await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# live metrics exposition (metrics_text / admin RPC / HTTP listener)
+# ---------------------------------------------------------------------------
+
+
+async def test_metrics_text_and_describe_metrics_rpc():
+    from tpuraft.core.cli_service import CliService
+
+    c, kv = await _kv_cluster()
+    try:
+        assert await kv.put(b"k", b"v")
+        store = next(iter(c.stores.values()))
+        text = store.metrics_text()
+        assert "tpuraft_kv_batch_rpcs" in text
+        assert "tpuraft_regions" in text
+        assert f'store="{store.server_id}"' in text
+        # counter/gauge semantics: monotonic series are counters,
+        # ring occupancy / toggles / EMAs are gauges (a decrease on a
+        # Prometheus counter reads as a reset)
+        assert "# TYPE tpuraft_recorder_events counter" in text
+        assert "# TYPE tpuraft_trace_ring_spans gauge" in text
+        assert "# TYPE tpuraft_trace_slow_ema_ms gauge" in text
+        # over the wire: the admin scrape returns the same rendering
+        cli = CliService(c.client_transport("admin:0"))
+        remote = await cli.describe_metrics(str(store.server_id))
+        assert "tpuraft_kv_batch_rpcs" in remote
+        assert f'store="{store.server_id}"' in remote
+    finally:
+        await kv.shutdown()
+        await c.stop_all()
+
+
+async def test_metrics_http_listener(tmp_path):
+    """The optional stdlib HTTP listener serves Prometheus text on
+    GET /metrics (port 0 = ephemeral bind)."""
+    import urllib.error
+    import urllib.request
+
+    from tpuraft.rheakv.metadata import Region
+    from tpuraft.rheakv.store_engine import StoreEngine, StoreEngineOptions
+    from tpuraft.rpc.transport import InProcNetwork, InProcTransport, RpcServer
+
+    net = InProcNetwork()
+    ep = "127.0.0.1:6900"
+    server = RpcServer(ep)
+    net.bind(server)
+    opts = StoreEngineOptions(
+        server_id=ep,
+        initial_regions=[Region(id=1, peers=[ep])],
+        election_timeout_ms=200,
+        metrics_port=0)
+    store = StoreEngine(opts, server, InProcTransport(net, ep))
+    await store.start()
+    try:
+        assert store.metrics_http_port
+        url = f"http://127.0.0.1:{store.metrics_http_port}/metrics"
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(
+            None, lambda: urllib.request.urlopen(url, timeout=5).read())
+        text = body.decode()
+        assert "tpuraft_regions" in text
+        assert "# TYPE" in text
+        # non-metrics paths 404
+        with pytest.raises(urllib.error.HTTPError):
+            await loop.run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{store.metrics_http_port}/nope",
+                    timeout=5).read())
+    finally:
+        await store.shutdown()

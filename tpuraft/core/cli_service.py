@@ -25,6 +25,7 @@ from tpuraft.rpc.cli_messages import (
     AddPeerRequest,
     ChangePeersRequest,
     CliResponse,
+    DescribeMetricsRequest,
     GetLeaderRequest,
     GetLeaderResponse,
     GetPeersRequest,
@@ -377,6 +378,20 @@ class CliService:
             lambda leader: ResetLearnersRequest(
                 group_id=group_id, peer_id=str(leader),
                 learners=[str(p) for p in learners]))
+
+    async def describe_metrics(self, endpoint: str) -> str:
+        """Scrape one store's live metrics (Prometheus text) over the
+        admin transport — the wire-borne equivalent of GET /metrics on
+        its optional HTTP listener.  Addressed per ENDPOINT (store
+        scope, not group scope): every region group on the store is
+        folded into the one rendering."""
+        resp = await self._transport.call(
+            endpoint, "cli_describe_metrics", DescribeMetricsRequest(),
+            self._opts.timeout_ms)
+        if not getattr(resp, "success", False):
+            raise RpcError(Status.error(RaftError.EINTERNAL,
+                                        f"describe_metrics on {endpoint}"))
+        return resp.text
 
     async def rebalance(self, balance_group_ids: list[str],
                         conf: Configuration) -> Status:

@@ -54,6 +54,7 @@ import numpy as np
 from tpuraft.conf import Configuration
 from tpuraft.entity import PeerId
 from tpuraft.options import TickOptions
+from tpuraft.util.trace import RECORDER as _RECORDER
 from tpuraft.ops.tick import (
     ROLE_CANDIDATE,
     ROLE_FOLLOWER,
@@ -524,6 +525,13 @@ class EngineControl:
             return
         e.quiescent[s] = True
         e.quiesce_events += 1
+        # coalesced: a hibernation sweep at region density flips
+        # thousands of groups at once — per-group rows would evict the
+        # whole ring (the steady trickle keeps its per-group detail)
+        _RECORDER.record_coalesced("quiesce", node.group_id,
+                                   per_group=False,
+                                   node=str(node.server_id),
+                                   role="leader")
         hub = node.node_manager.heartbeat_hub
         hub.groups_quiesced += 1
         eps = sorted({r.peer.endpoint for r in node.replicators.all()})
@@ -546,6 +554,10 @@ class EngineControl:
             return True
         e.quiescent[s] = True
         e.quiesce_events += 1
+        _RECORDER.record_coalesced("quiesce", node.group_id,
+                                   per_group=False,
+                                   node=str(node.server_id),
+                                   role="follower", src=leader_endpoint)
         self._lease_src = leader_endpoint
         hub = node.node_manager.heartbeat_hub
         hub.groups_quiesced += 1
@@ -557,6 +569,10 @@ class EngineControl:
         e, s = self.engine, self.slot
         if not e.quiescent[s]:
             return
+        _RECORDER.record_coalesced("wake", self.node.group_id,
+                                   per_group=False,
+                                   node=str(self.node.server_id),
+                                   reason=reason)
         now = e.now_ms()
         # a follower waking under a FRESH store lease (e.g. a vote
         # solicitation from a restarted peer) must carry the delegated

@@ -11,12 +11,14 @@ from __future__ import annotations
 import asyncio
 from collections import deque
 import logging
+import time
 from typing import Awaitable, Callable, Optional
 
 from tpuraft.conf import Configuration
 from tpuraft.entity import EntryType, LogEntry, LogId, PeerId
 from tpuraft.errors import RaftError, Status
 from tpuraft.core.state_machine import Iterator, StateMachine
+from tpuraft.util.trace import TRACER as _TRACE
 
 LOG = logging.getLogger(__name__)
 
@@ -24,11 +26,12 @@ LOG = logging.getLogger(__name__)
 class FSMCaller:
     def __init__(self, fsm: StateMachine, log_manager, apply_batch: int = 32,
                  on_error: Optional[Callable[[Status], Awaitable[None]]] = None,
-                 health=None):
+                 health=None, trace_proc: str = "fsm"):
         self._fsm = fsm
         self._lm = log_manager
         self._apply_batch = apply_batch
         self._node_on_error = on_error
+        self._trace_proc = trace_proc
         # gray-failure signal: committed-minus-applied depth, reported
         # to the store's HealthTracker on every commit advance — a
         # saturated/slow FSM shows up as a growing backlog long before
@@ -245,6 +248,12 @@ class FSMCaller:
                     run = batch_entries[run_start:pos]
                     run_closures = [self._closures.pop(x.id.index, None) for x in run]
                     it = Iterator(run, run_closures)
+                    # trace plane: the apply stage of any traced entry
+                    # in this run (one span per traced entry; the run
+                    # applies as one batch, so they share the envelope)
+                    tids = ([x.trace_id for x in run if x.trace_id]
+                            if _TRACE.enabled else [])
+                    a0 = time.perf_counter() if tids else 0.0
                     try:
                         await self._fsm.on_apply(it)
                     except Exception:
@@ -252,6 +261,12 @@ class FSMCaller:
                         await self._set_error(Status.error(
                             RaftError.ESTATEMACHINE, "on_apply raised"))
                         return
+                    if tids:
+                        a1 = time.perf_counter()
+                        for tid in tids:
+                            _TRACE.span(tid, "fsm_apply", a0, a1,
+                                        proc=self._trace_proc,
+                                        entries=len(run))
                     if it.stopped_status is not None:
                         await self._set_error(it.stopped_status)
                         return

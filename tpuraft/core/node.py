@@ -48,6 +48,8 @@ from tpuraft.rpc.messages import (
 )
 from tpuraft.rpc.transport import RpcError
 from tpuraft.util import describer
+from tpuraft.util.trace import (RECORDER, TRACER, adopt_entry_ctx,
+                                store_proc)
 from tpuraft.storage.log_manager import LogManager
 from tpuraft.storage.log_storage import create_log_storage
 from tpuraft.storage.meta_storage import MemoryRaftMetaStorage, RaftMetaStorage
@@ -287,6 +289,12 @@ class Node:
         # not WIN elections, but liveness demands it may still campaign
         # once every healthy peer had its chance
         self._sick_election_skips: int = 0      # guarded-by: _lock (writes)
+        # trace plane: staged index -> (trace context, stage perf_counter)
+        # for traced entries awaiting their quorum — _on_committed pops
+        # and emits the quorum_commit span; only sampled/staged ops ever
+        # enter, so the steady-state cost is one empty-dict branch
+        self._trace_quorum: dict[int, tuple[int, float]] = {}
+        self._trace_proc = store_proc(server_id)
 
     # ======================================================================
     # lifecycle
@@ -348,6 +356,7 @@ class Node:
             max_logs_in_memory_bytes=(
                 opts.raft_options.max_logs_in_memory_bytes),
             health=opts.health,
+            trace_proc=self._trace_proc,
         )
         await self.log_manager.init()
 
@@ -367,7 +376,8 @@ class Node:
             opts.fsm, self.log_manager,
             apply_batch=opts.raft_options.apply_batch,
             on_error=self._on_fsm_error,
-            health=opts.health)
+            health=opts.health,
+            trace_proc=self._trace_proc)
         self.fsm_caller.on_configuration_applied = self._on_configuration_applied
 
         # snapshot subsystem
@@ -560,13 +570,20 @@ class Node:
                 good.append(task)
             if not good:
                 return
-            entries = [LogEntry(type=EntryType.DATA, data=t.data)
+            entries = [LogEntry(type=EntryType.DATA, data=t.data,
+                                trace_id=t.trace_id)
                        for t in good]
             self._ctrl.note_activity()  # a write instantly wakes a
             # hibernating leader group (quiescence)
             term = self.current_term
             last_id = self.log_manager.stage_leader_entries(entries, term)
             first_index = last_id.index - len(good) + 1
+            if TRACER.enabled:
+                now = time.perf_counter()
+                for i, task in enumerate(good):
+                    if task.trace_id:
+                        self._trace_quorum[first_index + i] = (
+                            task.trace_id, now)
             for i, task in enumerate(good):
                 if task.done:
                     self.fsm_caller.append_pending_closure(
@@ -676,6 +693,12 @@ class Node:
     # ======================================================================
 
     def _on_committed(self, index: int) -> None:
+        if self._trace_quorum:
+            now = time.perf_counter()
+            for idx in [i for i in self._trace_quorum if i <= index]:
+                tid, t0 = self._trace_quorum.pop(idx)
+                TRACER.span(tid, "quorum_commit", t0, now,
+                            proc=self._trace_proc, index=idx)
         self.fsm_caller.on_committed(index)
         self.metrics.counter("commits", 1)
 
@@ -898,6 +921,9 @@ class Node:
         if not self.conf_entry.contains(self.server_id):
             return
         LOG.info("%s starting election at term %d", self, self.current_term + 1)
+        RECORDER.record("election_start", self.group_id,
+                        node=str(self.server_id),
+                        term=self.current_term + 1)
         self.state = State.CANDIDATE
         self._ctrl.on_candidate()
         self.current_term += 1
@@ -974,6 +1000,8 @@ class Node:
         self.leader_id = self.server_id
         self._ctrl.on_leader()
         LOG.info("%s became LEADER at term %d", self, self.current_term)
+        RECORDER.record("leader_elected", self.group_id,
+                        node=str(self.server_id), term=self.current_term)
         for peer in self.conf_entry.list_peers():
             if peer != self.server_id:
                 self.replicators.add(peer)
@@ -1039,11 +1067,16 @@ class Node:
             return
         LOG.info("%s step down at term %d -> %d: %s", self, self.current_term,
                  term, status)
+        RECORDER.record("step_down", self.group_id,
+                        node=str(self.server_id), was=self.state.value,
+                        term=self.current_term, to_term=term,
+                        reason=status.error_msg[:80])
         was_leader = self.state in (State.LEADER, State.TRANSFERRING)
         self._ctrl.on_step_down(self.state == State.CANDIDATE, was_leader)
         if was_leader:
             self.replicators.stop_all()
             self.ballot_box.clear_pending()
+            self._trace_quorum.clear()  # their quorum never happened here
             self.fsm_caller.fail_pending_closures(
                 Status.error(RaftError.ENEWLEADER,
                              "leader stepped down: " + status.error_msg))
@@ -1302,6 +1335,12 @@ class Node:
                 from tpuraft.entity import strip_entry_payload
 
                 entries = [strip_entry_payload(e) for e in entries]
+            # trace plane: wire-borne contexts join the follower-side
+            # append (incl. its fsync wait) to the originating trace
+            tr0 = 0.0
+            if TRACER.enabled and req.trace_ctx:
+                adopt_entry_ctx(entries, req.trace_ctx)
+                tr0 = time.perf_counter()
             try:
                 ok = await lm.append_entries_follower(
                     req.prev_log_index, req.prev_log_term, entries)
@@ -1320,6 +1359,12 @@ class Node:
                 raise RpcError(Status.error(
                     RaftError.EHOSTDOWN,
                     f"node failed: {e.status}")) from e
+            if tr0:
+                t1 = time.perf_counter()
+                for e in entries:
+                    if e.trace_id:
+                        TRACER.span(e.trace_id, "follower_append", tr0, t1,
+                                    proc=self._trace_proc, ok=ok)
             if not ok:
                 return AppendEntriesResponse(
                     multi_hb=mh,
@@ -1645,6 +1690,9 @@ class Node:
         if self.state in (State.SHUTTING, State.SHUTDOWN, State.ERROR):
             return
         LOG.error("%s entering ERROR state: %s", self, status)
+        RECORDER.record("node_error", self.group_id,
+                        node=str(self.server_id),
+                        status=str(status)[:120])
         if self.is_leader():
             self.replicators.stop_all()
             self.fsm_caller.fail_pending_closures(status)
@@ -1700,6 +1748,8 @@ class _ConfigurationCtx:
 
     def _set_stage(self, stage: str) -> None:
         self.stage = stage
+        RECORDER.record("conf_stage", self._node.group_id,
+                        node=str(self._node.server_id), stage=stage)
         listener = self._node.conf_stage_listener
         if listener is not None:
             try:

@@ -42,6 +42,8 @@ from tpuraft.rpc.messages import (
     TimeoutNowRequest,
 )
 from tpuraft.rpc.transport import RpcError
+from tpuraft.util.trace import TRACER as _TRACE
+from tpuraft.util.trace import entry_ctx as trace_entry_ctx
 
 LOG = logging.getLogger(__name__)
 
@@ -248,7 +250,7 @@ class Replicator:
     def _build_request(self, prev_index: int, prev_term: int,
                        entries: list) -> AppendEntriesRequest:
         node = self._node
-        return AppendEntriesRequest(
+        req = AppendEntriesRequest(
             group_id=node.group_id,
             server_id=str(node.server_id),
             peer_id=str(self.peer),
@@ -257,6 +259,11 @@ class Replicator:
             prev_log_term=prev_term,
             committed_index=node.ballot_box.last_committed_index,
             entries=entries)
+        if _TRACE.enabled and entries:
+            # trailing trace contexts (b"" when no entry is traced):
+            # follower-side append/flush spans join the leader's trace
+            req.trace_ctx = trace_entry_ctx(entries)
+        return req
 
     # -- batch resolution ----------------------------------------------------
 

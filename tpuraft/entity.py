@@ -144,6 +144,13 @@ class LogEntry:
     # identical, only the payload-stripping optimization is lost)
     witnesses: Optional[list[PeerId]] = None
     old_witnesses: Optional[list[PeerId]] = None
+    # trace plane: the originating op's trace context (util/trace).
+    # TRANSIENT — never encoded into the journal or the entry codec;
+    # the wire carries it as an AppendEntriesRequest TRAILING field so
+    # follower append/flush spans join the leader-side trace.  Excluded
+    # from equality: a wire-decoded entry must still compare equal to
+    # its storage-decoded twin.
+    trace_id: int = field(default=0, compare=False, repr=False)
 
     # -- codec ---------------------------------------------------------------
 
@@ -356,3 +363,6 @@ class Task:
     data: bytes = b""
     done: Optional[Callable[["Any"], None]] = None  # called with Status
     expected_term: int = -1
+    # trace plane: carried onto the staged LogEntry (util/trace); 0 =
+    # untraced (the steady state)
+    trace_id: int = field(default=0, compare=False, repr=False)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Optional
@@ -40,6 +41,7 @@ from tpuraft.rheakv.pd_client import PlacementDriverClient
 from tpuraft.rheakv.raw_store import Sequence
 from tpuraft.rheakv.region_route_table import RegionRouteTable
 from tpuraft.rpc.transport import RpcError, is_no_method
+from tpuraft.util.trace import TRACER, pack_ctx, wire_ctx
 
 LOG = logging.getLogger(__name__)
 
@@ -166,7 +168,12 @@ class _StoreSender:
             fut.set_result(RheaKVError(Status.error(
                 RaftError.EINVAL, f"malformed op: {e!r}")))
             return fut
-        self._q.append((region, peer, blob, fut, spread))
+        # trace plane: only a SAMPLED op's context rides the row (and
+        # the wire) — unsampled slow-candidates keep the serving path
+        # untouched (wire_ctx masks them to 0)
+        tid = wire_ctx(op.trace_id)
+        self._q.append((region, peer, blob, fut, spread, tid,
+                        time.perf_counter() if tid else 0.0))
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._drain())
         return fut
@@ -201,7 +208,17 @@ class _StoreSender:
     async def _send(self, batch: list) -> None:
         client = self._client
         req = KVCommandBatchRequest(
-            items=[blob for _r, _p, blob, _f, _s in batch])
+            items=[row[2] for row in batch])
+        rpc0 = 0.0
+        if TRACER.enabled:
+            rpc0 = time.perf_counter()
+            for row in batch:
+                if row[5]:  # client-queue stage: submit -> this send
+                    TRACER.span(row[5], "client_queue", row[6], rpc0,
+                                proc="client", store=self.endpoint)
+            # per-item contexts as the trailing wire field (b"" when
+            # nothing in the batch is traced)
+            req.trace_ctx = pack_ctx([row[5] for row in batch])
         t0 = asyncio.get_running_loop().time()
         try:
             resp = await client.transport.call(
@@ -216,19 +233,26 @@ class _StoreSender:
                     *(client._call_region_outcome(
                         region,
                         KVOperation.decode(decode_batch_item(blob)[3]))
-                      for region, _p, blob, _f, _s in batch))
-                for (_r, _p, _b, fut, _s), out in zip(batch, outs):
-                    if not fut.done():
-                        fut.set_result(out)
+                      for region, _p, blob, _f, _s, _t, _ts in batch))
+                for row, out in zip(batch, outs):
+                    if not row[3].done():
+                        row[3].set_result(out)
                 return
-            for region, _p, _b, fut, spread in batch:  # dead store:
-                if not spread:                         # retryable
+            for region, _p, _b, fut, spread, _t, _ts in batch:  # dead store:
+                if not spread:                                  # retryable
                     client._leaders.pop(region.id, None)
                 if not fut.done():
                     fut.set_result(_Retry(status=e.status))
             return
         client.batch_rpcs += 1
         client.batch_items += len(batch)
+        if rpc0:
+            rpc1 = time.perf_counter()
+            for row in batch:
+                if row[5]:
+                    TRACER.span(row[5], "kv_batch_rpc", rpc0, rpc1,
+                                proc="client", store=self.endpoint,
+                                items=len(batch))
         # feed the endpoint EMA only when the store actually SERVED
         # something: a SICK store's instant shed bounces (or a follower
         # instantly answering EPERM) would otherwise read as "fast" and
@@ -251,7 +275,8 @@ class _StoreSender:
                 if not row[3].done():
                     row[3].set_result(RheaKVError(st))
             return
-        for (region, peer, _b, fut, spread), blob in zip(batch, resp.items):
+        for (region, peer, _b, fut, spread, _t, _ts), blob \
+                in zip(batch, resp.items):
             if not fut.done():
                 fut.set_result(client._decode_outcome(region, peer, blob,
                                                       spread=spread))
@@ -498,11 +523,23 @@ class RheaKVStore:
                     or (self.read_from == "any"
                         and op.op in _READONLY_OPS))
 
-        return list(await asyncio.gather(
+        if TRACER.enabled:
+            # one trace per (region, op) dispatch cycle: the root span
+            # opens here (sampling + slow-op candidacy decided inside)
+            # and closes when the cycle's outcome lands below
+            for _region, op in pairs:
+                if not op.trace_id:
+                    op.trace_id = TRACER.begin_op("kv_op", proc="client")
+        outs = list(await asyncio.gather(
             *(self._call_region_outcome(region, op)
               if is_direct(region, op)
               else self._dispatch_one(region, op, attempt)
               for region, op in pairs)))
+        if TRACER.enabled:
+            for (_region, op), out in zip(pairs, outs):
+                if op.trace_id:
+                    TRACER.end_op(op.trace_id, ok=isinstance(out, tuple))
+        return outs
 
     # ------------------------------------------------------------------
     # client-side batcher flushes (one drain round)
@@ -736,7 +773,9 @@ class RheaKVStore:
                 region_id=region.id,
                 conf_ver=region.epoch.conf_ver,
                 version=region.epoch.version,
-                op_blob=op.encode())
+                op_blob=op.encode(),
+                trace_id=wire_ctx(op.trace_id))
+            rpc0 = time.perf_counter() if wire_ctx(op.trace_id) else 0.0
             t0 = asyncio.get_running_loop().time()
             try:
                 resp = await self.transport.call(endpoint, "kv_command", req,
@@ -746,6 +785,10 @@ class RheaKVStore:
                 if not spread_read:   # a dead read replica says nothing
                     self._leaders.pop(region.id, None)   # about the leader
                 continue
+            if rpc0:
+                TRACER.span(op.trace_id, "kv_rpc", rpc0,
+                            time.perf_counter(), proc="client",
+                            store=endpoint, code=resp.code)
             if resp.code == 0:
                 # EMA only on served replies (an instant error bounce
                 # must not make a gray endpoint look fast again)
@@ -783,6 +826,17 @@ class RheaKVStore:
 
     async def _execute(self, key: bytes, op: KVOperation):
         """Route by key, run with bounded retries."""
+        tid = TRACER.begin_op("kv_op", proc="client") \
+            if TRACER.enabled and not op.trace_id else 0
+        if tid:
+            op.trace_id = tid
+        try:
+            return await self._execute_traced(key, op)
+        finally:
+            if tid:
+                TRACER.end_op(tid)
+
+    async def _execute_traced(self, key: bytes, op: KVOperation):
         last = Status.error(RaftError.EAGAIN, "exhausted retries")
         for attempt in range(self.max_retries):
             region = self.route_table.find_region_by_key(key)

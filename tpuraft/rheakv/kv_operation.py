@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import enum
 import struct
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 
 class KVOp(enum.IntEnum):
@@ -50,6 +50,11 @@ class KVOperation:
     key: bytes = b""
     value: bytes = b""
     aux: bytes = b""
+    # trace plane: the originating client op's context (util/trace),
+    # TRANSIENT — not part of the wire layout above (the batch request
+    # carries contexts in its own trailing field); excluded from
+    # equality so decoded ops compare equal to their originals
+    trace_id: int = field(default=0, compare=False, repr=False)
 
     def encode(self) -> bytes:
         return (struct.pack("<B", self.op)

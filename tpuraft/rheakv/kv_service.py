@@ -15,10 +15,12 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from tpuraft.core.read_only import ReadIndexError
+from tpuraft.util.trace import RECORDER, TRACER, store_proc, unpack_ctx
 from tpuraft.errors import RaftError, Status
 from tpuraft.rheakv.kv_operation import KVOp, KVOperation
 from tpuraft.rheakv.metadata import Region
@@ -39,6 +41,9 @@ class KVCommandRequest:
     conf_ver: int
     version: int
     op_blob: bytes  # encoded KVOperation
+    # TRAILING trace-plane extension (old decoders stop before it):
+    # the client op's trace context; 0 = untraced
+    trace_id: int = 0
 
 
 @dataclass
@@ -69,6 +74,10 @@ class KVCommandBatchRequest:
     PER ITEM — one stale region never fails its neighbours."""
 
     items: list[bytes] = field(default_factory=list)
+    # TRAILING trace-plane extension: one packed i64 trace context per
+    # item (util/trace.pack_ctx), b"" when nothing is traced — old
+    # decoders stop before it, the untraced path pays zero wire bytes
+    trace_ctx: bytes = b""
 
 
 @dataclass
@@ -212,6 +221,10 @@ class KVCommandProcessor:
 
     def __init__(self, store_engine) -> None:
         self._se = store_engine
+        # trace-plane process identity: spans emitted by this store's
+        # handlers land on their own pid row even when several stores
+        # share one OS process (the in-proc bench/test topology)
+        self._proc = store_proc(store_engine.server_id)
         store_engine.rpc_server.register("kv_command", self.handle)
         store_engine.rpc_server.register("kv_command_batch",
                                          self.handle_batch)
@@ -292,6 +305,10 @@ class KVCommandProcessor:
         shed, retry_ms = self._se.should_shed()
         if shed:
             self.shed_items += 1
+            # coalesced: shed fires at REQUEST rate during the exact
+            # incident the recorder ring must survive
+            RECORDER.record_coalesced("shed", str(self._se.server_id),
+                                      items=1, retry_ms=retry_ms)
             return KVCommandResponse(
                 code=ERR_STORE_BUSY,
                 msg=f"store sick: shedding (retry-after-ms={retry_ms})")
@@ -300,6 +317,10 @@ class KVCommandProcessor:
         if rejected is not None:
             code, msg, meta = rejected
             return KVCommandResponse(code=code, msg=msg, region_meta=meta)
+        if req.trace_id and TRACER.enabled:
+            # same gate as the batch path: a wire-borne context only
+            # produces spans where the local tracer is armed
+            op.trace_id = req.trace_id
         self.inflight_items += 1
         try:
             code, msg, result = await self._execute_op(engine.raft_store, op)
@@ -326,6 +347,9 @@ class KVCommandProcessor:
         shed, retry_ms = self._se.should_shed()
         if shed:
             self.shed_items += len(req.items)
+            RECORDER.record_coalesced("shed", str(self._se.server_id),
+                                      items=len(req.items),
+                                      retry_ms=retry_ms)
             bounce = encode_batch_reply(
                 ERR_STORE_BUSY,
                 f"store sick: shedding (retry-after-ms={retry_ms})")
@@ -340,6 +364,12 @@ class KVCommandProcessor:
                                      ) -> KVCommandBatchResponse:
         replies: list[bytes] = [b""] * len(req.items)
         groups: dict[int, list[tuple[int, KVOperation]]] = {}
+        # trace plane: per-item contexts ride the trailing trace_ctx
+        # field; adopting them onto the decoded ops lets the propose /
+        # flush / apply stages downstream join the client's trace
+        tids = (unpack_ctx(req.trace_ctx, len(req.items))
+                if TRACER.enabled and req.trace_ctx else None)
+        v0 = time.perf_counter() if tids else 0.0
         for i, blob in enumerate(req.items):
             region_id, conf_ver, version, op_blob = decode_batch_item(blob)
             rejected, engine, op = self._validate(
@@ -348,7 +378,15 @@ class KVCommandProcessor:
                 code, msg, meta = rejected
                 replies[i] = encode_batch_reply(code, msg, region_meta=meta)
                 continue
+            if tids and tids[i]:
+                op.trace_id = tids[i]
             groups.setdefault(region_id, []).append((i, op))
+        if tids:
+            v1 = time.perf_counter()
+            for tid in tids:
+                if tid:
+                    TRACER.span(tid, "srv_validate", v0, v1,
+                                proc=self._proc)
         self.batch_regions += len(groups)
 
         async def run_region(rid: int, items: list) -> None:
@@ -385,6 +423,9 @@ class KVCommandProcessor:
                 # round started, so serving all of them at the fenced
                 # index is linearizable — and a kv_command_batch with N
                 # GETs for one region costs one confirmation, not N
+                rtids = ([op.trace_id for _, op in reads if op.trace_id]
+                         if TRACER.enabled else [])
+                f0 = time.perf_counter() if rtids else 0.0
                 try:
                     await rs.node.read_index()
                 except (RpcError, ReadIndexError) as e:
@@ -400,8 +441,17 @@ class KVCommandProcessor:
                     return
                 self.read_fences += 1
                 self.fenced_reads += len(reads)
+                if rtids:
+                    f1 = time.perf_counter()
+                    for tid in rtids:
+                        TRACER.span(tid, "srv_read_fence", f0, f1,
+                                    proc=self._proc)
                 for i, op in reads:
+                    s0 = time.perf_counter() if op.trace_id else 0.0
                     code, msg, result = _serve_read_local(rs, op)
+                    if op.trace_id:
+                        TRACER.span(op.trace_id, "srv_read_serve", s0,
+                                    time.perf_counter(), proc=self._proc)
                     replies[i] = (
                         encode_batch_reply(0, result=encode_result(result))
                         if code == 0 else encode_batch_reply(code, msg))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 from typing import Optional
 
 from tpuraft.core.node import Node
@@ -19,6 +20,7 @@ from tpuraft.errors import RaftError, Status
 from tpuraft.rheakv.kv_operation import KVOp, KVOperation
 from tpuraft.rheakv.raw_store import RawKVStore, Sequence
 from tpuraft.rheakv.state_machine import KVClosure
+from tpuraft.util.trace import TRACER, store_proc
 
 
 class KVStoreError(Exception):
@@ -44,8 +46,10 @@ class RaftRawKVStore:
         # one node-lock acquisition and one flush wait per drain round
         # instead of per op
         self._apply_batch = max(1, apply_batch)
-        self._pending: list[tuple[bytes, asyncio.Future]] = []
+        self._pending: list[tuple[bytes, asyncio.Future, int]] = []
         self._drainer: Optional[asyncio.Task] = None
+        # trace-plane process identity for the propose-stage span
+        self._proc = store_proc(node.server_id)
 
     # -- write path (through the log) ---------------------------------------
 
@@ -59,10 +63,18 @@ class RaftRawKVStore:
         # type) must fail its own caller, not kill the drain task and
         # hang every op coalesced into the same batch
         blob = op.encode()
-        self._pending.append((blob, fut))
+        tid = op.trace_id
+        # propose-stage span: drain-queue wait + node.apply_batch (lock
+        # + stage + fsync wait) + quorum round + FSM apply, ending when
+        # the closure resolves — the server-side submit→ack envelope
+        t0 = time.perf_counter() if tid else 0.0
+        self._pending.append((blob, fut, tid))
         if self._drainer is None or self._drainer.done():
             self._drainer = asyncio.ensure_future(self._drain())
         status, result = await fut
+        if tid:
+            TRACER.span(tid, "srv_propose", t0, time.perf_counter(),
+                        proc=self._proc, ok=status.is_ok())
         if not status.is_ok():
             raise KVStoreError(status)
         return result
@@ -103,7 +115,12 @@ class RaftRawKVStore:
                 else:
                     results.append((Status.OK(), out))
             return results
-        outs = await self.apply(KVOperation.multi(ops))
+        mop = KVOperation.multi(ops)
+        # the MULTI entry carries ONE trace context: the first traced
+        # sub-op's (the whole sub-batch shares one log entry / quorum
+        # round, so its flush/quorum/apply stages are genuinely shared)
+        mop.trace_id = next((o.trace_id for o in ops if o.trace_id), 0)
+        outs = await self.apply(mop)
         return [(Status.OK() if code == 0 else Status(code, msg), result)
                 for code, msg, result in outs]
 
@@ -114,13 +131,13 @@ class RaftRawKVStore:
         while self._pending:
             batch = self._pending[:self._apply_batch]
             del self._pending[:len(batch)]
-            tasks = [Task(data=blob, done=KVClosure(fut))
-                     for blob, fut in batch]
+            tasks = [Task(data=blob, done=KVClosure(fut), trace_id=tid)
+                     for blob, fut, tid in batch]
             try:
                 await self.node.apply_batch(tasks)
             except Exception as e:  # noqa: BLE001 — fail THIS batch only
                 st = Status.error(RaftError.EINTERNAL, f"apply: {e!r}")
-                for _, fut in batch:
+                for _, fut, _tid in batch:
                     if not fut.done():
                         fut.set_result((st, None))
 

@@ -37,7 +37,8 @@ from tpuraft.rheakv.raw_store import (
 )
 from tpuraft.rpc.messages import BatchRequest, CompactBeat
 from tpuraft.rpc.transport import RpcError, is_no_method
-from tpuraft.util.metrics import MetricRegistry
+from tpuraft.util.metrics import MetricRegistry, prometheus_text
+from tpuraft.util.trace import RECORDER, TRACER
 from tpuraft.rheakv.region_engine import RegionEngine
 
 LOG = logging.getLogger(__name__)
@@ -125,6 +126,15 @@ class StoreEngineOptions:
     # timing out 256 workers at p99=inf.
     shed_backlog_items: int = 512
     shed_retry_after_ms: int = 250
+    # -- live metrics exposition ---------------------------------------------
+    # serve Prometheus text at GET /metrics on a stdlib HTTP listener:
+    # None = off (the default — the describe_metrics admin RPC and
+    # SIGUSR2 describer dumps still work), 0 = bind an ephemeral port
+    # (tests; the bound port lands in StoreEngine.metrics_http_port),
+    # N = bind that port.  The listener runs on its own daemon thread
+    # and only READS counters — best-effort consistency by design.
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
 
 
 class _GroupFence:
@@ -355,10 +365,20 @@ class ReadConfirmBatcher:
                 *(self._beat_dst(dst, rows) for dst, rows in by_dst.items()),
                 *(self._classic(st, r) for st, r in classic))
         finally:
+            failed_groups = 0
             for st in order:
                 if not st.done:
                     self.failed += 1
+                    failed_groups += 1
                 st.resolve(False)
+            if failed_groups:
+                # fence-round outcome (flight recorder): one event per
+                # round with failures, not per group — a total
+                # partition at region density must not churn the ring
+                # with thousands of identical rows per round
+                RECORDER.record("fence_round_failed", "",
+                                groups=failed_groups,
+                                beats=len(by_dst) + len(classic))
 
     async def _beat_dst(self, dst: str, rows: list) -> None:
         node = rows[0][0].node
@@ -446,7 +466,8 @@ class StoreEngine:
             from tpuraft.util import describer
             from tpuraft.util.health import HealthTracker
 
-            self.health = HealthTracker(opts.health_options)
+            self.health = HealthTracker(opts.health_options,
+                                        label=str(self.server_id))
             describer.register(self.health)
             if self.read_batcher is not None:
                 self.read_batcher.health = self.health
@@ -494,6 +515,14 @@ class StoreEngine:
         self.pd_deltas_sent = 0
         self.pd_full_syncs = 0
         self.pd_hb_failures = 0
+        # live metrics exposition: the describe_metrics admin RPC makes
+        # a running fleet scrapeable over the wire (no signals), and the
+        # optional HTTP listener serves the same text to Prometheus
+        self.rpc_server.register("cli_describe_metrics",
+                                 self._handle_describe_metrics)
+        self._metrics_httpd = None
+        self._metrics_thread = None
+        self.metrics_http_port: Optional[int] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -526,6 +555,8 @@ class StoreEngine:
         if self.health is not None:
             self._wire_multilog_probe()
             self._health_task = asyncio.ensure_future(self._health_loop())
+        if self.opts.metrics_port is not None:
+            self._start_metrics_http()
         LOG.info("store engine %s up with %d regions", self.server_id,
                  len(self._regions))
 
@@ -545,6 +576,14 @@ class StoreEngine:
 
     async def shutdown(self) -> None:
         self._started = False
+        if self._metrics_httpd is not None:
+            httpd = self._metrics_httpd
+            self._metrics_httpd = None
+            # serve_forever exits on shutdown(); it blocks up to the
+            # poll interval, so hop off the event loop for it
+            await asyncio.get_running_loop().run_in_executor(
+                None, httpd.shutdown)
+            httpd.server_close()
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
@@ -624,6 +663,10 @@ class StoreEngine:
             if st.is_ok():
                 done += 1
                 self.evacuations += 1
+                RECORDER.record("evacuation", engine.group_id,
+                                node=str(self.server_id),
+                                target=str(target),
+                                cause=self.health.cause)
                 LOG.warning("gray-failure evacuation: region %d leadership "
                             "-> %s (store %s is SICK: %s)", rid, target,
                             self.server_id, self.health.cause)
@@ -676,6 +719,124 @@ class StoreEngine:
         if self.kv_processor.inflight_items < self.opts.shed_backlog_items:
             return False, 0
         return True, self.opts.shed_retry_after_ms
+
+    # -- live metrics exposition ---------------------------------------------
+
+    def metrics_counters(self) -> tuple[dict, dict]:
+        """(counters, gauges) of everything this store knows: serving
+        plane, PD reporting, hub/lease plane, read plane, health, trace
+        plane.  Plain int/float reads only — safe from the exposition
+        thread (best-effort consistency; no locks taken beyond the
+        recorder's own)."""
+        kp = self.kv_processor
+        counters: dict = {
+            "kv_batch_rpcs": kp.batch_rpcs,
+            "kv_batch_items": kp.batch_items,
+            "kv_batch_regions": kp.batch_regions,
+            "kv_single_rpcs": kp.single_rpcs,
+            "kv_shed_items": kp.shed_items,
+            "kv_read_fences": kp.read_fences,
+            "kv_fenced_reads": kp.fenced_reads,
+            "pd_batches_sent": self.pd_batches_sent,
+            "pd_deltas_sent": self.pd_deltas_sent,
+            "pd_full_syncs": self.pd_full_syncs,
+            "pd_hb_failures": self.pd_hb_failures,
+            "evacuations": self.evacuations,
+            "evacuation_rounds": self.evacuation_rounds,
+        }
+        if self.read_batcher is not None:
+            counters.update(self.read_batcher.counters())
+        counters.update(self.node_manager.heartbeat_hub.counters())
+        counters.update(TRACER.counters())
+        counters.update(RECORDER.counters())
+        # non-monotonic trace/recorder series render as gauges — a
+        # Prometheus rate() over a value that can DROP (ring occupancy,
+        # the enabled toggle, a two-way EMA) reads as counter resets
+        trace_gauges = {**TRACER.gauges(), **RECORDER.gauges()}
+        # read-plane + node counters aggregated across region groups
+        agg: dict = {}
+        for engine in list(self._regions.values()):
+            node = engine.node
+            if node is None:
+                continue
+            for k, v in node.read_only_service.counters().items():
+                agg[k] = agg.get(k, 0) + v
+            for k, v in node.metrics.counters_snapshot().items():
+                agg[f"node_{k}"] = agg.get(f"node_{k}", 0) + v
+        counters.update(agg)
+        gauges: dict = {
+            "regions": len(self._regions),
+            "leader_regions": len(self._leader_regions),
+            "kv_inflight_items": kp.inflight_items,
+            **trace_gauges,
+        }
+        if self.health is not None:
+            gauges.update(self.health.counters())
+        return counters, gauges
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of :meth:`metrics_counters` plus
+        the store registry's histograms (when KV metrics are on)."""
+        counters, gauges = self.metrics_counters()
+        hists: dict = {}
+        if self.metrics.enabled:
+            snap = self.metrics.snapshot()
+            counters.update({f"reg_{k}": v
+                             for k, v in snap["counters"].items()})
+            gauges.update({f"reg_{k}": v
+                           for k, v in snap["gauges"].items()})
+            hists = snap["histograms"]
+        return prometheus_text(counters, gauges, hists,
+                               labels={"store": str(self.server_id)})
+
+    async def _handle_describe_metrics(self, req):
+        """``cli_describe_metrics`` admin RPC: the wire-borne scrape
+        (examples/admin.py metrics) — same text the HTTP listener
+        serves, without needing a second listener or signals."""
+        from tpuraft.rpc.cli_messages import DescribeMetricsResponse
+
+        return DescribeMetricsResponse(text=self.metrics_text())
+
+    def _start_metrics_http(self) -> None:
+        """Optional stdlib HTTP listener: GET /metrics on its own
+        daemon thread.  Port 0 binds ephemerally (tests read
+        ``metrics_http_port``)."""
+        import http.server
+        import threading
+
+        se = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib handler contract
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = se.metrics_text().encode()
+                except Exception as e:  # noqa: BLE001 — racing a split
+                    self.send_error(500, str(e)[:100])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes aren't news
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(
+            (self.opts.metrics_host, self.opts.metrics_port), _Handler)
+        httpd.daemon_threads = True
+        self._metrics_httpd = httpd
+        self.metrics_http_port = httpd.server_address[1]
+        self._metrics_thread = threading.Thread(
+            target=httpd.serve_forever,
+            name=f"metrics-http-{self.server_id}", daemon=True)
+        self._metrics_thread.start()
+        LOG.info("store %s serving /metrics on %s:%d", self.server_id,
+                 self.opts.metrics_host, self.metrics_http_port)
 
     # -- PD heartbeats -------------------------------------------------------
 

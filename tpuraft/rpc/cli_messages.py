@@ -125,6 +125,23 @@ class ResetLearnersRequest:
     learners: list[str] = field(default_factory=list)
 
 
+@_cli(78)
+class DescribeMetricsRequest:
+    """Live-metrics scrape over the wire (observability plane): the
+    addressed STORE answers with its Prometheus text rendering — the
+    same content its optional HTTP /metrics listener serves, reachable
+    through the admin transport without signals or extra ports."""
+
+    # reserved scope selector (""=whole store); trailing-compatible
+    scope: str = ""
+
+
+@_cli(79)
+class DescribeMetricsResponse:
+    text: str = ""
+    success: bool = True
+
+
 @_cli(76)
 class CliResponse:
     """Uniform admin-op outcome: ok/error code/msg + new conf if changed."""

@@ -107,6 +107,12 @@ class AppendEntriesRequest:
     committed_index: int
     entries: list[LogEntry] = field(default_factory=list)
     # heartbeats are empty-entry requests (reference: sendEmptyEntries)
+    # TRAILING trace-plane extension (wire-compatible: old decoders
+    # stop before it, old encoders leave the default): one packed i64
+    # trace context per entry (util/trace.pack_ctx), b"" when no entry
+    # of the batch is traced — zero wire cost on the untraced path.
+    # Follower-side append/flush spans join the originating trace.
+    trace_ctx: bytes = b""
 
 
 @dataclass

@@ -24,6 +24,7 @@ from typing import Optional
 from tpuraft.conf import ConfigurationEntry, ConfigurationManager
 from tpuraft.entity import EntryType, LogEntry, LogId
 from tpuraft.errors import RaftError, RaftException, Status
+from tpuraft.util.trace import TRACER as _TRACE
 
 LOG = logging.getLogger(__name__)
 
@@ -47,8 +48,12 @@ class LogManager:
         max_logs_in_memory: int = 256,
         max_logs_in_memory_bytes: int = 256 * 1024,
         health=None,
+        trace_proc: str = "",
     ):
         self._storage = storage
+        # trace-plane process identity for flush spans (the owning
+        # node's store endpoint; "" for bare/legacy constructions)
+        self._trace_proc = trace_proc or "log"
         # gray-failure signal: the store-level HealthTracker whose disk
         # probe this flusher times every flush round into (append +
         # fsync, executor queueing included — CPU saturation IS a gray
@@ -363,15 +368,28 @@ class LogManager:
                     append_async = getattr(
                         self._storage, "append_entries_async", None)
                     health = self._health
+                    # trace plane: spans for the traced entries of this
+                    # flush round — timed IN the executor thread (the
+                    # PR 11 health-probe discipline: awaited duration
+                    # folds in executor-queue wait and a co-hosted
+                    # neighbor's slow disk would contaminate THIS
+                    # store's attribution exactly like it did the EMA)
+                    tids = ([e.trace_id for e in entries if e.trace_id]
+                            if _TRACE.enabled else [])
                     tok = health.disk.begin() if health is not None else None
                     try:
                         if append_async is not None:
                             # multilog: the group commit times its fsync
                             # IN the executor thread and feeds the EMA
                             # itself (StoreEngine wires the probe);
-                            # begin/end here covers only the stall age
+                            # begin/end here covers only the stall age.
+                            # The awaited envelope is the best span
+                            # available here (the commit round is
+                            # shared, not per-group).
+                            f0 = time.perf_counter()
                             await append_async(entries, self._sync)
-                        elif health is not None:
+                            f1 = time.perf_counter()
+                        elif health is not None or tids:
                             # time the append+fsync IN the executor
                             # thread: end-to-end (awaited) duration
                             # would fold in executor-queue wait, and a
@@ -381,10 +399,11 @@ class LogManager:
                                 t0 = time.perf_counter()
                                 self._storage.append_entries(entries,
                                                              self._sync)
-                                return time.perf_counter() - t0
+                                return t0, time.perf_counter()
 
-                            dur = await loop.run_in_executor(None, _timed)
-                            health.disk.note(dur)
+                            f0, f1 = await loop.run_in_executor(None, _timed)
+                            if health is not None:
+                                health.disk.note(f1 - f0)
                         else:
                             await loop.run_in_executor(
                                 None, self._storage.append_entries, entries,
@@ -392,6 +411,11 @@ class LogManager:
                     finally:
                         if tok is not None:
                             health.disk.end(tok)
+                    if tids:
+                        for tid in tids:
+                            _TRACE.span(tid, "log_flush", f0, f1,
+                                        proc=self._trace_proc,
+                                        entries=len(entries))
                     self._stable_index = max(self._stable_index, entries[-1].id.index)
                     if self.on_stable is not None:
                         self.on_stable(self._stable_index)

@@ -175,8 +175,10 @@ class HealthTracker:
     """One store's {HEALTHY, DEGRADED, SICK} score + per-peer scores."""
 
     def __init__(self, opts: HealthOptions | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, label: str = ""):
         self.opts = opts or HealthOptions()
+        # flight-recorder identity (the owning store's endpoint)
+        self.label = label
         self.disk = DiskLatencyProbe(self.opts.alpha, clock=clock)
         self._self_hyst = _Hysteresis(self.opts.worsen_after,
                                       self.opts.recover_after)
@@ -233,11 +235,26 @@ class HealthTracker:
         Call at a steady cadence (the store's health task) — hysteresis
         counts these calls, so cadence x worsen_after bounds detection
         latency."""
+        from tpuraft.util.trace import RECORDER
+
         self.evaluations += 1
+        prev = self._self_hyst.level
         raw, cause = self._raw_self()
         level = self._self_hyst.fold(raw)
         if level == raw:
             self.cause = cause
+        if level != prev:
+            # flight recorder: health transitions are incident markers,
+            # and a SICK transition snapshots the ring — the lead-up
+            # (elections, shed bounces, fence failures) must survive
+            # ring churn for post-hoc triage
+            RECORDER.record("health", self.label,
+                            level=level, was=prev, cause=self.cause)
+            if level == SICK:
+                RECORDER.note_anomaly(
+                    "sick_transition",
+                    f"{self.label or 'store'}: {prev} -> {level} "
+                    f"(cause={self.cause or '?'})")
         self.level_counts[level] += 1
         for ent in self._peers.values():
             o = self.opts
