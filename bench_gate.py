@@ -19,6 +19,12 @@ Rows:
                         stay within BENCH_GATE_TRACE_THRESHOLD
                         (default 5%) of the same-session untraced
                         measurement.
+  kv_ops_heat_overhead — heat-accounting gate: per-region heat
+                        tracking defaults ON, so the kv row already
+                        pays for it; this row runs the same shape with
+                        --no-heat and the heat-ON measurement must
+                        stay within BENCH_GATE_HEAT_THRESHOLD
+                        (default 3%) of the heat-OFF comparator.
 
 The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
@@ -74,12 +80,14 @@ def _run_e2e_once(extra: dict, duration: float) -> float:
 
 def _run_kv_once(extra: dict, duration: float,
                  read_frac: float = -1.0,
-                 trace_sample: float = 0.0) -> float:
+                 trace_sample: float = 0.0,
+                 heat_off: bool = False) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
     the read-mix shape (the amortized read plane's regression row);
     ``trace_sample > 0`` runs with product tracing sampling at that
-    rate (the tracing-overhead row)."""
+    rate (the tracing-overhead row); ``heat_off`` disables per-region
+    heat tracking (the heat-overhead row's A/B comparator)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -94,6 +102,9 @@ def _run_kv_once(extra: dict, duration: float,
         key += f"_r{int(round(read_frac * 100))}"
     if trace_sample > 0:
         cmd += ["--trace-sample", str(trace_sample)]
+    if heat_off:
+        cmd.append("--no-heat")
+        key += "_noheat"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -231,6 +242,27 @@ def main() -> int:
             worst = max(worst, rc)
             trep["untraced"] = rep["measured"]
             reports.append(trep)
+            # heat-overhead row (fleet observability): heat tracking
+            # defaults ON, so the kv row above already PAYS for heat —
+            # gate it against a same-session heat-OFF run at 3%.  The
+            # committed floor is the heat-off measurement (the faster
+            # comparator); retries re-run the heat-ON side.
+            heat_threshold = float(os.environ.get(
+                "BENCH_GATE_HEAT_THRESHOLD", "0.03"))
+            try:
+                heat_off = _run_kv_once(kv_extra, duration,
+                                        heat_off=True)
+                rc, hrep = _gate(
+                    "kv_ops_heat_overhead", heat_off,
+                    lambda: _run_kv_once(kv_extra, duration),
+                    heat_threshold, retries)
+                hrep["heat_off"] = round(heat_off, 1)
+            except RuntimeError as exc:
+                print(f"bench-gate[kv_ops_heat_overhead]: {exc}")
+                rc, hrep = 2, {"gate": "kv_ops_heat_overhead",
+                               "verdict": "BROKEN", "error": str(exc)}
+            worst = max(worst, rc)
+            reports.append(hrep)
     if "gate_read_ops_per_sec" not in kv_extra:
         # the amortized read plane (ISSUE 10) needs its own regression
         # row — a silent pass without a calibration would defeat it

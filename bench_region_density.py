@@ -64,6 +64,7 @@ async def run_config(args) -> dict:
         region_hbs = 0     # legacy per-region RPCs (the r5 1476/s metric)
         batch_hbs = 0      # pd_store_heartbeat_batch RPCs
         delta_rows = 0     # changed-region rows carried inside batches
+        heat_rows = 0      # noise-gated heat rows carried inside batches
 
         async def store_heartbeat(self, meta) -> None:
             CountingPD.store_hbs += 1
@@ -74,12 +75,14 @@ async def run_config(args) -> dict:
             return await super().region_heartbeat(region, leader, *a, **kw)
 
         async def store_heartbeat_batch(self, meta, deltas, full=False,
-                                        health=""):
+                                        health="", heat=None,
+                                        occupancy=None):
             # count what a real PD would SEE: one RPC + its delta rows
             # (not the base class's legacy decomposition, which would
             # double-count every row as a per-region RPC)
             CountingPD.batch_hbs += 1
             CountingPD.delta_rows += len(deltas)
+            CountingPD.heat_rows += len(heat or [])
             return [], False
 
     t0 = time.monotonic()
@@ -103,6 +106,8 @@ async def run_config(args) -> dict:
             raw_store_factory=lambda i=i: NativeRawKVStore(
                 f"{args.dir}/store{i}/kv", sync=False),
             heartbeat_interval_ms=1000,
+            # --no-heat: the bench-gate heat-overhead row's A/B knob
+            heat_tracking=not args.no_heat,
         )
         if args.lease_reads:
             from tpuraft.options import ReadOnlyOption
@@ -160,7 +165,8 @@ async def run_config(args) -> dict:
                              max_store_inflight=args.store_inflight),
                          read_from=args.read_from)
     hb0 = (CountingPD.store_hbs, CountingPD.region_hbs,
-           CountingPD.batch_hbs, CountingPD.delta_rows)
+           CountingPD.batch_hbs, CountingPD.delta_rows,
+           CountingPD.heat_rows)
 
     ok = [0]
     errs = [0]
@@ -199,6 +205,12 @@ async def run_config(args) -> dict:
         TRACER.configure(enabled=True, sample_rate=args.trace_sample,
                          seed=0)
 
+    if args.profile_ticks > 0:
+        # device-tick profiling window on the first store's engine:
+        # each of the next N ticks records build/device/apply phase
+        # spans, exported below as a perfetto tick timeline
+        engines[0].profile_ticks(args.profile_ticks)
+
     stop_at = time.monotonic() + args.duration
 
     async def worker(wid: int) -> None:
@@ -222,7 +234,8 @@ async def run_config(args) -> dict:
     await asyncio.gather(*(worker(i) for i in range(args.workers)))
     elapsed = time.monotonic() - t2
     hb1 = (CountingPD.store_hbs, CountingPD.region_hbs,
-           CountingPD.batch_hbs, CountingPD.delta_rows)
+           CountingPD.batch_hbs, CountingPD.delta_rows,
+           CountingPD.heat_rows)
     # snapshot hibernation state BEFORE the stage probes: the write
     # probe below legitimately wakes its target group
     quiesced_after = sum(int(e.quiescent.sum()) for e in engines) \
@@ -254,6 +267,7 @@ async def run_config(args) -> dict:
     _acc({"lease_lane_hits": sum(e.lease_lane_hits for e in engines),
           "lease_lane_misses": sum(e.lease_lane_misses for e in engines)})
 
+    ls = [e.lane_stats() for e in engines]
     rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     coalesced_flushes = sum(re.fsm.coalesced_flushes
                             for s in stores for re in s._regions.values())
@@ -311,6 +325,25 @@ async def run_config(args) -> dict:
         # batched round) → done (local serve + reply)
         "read_stage_marks_ms": read_stage,
         "read_plane": read_plane,
+        # tick-plane occupancy (fleet observability): [G]-lane
+        # vectorized reductions summed across the S engines, plus the
+        # first engine's per-tick phase attribution
+        "tick_plane": {
+            "groups": sum(ls[i]["groups"] for i in range(S)),
+            "leaders": sum(ls[i]["leaders"] for i in range(S)),
+            "quiescent": sum(ls[i]["quiescent"] for i in range(S)),
+            "tick_hists": engines[0].tick_histograms(),
+        },
+        # per-region heat telemetry: intake volume + noise-gated rows
+        # that actually rode the heartbeats
+        "heat": {
+            "enabled": not args.no_heat,
+            "rows_per_s": round((hb1[4] - hb0[4]) / elapsed, 2),
+            "writes_noted": sum(
+                s.heat.writes_noted for s in stores if s.heat),
+            "reads_noted": sum(
+                s.heat.reads_noted for s in stores if s.heat),
+        },
     }
     if args.quiesce:
         res["quiescent_replicas_before"] = quiesced_before
@@ -325,6 +358,13 @@ async def run_config(args) -> dict:
             # any window-sampled ops still in the ring)
             res["trace_file"] = args.trace
             res["trace_spans"] = TRACER.export_chrome(args.trace)
+    if args.profile_ticks > 0:
+        # tick timeline: the N-tick window as a perfetto-loadable
+        # export (root tick span + build/device/apply phase spans)
+        out = args.profile_ticks_out or os.path.join(
+            args.dir, "tick_timeline.json")
+        res["tick_timeline_file"] = out
+        res["tick_timeline_spans"] = engines[0].export_tick_timeline(out)
     print("RESULT " + json.dumps(res), flush=True)
     os._exit(0)  # 3R region engines: teardown is not the measurement
 
@@ -469,6 +509,16 @@ def main() -> None:
                     help="enable product tracing through the measured "
                          "window at this sample rate (0 = off; the "
                          "bench-gate overhead row uses 0.05)")
+    ap.add_argument("--no-heat", action="store_true",
+                    help="disable per-region heat tracking (the "
+                         "bench-gate heat-overhead row's A/B knob)")
+    ap.add_argument("--profile-ticks", type=int, default=0,
+                    help="arm an N-tick device profiling window on the "
+                         "first store's engine; exports a perfetto "
+                         "tick timeline (build/device/apply phases)")
+    ap.add_argument("--profile-ticks-out", default="",
+                    help="tick timeline output path (default: "
+                         "<workdir>/tick_timeline.json)")
     ap.add_argument("--json-out", default="BENCH_REGIONS.json")
     ap.add_argument("--config", action="store_true",
                     help="internal: run one config in this process")
@@ -504,6 +554,13 @@ def main() -> None:
         cmd.append("--lease-reads")
     if args.quiesce:
         cmd.append("--quiesce")
+    if args.no_heat:
+        cmd.append("--no-heat")
+    if args.profile_ticks > 0:
+        cmd += ["--profile-ticks", str(args.profile_ticks)]
+        if args.profile_ticks_out:
+            cmd += ["--profile-ticks-out",
+                    os.path.abspath(args.profile_ticks_out)]
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     t0 = time.monotonic()
     p = subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env)
@@ -536,6 +593,8 @@ def main() -> None:
         key += "_lease"
     if args.quiesce:
         key += "_quiesce"
+    if args.no_heat:
+        key += "_noheat"
     out[key] = row
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

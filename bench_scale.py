@@ -206,6 +206,14 @@ async def run_rung(args) -> dict:
             "groups_quiesced": sum(h.groups_quiesced for h in hubs),
             "groups_woken": sum(h.groups_woken for h in hubs),
             "lease_expiries": sum(h.lease_expiries for h in hubs),
+            # tick-plane gauges (fleet observability): the [G]-lane
+            # reductions metrics_text serves — the per-engine
+            # hibernation fractions here must agree with the raw
+            # quiescent_groups count above (same arrays, one reduce)
+            "lane_stats": [e.lane_stats() for e in engines],
+            "tick_p99_ms": round(max(
+                e.tick_hists["tick_total_ms"].percentile(99)
+                for e in engines), 3),
             "eto_floor_ms": max(e._floor_applied_ms for e in engines),
             "eff_eto_ms": int(max(int(e.eto_ms[e.has_ctrl].max())
                                   for e in engines if e.has_ctrl.any())),

@@ -26,6 +26,12 @@ Commands:
     metrics [endpoint]        scrape live metrics (Prometheus text)
                               from one store (default: first peer that
                               answers) over the admin transport
+
+PD (fleet) commands take --pd instead of --group/--peers:
+    cluster [K]               print the PD leader's ClusterView: top-K
+                              hot/cold regions, per-zone rates, store
+                              health roster, hibernation fraction
+    pd-metrics                scrape the PD leader's Prometheus text
 """
 
 from __future__ import annotations
@@ -51,9 +57,90 @@ def _report(st) -> int:
     return 3 if st.raft_error == RaftError.EBUSY else 1
 
 
+def _print_cluster_view(view: dict) -> None:
+    hib = view.get("hibernation", {})
+    print(f"cluster: {view.get('regions', 0)} regions, "
+          f"{len(view.get('stores', []))} stores, "
+          f"hibernation {hib.get('quiescent', 0)}/"
+          f"{hib.get('replicas', 0)} "
+          f"({100.0 * hib.get('fraction', 0.0):.1f}%), "
+          f"pd term {view.get('term', 0)}")
+    for s in view.get("stores", []):
+        health = s.get("health") or "healthy?"
+        zone = s.get("zone") or "-"
+        print(f"  store {s['endpoint']:<22} zone={zone:<10} "
+              f"health={health:<9} leaders={s.get('leaders', 0):<5} "
+              f"quiescent={s.get('replicas_quiescent', 0)}/"
+              f"{s.get('replicas', 0)}")
+    for z, zr in sorted(view.get("zone_rates", {}).items()):
+        print(f"  zone {z or '-':<10} writes/s={zr.get('writes_s', 0)} "
+              f"reads/s={zr.get('reads_s', 0)}")
+    if view.get("sick_stores"):
+        print("  SICK stores:", ", ".join(view["sick_stores"]))
+    for title, key in (("hot", "hot"), ("cold", "cold")):
+        rows = view.get(key, [])
+        if not rows:
+            continue
+        print(f"  {title} regions:")
+        for r in rows:
+            flag = " HOT" if r["region"] in view.get("hot_flagged", []) \
+                else ""
+            print(f"    region {r['region']:<8} score={r['score']:<8} "
+                  f"w/s={r['writes_s']:<7} r/s={r['reads_s']:<7} "
+                  f"keys={r['keys']:<8} leader={r['leader']}{flag}")
+
+
+async def _run_pd(args) -> int:
+    """PD-targeted commands (``--pd`` endpoints, no raft group conf)."""
+    import json
+
+    from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+    from tpuraft.rpc.transport import RpcError
+
+    transport = TcpTransport()
+    pd = RemotePlacementDriverClient(
+        transport, [e for e in args.pd.split(",") if e])
+    cmd = args.command[0]
+    try:
+        if cmd == "cluster":
+            top_k = int(args.command[1]) if len(args.command) > 1 else 8
+            view = await pd.cluster_describe(top_k=top_k)
+            if view is None:
+                print("error: PD does not serve pd_cluster_describe "
+                      "(pre-observability build)", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(view, indent=1))
+            else:
+                _print_cluster_view(view)
+        else:  # pd-metrics
+            text = await pd.describe_metrics()
+            if text is None:
+                print("error: PD does not serve pd_describe_metrics "
+                      "(pre-observability build)", file=sys.stderr)
+                return 1
+            print(text, end="")
+        return 0
+    except (RpcError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await transport.close()
+
+
 async def run(args) -> int:
     from tpuraft.rpc.transport import RpcError
 
+    cmd0 = args.command[0]
+    if cmd0 in ("cluster", "pd-metrics"):
+        if not args.pd:
+            print(f"{cmd0} needs --pd (comma-separated PD endpoints)",
+                  file=sys.stderr)
+            return 2
+        return await _run_pd(args)
+    if not args.group or not args.peers:
+        print(f"{cmd0} needs --group and --peers", file=sys.stderr)
+        return 2
     try:
         conf = Configuration.parse(args.peers)
     except ValueError as e:
@@ -155,16 +242,22 @@ async def run(args) -> int:
 def main() -> None:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--group", required=True, help="raft group id")
-    ap.add_argument("--peers", required=True,
+    ap.add_argument("--group", default="", help="raft group id")
+    ap.add_argument("--peers", default="",
                     help="comma-separated cluster conf (ip:port,...)")
+    ap.add_argument("--pd", default="",
+                    help="comma-separated PD endpoints (for the "
+                         "cluster / pd-metrics commands)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the cluster view as raw JSON")
     ap.add_argument("command", nargs="+",
                     help="leader | peers | snapshot <peer> | transfer <peer>"
                          " | add-peer <peer> | remove-peer <peer>"
                          " | add-witness <peer> | remove-witness <peer>"
                          " | change-peers <p1,p2,...>"
                          " | add-learners <p1,...> | remove-learners <p1,...>"
-                         " | reset-learners <p1,...> | metrics [endpoint]")
+                         " | reset-learners <p1,...> | metrics [endpoint]"
+                         " | cluster [K] | pd-metrics")
     sys.exit(asyncio.run(run(ap.parse_args())))
 
 

@@ -30,7 +30,8 @@ async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
                 split_threshold_keys: int = 0,
                 balance_leaders: bool = False,
                 seed_regions: int = 0,
-                transport_kind: str = "tcp") -> None:
+                transport_kind: str = "tcp",
+                metrics_port: int | None = None) -> None:
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -47,6 +48,7 @@ async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
         split_threshold_keys=split_threshold_keys,
         balance_leaders=balance_leaders,
         initial_regions=make_regions(seed_regions) if seed_regions else [],
+        metrics_port=metrics_port,
     )
     pd = PlacementDriverServer(opts, endpoint, server, transport)
     await pd.start()
@@ -75,6 +77,9 @@ def main() -> None:
                          "boot (metadata only; stores attach via "
                          "heartbeats)")
     ap.add_argument("--transport", choices=["tcp", "native"], default="tcp")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve PD Prometheus text at GET /metrics on "
+                         "this port (0 = ephemeral; default off)")
     args = ap.parse_args()
     pds = [e for e in args.pd.split(",") if e]
     if args.serve not in pds:
@@ -83,7 +88,7 @@ def main() -> None:
     try:
         asyncio.run(serve(args.serve, pds, args.data, args.split_keys,
                           args.balance_leaders, args.seed_regions,
-                          args.transport))
+                          args.transport, metrics_port=args.metrics_port))
     except KeyboardInterrupt:
         pass
 

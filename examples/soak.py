@@ -154,12 +154,18 @@ class SoakCluster(_BaseSoakCluster):
     def __init__(self, n_stores: int, data_path: str, n_regions: int = 1,
                  engine: bool = False, election_timeout_ms: int = 400,
                  quiesce_after_rounds: int = 0, geo_zones: int = 0,
-                 witness: bool = False, geo_seed: int = 0):
+                 witness: bool = False, geo_seed: int = 0,
+                 pd_endpoint: str = "",
+                 heartbeat_interval_ms: int = 0):
         super().__init__(data_path)
         self.net = InProcNetwork()
         self.endpoints = [f"127.0.0.1:{6300 + i}" for i in range(n_stores)]
         self.election_timeout_ms = election_timeout_ms
         self.engine = engine
+        # --hotspot: stores heartbeat to a REAL in-proc PD at this
+        # endpoint (heat rows + cluster view) instead of running PD-less
+        self.pd_endpoint = pd_endpoint
+        self.heartbeat_interval_ms = heartbeat_interval_ms
         self.quiesce_after_rounds = quiesce_after_rounds
         self.geo_zones = geo_zones
         self.witness = witness
@@ -202,6 +208,14 @@ class SoakCluster(_BaseSoakCluster):
             extra["quiesce_after_rounds"] = self.quiesce_after_rounds
         if self.geo_zones:
             extra["zone"] = self.zone_of(ep)
+        if self.heartbeat_interval_ms:
+            extra["heartbeat_interval_ms"] = self.heartbeat_interval_ms
+        pd_client = None
+        if self.pd_endpoint:
+            from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+
+            pd_client = RemotePlacementDriverClient(
+                transport, [self.pd_endpoint])
         raft_engine = None
         if self.engine:
             from tpuraft.core.engine import MultiRaftEngine
@@ -213,7 +227,8 @@ class SoakCluster(_BaseSoakCluster):
             extra["log_scheme"] = "multilog"
         store = StoreEngine(
             self._store_opts(ep, self.election_timeout_ms, **extra),
-            server, transport, multi_raft_engine=raft_engine)
+            server, transport, multi_raft_engine=raft_engine,
+            pd_client=pd_client)
         await store.start()
         self.stores[ep] = store
 
@@ -1359,6 +1374,163 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         # cover startup failures before this block exists too)
 
 
+async def run_hotspot_soak(duration_s: float, n_stores: int,
+                           n_regions: int, seed: int, data_path: str,
+                           verbose: bool) -> dict:
+    """Zipfian-hotspot telemetry soak (fleet observability plane).
+
+    Boots a REAL in-proc PD alongside the stores, drives a skewed
+    workload (80% of ops into a 3-region hot set, the rest uniform),
+    SHIFTS the hot set mid-run, and asserts the PD ClusterView's top-K
+    identifies the new hot regions within 3 heartbeat rounds of the
+    shift — the end-to-end accuracy contract for the heat plane
+    (store intake -> EWMA fold -> noise-gated heartbeat rows -> PD
+    stats -> cluster view)."""
+    import os as _os
+
+    from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+    from tpuraft.rheakv.pd_server import (PlacementDriverOptions,
+                                          PlacementDriverServer)
+
+    rng = random.Random(seed)
+    hb_ms = 500
+    c = SoakCluster(n_stores, data_path, n_regions=n_regions,
+                    pd_endpoint="127.0.0.1:7100",
+                    heartbeat_interval_ms=hb_ms)
+
+    def say(*a):
+        if verbose:
+            print(*a, flush=True)
+
+    # PD first (single-node metadata group on the same fabric): stores
+    # attach via heartbeats, the first batch full-syncs every region
+    pd_ep = c.pd_endpoint
+    server = RpcServer(pd_ep)
+    c.net.bind(server)
+    c.net.start_endpoint(pd_ep)
+    pd_transport = InProcTransport(c.net, pd_ep)
+    pd = PlacementDriverServer(
+        PlacementDriverOptions(
+            endpoints=[pd_ep], election_timeout_ms=300,
+            data_path=_os.path.join(data_path, "pd")),
+        pd_ep, server, pd_transport)
+    await pd.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if pd.node is not None and pd.node.is_leader():
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError("PD never elected")
+
+    for ep in c.endpoints:
+        await c.start_store(ep)
+    kv = RheaKVStore(FakePlacementDriverClient(
+        [r.copy() for r in c.regions]), c.client_transport(),
+        max_retries=1, jitter_seed=rng.randrange(1 << 30))
+    await kv.start()
+    pd_view = RemotePlacementDriverClient(
+        InProcTransport(c.net, "hotspot-admin:0"), [pd_ep])
+
+    hot_n = 3
+    hot_a = sorted(rng.sample(range(n_regions), hot_n))
+    hot_b = sorted(rng.sample(
+        [r for r in range(n_regions) if r not in hot_a], hot_n))
+    hot_now = list(hot_a)
+    payload = b"h" * 64
+
+    def hot_key() -> bytes:
+        # region k+1 owns [k%06d, (k+1)%06d)
+        if rng.random() < 0.8:
+            k = rng.choice(hot_now)
+        else:
+            k = rng.randrange(n_regions)
+        return b"k%06d/h%02d" % (k, rng.randrange(8))
+
+    stop = asyncio.Event()
+    ops = [0]
+    errs = [0]
+
+    async def driver() -> None:
+        while not stop.is_set():
+            key = hot_key()
+            try:
+                if rng.random() < 0.5:
+                    await kv.put(key, payload)
+                else:
+                    await kv.get(key)
+                ops[0] += 1
+            except Exception:
+                errs[0] += 1
+            await asyncio.sleep(0.001)
+
+    drivers = [asyncio.ensure_future(driver()) for _ in range(4)]
+    half = max(4.0, duration_s / 2.0)
+    await asyncio.sleep(half)
+
+    # phase A sanity: the PD already ranks the current hot set on top
+    view = await pd_view.cluster_describe(top_k=8)
+    top_a = [r["region"] for r in (view or {}).get("hot", [])]
+    phase_a_ok = all((k + 1) in top_a for k in hot_a)
+    say(f"phase A top-K {top_a} (true {[k + 1 for k in hot_a]})")
+
+    # the shift: re-aim the hot set, then count heartbeat rounds until
+    # the view's top-K contains every NEW hot region
+    hot_now[:] = hot_b
+    true_b = [k + 1 for k in hot_b]
+    detect_rounds = -1
+    rounds_slept = 0
+    top_b: list = []
+    for rnd in range(1, 9):
+        await asyncio.sleep(hb_ms / 1000.0)
+        rounds_slept = rnd
+        view = await pd_view.cluster_describe(top_k=8)
+        top_b = [r["region"] for r in (view or {}).get("hot", [])]
+        say(f"round {rnd}: top-K {top_b} (want {true_b})")
+        if all(r in top_b for r in true_b):
+            detect_rounds = rnd
+            break
+    # credit the rounds already slept, detected or not — a failing run
+    # must not overshoot the requested duration
+    await asyncio.sleep(max(0.0, duration_s - half - rounds_slept
+                            * hb_ms / 1000.0))
+    stop.set()
+    for d in drivers:
+        d.cancel()
+
+    view = await pd_view.cluster_describe(top_k=8) or {}
+    # the hot_region detector (the flight-recorder signal the split/
+    # move policy will consume) must also have flagged the new hot set
+    flag_ok = all(r in view.get("hot_flagged", []) for r in true_b)
+    hotspot_ok = phase_a_ok and 0 < detect_rounds <= 3 and flag_ok
+    result = {
+        "mode": "hotspot",
+        "duration_s": duration_s,
+        "regions": n_regions,
+        "stores": n_stores,
+        "ops": ops[0],
+        "errors": errs[0],
+        "heartbeat_ms": hb_ms,
+        "true_hot_a": [k + 1 for k in hot_a],
+        "true_hot_b": true_b,
+        "phase_a_topk_ok": phase_a_ok,
+        "detect_rounds": detect_rounds,
+        "hot_flag_ok": flag_ok,
+        "pd_top_hot": top_b,
+        "pd_hot_flagged": view.get("hot_flagged", []),
+        "pd_heat_rows": pd.hb_heat_rows,
+        "zone_rates": view.get("zone_rates", {}),
+        "hotspot_ok": hotspot_ok,
+        # the linearizability key so main()'s exit gate composes
+        "linearizable": True,
+    }
+    await kv.shutdown()
+    for ep in list(c.stores):
+        await c.stop_store(ep)
+    await pd.shutdown()
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--duration", type=float, default=30)
@@ -1452,9 +1624,24 @@ def main() -> None:
                     help="enable sampled product tracing (5%% of ops) "
                          "and export a perfetto-loadable Chrome trace "
                          "JSON to this path at the end")
+    ap.add_argument("--hotspot", action="store_true",
+                    help="zipfian-hotspot telemetry soak: real in-proc "
+                         "PD, skewed load with a mid-run hot-set "
+                         "shift; asserts the PD ClusterView top-K "
+                         "identifies the new hot regions within 3 "
+                         "heartbeat rounds (fleet observability)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
+    if args.hotspot:
+        import json
+
+        n_regions = args.regions if args.regions > 1 else 24
+        result = asyncio.run(run_hotspot_soak(
+            args.duration, args.stores, n_regions, args.seed, data,
+            args.verbose))
+        print(json.dumps(result))
+        raise SystemExit(0 if result["hotspot_ok"] else 1)
     result = asyncio.run(run_soak(args.duration, args.stores, args.keys,
                                   args.seed, data, args.verbose,
                                   transport=args.transport,

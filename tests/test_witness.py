@@ -501,9 +501,14 @@ def test_cli_and_pd_messages_decode_old_frames():
                         witness=True), ["witness"]),
         (StoreHeartbeatRequest(store_id=7, endpoint="a:1", zone="z1",
                                health="sick"), ["zone", "health"]),
+        # the batch request's trailing extensions span three PR
+        # generations (zone, health, then the fleet-observability
+        # heat/occupancy fields) — the oldest sender predates them all
         (StoreHeartbeatBatchRequest(store_id=7, endpoint="a:1",
-                                    zone="z2", health="degraded"),
-         ["zone", "health"]),
+                                    zone="z2", health="degraded",
+                                    heat=b"\x01\x02\x03", replicas=4,
+                                    replicas_quiescent=2),
+         ["zone", "health", "heat", "replicas", "replicas_quiescent"]),
     ]
     for msg, new_fields in cases:
         cls = type(msg)
@@ -558,6 +563,8 @@ def _encoded_len(v) -> int:
         return 8
     if isinstance(v, str):
         return 2 + len(v.encode())
+    if isinstance(v, bytes):
+        return 4 + len(v)
     if isinstance(v, list):
         return 4 + sum(2 + len(x.encode()) for x in v)
     raise TypeError(type(v))

@@ -729,6 +729,26 @@ class MultiRaftEngine:
         self._params_dev = None
         self.ticks = 0
         self.commit_advances = 0
+        # device-tick profiling (fleet observability): per-tick wall
+        # time attributed to the three phases every tick pays — host
+        # state build, device dispatch (jit call + output transfer, or
+        # the numpy twin), host apply (commit callbacks + protocol
+        # scheduling).  Always on: four locked histogram updates per
+        # TICK (not per op) — ticks are paced by their own cost, so
+        # this stays noise even at max cadence.
+        from tpuraft.util.metrics import Histogram
+        self.tick_hists = {
+            "tick_total_ms": Histogram(),
+            "tick_build_ms": Histogram(),
+            "tick_device_ms": Histogram(),
+            "tick_apply_ms": Histogram(),
+        }
+        # --profile-ticks window: a dedicated Tracer capturing one span
+        # per tick phase for the next N ticks (perfetto timeline export
+        # through the trace plane's exporter); None = disarmed (the
+        # hot-path cost is one attribute test per tick)
+        self._tick_tracer = None
+        self._tick_prof_left = 0
         # protocol params: [G] rows — each registered node's NodeOptions
         # timeouts apply to ITS groups only (mixed-timeout engines, e.g.
         # a PD group + region groups in one process, run correct
@@ -1104,7 +1124,100 @@ class MultiRaftEngine:
                 f"wake_events={self.wake_events} "
                 f"lease_lane_hits={self.lease_lane_hits} "
                 f"lease_lane_misses={self.lease_lane_misses} "
-                f"eto_floor_ms={self._floor_applied_ms}>")
+                f"eto_floor_ms={self._floor_applied_ms} "
+                f"tick_p99_ms={self.tick_hists['tick_total_ms'].percentile(99):.3f}>")
+
+    # -- device-tick profiling (fleet observability) -------------------------
+
+    def tick_histograms(self) -> dict:
+        """Per-tick phase wall-time histograms as snapshot dicts — the
+        shape ``prometheus_text(histograms=...)`` renders (served by
+        StoreEngine.metrics_text for engine-backed stores)."""
+        return {k: h.snapshot() for k, h in self.tick_hists.items()}
+
+    def lane_stats(self) -> dict:
+        """[G]-lane occupancy gauges, computed as vectorized reductions
+        over the host mirrors the tick already owns — no per-group
+        Python.  ``hibernation_fraction`` is quiescent/controlled (the
+        number the PD's ClusterView aggregates fleet-wide)."""
+        hc = self.has_ctrl
+        n = int(hc.sum())
+        leaders = int(((self.role == ROLE_LEADER) & hc).sum())
+        quiescent = int((self.quiescent & hc).sum())
+        stats = {
+            "groups": n,
+            "leaders": leaders,
+            "candidates": int(((self.role == ROLE_CANDIDATE) & hc).sum()),
+            "followers": int(((self.role == ROLE_FOLLOWER) & hc).sum()),
+            "quiescent": quiescent,
+            "hibernation_fraction": round(quiescent / n, 4) if n else 0.0,
+            "tick_cost_ema_ms": round(self._tick_cost_ema_s * 1e3, 3),
+        }
+        # q_ack distribution: age of the quorum-newest ack per AWAKE
+        # leader row (quiescent leaders ride the store lease; their rows
+        # age by design and would drown the signal) — the read plane's
+        # lease headroom at a glance
+        lead = (self.role == ROLE_LEADER) & hc & ~self.quiescent
+        qa = self.tick_q_ack[lead]
+        qa = qa[qa > _NEG_I32]
+        if qa.size:
+            ages = np.clip(self.now_ms() - qa, 0, None)
+            stats["q_ack_age_ms_p50"] = float(np.percentile(ages, 50))
+            stats["q_ack_age_ms_p99"] = float(np.percentile(ages, 99))
+            stats["q_ack_age_ms_max"] = float(ages.max())
+        else:
+            stats["q_ack_age_ms_p50"] = 0.0
+            stats["q_ack_age_ms_p99"] = 0.0
+            stats["q_ack_age_ms_max"] = 0.0
+        return stats
+
+    def profile_ticks(self, n: int) -> None:
+        """Arm a profiling window: the next ``n`` ticks each record a
+        root span + build/device/apply phase spans into a dedicated
+        tracer (sample_rate=1, no slow trigger), exportable as a
+        perfetto timeline via :meth:`export_tick_timeline`.  Disarmed
+        (the steady state) the tick pays one attribute test."""
+        from tpuraft.util.trace import Tracer
+
+        if n <= 0:
+            self._tick_tracer = None
+            self._tick_prof_left = 0
+            return
+        self._tick_tracer = Tracer().configure(
+            enabled=True, sample_rate=1.0, seed=0,
+            ring=max(4096, 4 * n + 8), slow_trigger=False)
+        self._tick_prof_left = n
+
+    def _profile_tick(self, t0: float, t1: float, t2: float, t3: float,
+                      advanced: int) -> None:
+        # direct-emit path (odd tid = "record unconditionally"): the
+        # spans carry their own measured [t0,t1] intervals, so staging
+        # through begin_op/end_op would mis-stamp the root.  One tid
+        # for the whole window keeps every tick on one perfetto track,
+        # with the phase spans nesting inside each tick span.
+        tr = self._tick_tracer
+        tid = 1
+        tr.span(tid, "tick", t0, t3, proc="engine", seq=self.ticks,
+                advanced=advanced,
+                groups=int(self.has_ctrl.sum()),
+                quiescent=int((self.quiescent & self.has_ctrl).sum()))
+        tr.span(tid, "tick_build", t0, t1, proc="engine")
+        tr.span(tid, "tick_device", t1, t2, proc="engine")
+        tr.span(tid, "tick_apply", t2, t3, proc="engine")
+        self._tick_prof_left -= 1
+        if self._tick_prof_left <= 0:
+            self._tick_prof_left = 0
+            # keep the tracer for export; stop recording
+            self._tick_tracer, self._tick_trace_done = None, tr
+
+    def export_tick_timeline(self, path: str) -> int:
+        """Write the captured (or in-flight) --profile-ticks window as
+        perfetto-loadable chrome trace JSON; returns the span count
+        (0 = no window was armed)."""
+        tr = self._tick_tracer or getattr(self, "_tick_trace_done", None)
+        if tr is None:
+            return 0
+        return tr.export_chrome(path)
 
     # -- tick loop -----------------------------------------------------------
 
@@ -1300,6 +1413,7 @@ class MultiRaftEngine:
         """One batched device tick for all groups: commit advancement,
         election/heartbeat scheduling, lease & step-down.  Returns the
         number of groups whose commit advanced."""
+        t0 = time.perf_counter()
         now = self.now_ms()
         self._maybe_time_rebase(now)
         now = self.now_ms()
@@ -1314,10 +1428,12 @@ class MultiRaftEngine:
         commit_rel_now = np.clip(self.commit_abs - self.base, 0, None
                                  ).astype(np.int32)
 
+        t1 = time.perf_counter()
         if self._tick_fn is not None:
             out = self._device_tick(rel, commit_rel_now, now)
         else:  # numpy fallback (tiny deployments / no jax)
             out = self._np_tick(rel, commit_rel_now, now)
+        t2 = time.perf_counter()
 
         self.ticks += 1
         # publish the read-plane lane: the fused q_ack reduce is exactly
@@ -1326,6 +1442,13 @@ class MultiRaftEngine:
         np.copyto(self.tick_q_ack, np.asarray(out.q_ack))
         advanced = self._apply_commits(out)
         self._apply_protocol(out, now)
+        t3 = time.perf_counter()
+        self.tick_hists["tick_build_ms"].update((t1 - t0) * 1e3)
+        self.tick_hists["tick_device_ms"].update((t2 - t1) * 1e3)
+        self.tick_hists["tick_apply_ms"].update((t3 - t2) * 1e3)
+        self.tick_hists["tick_total_ms"].update((t3 - t0) * 1e3)
+        if self._tick_tracer is not None:
+            self._profile_tick(t0, t1, t2, t3, advanced)
         return advanced
 
     def _device_tick(self, rel, commit_rel_now, now):

@@ -40,6 +40,12 @@ class FSMCaller:
         self.last_applied_index = 0
         self.last_applied_term = 0
         self._committed_index = 0
+        # apply-plane observability (fleet metrics): batches through
+        # on_apply and DATA entries they carried — the store engine
+        # aggregates these across regions, so mean entries/batch (the
+        # write plane's apply amortization) is scrapeable live
+        self.apply_batches = 0
+        self.applied_entries = 0
         self._closures: dict[int, Callable[[Status], None]] = {}
         # demand-spawned drain (r4): a standing task per FSMCaller was
         # O(nodes) standing tasks per process — at 16K groups x 3
@@ -261,6 +267,8 @@ class FSMCaller:
                         await self._set_error(Status.error(
                             RaftError.ESTATEMACHINE, "on_apply raised"))
                         return
+                    self.apply_batches += 1
+                    self.applied_entries += len(run)
                     if tids:
                         a1 = time.perf_counter()
                         for tid in tids:

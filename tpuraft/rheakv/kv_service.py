@@ -225,6 +225,11 @@ class KVCommandProcessor:
         # handlers land on their own pid row even when several stores
         # share one OS process (the in-proc bench/test topology)
         self._proc = store_proc(store_engine.server_id)
+        # per-region heat intake (fleet observability): writes noted at
+        # admission (op count + op-blob bytes in), reads at serve (op
+        # count + reply bytes out) — one dict bump per item, the O(1)
+        # hot-path contract the bench-gate heat row enforces
+        self._heat = store_engine.heat
         store_engine.rpc_server.register("kv_command", self.handle)
         store_engine.rpc_server.register("kv_command_batch",
                                          self.handle_batch)
@@ -321,6 +326,9 @@ class KVCommandProcessor:
             # same gate as the batch path: a wire-borne context only
             # produces spans where the local tracer is armed
             op.trace_id = req.trace_id
+        is_write = op.op in _WRITE_OPS
+        if self._heat is not None and is_write:
+            self._heat.note_write(req.region_id, 1, len(req.op_blob))
         self.inflight_items += 1
         try:
             code, msg, result = await self._execute_op(engine.raft_store, op)
@@ -328,7 +336,10 @@ class KVCommandProcessor:
             self.inflight_items -= 1
         if code:
             return KVCommandResponse(code=code, msg=msg)
-        return KVCommandResponse(result=encode_result(result))
+        blob = encode_result(result)
+        if self._heat is not None and not is_write:
+            self._heat.note_read(req.region_id, 1, len(blob))
+        return KVCommandResponse(result=blob)
 
     async def handle_batch(self, req: KVCommandBatchRequest
                            ) -> KVCommandBatchResponse:
@@ -380,6 +391,8 @@ class KVCommandProcessor:
                 continue
             if tids and tids[i]:
                 op.trace_id = tids[i]
+            if self._heat is not None and op.op in _WRITE_OPS:
+                self._heat.note_write(region_id, 1, len(op_blob))
             groups.setdefault(region_id, []).append((i, op))
         if tids:
             v1 = time.perf_counter()
@@ -446,6 +459,7 @@ class KVCommandProcessor:
                     for tid in rtids:
                         TRACER.span(tid, "srv_read_fence", f0, f1,
                                     proc=self._proc)
+                served = out_bytes = 0
                 for i, op in reads:
                     s0 = time.perf_counter() if op.trace_id else 0.0
                     code, msg, result = _serve_read_local(rs, op)
@@ -455,6 +469,11 @@ class KVCommandProcessor:
                     replies[i] = (
                         encode_batch_reply(0, result=encode_result(result))
                         if code == 0 else encode_batch_reply(code, msg))
+                    if code == 0:
+                        served += 1
+                        out_bytes += len(replies[i])
+                if served and self._heat is not None:
+                    self._heat.note_read(rid, served, out_bytes)
 
             await asyncio.gather(
                 *([run_writes()] if writes else []),

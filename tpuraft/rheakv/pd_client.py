@@ -42,32 +42,49 @@ class PlacementDriverClient:
     async def store_heartbeat_batch(
             self, meta: StoreMeta,
             deltas: list[tuple[Region, str, int]],
-            full: bool = False, health: str = "") -> tuple[list, bool]:
+            full: bool = False, health: str = "",
+            heat: Optional[list] = None,
+            occupancy: Optional[tuple] = None) -> tuple[list, bool]:
         """Delta-batched reporting: ONE call per interval carrying only
         the CHANGED (region, leader, approximate_keys) rows.  Returns
         (instructions, need_full).  ``health`` is the store's
         self-reported gray-failure level (trailing wire field; "" on
-        stores without scoring).  Default: decompose into the legacy
-        per-region calls — PD-less / legacy clients keep exact
-        semantics while batch-aware clients override with one RPC.
-        need_full is always True here: a legacy PD has no delta state
-        and runs its policy (split re-issue, leader balancing) off the
-        per-region reports, so every round must carry EVERY led region
-        — delta-only reporting would starve it, and a failed-over
-        legacy PD leader would stay cold forever (it cannot ask for a
-        resync the way the batch protocol can)."""
+        stores without scoring).  ``heat`` is the noise-gated list of
+        (region_id, writes_s, reads_s, bytes_in_s, bytes_out_s) rows
+        and ``occupancy`` the (replicas, replicas_quiescent) pair —
+        both trailing wire fields of the fleet observability plane.
+        Default: decompose into the legacy per-region calls — PD-less /
+        legacy clients keep exact semantics while batch-aware clients
+        override with one RPC.  need_full is always True here: a legacy
+        PD has no delta state and runs its policy (split re-issue,
+        leader balancing) off the per-region reports, so every round
+        must carry EVERY led region — delta-only reporting would starve
+        it, and a failed-over legacy PD leader would stay cold forever
+        (it cannot ask for a resync the way the batch protocol can)."""
         meta = StoreMeta(id=meta.id, endpoint=meta.endpoint,
                          regions=[r.copy() for (r, _l, _k) in deltas],
                          zone=meta.zone)
-        # legacy decomposition deliberately DROPS health: the per-region
-        # protocol (and the subclasses that implement it) predates
-        # scoring, and a legacy PD has no drain policy to feed anyway
+        # legacy decomposition deliberately DROPS health/heat/occupancy:
+        # the per-region protocol (and the subclasses that implement
+        # it) predates them, and a legacy PD has no drain/heat policy
+        # to feed anyway
         await self.store_heartbeat(meta)
         instructions: list = []
         for region, leader, keys in deltas:
             instructions.extend(await self.region_heartbeat(
                 region, leader, {"approximate_keys": keys}))
         return instructions, True
+
+    async def cluster_describe(self, top_k: int = 8) -> Optional[dict]:
+        """Fleet observability: the PD leader's folded ClusterView as a
+        dict (see pd_server.PlacementDriverServer._build_cluster_view).
+        None = this client has no PD to ask (PD-less deployments)."""
+        return None
+
+    async def describe_metrics(self) -> Optional[str]:
+        """Fleet observability: the PD leader's Prometheus text
+        (pd_describe_metrics).  None = no PD / pre-observability PD."""
+        return None
 
     async def shutdown(self) -> None:
         pass
@@ -190,22 +207,28 @@ class RemotePlacementDriverClient(PlacementDriverClient):
     async def store_heartbeat_batch(
             self, meta: StoreMeta,
             deltas: list[tuple[Region, str, int]],
-            full: bool = False, health: str = "") -> tuple[list, bool]:
+            full: bool = False, health: str = "",
+            heat: Optional[list] = None,
+            occupancy: Optional[tuple] = None) -> tuple[list, bool]:
         from tpuraft.rheakv.pd_messages import (
             Instruction,
             StoreHeartbeatBatchRequest,
             encode_region_delta,
         )
         from tpuraft.rpc.transport import RpcError, is_no_method
+        from tpuraft.util.heat import encode_heat_rows
 
         if not self._batch_ok:
             return await super().store_heartbeat_batch(
                 meta, deltas, full, health=health)
+        replicas, quiescent = occupancy or (0, 0)
         req = StoreHeartbeatBatchRequest(
             store_id=meta.id, endpoint=meta.endpoint,
             deltas=[encode_region_delta(r.encode(), leader, keys)
                     for (r, leader, keys) in deltas],
-            full=full, zone=meta.zone, health=health)
+            full=full, zone=meta.zone, health=health,
+            heat=encode_heat_rows(heat or []),
+            replicas=replicas, replicas_quiescent=quiescent)
         try:
             resp = await self._call("pd_store_heartbeat_batch", req)
         except RpcError as e:
@@ -216,3 +239,31 @@ class RemotePlacementDriverClient(PlacementDriverClient):
             raise
         return ([Instruction.decode(b) for b in resp.instructions],
                 bool(getattr(resp, "need_full", False)))
+
+    async def cluster_describe(self, top_k: int = 8) -> Optional[dict]:
+        import json
+
+        from tpuraft.rheakv.pd_messages import ClusterDescribeRequest
+        from tpuraft.rpc.transport import RpcError, is_no_method
+
+        try:
+            resp = await self._call("pd_cluster_describe",
+                                    ClusterDescribeRequest(top_k=top_k))
+        except RpcError as e:
+            if is_no_method(e):
+                return None  # pre-observability PD
+            raise
+        return json.loads(resp.view_json) if resp.view_json else None
+
+    async def describe_metrics(self) -> Optional[str]:
+        from tpuraft.rpc.cli_messages import DescribeMetricsRequest
+        from tpuraft.rpc.transport import RpcError, is_no_method
+
+        try:
+            resp = await self._call("pd_describe_metrics",
+                                    DescribeMetricsRequest())
+        except RpcError as e:
+            if is_no_method(e):
+                return None  # pre-observability PD
+            raise
+        return resp.text

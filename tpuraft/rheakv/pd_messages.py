@@ -129,6 +129,20 @@ class StoreHeartbeatBatchRequest:
     # trailing extension (gray failures): self-reported health level
     # ("" = store predates health scoring, treated as healthy)
     health: str = ""
+    # trailing extension (fleet observability): packed per-region heat
+    # rows (util/heat.encode_heat_rows — region_id + EWMA writes/s,
+    # reads/s, bytes in/out per s) for led regions whose heat moved
+    # past the noise gate this interval; b"" = nothing moved (zero
+    # wire cost) or a pre-heat sender.  Rows are independent of
+    # ``deltas``: heat changes at its own cadence.
+    heat: bytes = b""
+    # trailing extension (fleet observability): tick-plane occupancy —
+    # how many region replicas this store hosts and how many of them
+    # are hibernating (group quiescence).  The PD folds these into the
+    # ClusterView's fleet hibernation fraction.  0/0 = pre-occupancy
+    # sender or a timer-mode store that doesn't track it.
+    replicas: int = 0
+    replicas_quiescent: int = 0
 
 
 @_pd(153)
@@ -138,6 +152,26 @@ class StoreHeartbeatBatchResponse:
     # the PD leader has no full picture of this store (new leader /
     # store unknown): send a full batch next round
     need_full: bool = False
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
+@_pd(154)
+class ClusterDescribeRequest:
+    """Fleet observability: ask the PD leader for its folded
+    :class:`~tpuraft.rheakv.pd_server.ClusterView` — top-K hot/cold
+    regions, per-zone access rates, store health roster, leader
+    histograms and the fleet hibernation fraction."""
+
+    top_k: int = 8
+
+
+@_pd(155)
+class ClusterDescribeResponse:
+    # JSON rendering of the ClusterView (an admin/read surface: JSON
+    # keeps it extensible without wire-schema churn per added field)
+    view_json: str = ""
     success: bool = True
     redirect: str = ""
     msg: str = ""

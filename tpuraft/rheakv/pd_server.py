@@ -259,16 +259,68 @@ class PDMetadataFSM(StateMachine):
         return True
 
 
+@dataclass
+class RegionStats:
+    """ONE region-stats record per region — the unified intake the PD
+    split policy reads.  Key counts (the legacy ``approximate_keys``
+    path) and heat rates (the fleet observability plane) land in the
+    SAME record, so ``should_split`` — and item 2's heat-driven
+    split/merge/move policy after it — has one place to look."""
+
+    keys: int = 0
+    writes_s: float = 0.0
+    reads_s: float = 0.0
+    bytes_in_s: float = 0.0
+    bytes_out_s: float = 0.0
+    # monotonic stamp of the last heat intake (0.0 = keys-only entry);
+    # the staleness sweep zeroes rates whose reporter went silent — a
+    # moved/evacuated leadership must not leave hot rates behind forever
+    heat_at: float = 0.0
+
+    @property
+    def score(self) -> float:
+        from tpuraft.util.heat import heat_score
+
+        return heat_score(self.writes_s, self.reads_s,
+                          self.bytes_in_s, self.bytes_out_s)
+
+
 class ClusterStatsManager:
-    """Leader-side (non-replicated) stats: key counts + split decisions.
+    """Leader-side (non-replicated) stats: per-region key counts + heat
+    rates (ONE record per region — see :class:`RegionStats`) and
+    split/transfer decisions.
 
     Reference: ``pd:ClusterStatsManager`` — finds the region with the
-    most keys above the split threshold.
+    most keys above the split threshold; extended here with the heat
+    intake the heartbeats report, top-K hot/cold ranking for the
+    ClusterView, and hot-region detection (a region whose score crosses
+    the fleet's heat percentile fires a ``hot_region`` flight-recorder
+    event — the exact signal a split/move policy consumes).
     """
+
+    # hot-region detection: a region is HOT when its score exceeds
+    # max(hot_min_score, hot_factor x the fleet's BACKGROUND percentile
+    # — the median, NOT a tail percentile: in a small fleet the hot
+    # regions ARE the tail, so anchoring on p90 would set the bar at
+    # 4x the hot set's own score and unflag exactly the regions the
+    # detector exists to find); it cools at half the threshold
+    # (hysteresis, no event flapping).  Below ``hot_min_population``
+    # scored regions the threshold is undefined (infinity): a
+    # half-reported bootstrap fleet must not mass-flag on a floor
+    # computed from the first few rows.
+    hot_percentile = 50.0
+    hot_factor = 4.0
+    hot_min_score = 2.0
+    hot_min_population = 8
+    # rates not re-reported for this long are zeroed by the sweep
+    # (leadership moved and the new leader's heat sits under the noise
+    # gate, or the region left the fleet) — keys are kept, matching
+    # the legacy keys-only intake which never expired either
+    heat_stale_s = 30.0
 
     def __init__(self, split_threshold_keys: int) -> None:
         self.split_threshold_keys = split_threshold_keys
-        self._keys: dict[int, int] = {}
+        self._stats: dict[int, RegionStats] = {}
         self._inflight_splits: dict[int, float] = {}  # region -> deadline
         self._transfer_cooldown: dict[int, float] = {}  # region -> deadline
         # region -> (from_ep, to_ep, expiry): ordered but not yet
@@ -276,6 +328,14 @@ class ClusterStatsManager:
         self._pending_moves: dict[int, tuple[str, str, float]] = {}
         self._leader_term = -1      # last PD term balancing ran under
         self._grace_until = 0.0     # post-failover balancing pause
+        # hot-region state: currently-hot set + cached threshold (the
+        # percentile scan is O(regions), so it refreshes at most once
+        # per second, not per intake row; None = undefined — heated
+        # population below hot_min_population)
+        self._hot: set[int] = set()
+        self._hot_threshold: Optional[float] = None
+        self._hot_recalc_at = 0.0
+        self.hot_events = 0
 
     def note_leadership(self, term: int, cooldown_s: float) -> None:
         """Deterministic cooldown rebuild on PD leadership change
@@ -291,13 +351,139 @@ class ClusterStatsManager:
         self._transfer_cooldown.clear()
         self._pending_moves.clear()
 
+    def _ent(self, region_id: int) -> RegionStats:
+        ent = self._stats.get(region_id)
+        if ent is None:
+            ent = self._stats[region_id] = RegionStats()
+        return ent
+
     def record(self, region_id: int, approximate_keys: int) -> None:
-        self._keys[region_id] = approximate_keys
+        self._ent(region_id).keys = approximate_keys
+
+    def record_heat(self, region_id: int, writes_s: float, reads_s: float,
+                    bytes_in_s: float, bytes_out_s: float) -> None:
+        """Heat intake (heartbeat trailing field) into the SAME record
+        the split policy reads; fires the hot_region detector."""
+        ent = self._ent(region_id)
+        ent.writes_s = writes_s
+        ent.reads_s = reads_s
+        ent.bytes_in_s = bytes_in_s
+        ent.bytes_out_s = bytes_out_s
+        ent.heat_at = time.monotonic()
+        self._note_hot(region_id, ent.score)
+
+    def _note_hot(self, region_id: int, score: float) -> None:
+        from tpuraft.util.trace import RECORDER
+
+        self.maybe_sweep()
+        thr = self._hot_threshold
+        if thr is None:
+            # threshold undefined (heated population below the gate):
+            # flag nothing new AND cool nothing — standing flags must
+            # not flap on a population-count transient
+            return
+        if region_id in self._hot:
+            if score < thr / 2.0:
+                self._hot.discard(region_id)
+            return
+        if score >= thr:
+            self._hot.add(region_id)
+            self.hot_events += 1
+            # coalesced: a hotspot shift can re-flag a whole shard
+            # family inside one heartbeat burst
+            RECORDER.record_coalesced(
+                "hot_region", str(region_id),
+                score=round(score, 2), threshold=round(thr, 2))
+
+    def maybe_sweep(self) -> None:
+        """Run the staleness/threshold sweep if one is due (rate-bound
+        to 1/s); called from heat intake AND from the view build, so a
+        fleet that went silent still ages its standing rates out."""
+        now = time.monotonic()
+        if now >= self._hot_recalc_at:
+            self._hot_sweep(now)
+
+    def _hot_sweep(self, now: float) -> None:
+        """At most once per second: zero stale heat (a silent reporter
+        must not leave standing rates in the view or the percentile
+        base), refresh the threshold, and re-judge every currently
+        flagged region against it — cooling must not wait for an
+        intake row the noise gate may never send."""
+        self._hot_recalc_at = now + 1.0
+        stale = now - self.heat_stale_s
+        heated = 0
+        for ent in self._stats.values():
+            if ent.heat_at <= 0.0:
+                continue
+            if ent.heat_at < stale:
+                ent.writes_s = ent.reads_s = 0.0
+                ent.bytes_in_s = ent.bytes_out_s = 0.0
+                ent.heat_at = 0.0
+            else:
+                heated += 1
+        if heated < self.hot_min_population:
+            # undefined: too few live reporters to anchor a background
+            # percentile.  No new flags, and LIVE standing flags stand
+            # — a brief reporter dropout must not erase (then re-fire)
+            # them; only flags whose own reporter went stale cool
+            # (their rates were just zeroed — we know nothing anymore)
+            self._hot_threshold = None
+            for rid in list(self._hot):
+                ent = self._stats.get(rid)
+                if ent is None or ent.heat_at <= 0.0:
+                    self._hot.discard(rid)
+            return
+        self._hot_threshold = max(
+            self.hot_min_score,
+            self.hot_factor * self._score_percentile(
+                self.hot_percentile))
+        for rid in list(self._hot):
+            ent = self._stats.get(rid)
+            if ent is None or ent.score < self._hot_threshold / 2.0:
+                self._hot.discard(rid)
+
+    def _score_percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the heated regions' scores
+        (keys-only entries carry no load information and would drag
+        the background estimate to zero)."""
+        import math
+
+        scores = sorted(ent.score for ent in self._stats.values()
+                        if ent.heat_at > 0.0)
+        if not scores:
+            return 0.0
+        idx = max(0, min(len(scores) - 1,
+                         math.ceil(p / 100.0 * len(scores)) - 1))
+        return scores[idx]
+
+    def hot_regions(self) -> set[int]:
+        return set(self._hot)
+
+    def hot_count(self) -> int:
+        """Flagged-region count via len() (GIL-atomic) — safe from the
+        metrics exposition thread, unlike copying the live set."""
+        return len(self._hot)
+
+    def region_stats(self, region_id: int) -> RegionStats:
+        return self._stats.get(region_id) or RegionStats()
+
+    def top_hot(self, k: int) -> list[tuple[int, RegionStats]]:
+        """Hottest k regions by score, descending (zero-score regions
+        excluded — a silent fleet has no hot regions)."""
+        return sorted(((rid, ent) for rid, ent in self._stats.items()
+                       if ent.score > 0.0),
+                      key=lambda kv: -kv[1].score)[:max(0, k)]
+
+    def top_cold(self, k: int) -> list[tuple[int, RegionStats]]:
+        """Coldest k regions by score, ascending — merge candidates."""
+        return sorted(self._stats.items(),
+                      key=lambda kv: kv[1].score)[:max(0, k)]
 
     def last_keys(self, region_id: int) -> int:
         """Last reported key count (delta-batched stores skip unchanged
         regions, so the policy pass reads the standing estimate)."""
-        return self._keys.get(region_id, 0)
+        ent = self._stats.get(region_id)
+        return ent.keys if ent is not None else 0
 
     def should_split(self, region_id: int) -> bool:
         if self.split_threshold_keys <= 0:
@@ -307,12 +493,16 @@ class ClusterStatsManager:
                                  self._inflight_splits.items() if d > now}
         if region_id in self._inflight_splits:
             return False
-        return self._keys.get(region_id, 0) >= self.split_threshold_keys
+        return self.last_keys(region_id) >= self.split_threshold_keys
 
     def mark_split_issued(self, region_id: int, cooldown_s: float = 30.0
                           ) -> None:
         self._inflight_splits[region_id] = time.monotonic() + cooldown_s
-        self._keys.pop(region_id, None)
+        ent = self._stats.get(region_id)
+        if ent is not None:
+            # keys reset (the split empties the parent's estimate); the
+            # heat rates stay — load keeps landing until clients re-route
+            ent.keys = 0
 
     # -- leader balancing (reference: ClusterStatsManager's busiest-store
     # accounting feeding rebalance) ------------------------------------
@@ -424,6 +614,13 @@ class PlacementDriverOptions:
     # doesn't spray repeated TRANSFER_LEADER at a region mid-move
     transfer_cooldown_s: float = 5.0
     initial_regions: list[Region] = field(default_factory=list)
+    # fleet observability: serve PD-side Prometheus text at GET
+    # /metrics on the PD's OWN stdlib listener (None = off, 0 =
+    # ephemeral — the bound port lands in
+    # PlacementDriverServer.metrics_http_port, N = that port).  The
+    # same render answers the ``pd_describe_metrics`` RPC regardless.
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
 
 
 class PlacementDriverServer:
@@ -447,6 +644,8 @@ class PlacementDriverServer:
             ("pd_store_heartbeat_batch", self._store_heartbeat_batch),
             ("pd_report_split", self._report_split),
             ("pd_create_region_id", self._create_region_id),
+            ("pd_cluster_describe", self._cluster_describe),
+            ("pd_describe_metrics", self._describe_metrics),
         ]:
             rpc_server.register(method, handler)
         # delta-batch protocol state (leader-local, like ClusterStats):
@@ -459,6 +658,21 @@ class PlacementDriverServer:
         # — re-derived from heartbeats after failover): store endpoint
         # -> self-reported health level ("healthy"/"degraded"/"sick")
         self._store_health: dict[str, str] = {}
+        # tick-plane occupancy (leader-local, from heartbeat trailing
+        # fields): store endpoint -> (replicas, replicas_quiescent);
+        # folded into the ClusterView's fleet hibernation fraction
+        self._store_occupancy: dict[str, tuple[int, int]] = {}
+        # fleet-observability counters (pd_describe_metrics / HTTP)
+        self.hb_rpcs = 0            # legacy per-store heartbeats
+        self.hb_region_rpcs = 0     # legacy per-region heartbeats
+        self.hb_batch_rpcs = 0      # delta-batched heartbeats
+        self.hb_delta_rows = 0      # region delta rows carried
+        self.hb_heat_rows = 0       # heat rows carried
+        self.splits_ordered = 0
+        self.transfers_ordered = 0
+        self.cluster_describes = 0
+        self._metrics_httpd = None
+        self.metrics_http_port: Optional[int] = None
 
     @property
     def node(self):
@@ -488,8 +702,23 @@ class PlacementDriverServer:
             self._seed_regions = list(self.opts.initial_regions)
         else:
             self._seed_regions = []
+        if self.opts.metrics_port is not None:
+            from tpuraft.util.metrics_http import MetricsHttpServer
+
+            self._metrics_httpd = MetricsHttpServer(
+                self.opts.metrics_host, self.opts.metrics_port,
+                self.metrics_text,
+                name=f"pd-metrics-http-{self.server_id}")
+            self.metrics_http_port = self._metrics_httpd.port
 
     async def shutdown(self) -> None:
+        if self._metrics_httpd is not None:
+            import asyncio
+
+            httpd = self._metrics_httpd
+            self._metrics_httpd = None
+            await asyncio.get_running_loop().run_in_executor(
+                None, httpd.shutdown_blocking)
         if self._group:
             await self._group.shutdown()
             self._group = None
@@ -568,6 +797,7 @@ class PlacementDriverServer:
         node = self.node
         if node is None or not node.is_leader():
             return self._not_leader(StoreHeartbeatResponse)
+        self.hb_rpcs += 1
         await self._maybe_seed()
         # only replicate *changes* — heartbeats repeat at 1s cadence and
         # must not grow the PD log when nothing moved
@@ -591,6 +821,7 @@ class PlacementDriverServer:
         node = self.node
         if node is None or not node.is_leader():
             return self._not_leader(RegionHeartbeatResponse)
+        self.hb_region_rpcs += 1
         await self._maybe_seed()
         instructions = await self._region_hb_core(
             Region.decode(req.region), req.leader, req.approximate_keys)
@@ -611,9 +842,26 @@ class PlacementDriverServer:
         node = self.node
         if node is None or not node.is_leader():
             return self._not_leader(StoreHeartbeatBatchResponse)
+        self.hb_batch_rpcs += 1
+        self.hb_delta_rows += len(req.deltas)
         await self._maybe_seed()
         zone = getattr(req, "zone", "")
         self._note_store_health(req.endpoint, getattr(req, "health", ""))
+        # fleet observability intake: heat rows ride their own trailing
+        # field (independent of deltas — heat changes at its own
+        # cadence), occupancy feeds the hibernation fraction
+        from tpuraft.util.heat import decode_heat_rows
+
+        heat_rows = decode_heat_rows(getattr(req, "heat", b""))
+        self.hb_heat_rows += len(heat_rows)
+        for rid, w, r, bi, bo in heat_rows:
+            self.stats.record_heat(rid, w, r, bi, bo)
+        replicas = getattr(req, "replicas", 0)
+        if replicas:
+            self._store_occupancy[req.endpoint] = (
+                replicas, getattr(req, "replicas_quiescent", 0))
+        else:
+            self._store_occupancy.pop(req.endpoint, None)
         cur = self.fsm.stores.get(req.endpoint)
         if cur is None or cur.store_id != req.store_id \
                 or (zone and cur.zone != zone):
@@ -697,6 +945,7 @@ class PlacementDriverServer:
             # the leader-local cooldown.  Never allocate a duplicate.
             if self.stats.should_split(region.id):
                 self.stats.mark_split_issued(region.id)
+                self.splits_ordered += 1
                 instructions.append(Instruction(
                     kind=Instruction.KIND_SPLIT, region_id=region.id,
                     new_region_id=pending_child))
@@ -704,6 +953,7 @@ class PlacementDriverServer:
             new_id = await self._apply(_cmd(
                 _CMD_SPLIT_ISSUED, struct.pack("<q", region.id)))
             self.stats.mark_split_issued(region.id)
+            self.splits_ordered += 1
             instructions.append(Instruction(
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
                 new_region_id=new_id))
@@ -724,10 +974,146 @@ class PlacementDriverServer:
                 zones=zones, zone_counts=zone_counts,
                 health=self._store_health)
             if target is not None:
+                self.transfers_ordered += 1
                 instructions.append(Instruction(
                     kind=Instruction.KIND_TRANSFER_LEADER,
                     region_id=region.id, target_peer=target))
         return instructions
+
+    # -- fleet observability: cluster view + metrics exposition --------------
+
+    def _build_cluster_view(self, top_k: int = 8) -> dict:
+        """Fold everything the PD leader knows into one dict: per-store
+        roster (zone, health, leader count, occupancy), per-zone access
+        rates, top-K hot/cold regions, the sick-store roster and the
+        fleet hibernation fraction.  Leader-local like ClusterStats —
+        rebuilt from heartbeats after a failover."""
+        top_k = max(1, min(top_k or 8, 64))
+        self.stats.maybe_sweep()
+        leaders_per_ep: dict[str, int] = {}
+        for leader in self.fsm.region_leaders.values():
+            ep = _peer_endpoint(leader)
+            leaders_per_ep[ep] = leaders_per_ep.get(ep, 0) + 1
+        stores = []
+        for rec in self.fsm.stores.values():
+            occ = self._store_occupancy.get(rec.endpoint)
+            stores.append({
+                "endpoint": rec.endpoint,
+                "zone": rec.zone,
+                "health": self._store_health.get(rec.endpoint, ""),
+                "leaders": leaders_per_ep.get(rec.endpoint, 0),
+                "replicas": occ[0] if occ else 0,
+                "replicas_quiescent": occ[1] if occ else 0,
+            })
+        # per-zone rates: each led region's heat lands on its leader's
+        # zone ("" = unlabeled stores)
+        zones = self._store_zones()
+        zone_rates: dict[str, dict] = {}
+        for rid, leader in self.fsm.region_leaders.items():
+            ent = self.stats.region_stats(rid)
+            if ent.writes_s == 0.0 and ent.reads_s == 0.0:
+                continue
+            z = zones.get(_peer_endpoint(leader), "")
+            zr = zone_rates.setdefault(z, {"writes_s": 0.0, "reads_s": 0.0})
+            zr["writes_s"] += ent.writes_s
+            zr["reads_s"] += ent.reads_s
+        zone_rates = {z: {k: round(v, 2) for k, v in zr.items()}
+                      for z, zr in zone_rates.items()}
+
+        def _region_row(rid: int, ent) -> dict:
+            return {
+                "region": rid,
+                "leader": self.fsm.region_leaders.get(rid, ""),
+                "score": round(ent.score, 2),
+                "writes_s": round(ent.writes_s, 2),
+                "reads_s": round(ent.reads_s, 2),
+                "bytes_in_s": round(ent.bytes_in_s, 1),
+                "bytes_out_s": round(ent.bytes_out_s, 1),
+                "keys": ent.keys,
+            }
+
+        replicas = sum(o[0] for o in self._store_occupancy.values())
+        quiescent = sum(o[1] for o in self._store_occupancy.values())
+        return {
+            "term": self.node.current_term if self.node else 0,
+            "stores": stores,
+            "regions": len(self.fsm.regions),
+            "zone_rates": zone_rates,
+            "hot": [_region_row(rid, ent)
+                    for rid, ent in self.stats.top_hot(top_k)],
+            "cold": [_region_row(rid, ent)
+                     for rid, ent in self.stats.top_cold(top_k)],
+            "hot_flagged": sorted(self.stats.hot_regions()),
+            "sick_stores": sorted(
+                ep for ep, lvl in self._store_health.items()
+                if lvl == "sick"),
+            "hibernation": {
+                "replicas": replicas,
+                "quiescent": quiescent,
+                "fraction": round(quiescent / replicas, 4)
+                if replicas else 0.0,
+            },
+        }
+
+    async def _cluster_describe(self, req) -> "object":
+        import json
+
+        from tpuraft.rheakv.pd_messages import ClusterDescribeResponse
+
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(ClusterDescribeResponse)
+        self.cluster_describes += 1
+        view = self._build_cluster_view(getattr(req, "top_k", 8))
+        return ClusterDescribeResponse(view_json=json.dumps(view))
+
+    def metrics_text(self) -> str:
+        """PD-side Prometheus text: heartbeat/instruction counters plus
+        fleet gauges (stores, regions, sick stores, hot regions,
+        hibernation).  Served by the ``pd_describe_metrics`` RPC and
+        the optional HTTP listener; reads are plain ints/floats
+        (best-effort consistency from the exposition thread)."""
+        from tpuraft.util.metrics import prometheus_text
+
+        counters = {
+            "pd_hb_rpcs": self.hb_rpcs,
+            "pd_hb_region_rpcs": self.hb_region_rpcs,
+            "pd_hb_batch_rpcs": self.hb_batch_rpcs,
+            "pd_hb_delta_rows": self.hb_delta_rows,
+            "pd_hb_heat_rows": self.hb_heat_rows,
+            "pd_splits_ordered": self.splits_ordered,
+            "pd_transfers_ordered": self.transfers_ordered,
+            "pd_cluster_describes": self.cluster_describes,
+            "pd_hot_region_events": self.stats.hot_events,
+        }
+        # C-atomic list() snapshots: this render runs on the metrics
+        # HTTP daemon thread while heartbeats mutate these dicts on the
+        # event loop — a bytecode-level genexpr over the live .values()
+        # view can raise "dictionary changed size during iteration"
+        # (the store side fixed this class with counters_snapshot())
+        occ = list(self._store_occupancy.values())
+        health = list(self._store_health.values())
+        replicas = sum(o[0] for o in occ)
+        quiescent = sum(o[1] for o in occ)
+        node = self.node
+        gauges = {
+            "pd_is_leader": int(bool(node and node.is_leader())),
+            "pd_stores": len(self.fsm.stores),
+            "pd_regions": len(self.fsm.regions),
+            "pd_sick_stores": sum(1 for lvl in health if lvl == "sick"),
+            "pd_hot_regions": self.stats.hot_count(),
+            "pd_replicas": replicas,
+            "pd_replicas_quiescent": quiescent,
+            "pd_hibernation_fraction":
+                round(quiescent / replicas, 4) if replicas else 0.0,
+        }
+        return prometheus_text(counters, gauges,
+                               labels={"pd": str(self.server_id)})
+
+    async def _describe_metrics(self, req) -> "object":
+        from tpuraft.rpc.cli_messages import DescribeMetricsResponse
+
+        return DescribeMetricsResponse(text=self.metrics_text())
 
     async def _report_split(self, req: ReportSplitRequest
                             ) -> ReportSplitResponse:

@@ -48,6 +48,11 @@ class RaftRawKVStore:
         self._apply_batch = max(1, apply_batch)
         self._pending: list[tuple[bytes, asyncio.Future, int]] = []
         self._drainer: Optional[asyncio.Task] = None
+        # propose-plane observability (fleet metrics): drain rounds and
+        # the entries they coalesced — proposed_ops/propose_drains is
+        # the live write-amortization factor (ROADMAP item 1's number)
+        self.propose_drains = 0
+        self.proposed_ops = 0
         # trace-plane process identity for the propose-stage span
         self._proc = store_proc(node.server_id)
 
@@ -131,6 +136,8 @@ class RaftRawKVStore:
         while self._pending:
             batch = self._pending[:self._apply_batch]
             del self._pending[:len(batch)]
+            self.propose_drains += 1
+            self.proposed_ops += len(batch)
             tasks = [Task(data=blob, done=KVClosure(fut), trace_id=tid)
                      for blob, fut, tid in batch]
             try:

@@ -88,7 +88,9 @@ class KVStoreStateMachine(StateMachine):
     async def on_apply(self, it: Iterator) -> None:
         run_rows: list = []
         run_dones: list = []   # (done, closure) per coalesced entry
+        applied_ops = 0        # heat telemetry: replication-side rate
         while it.valid():
+            applied_ops += 1
             op = KVOperation.decode(it.data())
             done = it.done()
             closure = done if isinstance(done, KVClosure) else None
@@ -113,6 +115,13 @@ class KVStoreStateMachine(StateMachine):
             it.next()
         if run_dones:
             self._flush_run(run_rows, run_dones)
+        # per-region heat (fleet observability): the applied lane is the
+        # replication-side load — followers see it for regions they
+        # never serve, giving the store a full local picture; the PD
+        # only ever reads the leaders' serving rates
+        heat = getattr(self.store_engine, "heat", None)
+        if heat is not None and applied_ops:
+            heat.note_applied(self.region.id, applied_ops)
 
     def _dispatch(self, op: KVOperation):
         s = self.store

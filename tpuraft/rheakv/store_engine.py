@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -135,6 +136,30 @@ class StoreEngineOptions:
     # and only READS counters — best-effort consistency by design.
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
+    # metrics_text() render cache: per-region aggregation is O(regions),
+    # so a tight scrape loop against a 1024-region store would burn the
+    # serving thread re-rendering identical text — scrapes within the
+    # TTL serve the cached render (stale-ok; the render's age is itself
+    # exposed as tpuraft_metrics_age_seconds, bounded by this TTL).
+    # 0 = render every call (tests / debugging).
+    metrics_cache_ttl_ms: int = 250
+    # -- per-region heat telemetry (fleet observability) ---------------------
+    # track decayed EWMAs of writes/s, reads/s and bytes in/out per
+    # region (util/heat.RegionHeatTracker), fed O(1) from the KV
+    # serving paths and FSM apply, reported to the PD on the delta-
+    # batched heartbeat (noise-gated) — the signal ROADMAP item 2's
+    # split/merge/move policy consumes.  False = no tracker at all
+    # (the bench-gate A/B knob).
+    heat_tracking: bool = True
+    # EWMA half-life: how fast a region's rates chase the live load /
+    # decay when it goes idle.  ~10 heartbeat intervals by default.
+    heat_half_life_s: float = 10.0
+    # steady-heat keepalive: a led region whose standing rate hasn't
+    # been reported for this long is re-reported even though the noise
+    # gate sees no movement — the PD expires rates not refreshed
+    # within ClusterStatsManager.heat_stale_s (30s), so this must stay
+    # WELL below that or a steadily-hot region vanishes from the view
+    heat_refresh_s: float = 10.0
 
 
 class _GroupFence:
@@ -444,6 +469,15 @@ class StoreEngine:
         self.transport = transport
         self.node_manager = NodeManager(rpc_server)
         CliProcessors(self.node_manager)
+        # per-region heat telemetry: ONE tracker per store, fed from
+        # the KV serving paths (kv_processor binds it at construction)
+        # + FSM apply, folded and reported on the PD heartbeat cadence
+        self.heat = None
+        if opts.heat_tracking:
+            from tpuraft.util.heat import RegionHeatTracker
+
+            self.heat = RegionHeatTracker(
+                half_life_s=opts.heat_half_life_s)
         self.kv_processor = KVCommandProcessor(self)
         # store-wide SAFE read-confirmation amortizer (attached to every
         # region node's ReadOnlyService by RegionEngine.start)
@@ -494,35 +528,56 @@ class StoreEngine:
         self._pd_reported: dict[int, tuple] = {}
         self._pd_dirty: set[int] = set()
         self._pd_need_full = True
-        # does the PD client's store_heartbeat_batch accept health=?
-        # Probed from the signature (not by catching TypeError, which
-        # would also swallow bugs inside a real implementation): a
-        # pre-health subclass override reports without health — the
-        # alternative is the retry loop eating its TypeError forever
-        # and silently starving the PD of heartbeats.
+        # does the PD client's store_heartbeat_batch accept health= /
+        # heat=?  Probed from the signature (not by catching TypeError,
+        # which would also swallow bugs inside a real implementation):
+        # a pre-health/pre-heat subclass override is reported to
+        # without the kwargs it predates — the alternative is the
+        # retry loop eating its TypeError forever and silently
+        # starving the PD of heartbeats.
         self._pd_health_kwarg = True
+        self._pd_heat_kwarg = True
         if pd_client is not None:
             import inspect
 
             try:
                 params = inspect.signature(
                     pd_client.store_heartbeat_batch).parameters
-                self._pd_health_kwarg = "health" in params or any(
-                    p.kind == p.VAR_KEYWORD for p in params.values())
+                has_var_kw = any(p.kind == p.VAR_KEYWORD
+                                 for p in params.values())
+                self._pd_health_kwarg = "health" in params or has_var_kw
+                self._pd_heat_kwarg = "heat" in params or has_var_kw
             except (TypeError, ValueError):
                 pass  # unintrospectable callable: assume current API
         self.pd_batches_sent = 0     # observability (bench counters)
         self.pd_deltas_sent = 0
         self.pd_full_syncs = 0
         self.pd_hb_failures = 0
+        self.pd_heat_rows_sent = 0
+        if self.heat is not None:
+            from tpuraft.util import describer
+
+            describer.register(self.heat)
+        # region -> (last-reported heat score, reported-at monotonic) —
+        # the noise gate's memory (mirrors _pd_reported for the keys/
+        # epoch delta plane) plus the steady-heat keepalive's clock
+        self._pd_heat_reported: dict[int, tuple[float, float]] = {}
         # live metrics exposition: the describe_metrics admin RPC makes
         # a running fleet scrapeable over the wire (no signals), and the
         # optional HTTP listener serves the same text to Prometheus
         self.rpc_server.register("cli_describe_metrics",
                                  self._handle_describe_metrics)
         self._metrics_httpd = None
-        self._metrics_thread = None
         self.metrics_http_port: Optional[int] = None
+        # metrics_text render cache (satellite: a tight scrape loop at
+        # region density must not burn the serving thread re-rendering):
+        # (body, rendered_at_monotonic); the HTTP daemon thread and the
+        # loop-side RPC handler both serve through it
+        self._metrics_cache_lock = threading.Lock()
+        self._metrics_cache: tuple[Optional[str], float] = \
+            (None, 0.0)  # guarded-by: _metrics_cache_lock
+        self.metrics_renders = 0       # actual renders (cache misses)
+        self.metrics_cache_hits = 0    # scrapes served from the cache
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -582,8 +637,11 @@ class StoreEngine:
             # serve_forever exits on shutdown(); it blocks up to the
             # poll interval, so hop off the event loop for it
             await asyncio.get_running_loop().run_in_executor(
-                None, httpd.shutdown)
-            httpd.server_close()
+                None, httpd.shutdown_blocking)
+        if self.heat is not None:
+            from tpuraft.util import describer
+
+            describer.unregister(self.heat)
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             self._heartbeat_task = None
@@ -741,9 +799,33 @@ class StoreEngine:
             "pd_deltas_sent": self.pd_deltas_sent,
             "pd_full_syncs": self.pd_full_syncs,
             "pd_hb_failures": self.pd_hb_failures,
+            "pd_heat_rows_sent": self.pd_heat_rows_sent,
             "evacuations": self.evacuations,
             "evacuation_rounds": self.evacuation_rounds,
+            "metrics_renders": self.metrics_renders,
+            "metrics_cache_hits": self.metrics_cache_hits,
         }
+        if self.heat is not None:
+            counters.update(self.heat.counters())
+        # per-region O(regions) aggregation (the pass metrics_text's
+        # TTL cache bounds): apply/propose plane totals across every
+        # hosted region — entries-per-batch amortization, live
+        apply_batches = applied_entries = 0
+        propose_drains = proposed_ops = 0
+        for eng in list(self._regions.values()):
+            node = eng.node
+            if node is not None and node.fsm_caller is not None:
+                apply_batches += node.fsm_caller.apply_batches
+                applied_entries += node.fsm_caller.applied_entries
+            if eng.raft_store is not None:
+                propose_drains += eng.raft_store.propose_drains
+                proposed_ops += eng.raft_store.proposed_ops
+        counters.update({
+            "fsm_apply_batches": apply_batches,
+            "fsm_applied_entries": applied_entries,
+            "propose_drains": propose_drains,
+            "proposed_ops": proposed_ops,
+        })
         if self.read_batcher is not None:
             counters.update(self.read_batcher.counters())
         counters.update(self.node_manager.heartbeat_hub.counters())
@@ -772,11 +854,22 @@ class StoreEngine:
         }
         if self.health is not None:
             gauges.update(self.health.counters())
+        if self.heat is not None:
+            gauges.update(self.heat.gauges())
+        if self.multi_raft_engine is not None:
+            # tick-plane occupancy lanes ([G] vectorized reductions —
+            # no per-group Python) + tick counters
+            eng = self.multi_raft_engine
+            counters["engine_ticks"] = eng.ticks
+            counters["engine_commit_advances"] = eng.commit_advances
+            gauges.update({f"engine_{k}": v
+                           for k, v in eng.lane_stats().items()})
         return counters, gauges
 
-    def metrics_text(self) -> str:
-        """Prometheus text exposition of :meth:`metrics_counters` plus
-        the store registry's histograms (when KV metrics are on)."""
+    def _render_metrics_text(self) -> str:
+        """Uncached Prometheus render of :meth:`metrics_counters` plus
+        the store registry's histograms (when KV metrics are on) and
+        the engine tick-plane histograms (when engine-backed)."""
         counters, gauges = self.metrics_counters()
         hists: dict = {}
         if self.metrics.enabled:
@@ -786,8 +879,35 @@ class StoreEngine:
             gauges.update({f"reg_{k}": v
                            for k, v in snap["gauges"].items()})
             hists = snap["histograms"]
+        if self.multi_raft_engine is not None:
+            hists.update(self.multi_raft_engine.tick_histograms())
         return prometheus_text(counters, gauges, hists,
                                labels={"store": str(self.server_id)})
+
+    def metrics_text(self) -> str:
+        """Cached Prometheus text exposition.
+
+        The per-region aggregation in :meth:`metrics_counters` is
+        O(regions); at 1024 regions a tight scrape loop re-rendering
+        per GET burns the serving thread.  Renders within
+        ``metrics_cache_ttl_ms`` serve the cached body (stale-ok), and
+        every response carries ``tpuraft_metrics_age_seconds`` — the
+        staleness is visible and bounded by the TTL."""
+        ttl = max(0.0, self.opts.metrics_cache_ttl_ms / 1000.0)
+        with self._metrics_cache_lock:
+            now = time.monotonic()
+            body, t = self._metrics_cache
+            if body is None or now - t >= ttl:
+                body = self._render_metrics_text()
+                t = now
+                self._metrics_cache = (body, t)
+                self.metrics_renders += 1
+            else:
+                self.metrics_cache_hits += 1
+            age = now - t
+        return body + prometheus_text(
+            gauges={"metrics_age_seconds": round(age, 4)},
+            labels={"store": str(self.server_id)})
 
     async def _handle_describe_metrics(self, req):
         """``cli_describe_metrics`` admin RPC: the wire-borne scrape
@@ -799,44 +919,15 @@ class StoreEngine:
 
     def _start_metrics_http(self) -> None:
         """Optional stdlib HTTP listener: GET /metrics on its own
-        daemon thread.  Port 0 binds ephemerally (tests read
+        daemon thread (util/metrics_http — shared with the PD's
+        listener).  Port 0 binds ephemerally (tests read
         ``metrics_http_port``)."""
-        import http.server
-        import threading
+        from tpuraft.util.metrics_http import MetricsHttpServer
 
-        se = self
-
-        class _Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 — stdlib handler contract
-                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
-                    self.send_error(404)
-                    return
-                try:
-                    body = se.metrics_text().encode()
-                except Exception as e:  # noqa: BLE001 — racing a split
-                    self.send_error(500, str(e)[:100])
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # quiet: scrapes aren't news
-                pass
-
-        httpd = http.server.ThreadingHTTPServer(
-            (self.opts.metrics_host, self.opts.metrics_port), _Handler)
-        httpd.daemon_threads = True
-        self._metrics_httpd = httpd
-        self.metrics_http_port = httpd.server_address[1]
-        self._metrics_thread = threading.Thread(
-            target=httpd.serve_forever,
-            name=f"metrics-http-{self.server_id}", daemon=True)
-        self._metrics_thread.start()
-        LOG.info("store %s serving /metrics on %s:%d", self.server_id,
-                 self.opts.metrics_host, self.metrics_http_port)
+        self._metrics_httpd = MetricsHttpServer(
+            self.opts.metrics_host, self.opts.metrics_port,
+            self.metrics_text, name=f"metrics-http-{self.server_id}")
+        self.metrics_http_port = self._metrics_httpd.port
 
     # -- PD heartbeats -------------------------------------------------------
 
@@ -918,17 +1009,24 @@ class StoreEngine:
         # pre-health PD client override is probed at construction and
         # reported to without the kwarg — see _pd_health_kwarg)
         health = self.health.score() if self.health is not None else ""
+        heat_rows = self._heat_report(full)
+        kwargs: dict = {}
         if self._pd_health_kwarg:
-            instructions, need_full = \
-                await self.pd_client.store_heartbeat_batch(
-                    meta, deltas, full=full, health=health)
-        else:
-            instructions, need_full = \
-                await self.pd_client.store_heartbeat_batch(
-                    meta, deltas, full=full)
+            kwargs["health"] = health
+        if self._pd_heat_kwarg:
+            kwargs["heat"] = [row for row, _score in heat_rows]
+            kwargs["occupancy"] = self.tick_occupancy()
+        instructions, need_full = \
+            await self.pd_client.store_heartbeat_batch(
+                meta, deltas, full=full, **kwargs)
         # only now (RPC succeeded) do the fingerprints count as reported
         self.pd_batches_sent += 1
         self.pd_deltas_sent += len(deltas)
+        if self._pd_heat_kwarg:
+            self.pd_heat_rows_sent += len(heat_rows)
+            now = time.monotonic()
+            self._pd_heat_reported.update(
+                {row[0]: (score, now) for row, score in heat_rows})
         if full:
             self.pd_full_syncs += 1
         self._pd_reported.update(fps)
@@ -950,6 +1048,45 @@ class StoreEngine:
                     and ins.target_peer:
                 await engine.transfer_leadership_to(
                     PeerId.parse(ins.target_peer))
+
+    def _heat_report(self, full: bool) -> list[tuple[tuple, float]]:
+        """Fold the heat window and pick the led regions whose heat
+        moved past the noise gate (util/heat.heat_changed), whose
+        standing rate is due its keepalive refresh (``heat_refresh_s``
+        — the PD expires silent rates after heat_stale_s, so steady
+        heat must re-report, just slowly), or every led region with
+        any heat when ``full`` (PD resync).  Returns
+        [((region_id, w, r, bi, bo), score), ...]; the scores land in
+        ``_pd_heat_reported`` only after the RPC succeeds."""
+        if self.heat is None:
+            return []
+        from tpuraft.util.heat import heat_changed
+
+        self.heat.fold()
+        now = time.monotonic()
+        rows: list[tuple[tuple, float]] = []
+        for rid in self.leader_region_ids():
+            h = self.heat.heat(rid)
+            score = h.score
+            last, last_t = self._pd_heat_reported.get(rid, (0.0, 0.0))
+            refresh = (score >= 0.5 and last_t > 0.0
+                       and now - last_t >= self.opts.heat_refresh_s)
+            if full and (score or last) or refresh \
+                    or heat_changed(score, last):
+                rows.append(((rid, h.writes_s, h.reads_s,
+                              h.bytes_in_s, h.bytes_out_s), score))
+        return rows
+
+    def tick_occupancy(self) -> tuple[int, int]:
+        """(replicas_hosted, replicas_quiescent) for the PD heartbeat's
+        hibernation fraction — one vectorized reduce over the engine's
+        [G] rows for engine-backed stores; (regions, 0) in timer mode
+        (host timers have no quiescence)."""
+        e = self.multi_raft_engine
+        if e is None:
+            return len(self._regions), 0
+        return (int(e.has_ctrl.sum()),
+                int((e.quiescent & e.has_ctrl).sum()))
 
     async def _start_region(self, region: Region) -> RegionEngine:
         engine = RegionEngine(region, self)
@@ -1132,6 +1269,12 @@ class StoreEngine:
         new_region.epoch.version = parent.epoch.version + 1
         parent.end_key = split_key
         parent.epoch.version += 1
+        if self.heat is not None:
+            # the parent's standing rates describe the PRE-split
+            # keyspace — half that load now lands on the child.  Reset
+            # and let both halves re-accumulate their true rates (the
+            # PD-side mirror: mark_split_issued resets keys)
+            self.heat.drop(region_id)
         self._pending_splits.add(new_region_id)
 
         async def boot():
