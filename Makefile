@@ -9,7 +9,9 @@
 #   make test       -> Python suite only
 #   make lint       -> graftcheck static analysis over tpuraft/ (lock
 #                      discipline, lock-order cycles, wire-schema drift,
-#                      blocking-call + future-leak lints); <10s
+#                      blocking-call + future-leak lints, interprocedural
+#                      transitive-blocking + loop-affinity, [G] lane-site
+#                      coverage, host-sync + donated-read); <10s
 #   make san        -> sanitizer drivers only
 #   make chaos-smoke-> storage-plane crash-consistency harness + short
 #                      power-loss soak (<60s)
@@ -36,12 +38,16 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # graftcheck: the Python plane's analog of `make san` (PAPER.md §6 race
-# detection) — five AST checkers for the defect classes the chaos
+# detection) — eight AST checkers for the defect classes the chaos
 # harness kept catching dynamically (PR 2 storage lock races + wedged
-# waiters, PR 3 wire drift).  Intentional wire/lock-order changes:
-# review, then `python -m tpuraft.analysis --record` and commit the
-# lockfiles (docs/operations.md "Static analysis & wire-format
-# changes").
+# waiters, PR 3 wire drift, PR 10's hand-wired lane lifecycle sites).
+# v2 adds a whole-program pass: call-graph summary propagation makes
+# the blocking/loop-confined/holds rules transitive, infers executor
+# contexts, and the device-plane lint covers [G] lane lifecycle sites,
+# host syncs in jitted bodies, and donated-buffer reads.  Intentional
+# wire/lock-order changes: review, then `python -m tpuraft.analysis
+# --record` and commit the lockfiles (docs/operations.md "Static
+# analysis & wire-format changes").  `--json` for CI annotation.
 lint:
 	$(PY) -m tpuraft.analysis
 

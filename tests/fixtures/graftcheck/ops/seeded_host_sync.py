@@ -1,0 +1,54 @@
+"""graftcheck fixture: host-sync violations inside jitted bodies.
+
+NOT imported by anything — parsed by tests/test_analysis.py.  Both jit
+root shapes appear (module-level ``jax.jit(fn, ...)`` assignment and a
+``functools.partial(jax.jit)`` decorator) plus a helper reached only
+THROUGH a jit root, proving the jit-body set closes over the call
+graph.  ``ok_host_probe`` uses every banned construct but is never
+reachable from a root — host-side probe code stays legal.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _masked(x: jnp.ndarray, mask: jnp.ndarray):
+    return jnp.where(mask, x, 0)
+
+
+def bad_kernel(state: jnp.ndarray, mask: jnp.ndarray, flavor: str = "x"):
+    total = _masked(state, mask).sum()
+    peak = total.item()             # VIOLATION: .item() host sync
+    host = np.asarray(state)        # VIOLATION: np.asarray on traced
+    n = int(state[0])               # VIOLATION: int() of traced value
+    if state.sum() > 0:             # VIOLATION: data-dependent `if`
+        total = total + 1
+    if flavor == "x":               # clean: static str argument
+        total = total * 2
+    while mask.any():               # VIOLATION: data-dependent `while`
+        break
+    return total, peak, host, n
+
+
+bad_kernel_jit = jax.jit(bad_kernel, static_argnames=("flavor",))
+
+
+def helper_sync(v: jnp.ndarray):
+    return float(v)                 # VIOLATION: reached through a root
+
+
+@functools.partial(jax.jit)
+def bad_via_helper(v: jnp.ndarray):
+    return helper_sync(v)
+
+
+def ok_host_probe(v):
+    # not reachable from any jit root: .item()/np/int branching is the
+    # NORMAL host idiom out here
+    arr = np.asarray(v)
+    if arr.sum() > 0:
+        return int(arr[0]), arr.item() if arr.size == 1 else None
+    return 0, None

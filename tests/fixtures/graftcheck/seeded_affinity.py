@@ -1,0 +1,74 @@
+"""graftcheck fixture: loop-confined state touched from an inferred
+executor context (+ transitive thread spawns).
+
+NOT imported by anything — parsed by tests/test_analysis.py.  The
+violations mirror the PR 11/12 in-thread flush-timing hazard: code
+handed to run_in_executor / Thread(target=) / executor.submit writing
+a loop-confined class's unguarded attributes.
+"""
+
+import threading
+
+
+def noop():
+    pass
+
+
+def spawn_worker():
+    t = threading.Thread(target=noop)
+    t.start()
+    return t
+
+
+# graftcheck: loop-confined — fixture: caches and counters live on the
+# owning loop; only the locked probe counter crosses threads
+class ConfinedCache:
+    def __init__(self, loop, lock):
+        self._loop = loop
+        self._entries = {}
+        self._stale = False
+        self._via_submit = 0
+        self._probe_lock = lock
+        self._flush_count = 0   # guarded-by: _probe_lock
+
+    def kick(self):
+        self._loop.run_in_executor(None, self._bad_refresh)
+        self._loop.run_in_executor(None, self._ok_probe)
+
+    def _bad_refresh(self):
+        self._entries = {}          # VIOLATION: off-loop unguarded write
+
+    def _ok_probe(self):
+        with self._probe_lock:
+            self._flush_count += 1  # clean: locked state is the channel
+
+    def kick_indirect(self):
+        self._loop.run_in_executor(None, self._outer)
+
+    def _outer(self):
+        self._inner()               # off-loop propagates to callees
+
+    def _inner(self):
+        self._stale = True          # VIOLATION: transitive off-loop write
+
+    def kick_submit(self, executor):
+        executor.submit(self._bad_submit_write)
+
+    def _bad_submit_write(self):
+        self._via_submit = 1        # VIOLATION: submit() target write
+
+    def bad_spawns_via_helper(self):
+        spawn_worker()  # VIOLATION: transitive thread spawn from confined
+
+    def on_loop_write(self):
+        self._entries = {"k": 1}    # clean: written on the loop itself
+
+
+class UnconfinedWorkerOwner:
+    """No loop-confined marker: off-loop writes are its own business."""
+
+    def go(self, loop):
+        loop.run_in_executor(None, self._work)
+
+    def _work(self):
+        self.done = True            # clean: class is not loop-confined

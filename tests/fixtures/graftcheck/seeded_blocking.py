@@ -53,8 +53,13 @@ async def ok_lambda_off_loop():
     loop = asyncio.get_running_loop()
     with _lock:
         # the sanctioned off-loop pattern: the lambda body runs on an
-        # executor thread, NOT under the lock — must stay clean
-        await loop.run_in_executor(None, lambda: time.sleep(0.1))
+        # executor thread, NOT under the lock — must stay clean.  The
+        # await itself sits OUTSIDE the with: holding a sync lock
+        # across a suspension point is its own (transitive-blocking)
+        # finding — graftcheck v2 flagged the original shape of this
+        # very fixture for exactly that convoy hazard
+        fut = loop.run_in_executor(None, lambda: time.sleep(0.1))
+    await fut
 
 
 def bad_socket_under_lock(server_sock):

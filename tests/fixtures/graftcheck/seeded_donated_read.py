@@ -1,0 +1,31 @@
+"""graftcheck fixture: donated-buffer reads after a donating jit call.
+
+NOT imported by anything — parsed by tests/test_analysis.py.  Mirrors
+the ``raft_tick_jit = jax.jit(raft_tick, donate_argnums=(0,))`` shape:
+the state buffer handed to the jitted callable is invalidated by
+donation, so only the returned arrays are legal afterwards.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(state: jnp.ndarray, now: jnp.ndarray):
+    return state + now
+
+
+step_donating = jax.jit(step, donate_argnums=(0,))
+
+
+def bad_read_after_donate(state, now):
+    out = step_donating(state, now)
+    return out, state.sum()         # VIOLATION: donated buffer read
+
+
+def ok_rebind(state, now):
+    state = step_donating(state, now)
+    return state.sum()              # clean: rebound to the fresh output
+
+
+def ok_no_later_read(state, now):
+    return step_donating(state, now)

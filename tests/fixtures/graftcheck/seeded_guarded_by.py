@@ -99,3 +99,17 @@ class Confined:
 class ConfinedMultiLineAnnotation:
     def bad_sleep_multiline(self):
         time.sleep(0.1)                 # VIOLATION: loop-confined
+
+
+def _decor(cls):
+    return cls
+
+
+# graftcheck: loop-confined — the annotation above a DECORATED class
+# must anchor at the decorator line (review catch: the block-above walk
+# from the class line stops at @_decor and killed the marker — the
+# in-tree @dataclass RegionHeat annotation was dead on arrival)
+@_decor
+class ConfinedDecorated:
+    def bad_sleep_decorated(self):
+        time.sleep(0.1)                 # VIOLATION: loop-confined

@@ -1,0 +1,69 @@
+"""graftcheck fixture: [G] lane lifecycle-site coverage violations.
+
+NOT imported by anything — parsed by tests/test_analysis.py.  A
+miniature MultiRaftEngine whose lanes exercise every lane-coverage
+shape: fully covered, missing one site, reasoned waiver, reasonless
+waiver, unknown waiver token, and a [P]-shaped row that is NOT a lane.
+"""
+
+import numpy as np
+
+NEG = -(2 ** 30)
+
+
+class MultiRaftEngine:
+    def __init__(self, opts):
+        g, p = opts.max_groups, opts.max_peers
+        self.G, self.P = g, p
+        self.ok_lane = np.zeros(g, np.int64)
+        self.bad_free_lane = np.zeros((g, p), np.int64)  # VIOLATION: release
+        self.bad_conf_lane = np.full(g, NEG, np.int64)   # VIOLATION: set_conf
+        # lane: no-conf no-shift — fixture: registration-owned duration row
+        self.waived_lane = np.full(g, 7, np.int64)
+        # lane: no-shift
+        self.bad_waiver_lane = np.zeros(g, np.int64)  # VIOLATION: no reason
+        # lane: no-grift — fixture: typo'd site token
+        self.bad_token_lane = np.zeros(g, np.int64)   # VIOLATION: bad site
+        self.not_a_lane = np.zeros(p, np.int64)       # [P] row: not a lane
+        self._free = list(range(g))
+
+    def _grow(self):
+        old_g = self.G
+
+        def pad(a, fill=0):
+            extra = np.full((old_g,) + a.shape[1:], fill, a.dtype)
+            return np.concatenate([a, extra])
+
+        self.ok_lane = pad(self.ok_lane)
+        self.bad_free_lane = pad(self.bad_free_lane)
+        self.bad_conf_lane = pad(self.bad_conf_lane, NEG)
+        self.waived_lane = pad(self.waived_lane, 7)
+        self.bad_waiver_lane = pad(self.bad_waiver_lane)
+        self.bad_token_lane = pad(self.bad_token_lane)
+        self.G = old_g * 2
+
+    def release(self, slot):
+        self.ok_lane[slot] = 0
+        self.bad_conf_lane[slot] = NEG
+        self.waived_lane[slot] = 7
+        self.bad_token_lane[slot] = 0
+        self._reset_extra(slot)
+
+    def _reset_extra(self, slot):
+        # one level of intra-class call resolution covers this write
+        self.bad_waiver_lane[slot] = 0
+
+    def set_conf(self, slot, conf):
+        self.ok_lane[slot] = 0
+        self.bad_free_lane[slot, :] = 0
+        self.bad_waiver_lane[slot] = 0
+        self.bad_token_lane[slot] = 0
+
+    def _maybe_time_rebase(self, now):
+        shift = now
+        self.ok_lane -= shift
+        self.bad_free_lane -= shift
+        self.bad_conf_lane -= shift
+        np.maximum(self.bad_waiver_lane - shift, NEG,
+                   out=self.bad_waiver_lane)
+        self.bad_token_lane -= shift

@@ -1,0 +1,66 @@
+"""graftcheck fixture: seeded transitive-blocking violations.
+
+NOT imported by anything — parsed by tests/test_analysis.py.  Every
+helper below blocks only CONTEXT-FREE (the intra-procedural lint stays
+silent); the violations are the call sites that reach them from a
+forbidden context through one or two resolution hops.
+"""
+
+import threading
+import time
+
+from tests.fixtures.graftcheck.seeded_transitive_dep import remote_pause
+
+
+def sleeper():
+    time.sleep(0.01)            # context-free: direct lint stays quiet
+
+
+def hop():
+    sleeper()                   # one more hop
+
+
+def untimed_wait(fut):
+    return fut.result()         # context-free untimed wait
+
+
+async def bad_coro_transitive():
+    hop()           # VIOLATION: coroutine -> hop -> sleeper -> time.sleep
+
+
+async def bad_coro_cross_module():
+    remote_pause()  # VIOLATION: the sink lives in seeded_transitive_dep
+
+
+async def ok_result_via_helper(fut):
+    # the soft coroutine contract carries over transitively: an untimed
+    # .result() reached from a coroutine is the done-task idiom, not a
+    # finding (sleep/socket only) — mirrors the direct lint
+    return untimed_wait(fut)
+
+
+# graftcheck: allow(transitive-blocking) — fixture: waiver honored
+async def waived_coro_transitive():
+    hop()
+
+
+class Locky:
+    def __init__(self, lock):
+        self._lock = lock
+
+    def bad_under_lock(self):
+        with self._lock:
+            hop()               # VIOLATION: transitively sleeps under lock
+
+    def ok_outside_lock(self):
+        hop()                   # clean: plain sync context is free to block
+
+
+class SeededStateMachine:
+    def on_apply(self, fut):
+        return untimed_wait(fut)   # VIOLATION: FSM path -> untimed result
+
+
+async def bad_await_under_sync_lock(box, other):
+    with box.state_lock:
+        await other.flush()     # VIOLATION: await while holding sync lock

@@ -1,0 +1,9 @@
+"""graftcheck fixture: the cross-module blocking sink for
+seeded_transitive.py — proves summary propagation follows an absolute
+import whose target module is in the analyzed set."""
+
+import time
+
+
+def remote_pause():
+    time.sleep(0.05)        # the sink, one module away

@@ -682,6 +682,53 @@ def test_density_floor_math_and_slot_application():
     assert eng2._density_floor_ms() == 0
 
 
+def test_ctrl_count_survives_double_unregister_and_bare_boxes():
+    """graftcheck-v2 burn regression: a controlled node's shutdown
+    reaches unregister_ctrl TWICE (EngineControl.shutdown, then
+    ballot_box.close -> release), and a bare commit-plane box releases
+    without ever registering.  The unconditional decrement drifted
+    _n_ctrls negative under churn, silencing the density-floor
+    recompute trigger while real controlled density kept growing."""
+    from tpuraft.entity import PeerId
+
+    eng = MultiRaftEngine(TickOptions(max_groups=8, max_peers=3,
+                                      backend="numpy"))
+    # bare box (drive_protocol off / commit plane only): release must
+    # not decrement a registration that never happened
+    bare = eng.ballot_box_factory()(lambda i: None)
+    bare.close()
+    assert eng._n_ctrls == 0
+
+    box = eng.ballot_box_factory()(lambda i: None)
+
+    class _StubCtrl:
+        slot = box.slot
+
+        def _adopt_eto(self, eff):
+            pass
+
+    eng.register_ctrl(_StubCtrl(), PeerId.parse("127.0.0.1:6000"),
+                      eto_ms=1000, hb_ms=100, lease_ms=900)
+    assert eng._n_ctrls == 1
+    eng.unregister_ctrl(box.slot)       # EngineControl.shutdown path
+    assert eng._n_ctrls == 0
+    box.close()                         # release path unregisters again
+    assert eng._n_ctrls == 0, \
+        "double unregister must not double-decrement"
+    # the floor trigger keeps firing for later registration waves
+    box2 = eng.ballot_box_factory()(lambda i: None)
+
+    class _StubCtrl2:
+        slot = box2.slot
+
+        def _adopt_eto(self, eff):
+            pass
+
+    eng.register_ctrl(_StubCtrl2(), PeerId.parse("127.0.0.1:6001"),
+                      eto_ms=1000, hb_ms=100, lease_ms=900)
+    assert eng._n_ctrls == 1
+
+
 async def test_density_floor_raises_live_cluster_timeouts():
     """End to end: groups registering into a dense engine must come up
     with RAISED effective timeouts (node options adopted, device rows

@@ -7,6 +7,9 @@ well under the ~10s lint budget (the jax import alone would triple it).
   python -m tpuraft.analysis                 # lint tpuraft/ (the gate)
   python -m tpuraft.analysis examples        # lint another tree
   python -m tpuraft.analysis --rule guarded-by
+  python -m tpuraft.analysis --json          # machine-readable findings
+                                             # (file/line/rule/message)
+                                             # for CI annotation
   python -m tpuraft.analysis --record        # re-record wire_schema.
                                              # lock.json + lock_order.json
                                              # after reviewing a change
@@ -15,6 +18,7 @@ well under the ~10s lint budget (the jax import alone would triple it).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -28,7 +32,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tpuraft.analysis",
         description="graftcheck: project-invariant static analysis "
                     "(guarded-by, lock-order, wire-schema, blocking-call, "
-                    "future-leak)")
+                    "future-leak, transitive-blocking, loop-affinity, "
+                    "lane-coverage, host-sync, donated-read)")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: tpuraft/)")
     ap.add_argument("--record", action="store_true",
@@ -36,6 +41,9 @@ def main(argv: list[str] | None = None) -> int:
                          "lock_order.json from the live tree, then verify")
     ap.add_argument("--rule", action="append", choices=sorted(RULES),
                     help="run only these rules (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array of {file, line, "
+                         "rule, message} on stdout (for CI annotation)")
     ap.add_argument("--quiet", action="store_true",
                     help="findings only, no summary line")
     args = ap.parse_args(argv)
@@ -45,8 +53,13 @@ def main(argv: list[str] | None = None) -> int:
     mods, findings = load_modules(roots)
     findings += run_checkers(mods, record=args.record,
                              rules=set(args.rule) if args.rule else None)
-    for f in findings:
-        print(f)
+    if args.as_json:
+        print(json.dumps(
+            [{"file": f.path, "line": f.line, "rule": f.rule,
+              "message": f.message} for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
     if not args.quiet:
         dt = time.monotonic() - t0
         verdict = "clean" if not findings else f"{len(findings)} finding(s)"

@@ -25,6 +25,11 @@ RULES = (
     "wire-schema",
     "blocking-call",
     "future-leak",
+    "transitive-blocking",
+    "loop-affinity",
+    "lane-coverage",
+    "host-sync",
+    "donated-read",
     "waiver",
 )
 
@@ -197,17 +202,43 @@ def run_checkers(mods: list[Module], record: bool = False,
     """Run every checker over the loaded modules.  ``record`` rewrites
     the committed lockfiles (wire_schema.lock.json, lock_order.json)
     from the live tree before verifying."""
-    from tpuraft.analysis import (blocking_calls, future_leaks, guarded_by,
+    from tpuraft.analysis import (blocking_calls, callgraph, concurrency,
+                                  future_leaks, guarded_by, lanes,
                                   lock_order, wire_schema)
+
+    def want(*ids: str) -> bool:
+        """Skip checkers whose rules are all filtered out — a targeted
+        `--rule guarded-by` run must not pay the whole-program index
+        (still filtered post-hoc below, since concurrency also emits
+        guarded-by findings)."""
+        return rules is None or bool(rules & set(ids))
 
     findings: list[Finding] = []
     for m in mods:
         findings.extend(m.check_waiver_reasons())
-    findings.extend(guarded_by.check(mods))
-    findings.extend(lock_order.check(mods, record=record))
-    findings.extend(wire_schema.check(mods, record=record))
-    findings.extend(blocking_calls.check(mods))
-    findings.extend(future_leaks.check(mods))
+    if want("guarded-by", "loop-confined"):
+        findings.extend(guarded_by.check(mods))
+    if record or want("lock-order"):
+        findings.extend(lock_order.check(mods, record=record))
+    if record or want("wire-schema"):
+        findings.extend(wire_schema.check(mods, record=record))
+    if want("blocking-call"):
+        findings.extend(blocking_calls.check(mods))
+    if want("future-leak"):
+        findings.extend(future_leaks.check(mods))
+    run_concurrency = want("transitive-blocking", "loop-affinity",
+                           "guarded-by")
+    run_lanes = want("lane-coverage", "host-sync", "donated-read")
+    if run_concurrency or run_lanes:
+        # the whole-program index (call graph + summaries) is built
+        # ONCE per run and shared by every interprocedural rule — the
+        # lint budget pays one extra AST walk per module, not one per
+        # checker
+        index = callgraph.ProjectIndex(mods)
+        if run_concurrency:
+            findings.extend(concurrency.check(mods, index))
+        if run_lanes:
+            findings.extend(lanes.check(mods, index))
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     # drop waived findings last: waivers apply uniformly to every rule
@@ -229,6 +260,16 @@ def _waived(mods: list[Module], f: Finding) -> bool:
 
 
 # ---- small AST helpers shared by checkers -----------------------------------
+
+
+def decl_lineno(node) -> int:
+    """The line a class/function ANNOTATION comment sits above: the
+    first decorator's line when decorators exist, else the def/class
+    line itself — ``comment_block_above(node.lineno)`` on a decorated
+    class stops at the decorator and silently kills the annotation."""
+    if getattr(node, "decorator_list", None):
+        return node.decorator_list[0].lineno
+    return node.lineno
 
 
 def attr_chain(node: ast.AST) -> str:

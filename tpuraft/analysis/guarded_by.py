@@ -44,7 +44,8 @@ import ast
 import re
 from dataclasses import dataclass
 
-from tpuraft.analysis.core import Finding, Module, attr_chain, iter_classes
+from tpuraft.analysis.core import (Finding, Module, attr_chain, decl_lineno,
+                                   iter_classes)
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)\s*(\(writes\))?")
 _HOLDS_RE = re.compile(r"#\s*graftcheck:\s*holds\((\w+)\)")
@@ -136,7 +137,8 @@ def _check_class(mod: Module, cls) -> list[Finding]:
     fields = _collect_fields(mod, cls)
     holds = _holds_locks(mod, cls, fields)
     loop_confined = bool(
-        _LOOP_CONFINED_RE.search(mod.comment_block_above(cls.node.lineno))
+        _LOOP_CONFINED_RE.search(
+            mod.comment_block_above(decl_lineno(cls.node)))
         or (cls.node.body and isinstance(cls.node.body[0], ast.Expr)
             and isinstance(cls.node.body[0].value, ast.Constant)
             and isinstance(cls.node.body[0].value.value, str)

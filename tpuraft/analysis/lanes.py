@@ -1,0 +1,588 @@
+"""Checkers 7+8: device-plane lane lint (graftcheck v2).
+
+The engine's premise is vectorizing per-group protocol state over the
+``[G]`` / ``[G, P]`` device plane — and every new lane pays a wiring
+tax at four engine lifecycle sites.  PR 10's ``tick_q_ack`` touched
+grow/``pad``, the ``release`` reset, ``set_conf`` invalidation and the
+time-shift path, and nothing but review memory catches a missed site
+until state silently corrupts on resize.  These rules mechanize that
+contract:
+
+``lane-coverage``
+    Every ``[G]``/``[G, P]`` lane — a ``self.X = np.zeros/full/ones/
+    empty(g, ...)`` assignment in ``MultiRaftEngine.__init__`` whose
+    leading dimension is the group-capacity local ``g`` — must be
+    WRITTEN at each of the four lifecycle sites:
+
+      grow   ``_grow``               (capacity doubling pads every lane)
+      free   ``release``             (slot reuse resets every lane)
+      conf   ``set_conf``            (conf-derived lanes re-map/invalidate)
+      shift  ``_maybe_time_rebase``  (time-valued lanes epoch-shift)
+
+    One level of intra-class call resolution applies (``release`` covers
+    ``has_ctrl`` through its ``self.unregister_ctrl(s)`` call).  A lane
+    that legitimately skips a site declares it ON ITS DECLARATION LINE:
+
+        self.role = np.full(g, ROLE_INACTIVE, np.int32) \\
+            # lane: no-conf no-shift — role is host-applied, not
+            # conf-derived; not time-valued
+
+    A waiver with no reason is itself a finding (the graftcheck
+    escape-hatch policy).  The same rule keeps the device dataclasses
+    honest: ``GroupState``/``TickOutputs`` field sets must match every
+    keyword construction of them (engine upload, mesh shardings, the
+    numpy twin) and ``_NpOutputs.__slots__`` must equal ``TickOutputs``
+    — the exact multi-file drift PR 10 hand-wired.
+
+``host-sync`` / ``donated-read``
+    Inside jitted bodies (functions reachable from a ``jax.jit`` root
+    or a ``pallas_call`` kernel through the project call graph), flag
+    host synchronization on traced values: ``.item()``, ``np.asarray/
+    np.array``, ``int()/float()/bool()`` of a traced parameter, and
+    data-dependent Python branching (``if``/``while`` on a traced
+    parameter — stage it through ``jnp.where`` or lift it to a static
+    argument).  A parameter is traced unless its annotation is scalar
+    (str/int/bool/float) or it appears in the root's
+    ``static_argnames``.  And a buffer passed at a donated position
+    (``donate_argnums``) of a jitted callable must not be read after
+    the call — donation invalidates it; rebinding the name to the
+    call's result re-arms it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from tpuraft.analysis.callgraph import ProjectIndex, _all_functions
+from tpuraft.analysis.core import Finding, Module, attr_chain
+
+RULE_LANE = "lane-coverage"
+RULE_SYNC = "host-sync"
+RULE_DONATED = "donated-read"
+
+ENGINE_CLASS = "MultiRaftEngine"
+SITES = (
+    ("grow", "_grow"),
+    ("free", "release"),
+    ("conf", "set_conf"),
+    ("shift", "_maybe_time_rebase"),
+)
+_SITE_NAMES = {s for s, _ in SITES}
+
+_LANE_RE = re.compile(r"#\s*lane:\s*((?:no-[a-z]+\s*)+)(?:[—–-]+\s*(\S.*))?")
+_NP_CTORS = {"np.zeros", "np.full", "np.ones", "np.empty",
+             "numpy.zeros", "numpy.full", "numpy.ones", "numpy.empty"}
+_STATE_CLASSES = ("GroupState", "TickOutputs")
+_NP_TWIN = "_NpOutputs"
+_SCALARISH = re.compile(r"\b(str|int|bool|float|bytes|None)\b")
+_ARRAYISH = re.compile(r"ndarray|Array|GroupState|TickParams|TickOutputs")
+
+
+def check(mods: list[Module], index: ProjectIndex) -> list[Finding]:
+    out: list[Finding] = []
+    out.extend(_check_lanes(mods))
+    out.extend(_check_state_parity(mods))
+    jit = _JitIndex(mods, index)
+    out.extend(_check_host_sync(index, jit))
+    out.extend(_check_donated_reads(mods, jit))
+    return out
+
+
+# ---- lane-site coverage -----------------------------------------------------
+
+
+def _check_lanes(mods: list[Module]) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == ENGINE_CLASS:
+                out.extend(_check_engine_class(mod, node))
+    return out
+
+
+def _check_engine_class(mod: Module, cls: ast.ClassDef) -> list[Finding]:
+    methods = {item.name: item for item in cls.body
+               if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    init = methods.get("__init__")
+    if init is None:
+        return []
+    lanes = _collect_lanes(mod, init)
+    if not lanes:
+        return []
+    out: list[Finding] = []
+    written = {}
+    for site, meth_name in SITES:
+        fn = methods.get(meth_name)
+        written[site] = (_written_attrs(methods, fn) if fn is not None
+                         else set())
+    for name, (line, waived, reason, bad_tokens) in sorted(lanes.items()):
+        for tok in bad_tokens:
+            out.append(Finding(
+                RULE_LANE, mod.rel, line,
+                f"lane '{name}': unknown waiver site 'no-{tok}' (known: "
+                + ", ".join(f"no-{s}" for s in _SITE_NAMES) + ")"))
+        if waived and not reason:
+            out.append(Finding(
+                RULE_LANE, mod.rel, line,
+                f"lane '{name}': waiver carries no justification — write "
+                f"'# lane: no-<site> — <reason>'"))
+        for site, meth_name in SITES:
+            if site in waived:
+                continue
+            if name not in written[site]:
+                out.append(Finding(
+                    RULE_LANE, mod.rel, line,
+                    f"[G] lane '{name}' (declared line {line}) is not "
+                    f"covered at the {site} site ({ENGINE_CLASS}."
+                    f"{meth_name}) — handle it there or waive with "
+                    f"'# lane: no-{site} — <reason>'"))
+    return out
+
+
+def _collect_lanes(mod: Module, init) -> dict:
+    """lane name -> (decl line, waived site set, reason, bad tokens)."""
+    lanes: dict = {}
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        if not _is_group_row_ctor(node.value):
+            continue
+        waived: set[str] = set()
+        bad: list[str] = []
+        reason = ""
+        m = _LANE_RE.search(mod.comment_block_above(node.lineno))
+        if m:
+            for tok in m.group(1).split():
+                site = tok[3:]
+                if site in _SITE_NAMES:
+                    waived.add(site)
+                else:
+                    bad.append(site)
+            reason = (m.group(2) or "").strip()
+        lanes[t.attr] = (node.lineno, waived, reason, bad)
+    return lanes
+
+
+def _is_group_row_ctor(value: ast.AST) -> bool:
+    """np.zeros/full/ones/empty with the group-capacity local ``g`` as
+    the leading dimension."""
+    if not isinstance(value, ast.Call) or not value.args:
+        return False
+    if attr_chain(value.func) not in _NP_CTORS:
+        return False
+    shape = value.args[0]
+    if isinstance(shape, ast.Tuple) and shape.elts:
+        shape = shape.elts[0]
+    return isinstance(shape, ast.Name) and shape.id == "g"
+
+
+def _written_attrs(methods: dict, fn, depth: int = 1) -> set[str]:
+    """self attributes written anywhere in ``fn``, with one level of
+    intra-class self-call resolution."""
+    written: set[str] = set()
+    calls: list[str] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _collect_target(t, written)
+        elif isinstance(node, ast.AugAssign):
+            _collect_target(node.target, written)
+        elif isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            for kw in node.keywords:
+                if kw.arg == "out":
+                    _self_attr_of(kw.value, written)
+            if chain.endswith("copyto") and node.args:
+                _self_attr_of(node.args[0], written)
+            if chain.startswith("self.") and chain.count(".") == 2 \
+                    and chain.endswith((".fill", ".clear")):
+                written.add(chain.split(".")[1])
+            if chain.startswith("self.") and chain.count(".") == 1:
+                calls.append(chain[5:])
+    if depth > 0:
+        for name in calls:
+            callee = methods.get(name)
+            if callee is not None and callee is not fn:
+                written |= _written_attrs(methods, callee, depth - 1)
+    return written
+
+
+def _collect_target(t: ast.AST, written: set[str]) -> None:
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _collect_target(e, written)
+        return
+    if isinstance(t, ast.Starred):
+        _collect_target(t.value, written)
+        return
+    if isinstance(t, ast.Subscript):
+        _self_attr_of(t.value, written)
+        return
+    _self_attr_of(t, written)
+
+
+def _self_attr_of(node: ast.AST, written: set[str]) -> None:
+    chain = attr_chain(node)
+    if chain.startswith("self.") and chain.count(".") == 1:
+        written.add(chain[5:])
+
+
+# ---- device dataclass parity ------------------------------------------------
+
+
+def _check_state_parity(mods: list[Module]) -> list[Finding]:
+    fields: dict[str, tuple[list[str], str, int]] = {}  # cls -> (names, rel, line)
+    slots: list[tuple[list[str], Module, int]] = []
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name in _STATE_CLASSES:
+                names = [item.target.id for item in node.body
+                         if isinstance(item, ast.AnnAssign)
+                         and isinstance(item.target, ast.Name)]
+                if names:
+                    fields.setdefault(node.name,
+                                      (names, mod.rel, node.lineno))
+            elif node.name == _NP_TWIN:
+                for item in node.body:
+                    if isinstance(item, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == "__slots__"
+                            for t in item.targets):
+                        vals = getattr(item.value, "elts", [])
+                        names = [v.value for v in vals
+                                 if isinstance(v, ast.Constant)]
+                        slots.append((names, mod, item.lineno))
+    out: list[Finding] = []
+    tick_out = fields.get("TickOutputs")
+    if tick_out is not None:
+        expected = set(tick_out[0])
+        for names, mod, line in slots:
+            missing = expected - set(names)
+            extra = set(names) - expected
+            if missing or extra:
+                out.append(Finding(
+                    RULE_LANE, mod.rel, line,
+                    f"{_NP_TWIN}.__slots__ drifted from TickOutputs "
+                    f"({tick_out[1]}:{tick_out[2]})"
+                    + (f": missing {sorted(missing)}" if missing else "")
+                    + (f": extra {sorted(extra)}" if extra else "")
+                    + " — the numpy twin must mirror the device lanes"))
+    # every keyword construction of a state class passes the full lane set
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = attr_chain(node.func).split(".")[-1]
+            target = name if name in _STATE_CLASSES else (
+                "TickOutputs" if name == _NP_TWIN else None)
+            if target is None or target not in fields:
+                continue
+            if node.args or not node.keywords \
+                    or any(kw.arg is None for kw in node.keywords):
+                continue  # positional/**kw constructions: out of scope
+            expected = set(fields[target][0])
+            got = {kw.arg for kw in node.keywords}
+            missing = expected - got
+            if missing:
+                out.append(Finding(
+                    RULE_LANE, mod.rel, node.lineno,
+                    f"{name}(...) construction misses lane field(s) "
+                    f"{sorted(missing)} (declared {fields[target][1]}:"
+                    f"{fields[target][2]}) — every device-state "
+                    f"construction site must carry every lane"))
+    return out
+
+
+# ---- jit-body discovery -----------------------------------------------------
+
+
+class _JitRoot:
+    __slots__ = ("fn_name", "statics", "donated", "bound_name", "line")
+
+    def __init__(self, fn_name, statics, donated, bound_name, line):
+        self.fn_name = fn_name
+        self.statics = statics      # static_argnames
+        self.donated = donated      # donate_argnums positions
+        self.bound_name = bound_name  # the jitted callable's local name
+        self.line = line
+
+
+class _JitIndex:
+    """Per-module jit roots + the transitive jit-body set."""
+
+    def __init__(self, mods: list[Module], index: ProjectIndex):
+        self.index = index
+        self.roots: dict[str, list[_JitRoot]] = {}   # mod.rel -> roots
+        # (mod.rel, bound name) -> donated positions, for donated-read
+        self.donated_names: dict[tuple[str, str], tuple] = {}
+        for mod in mods:
+            self.roots[mod.rel] = list(self._scan_module(mod))
+        # id(fn node) -> static param names for that body
+        self.bodies: dict[int, frozenset] = {}
+        self._close()
+
+    def _scan_module(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                root = self._jit_call_root(node.value)
+                if root is not None:
+                    if len(node.targets) == 1 and isinstance(
+                            node.targets[0], ast.Name):
+                        root.bound_name = node.targets[0].id
+                        if root.donated:
+                            self.donated_names[(mod.rel, root.bound_name)] \
+                                = root.donated
+                    yield root
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics = self._jit_decorator(dec)
+                    if statics is not None:
+                        yield _JitRoot(node.name, statics, (), None,
+                                       node.lineno)
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain.split(".")[-1] == "pallas_call" and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    yield _JitRoot(node.args[0].id, frozenset(), (), None,
+                                   node.lineno)
+
+    def _jit_call_root(self, call: ast.Call) -> Optional[_JitRoot]:
+        if attr_chain(call.func) not in ("jax.jit", "jit"):
+            return None
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return None
+        statics, donated = _jit_kwargs(call)
+        return _JitRoot(call.args[0].id, statics, donated, None, call.lineno)
+
+    def _jit_decorator(self, dec) -> Optional[frozenset]:
+        chain = attr_chain(dec) if not isinstance(dec, ast.Call) \
+            else attr_chain(dec.func)
+        if chain in ("jax.jit", "jit"):
+            return (_jit_kwargs(dec)[0] if isinstance(dec, ast.Call)
+                    else frozenset())
+        if isinstance(dec, ast.Call) \
+                and chain in ("functools.partial", "partial") and dec.args:
+            inner = dec.args[0]
+            if attr_chain(inner) in ("jax.jit", "jit"):
+                return _jit_kwargs(dec)[0]
+        return None
+
+    def _close(self) -> None:
+        stack = []
+        for rel, roots in self.roots.items():
+            midx = self.index.by_rel.get(rel)
+            if midx is None:
+                continue
+            for root in roots:
+                info = midx.functions.get(root.fn_name)
+                if info is not None:
+                    stack.append((info, root.statics))
+        while stack:
+            info, statics = stack.pop()
+            key = id(info.node)
+            if key in self.bodies:
+                continue
+            self.bodies[key] = frozenset(statics)
+            for site in info.calls:
+                callee = self.index.resolve_call(info, site.call)
+                if callee is not None and not callee.is_async:
+                    stack.append((callee, frozenset()))
+
+
+def _jit_kwargs(call: ast.Call) -> tuple[frozenset, tuple]:
+    statics: set[str] = set()
+    donated: tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = getattr(kw.value, "elts", [kw.value])
+            statics = {v.value for v in vals
+                       if isinstance(v, ast.Constant)
+                       and isinstance(v.value, str)}
+        elif kw.arg == "donate_argnums":
+            vals = getattr(kw.value, "elts", [kw.value])
+            donated = tuple(v.value for v in vals
+                            if isinstance(v, ast.Constant)
+                            and isinstance(v.value, int))
+    return frozenset(statics), donated
+
+
+# ---- host-sync lint ---------------------------------------------------------
+
+
+def _check_host_sync(index: ProjectIndex, jit: _JitIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for midx in index.by_rel.values():
+        for info in _all_functions(midx):
+            statics = jit.bodies.get(id(info.node))
+            if statics is None:
+                continue
+            out.extend(_scan_jit_body(info, statics))
+    return out
+
+
+def _traced_params(fn, statics: frozenset) -> set[str]:
+    traced = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if a.arg in statics or a.arg == "self":
+            continue
+        if a.annotation is None:
+            traced.add(a.arg)
+            continue
+        ann = ast.unparse(a.annotation) if hasattr(ast, "unparse") else ""
+        if _ARRAYISH.search(ann) or not _SCALARISH.search(ann):
+            traced.add(a.arg)
+    return traced
+
+
+def _scan_jit_body(info, statics: frozenset) -> list[Finding]:
+    fn = info.node
+    mod = info.mod
+    traced = _traced_params(fn, statics)
+    out: list[Finding] = []
+
+    def touches_traced(expr) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in traced:
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    RULE_SYNC, mod.rel, node.lineno,
+                    f"{info.qualname}(): .item() in a jitted body forces "
+                    f"a device->host sync per trace — return the array "
+                    f"and read it host-side"))
+            elif chain in ("np.asarray", "np.array", "numpy.asarray",
+                           "numpy.array"):
+                out.append(Finding(
+                    RULE_SYNC, mod.rel, node.lineno,
+                    f"{info.qualname}(): {chain}() in a jitted body "
+                    f"materializes a traced value on host — use jnp, or "
+                    f"hoist the conversion out of the jit"))
+            elif chain in ("int", "float", "bool") and node.args \
+                    and touches_traced(node.args[0]):
+                out.append(Finding(
+                    RULE_SYNC, mod.rel, node.lineno,
+                    f"{info.qualname}(): {chain}() of traced value in a "
+                    f"jitted body is a concretization error under jit — "
+                    f"keep it an array or lift it to a static argument"))
+        elif isinstance(node, (ast.If, ast.While)) \
+                and touches_traced(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(Finding(
+                RULE_SYNC, mod.rel, node.lineno,
+                f"{info.qualname}(): data-dependent Python `{kind}` on a "
+                f"traced value in a jitted body — branch with jnp.where/"
+                f"lax.cond or make the operand a static argument"))
+    return out
+
+
+# ---- donated-read lint ------------------------------------------------------
+
+
+def _check_donated_reads(mods: list[Module], jit: _JitIndex
+                         ) -> list[Finding]:
+    out: list[Finding] = []
+    if not jit.donated_names:
+        return out
+    for mod in mods:
+        # local + imported donated callables visible in this module
+        visible: dict[str, tuple[str, tuple]] = {}
+        for (rel, name), pos in jit.donated_names.items():
+            if rel == mod.rel:
+                visible[name] = (name, pos)
+        midx = jit.index.by_rel.get(mod.rel)
+        if midx is not None:
+            for local, entry in midx.imports.items():
+                imp = jit.index.resolve_import(midx, local)
+                if imp is None or imp[1] is None:
+                    continue
+                pos = jit.donated_names.get((imp[0], imp[1]))
+                if pos is not None:
+                    visible[local] = (imp[1], pos)
+        if not visible:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_scan_donated_fn(mod, node, visible))
+    return out
+
+
+def _scan_donated_fn(mod: Module, fn, visible: dict) -> list[Finding]:
+    out: list[Finding] = []
+    donations: list[tuple[str, str, int]] = []  # (var, callee, call line)
+    rebinds: list[tuple[str, int]] = []
+    loads: list[tuple[str, int]] = []
+
+    for node in _direct(fn):
+        # every binding form re-arms tracking: plain/annotated/augmented
+        # assignment and loop targets (an annotated rebind on the call
+        # line — `state: TickState = step(state, ...)` — must not leave
+        # the name flagged)
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    rebinds.append((n.id, node.lineno))
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            entry = visible.get(chain)
+            if entry is not None:
+                callee, positions = entry
+                for pos in positions:
+                    if pos < len(node.args) \
+                            and isinstance(node.args[pos], ast.Name):
+                        donations.append(
+                            (node.args[pos].id, callee, node.lineno))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.append((node.id, node.lineno))
+
+    for var, callee, call_line in donations:
+        for name, line in sorted(loads, key=lambda x: x[1]):
+            if name != var or line <= call_line:
+                continue
+            if any(rb == var and call_line <= rline <= line
+                   for rb, rline in rebinds):
+                # rebound (including `state = donating(state, ...)` on
+                # the call line itself): the name now holds the fresh
+                # output, so tracking re-arms
+                break
+            out.append(Finding(
+                RULE_DONATED, mod.rel, line,
+                f"{fn.name}() reads '{var}' after passing it to "
+                f"{callee}() at line {call_line}, which donates that "
+                f"argument (donate_argnums) — the buffer is invalidated "
+                f"by donation; use the returned arrays instead"))
+            break
+    return out
+
+
+def _direct(fn):
+    """Walk fn's body without descending into nested defs/lambdas."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

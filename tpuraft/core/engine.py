@@ -680,24 +680,49 @@ class MultiRaftEngine:
         self.opts = opts or TickOptions()
         g, p = self.opts.max_groups, self.opts.max_peers
         self.G, self.P = g, p
-        # numpy mirrors (host-owned truth between ticks) — commit plane
+        # numpy mirrors (host-owned truth between ticks) — commit plane.
+        # Every [G]-leading row below is a LANE under graftcheck's
+        # lane-coverage rule: it must be handled at _grow (pad), release
+        # (slot reset), set_conf (conf re-map/invalidation) and
+        # _maybe_time_rebase (time epoch shift), or carry a reasoned
+        # `# lane: no-<site>` waiver here on its declaration.
+        # lane: no-shift — log-index domain (rebased by _rebase, not the
+        # time epoch)
         self.match_abs = np.zeros((g, p), np.int64)
+        # lane: no-conf no-shift — per-group log base, conf-independent
         self.base = np.zeros(g, np.int64)
+        # lane: no-conf no-shift — leadership window, reset by
+        # reset_pending_index on role transitions; log-index domain
         self.pending_rel = np.ones(g, np.int32)
-        self.voter_mask = np.zeros((g, p), bool)
-        self.old_voter_mask = np.zeros((g, p), bool)
+        self.voter_mask = np.zeros((g, p), bool)    # lane: no-shift — bool mask
+        self.old_voter_mask = np.zeros((g, p), bool)  # lane: no-shift — bool mask
+        # lane: no-conf no-shift — absolute committed index; a conf
+        # change never moves what is already committed
         self.commit_abs = np.zeros(g, np.int64)
         # protocol plane (SURVEY §8.1): roles, deadlines, acks, votes
+        # lane: no-conf no-shift — host-applied role transitions only
+        # (set_conf never changes who leads); not time-valued
         self.role = np.full(g, ROLE_INACTIVE, np.int32)
+        # lane: no-conf — deadlines re-arm on role transitions and leader
+        # contact, not on membership changes
         self.elect_deadline = np.zeros(g, np.int64)
+        # lane: no-conf — beat cadence is role-driven; set_conf's fresh
+        # peers get their grace stamp through last_ack instead
         self.hb_deadline = np.zeros(g, np.int64)
         self.last_ack = np.full((g, p), _NEG_I32, np.int64)
-        self.granted = np.zeros((g, p), bool)
+        self.granted = np.zeros((g, p), bool)   # lane: no-shift — bool votes
+        # lane: no-shift — column index, not time-valued
         self.self_col = np.full(g, -1, np.int32)
+        # lane: no-conf no-shift — registration bit (register_ctrl /
+        # unregister_ctrl own it); not time-valued
         self.has_ctrl = np.zeros(g, bool)
         # quiescence ("hibernate raft"): a True row suppresses the
         # group's hb_due/election_due masks on device; liveness rides
         # the store-level lease (HeartbeatHub).  Host-owned like role.
+        # lane: no-conf no-shift — set_conf wakes a hibernating group
+        # THROUGH EngineControl.wake_from_quiescence (which clears this
+        # row and the hub lease bookkeeping together — a bare row write
+        # here would leak the lease); not time-valued
         self.quiescent = np.zeros(g, bool)
         # read plane: the last tick's fused q_ack reduction ([G] q-th
         # newest voter ack, ms).  Acks only ever arrive, so a stale row
@@ -752,9 +777,14 @@ class MultiRaftEngine:
         # protocol params: [G] rows — each registered node's NodeOptions
         # timeouts apply to ITS groups only (mixed-timeout engines, e.g.
         # a PD group + region groups in one process, run correct
-        # per-group constants; was engine-wide first-node-wins pre-r3)
+        # per-group constants; was engine-wide first-node-wins pre-r3).
+        # lane: no-conf no-shift — registration-derived parameters
+        # (register_ctrl + the density floor own them); they are
+        # durations, not absolute times, so the epoch shift skips them
         self.eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
+        # lane: no-conf no-shift — same registration-derived duration row
         self.hb_ms = np.full(g, _DEF_HB_MS, np.int64)
+        # lane: no-conf no-shift — same registration-derived duration row
         self.lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
         # density-aware timeout floors: the REQUESTED NodeOptions values
         # per slot; the effective rows above are max(requested, derived
@@ -762,8 +792,12 @@ class MultiRaftEngine:
         # with registered group count and the measured tick cost, so a
         # 16K-group process lands on a safe operating point without the
         # hand-tuned 60s timeouts BENCH_SCALE previously required.
+        # lane: no-conf no-shift — requested durations (register_ctrl
+        # writes them; conf changes and the time epoch never do)
         self.req_eto_ms = np.full(g, _DEF_ETO_MS, np.int64)
+        # lane: no-conf no-shift — same requested-duration row
         self.req_hb_ms = np.full(g, _DEF_HB_MS, np.int64)
+        # lane: no-conf no-shift — same requested-duration row
         self.req_lease_ms = np.full(g, _DEF_LEASE_MS, np.int64)
         self._floor_applied_ms = 0
         self._tick_cost_ema_s = 0.0
@@ -776,8 +810,12 @@ class MultiRaftEngine:
         self._floor_next_n = 0
         # engine-scheduled snapshot cadence (the reference's 4th timer,
         # snapshotTimer): [G] interval row (0 = disabled) + deadline row
-        # replace G per-group RepeatedTimers; fires staggered by jitter
+        # replace G per-group RepeatedTimers; fires staggered by jitter.
+        # lane: no-conf no-shift — interval duration owned by
+        # register_ctrl; membership changes don't move the cadence
         self.snap_ms = np.zeros(g, np.int64)
+        # lane: no-conf — snapshot cadence is registration-driven, not
+        # membership-driven (the deadline row IS epoch-shifted)
         self.snap_deadline = np.zeros(g, np.int64)
         self._t0 = time.monotonic()
 
@@ -915,11 +953,20 @@ class MultiRaftEngine:
         self._params_dev = None
 
     def unregister_ctrl(self, slot: int) -> None:
+        # idempotent per REGISTRATION, not per call: a controlled node's
+        # shutdown reaches here twice (EngineControl.shutdown, then
+        # ballot_box.close -> release), and a bare commit-plane box
+        # (drive_protocol off) releases without ever registering — an
+        # unconditional decrement drifted _n_ctrls negative under churn,
+        # and the density-floor recompute trigger (_n_ctrls >=
+        # _floor_next_n in register_ctrl) could then stay silent while
+        # the REAL controlled density grew past the safe operating point
+        if self.has_ctrl[slot]:
+            self._n_ctrls -= 1
         self._ctrls[slot] = None
         self._ctrl_server[slot] = None
         self.has_ctrl[slot] = False
         self.self_col[slot] = -1
-        self._n_ctrls -= 1
 
     def alloc_slot(self) -> int:
         if not self._free:

@@ -1707,6 +1707,10 @@ class Node:
 
 # graftcheck: loop-confined — every method runs under the node lock on
 # the node's loop (see class docstring termination discipline)
+# graftcheck: called-under(_lock) — the ctx is driven exclusively from
+# node paths that already hold the node lock (change_peers, on_committed
+# apply, step-down teardown), so its cross-object calls into
+# holds-annotated Node methods inherit the held lock
 class _ConfigurationCtx:
     """Membership-change state machine: CATCHING_UP -> JOINT -> STABLE.
 

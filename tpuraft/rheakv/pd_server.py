@@ -285,6 +285,10 @@ class RegionStats:
                           self.bytes_in_s, self.bytes_out_s)
 
 
+# graftcheck: loop-confined — every intake/policy path (heartbeat
+# handlers, the staleness sweep, balancing) runs on the PD node's RPC
+# loop; the metrics HTTP thread reads SNAPSHOT copies only (render
+# methods list()/copy live dicts before iterating — the PR 13 rule)
 class ClusterStatsManager:
     """Leader-side (non-replicated) stats: per-region key counts + heat
     rates (ONE record per region — see :class:`RegionStats`) and

@@ -142,6 +142,9 @@ class DiskLatencyProbe:
             return self._ema_ms, age, self._samples
 
 
+# graftcheck: loop-confined — owned by HealthTracker (self + per-peer
+# rows), folded only on the store's event loop; the cross-thread disk
+# signal stays inside the LOCKED DiskLatencyProbe above
 class _Hysteresis:
     """Evaluation-count hysteresis around a raw level stream."""
 

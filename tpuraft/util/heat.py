@@ -44,6 +44,9 @@ _HEAT_ROW = struct.Struct("<qffff")
 _EPS = 1e-3
 
 
+# graftcheck: loop-confined — rows live inside RegionHeatTracker's
+# rates dict and are folded/served only on the owning store's loop;
+# the exposition thread reads plain floats (best-effort, like counters)
 @dataclass
 class RegionHeat:
     """One region's decayed access rates (all per second)."""

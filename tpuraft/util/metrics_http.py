@@ -17,6 +17,12 @@ from typing import Callable
 LOG = logging.getLogger(__name__)
 
 
+# concurrency contract (graftcheck-reviewed, deliberately NOT
+# loop-confined): the handler runs on ThreadingHTTPServer daemon
+# threads.  Every attribute below is published BEFORE the serving
+# thread starts and never rebound afterwards (immutable-after-publish);
+# the render callable itself must only read counters or snapshot
+# copies — the contract each metrics_text() implementation documents
 class MetricsHttpServer:
     """GET /metrics (or /) -> ``render()`` as Prometheus text.
 

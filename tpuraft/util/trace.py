@@ -54,6 +54,8 @@ from tpuraft.util import describer
 _pc = time.perf_counter
 
 
+# graftcheck: loop-confined — created and consumed only by the Tracer
+# (itself loop-confined below); executor threads never hold one
 class _Staged:
     """One locally-originated op, staged until end_op decides retention
     (sampled => always; slow => force-retained).  Only SAMPLED ops
