@@ -62,8 +62,9 @@ soak:
 # (docs/operations.md "Crash-consistency testing" + "Elastic
 # membership runbook").
 chaos-smoke:
-	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py tests/test_quiescence.py tests/test_witness.py tests/test_read_only.py tests/test_gray_failure.py -q
+	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py tests/test_quiescence.py tests/test_witness.py tests/test_read_only.py tests/test_gray_failure.py tests/test_append_batch.py -q
 	$(PY) -m examples.soak --duration 20 --seed 1 --power-loss
+	$(PY) -m examples.soak --duration 20 --seed 8 --write-burst --power-loss
 	$(PY) -m examples.soak --duration 20 --seed 3 --churn --power-loss
 	$(PY) -m examples.soak --duration 20 --seed 5 --regions 48 --engine --quiesce --kv-batching
 	$(PY) -m examples.soak --duration 20 --seed 2 --geo 3 --witness

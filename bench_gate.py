@@ -11,6 +11,10 @@ Rows:
                         KV-vs-protocol throughput gap (ROADMAP item 1)
                         can't silently reopen.
   kv_read_ops_per_sec — the 95/5 read-mix shape vs its calibration.
+  kv_write_ops_per_sec — the saturated pure-write shape (w256 @128
+                        regions) vs its calibration, so write-plane
+                        regressions (ISSUE 15's append rounds + eager
+                        commits + ack-at-commit) gate like the rest.
   kv_ops_traced       — tracing-overhead gate: the untraced rows above
                         run with the trace plane DISABLED (the
                         zero-cost claim — any always-on cost regresses
@@ -81,7 +85,8 @@ def _run_e2e_once(extra: dict, duration: float) -> float:
 def _run_kv_once(extra: dict, duration: float,
                  read_frac: float = -1.0,
                  trace_sample: float = 0.0,
-                 heat_off: bool = False) -> float:
+                 heat_off: bool = False,
+                 workers: int = 0) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
     the read-mix shape (the amortized read plane's regression row);
@@ -97,6 +102,10 @@ def _run_kv_once(extra: dict, duration: float,
            "--election-timeout-ms", str(extra.get("gate_eto_ms", 1000)),
            "--json-out", out_path]
     key = "row" if regions == 1024 else f"row_{regions}"
+    if workers > 0:
+        cmd += ["--workers", str(workers)]
+        if workers != 24:
+            key += f"_w{workers}"
     if read_frac >= 0:
         cmd += ["--read-frac", str(read_frac)]
         key += f"_r{int(round(read_frac * 100))}"
@@ -174,6 +183,9 @@ def main() -> int:
                           for _ in range(2))
             read_best = max(_run_kv_once(kv_extra, duration, read_frac=0.95)
                             for _ in range(2))
+            write_best = max(_run_kv_once(kv_extra, duration,
+                                          read_frac=0.0, workers=256)
+                             for _ in range(2))
         except RuntimeError as exc:
             print(f"bench-gate: {exc}")
             return 2
@@ -185,6 +197,7 @@ def main() -> int:
             f.write("\n")
         kv_extra["gate_kv_ops_per_sec"] = round(kv_best, 1)
         kv_extra["gate_read_ops_per_sec"] = round(read_best, 1)
+        kv_extra["gate_write_ops_per_sec"] = round(write_best, 1)
         kv_extra["gate_duration_s"] = duration
         kv_extra.setdefault("gate_regions", 128)
         kv_extra.setdefault("gate_eto_ms", 1000)
@@ -198,6 +211,8 @@ def main() -> int:
                               kv_extra["gate_kv_ops_per_sec"],
                           "gate_read_ops_per_sec":
                               kv_extra["gate_read_ops_per_sec"],
+                          "gate_write_ops_per_sec":
+                              kv_extra["gate_write_ops_per_sec"],
                           "duration_s": duration}))
         return 0
 
@@ -276,6 +291,25 @@ def main() -> int:
                         float(kv_extra["gate_read_ops_per_sec"]),
                         lambda: _run_kv_once(kv_extra, duration,
                                              read_frac=0.95),
+                        threshold, retries)
+        worst = max(worst, rc)
+        reports.append(rep)
+    if "gate_write_ops_per_sec" not in kv_extra:
+        # the batched write plane (ISSUE 15) needs its own regression
+        # row: the saturated pure-write shape (w256) exercises the
+        # append rounds + eager commits + ack-at-commit pipeline the
+        # default 24-worker mixed row barely touches
+        print("bench-gate[kv_write_ops_per_sec]: no calibration "
+              "(run `python bench_gate.py --record`)")
+        worst = max(worst, 2)
+        reports.append({"gate": "kv_write_ops_per_sec",
+                        "verdict": "BROKEN",
+                        "error": "no gate_write_ops_per_sec calibration"})
+    else:
+        rc, rep = _gate("kv_write_ops_per_sec",
+                        float(kv_extra["gate_write_ops_per_sec"]),
+                        lambda: _run_kv_once(kv_extra, duration,
+                                             read_frac=0.0, workers=256),
                         threshold, retries)
         worst = max(worst, rc)
         reports.append(rep)

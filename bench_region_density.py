@@ -95,7 +95,9 @@ async def run_config(args) -> dict:
         net.bind(server)
         transport = InProcTransport(net, ep)
         engine = MultiRaftEngine(TickOptions(
-            max_groups=cap, max_peers=4, tick_interval_ms=20))
+            max_groups=cap, max_peers=4, tick_interval_ms=20,
+            # --no-write-batch A/B: tick-cadence commits (pre-ISSUE-15)
+            eager_commit=not args.no_write_batch))
         engines.append(engine)
         opts = StoreEngineOptions(
             server_id=ep,
@@ -108,6 +110,10 @@ async def run_config(args) -> dict:
             heartbeat_interval_ms=1000,
             # --no-heat: the bench-gate heat-overhead row's A/B knob
             heat_tracking=not args.no_heat,
+            # --no-write-batch: the write-plane A/B knob — send-plane
+            # stop-and-wait appends + ack-after-apply (pre-ISSUE-15)
+            append_batching=not args.no_write_batch,
+            ack_at_commit=not args.no_write_batch,
         )
         if args.lease_reads:
             from tpuraft.options import ReadOnlyOption
@@ -320,6 +326,20 @@ async def run_config(args) -> dict:
         # the region store, submit=entry handed to the raft node,
         # apply_s/apply_e=FSM executed, ack=proposal future resolved
         "stage_marks_ms": stage,
+        # write-plane batching (ISSUE 15): store-wide append rounds +
+        # event-driven commits + ack-at-commit pipelined apply
+        "write_plane": {
+            "enabled": not args.no_write_batch,
+            **{k: sum(s.append_batcher.counters()[k] for s in stores
+                      if s.append_batcher is not None)
+               for k in (stores[0].append_batcher.counters()
+                         if stores[0].append_batcher is not None else {})},
+            "engine_eager_commits": sum(e.eager_commits for e in engines),
+            "fsm_eager_acked": sum(
+                re.node.fsm_caller.eager_acked
+                for s in stores for re in s._regions.values()
+                if re.node is not None),
+        },
         # read-side attribution for one probe GET: queue → rpc →
         # fence_s/fence_e (read_index confirmation incl. the store-wide
         # batched round) → done (local serve + reply)
@@ -512,6 +532,10 @@ def main() -> None:
     ap.add_argument("--no-heat", action="store_true",
                     help="disable per-region heat tracking (the "
                          "bench-gate heat-overhead row's A/B knob)")
+    ap.add_argument("--no-write-batch", action="store_true",
+                    help="disable the write plane (store-wide append "
+                         "rounds, eager commits, ack-at-commit) — the "
+                         "unbatched A/B comparator")
     ap.add_argument("--profile-ticks", type=int, default=0,
                     help="arm an N-tick device profiling window on the "
                          "first store's engine; exports a perfetto "
@@ -556,6 +580,8 @@ def main() -> None:
         cmd.append("--quiesce")
     if args.no_heat:
         cmd.append("--no-heat")
+    if args.no_write_batch:
+        cmd.append("--no-write-batch")
     if args.profile_ticks > 0:
         cmd += ["--profile-ticks", str(args.profile_ticks)]
         if args.profile_ticks_out:
@@ -595,6 +621,8 @@ def main() -> None:
         key += "_quiesce"
     if args.no_heat:
         key += "_noheat"
+    if args.no_write_batch:
+        key += "_nowb"
     out[key] = row
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

@@ -108,6 +108,16 @@ class _BaseSoakCluster:
     def _retire_counters(self, store: StoreEngine) -> None:
         rc = self.retired_counters
         rc["evacuations"] = rc.get("evacuations", 0) + store.evacuations
+        if store.append_batcher is not None:
+            # write-plane rounds survive store kill/restart in the run
+            # record (the PR 11 retired-counter lesson)
+            for k, v in store.append_batcher.counters().items():
+                rc[k] = rc.get(k, 0) + v
+        eager = sum(re_.node.fsm_caller.eager_acked
+                    for re_ in store._regions.values()
+                    if re_.node is not None)
+        if eager:
+            rc["fsm_eager_acked"] = rc.get("fsm_eager_acked", 0) + eager
         rc["shed_items"] = rc.get("shed_items", 0) \
             + store.kv_processor.shed_items
         if store.health is not None:
@@ -643,6 +653,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    read_mix: float = 0.0,
                    read_from: str = "leader",
                    gray: bool = False,
+                   write_burst: bool = False,
                    trace: str = "") -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
@@ -725,7 +736,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             duration_s, n_keys, verbose, transport, dump_history,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
             kv_batching, geo, witness, read_mix, read_from,
-            gray=gray, power_loss=power_loss, trace=trace)
+            gray=gray, power_loss=power_loss, write_burst=write_burst,
+            trace=trace)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -739,7 +751,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           chaos, churn=False, quiesce=False,
                           kv_batching=False, geo=0, witness=False,
                           read_mix=0.0, read_from="leader", gray=False,
-                          power_loss=False, trace="") -> dict:
+                          power_loss=False, write_burst=False,
+                          trace="") -> dict:
     if trace:
         # sampled product tracing through the whole drive; exported as
         # perfetto-loadable JSON next to the result
@@ -808,6 +821,33 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         own_keys = [k for k in keys if key_owner[k] == cid]
         while not stop.is_set():
             n += 1
+            if write_burst:
+                # write-heavy shape (ISSUE 15): a burst of 4 concurrent
+                # puts — the store-wide append rounds and ack-at-commit
+                # path run loaded while the nemeses fire — plus ~10%
+                # reads so acked-at-commit writes are read back under
+                # the same history
+                if rng.random() < 0.1:
+                    key = rng.choice(keys)
+                    tok = h.invoke(cid, "r", (key,))
+                    try:
+                        v = await asyncio.wait_for(kv.get(key), 4.0)
+                        h.complete(tok, v)
+                    except Exception:
+                        pass
+                else:
+                    async def one_put(j: int):
+                        key = rng.choice(keys)
+                        val = b"c%d-%d-%d" % (cid, n, j)
+                        tok = h.invoke(cid, "w", (key, val))
+                        try:
+                            await asyncio.wait_for(kv.put(key, val), 4.0)
+                            h.complete(tok, True)
+                        except Exception:
+                            pass        # pending: maybe applied
+                    await asyncio.gather(*(one_put(j) for j in range(4)))
+                await asyncio.sleep(0.005)
+                continue
             if read_mix > 0:
                 do_read = not own_keys or rng.random() < read_mix
                 key = rng.choice(keys if do_read else own_keys)
@@ -1241,6 +1281,24 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                     _acc(node.read_only_service.counters())
         if any(read_plane.values()):
             result["read_plane"] = read_plane
+        # write-plane counters (ISSUE 15): store-wide append rounds +
+        # ack-at-commit, live stores + everything retired by kill/restart
+        write_plane: dict[str, int] = dict(
+            (k, v) for k, v in c.retired_counters.items()
+            if k.startswith("append_") or k == "fsm_eager_acked")
+        for store in c.stores.values():
+            ab = getattr(store, "append_batcher", None)
+            if ab is not None:
+                for k, v in ab.counters().items():
+                    write_plane[k] = write_plane.get(k, 0) + v
+            for re_ in store._regions.values():
+                node = re_.node
+                if node is not None:
+                    write_plane["fsm_eager_acked"] = (
+                        write_plane.get("fsm_eager_acked", 0)
+                        + node.fsm_caller.eager_acked)
+        if any(write_plane.values()):
+            result["write_plane"] = write_plane
         if read_from != "leader":
             result["read_serves"] = dict(kv.read_serves)
         if chaos:
@@ -1606,6 +1664,13 @@ def main() -> None:
                          "coalesce into store-grouped kv_command_batch "
                          "RPCs; linearizability is checked per op as "
                          "usual (batched items ack/apply atomically)")
+    ap.add_argument("--write-burst", action="store_true",
+                    help="write-heavy load shape (ISSUE 15): each worker "
+                         "issues bursts of 4 concurrent puts (~10%% "
+                         "reads) so the store-wide append rounds + "
+                         "ack-at-commit pipeline run saturated under "
+                         "the nemesis menu; write-plane counters land "
+                         "in the report")
     ap.add_argument("--read-mix", type=float, default=0.0, metavar="FRAC",
                     help="read-dominant workload: reads with this "
                          "probability (e.g. 0.95), writes carry per-key "
@@ -1659,6 +1724,7 @@ def main() -> None:
                                   read_mix=args.read_mix,
                                   read_from=args.read_from,
                                   gray=args.gray,
+                                  write_burst=args.write_burst,
                                   trace=args.trace))
     import json
 

@@ -79,7 +79,8 @@ class TestCluster:
                  coalesce_heartbeats: bool = False,
                  log_scheme: str = "file",
                  meta_scheme: str = "file",
-                 witness_idx: tuple = ()):
+                 witness_idx: tuple = (),
+                 append_batching: bool = False):
         self.net = InProcNetwork()
         self.group_id = group_id
         self.peers = [PeerId.parse(f"127.0.0.1:{5000 + i}") for i in range(n)]
@@ -105,9 +106,14 @@ class TestCluster:
         if meta_scheme != "file" and tmp_path is None:
             raise ValueError(f"meta_scheme={meta_scheme!r} needs a tmp_path")
         self.meta_scheme = meta_scheme  # "file" | "multimeta"
+        # store-wide write plane: each endpoint gets an AppendBatcher
+        # and its node submits windows through it (the StoreEngine
+        # wiring, reproduced for bare protocol nodes)
+        self.append_batching = append_batching
         self.nodes: dict[PeerId, Node] = {}
         self.fsms: dict[PeerId, MockStateMachine] = {}
         self.managers: dict[PeerId, NodeManager] = {}
+        self.batchers: dict[PeerId, object] = {}
 
     def _options(self, peer: PeerId) -> NodeOptions:
         opts = NodeOptions(
@@ -155,6 +161,10 @@ class TestCluster:
         transport = InProcTransport(self.net, peer.endpoint)
         node = Node(self.group_id, peer, self._options(peer), transport)
         node.node_manager = manager
+        if self.append_batching:
+            from tpuraft.core.append_batcher import AppendBatcher
+
+            self.batchers[peer] = node.append_batcher = AppendBatcher()
         manager.add(node)
         ok = await node.init()
         assert ok, f"init failed for {peer}"
@@ -166,6 +176,9 @@ class TestCluster:
         """Crash-stop: unbind from the network, shut the node down."""
         self.net.stop_endpoint(peer.endpoint)
         node = self.nodes.pop(peer, None)
+        batcher = self.batchers.pop(peer, None)
+        if batcher is not None:
+            await batcher.shutdown()
         if node:
             self.net.unbind(peer.endpoint)
             await node.shutdown()

@@ -32,7 +32,8 @@ class KVTestCluster:
                  multi_raft_engine_factory=None,
                  raw_store_factory=None,
                  read_only_option=None,
-                 log_scheme: str = "file"):
+                 log_scheme: str = "file",
+                 store_opts: Optional[dict] = None):
         # raw_store_factory: Callable[[endpoint], RawKVStore] — lets tests
         # swap the memory store for the native C++ engine per store
         self.net = InProcNetwork()
@@ -51,6 +52,9 @@ class KVTestCluster:
         self.raw_store_factory = raw_store_factory
         self.read_only_option = read_only_option
         self.log_scheme = log_scheme  # "file" | "multilog" (needs tmp_path)
+        # extra StoreEngineOptions field overrides (e.g. the write-plane
+        # A/B knobs append_batching / ack_at_commit)
+        self.store_opts = dict(store_opts or {})
         if log_scheme != "file" and tmp_path is None:
             raise ValueError(f"log_scheme={log_scheme!r} needs a tmp_path")
         self.stores: dict[str, StoreEngine] = {}
@@ -73,6 +77,8 @@ class KVTestCluster:
         )
         if self.read_only_option is not None:
             opts.read_only_option = self.read_only_option
+        for k, v in self.store_opts.items():
+            setattr(opts, k, v)
         if self.raw_store_factory is not None:
             opts.raw_store_factory = (
                 lambda ep=endpoint: self.raw_store_factory(ep))

@@ -1,0 +1,665 @@
+"""Write-plane batching tests (ISSUE 15): AppendBatcher rounds, the
+store_append wire pair, event-driven (eager) commit advancement, and
+the ack-at-commit pipelined apply.
+
+Mirrors the shape of test_read_only.py's ReadConfirmBatcher battery:
+scripted-transport unit tests for the batcher's round/window/fallback
+mechanics, engine-level tests for the eager commit tally (incl. the
+joint-consensus both-quorums rule), and real-cluster integration for
+the safety edges (leader deposed mid-round, end-to-end replication
+through store_append rounds)."""
+
+from __future__ import annotations
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from tests.cluster import TestCluster
+from tpuraft.conf import Configuration
+from tpuraft.core.append_batcher import AppendBatcher
+from tpuraft.entity import PeerId
+from tpuraft.errors import RaftError, Status
+from tpuraft.options import NodeOptions
+from tpuraft.rpc.messages import (
+    AppendEntriesRequest,
+    AppendEntriesResponse,
+    ErrorResponse,
+    StoreAppendRequest,
+    StoreAppendResponse,
+    decode_message,
+    encode_message,
+)
+from tpuraft.rpc.transport import RpcError
+
+pytestmark = pytest.mark.asyncio
+
+
+# ---------------------------------------------------------------------------
+# unit plane: scripted transports + fake replicators
+# ---------------------------------------------------------------------------
+
+
+class _Rep:
+    """Fake replicator: records resolutions, same submit contract."""
+
+    def __init__(self, node, peer: PeerId):
+        self._node = node
+        self.peer = peer
+        self.resolved: list[list] = []
+        self.errors = 0
+
+    async def on_batch_responses(self, acks: list) -> None:
+        self.resolved.append(list(acks))
+
+    async def on_batch_error(self) -> None:
+        self.errors += 1
+
+
+class _AppendTransport:
+    """store_append stub: per-dst scripted acks (or exceptions)."""
+
+    def __init__(self, fail_dst=None, no_method_dst=None):
+        self.fail_dst = fail_dst or set()
+        self.no_method_dst = no_method_dst or set()
+        self.calls: list[tuple[str, str, int]] = []
+        self.legacy_appends: list[tuple[str, str]] = []
+
+    async def call(self, dst, method, request, timeout_ms=None):
+        assert method == "store_append"
+        self.calls.append((dst, method, len(request.rows)))
+        if dst in self.no_method_dst:
+            raise RpcError(Status.error(RaftError.ENOMETHOD, "old build"))
+        if dst in self.fail_dst:
+            raise RpcError(Status.error(RaftError.EHOSTDOWN, "dead"))
+        return StoreAppendResponse(acks=[
+            AppendEntriesResponse(term=r.term, success=True,
+                                  last_log_index=r.prev_log_index
+                                  + len(r.entries))
+            for r in request.rows])
+
+    async def append_entries(self, dst, req, timeout_ms=None):
+        # legacy per-frame fallback path (sequential_appends)
+        self.legacy_appends.append((dst, req.group_id))
+        return AppendEntriesResponse(term=req.term, success=True,
+                                     last_log_index=req.prev_log_index
+                                     + len(req.entries))
+
+
+def _node(transport) -> SimpleNamespace:
+    return SimpleNamespace(transport=transport,
+                           options=NodeOptions(election_timeout_ms=200))
+
+
+def _req(gid: str, peer: PeerId, prev: int = 0) -> AppendEntriesRequest:
+    return AppendEntriesRequest(
+        group_id=gid, server_id="127.0.0.1:9000", peer_id=str(peer),
+        term=3, prev_log_index=prev, prev_log_term=0, committed_index=0,
+        entries=[])
+
+
+def _peer(port: int) -> PeerId:
+    return PeerId.parse(f"127.0.0.1:{port}")
+
+
+async def test_batcher_amortizes_many_groups_into_one_round():
+    """The tentpole: N groups' windows headed for the same follower
+    endpoint cost ONE store_append RPC, not one RPC per group."""
+    transport = _AppendTransport()
+    node = _node(transport)
+    dst_a, dst_b = _peer(9101), _peer(9102)
+    reps = [_Rep(node, dst_a if i % 2 == 0 else dst_b) for i in range(16)]
+    b = AppendBatcher()
+    for i, rep in enumerate(reps):
+        b.submit_append(rep, [_req(f"g{i}", rep.peer)])
+    # wait for every rep to resolve
+    for _ in range(200):
+        if all(r.resolved for r in reps):
+            break
+        await asyncio.sleep(0.01)
+    assert all(len(r.resolved) == 1 and len(r.resolved[0]) == 1
+               for r in reps)
+    # one RPC per destination, 8 groups' rows each
+    assert sorted(transport.calls) == sorted(
+        [(dst_a.endpoint, "store_append", 8),
+         (dst_b.endpoint, "store_append", 8)])
+    assert b.rounds == 2 and b.rows == 16
+
+
+async def test_batcher_multi_frame_window_resolves_as_one_unit():
+    transport = _AppendTransport()
+    node = _node(transport)
+    dst = _peer(9111)
+    rep = _Rep(node, dst)
+    b = AppendBatcher()
+    b.submit_append(rep, [_req("g0", dst, prev=0), _req("g0", dst, prev=4)])
+    for _ in range(100):
+        if rep.resolved:
+            break
+        await asyncio.sleep(0.01)
+    assert len(rep.resolved) == 1 and len(rep.resolved[0]) == 2
+    assert transport.calls == [(dst.endpoint, "store_append", 2)]
+
+
+class _StallTransport(_AppendTransport):
+    """One destination is STALLED (not dead): RPCs block until
+    release — the gray-failure shape a timeout never sees in time."""
+
+    def __init__(self, stalled: set[str]):
+        super().__init__()
+        self.stalled = stalled
+        self.release = asyncio.Event()
+
+    async def call(self, dst, method, request, timeout_ms=None):
+        if dst in self.stalled:
+            self.calls.append((dst, method, len(request.rows)))
+            await self.release.wait()
+            return StoreAppendResponse(acks=[
+                AppendEntriesResponse(term=r.term, success=True,
+                                      last_log_index=r.prev_log_index
+                                      + len(r.entries))
+                for r in request.rows])
+        return await super().call(dst, method, request, timeout_ms)
+
+
+async def test_stalled_endpoint_delays_only_its_own_lane():
+    """Windowing bound: a stalled destination's round keeps only ITS
+    lane waiting — windows to healthy destinations submitted afterwards
+    keep shipping round after round."""
+    stalled_dst, fast_dst = _peer(9201), _peer(9210)
+    transport = _StallTransport({stalled_dst.endpoint})
+    node = _node(transport)
+    b = AppendBatcher()
+    stalled_rep = _Rep(node, stalled_dst)
+    b.submit_append(stalled_rep, [_req("slow", stalled_dst)])
+    await asyncio.sleep(0.05)   # its round is in flight, stalled
+    assert not stalled_rep.resolved
+
+    for i in range(5):
+        rep = _Rep(node, fast_dst)
+        b.submit_append(rep, [_req(f"fast{i}", fast_dst)])
+        for _ in range(100):
+            if rep.resolved:
+                break
+            await asyncio.sleep(0.01)
+        assert rep.resolved, f"healthy window {i} convoyed behind stall"
+    assert not stalled_rep.resolved
+    transport.release.set()
+    for _ in range(100):
+        if stalled_rep.resolved:
+            break
+        await asyncio.sleep(0.01)
+    assert stalled_rep.resolved
+
+
+async def test_window_bounds_rounds_per_destination():
+    """max_inflight_rounds stalled rounds on one lane: the next window
+    waits for a slot (no unbounded RPC pileup at a limping endpoint)
+    and ships the moment one opens."""
+    dst = _peer(9301)
+    transport = _StallTransport({dst.endpoint})
+    node = _node(transport)
+    b = AppendBatcher()
+    assert b.max_inflight_rounds == 4
+    reps = []
+    for i in range(4):
+        rep = _Rep(node, dst)
+        reps.append(rep)
+        b.submit_append(rep, [_req(f"g{i}", dst)])
+        await asyncio.sleep(0.02)   # one round each, all stalled
+    assert len(b._inflight[dst.endpoint]) == 4
+    late = _Rep(node, dst)
+    b.submit_append(late, [_req("late", dst)])
+    await asyncio.sleep(0.05)
+    assert len(transport.calls) == 4, "5th round ran past the window"
+    transport.release.set()
+    for _ in range(200):
+        if late.resolved and all(r.resolved for r in reps):
+            break
+        await asyncio.sleep(0.01)
+    assert late.resolved and all(r.resolved for r in reps)
+
+
+async def test_enomethod_fallback_sticks_and_counts():
+    """A receiver without store_append answers ENOMETHOD: the batch is
+    resent as classic per-group append_entries and the endpoint stays
+    legacy PERMANENTLY (no re-probe per round)."""
+    dst = _peer(9401)
+    transport = _AppendTransport(no_method_dst={dst.endpoint})
+    node = _node(transport)
+    b = AppendBatcher()
+    rep = _Rep(node, dst)
+    b.submit_append(rep, [_req("g0", dst)])
+    for _ in range(100):
+        if rep.resolved:
+            break
+        await asyncio.sleep(0.01)
+    assert rep.resolved and rep.errors == 0
+    assert b.fallbacks == 1 and b.legacy_rows == 1
+    assert len(transport.calls) == 1          # one probe, then legacy
+    assert transport.legacy_appends == [(dst.endpoint, "g0")]
+    # second window: straight to legacy, no store_append attempt
+    rep2 = _Rep(node, dst)
+    b.submit_append(rep2, [_req("g1", dst)])
+    for _ in range(100):
+        if rep2.resolved:
+            break
+        await asyncio.sleep(0.01)
+    assert rep2.resolved
+    assert len(transport.calls) == 1
+    assert b.legacy_rows == 2
+    assert transport.legacy_appends[-1] == (dst.endpoint, "g1")
+
+
+async def test_dead_endpoint_fails_batch_not_silence():
+    dst = _peer(9501)
+    transport = _AppendTransport(fail_dst={dst.endpoint})
+    node = _node(transport)
+    b = AppendBatcher()
+    rep = _Rep(node, dst)
+    b.submit_append(rep, [_req("g0", dst)])
+    for _ in range(100):
+        if rep.errors:
+            break
+        await asyncio.sleep(0.01)
+    assert rep.errors == 1 and not rep.resolved
+    assert b.round_errors == 1
+
+
+async def test_short_reply_fails_whole_round():
+    dst = _peer(9601)
+
+    class ShortTransport(_AppendTransport):
+        async def call(self, dst, method, request, timeout_ms=None):
+            return StoreAppendResponse(acks=[])   # truncated
+
+    transport = ShortTransport()
+    node = _node(transport)
+    b = AppendBatcher()
+    rep = _Rep(node, dst)
+    b.submit_append(rep, [_req("g0", dst)])
+    for _ in range(100):
+        if rep.errors:
+            break
+        await asyncio.sleep(0.01)
+    assert rep.errors == 1
+    assert b.round_errors == 1
+
+
+async def test_deviating_and_rejected_row_counters():
+    dst = _peer(9701)
+
+    class MixedTransport(_AppendTransport):
+        async def call(self, dst, method, request, timeout_ms=None):
+            acks = [ErrorResponse(int(RaftError.EBUSY), "busy"),
+                    AppendEntriesResponse(term=3, success=False,
+                                          last_log_index=0)]
+            return StoreAppendResponse(acks=acks)
+
+    transport = MixedTransport()
+    node = _node(transport)
+    b = AppendBatcher()
+    rep = _Rep(node, dst)
+    b.submit_append(rep, [_req("g0", dst), _req("g0", dst, prev=1)])
+    for _ in range(100):
+        if rep.resolved:
+            break
+        await asyncio.sleep(0.01)
+    assert rep.resolved    # resolution is the replicator's job
+    assert b.deviating_rows == 1 and b.rejected_rows == 1
+
+
+# ---------------------------------------------------------------------------
+# wire plane: the store_append pair, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_store_append_wire_roundtrip():
+    rows = [_req("g0", _peer(9801)),
+            AppendEntriesRequest(group_id="g1", server_id="a", peer_id="b",
+                                 term=9, prev_log_index=4, prev_log_term=2,
+                                 committed_index=3, entries=[],
+                                 trace_ctx=b"\x01\x02")]
+    req = decode_message(encode_message(StoreAppendRequest(rows=rows)))
+    assert isinstance(req, StoreAppendRequest)
+    assert [r.group_id for r in req.rows] == ["g0", "g1"]
+    assert req.rows[1].trace_ctx == b"\x01\x02"
+    acks = [AppendEntriesResponse(term=9, success=True, last_log_index=5,
+                                  conflict_index=0, multi_hb=True),
+            ErrorResponse(int(RaftError.EBUSY), "busy")]
+    resp = decode_message(encode_message(StoreAppendResponse(acks=acks)))
+    assert isinstance(resp, StoreAppendResponse)
+    assert resp.acks[0].success and resp.acks[0].last_log_index == 5
+    assert isinstance(resp.acks[1], ErrorResponse)
+
+
+def test_store_append_rows_decode_old_format_frames():
+    """Old→new: a row encoded by a PRE-trace-plane sender (no trailing
+    trace_ctx bytes) decodes with the default — the nested-frame codec
+    keeps mixed-fleet rounds decodable."""
+    row = _req("g0", _peer(9802))
+    blob = encode_message(row)
+    # simulate the old sender: strip the trailing trace_ctx field
+    # (4-byte length prefix + empty payload)
+    old_blob = blob[:-4]
+    import struct
+
+    from tpuraft.rpc.messages import _pack_bytes
+
+    inner = decode_message(old_blob)
+    assert inner.trace_ctx == b""
+    # and nested inside a round envelope built from such frames
+    out = bytearray(struct.pack("<B", 21))   # StoreAppendRequest tid
+    out += struct.pack("<I", 1)
+    out += _pack_bytes(bytes(old_blob))
+    req = decode_message(bytes(out))
+    assert isinstance(req, StoreAppendRequest)
+    assert req.rows[0].group_id == "g0" and req.rows[0].trace_ctx == b""
+
+
+# ---------------------------------------------------------------------------
+# engine plane: event-driven (eager) commit advancement
+# ---------------------------------------------------------------------------
+
+
+def _eager_engine(eager: bool = True):
+    from tpuraft.core.engine import MultiRaftEngine
+    from tpuraft.options import TickOptions
+
+    return MultiRaftEngine(TickOptions(
+        max_groups=8, max_peers=8, backend="numpy", eager_commit=eager))
+
+
+def _voters(base: int, n: int = 3) -> list[PeerId]:
+    return [PeerId.parse(f"127.0.0.1:{base + i}") for i in range(n)]
+
+
+async def test_eager_commit_advances_on_the_completing_ack():
+    """The quorum-completing ack advances commit ON THE ACK PATH — no
+    tick in between."""
+    eng = _eager_engine()
+    peers = _voters(9900)
+    conf = Configuration(list(peers))
+    commits: list[int] = []
+    box = eng.ballot_box_factory()(commits.append)
+    box.update_conf(conf, Configuration())
+    box.reset_pending_index(1)
+    assert not box.commit_at(peers[0], 5, conf, Configuration())
+    assert not commits, "1/3 acks must not commit"
+    assert box.commit_at(peers[1], 5, conf, Configuration())
+    assert commits == [5] and box.last_committed_index == 5
+    assert eng.eager_commits == 1
+    # the safety-net tick finds nothing left to advance
+    assert eng.tick_once() == 0
+
+
+async def test_eager_commit_joint_conf_tallies_both_quorums():
+    """Joint consensus: a new-config-only majority must not advance the
+    commit point — both electorates tally, exactly like the device
+    reduce."""
+    eng = _eager_engine()
+    new = _voters(9910)
+    old = [new[0]] + _voters(9950, 2)
+    conf, old_conf = Configuration(list(new)), Configuration(list(old))
+    commits: list[int] = []
+    box = eng.ballot_box_factory()(commits.append)
+    box.update_conf(conf, old_conf)
+    box.reset_pending_index(1)
+    # full NEW quorum acks; old config has only the shared peer
+    for p in new:
+        box.commit_at(p, 7, conf, old_conf)
+    assert not commits, "new-only majority committed through a joint conf"
+    # one more OLD voter completes the old quorum too
+    assert box.commit_at(old[1], 7, conf, old_conf)
+    assert commits == [7]
+
+
+async def test_eager_commit_matches_tick_plane():
+    """Equivalence: eager ack-path advancement lands exactly where the
+    tick's device reduce would."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    peers = _voters(9920, 5)
+    conf = Configuration(list(peers))
+    eng_e, eng_t = _eager_engine(True), _eager_engine(False)
+    got_e: dict[int, int] = {}
+    got_t: dict[int, int] = {}
+    for g in range(6):
+        be = eng_e.ballot_box_factory()(
+            lambda idx, g=g: got_e.__setitem__(g, idx))
+        bt = eng_t.ballot_box_factory()(
+            lambda idx, g=g: got_t.__setitem__(g, idx))
+        for b in (be, bt):
+            b.update_conf(conf, Configuration())
+            b.reset_pending_index(1)
+        for p in peers:
+            m = int(rng.integers(0, 50))
+            be.commit_at(p, m, conf, Configuration())
+            bt.commit_at(p, m, conf, Configuration())
+    eng_t.tick_once()   # the tick plane needs its tick; eager did not
+    assert got_e == got_t and len(got_t) > 0
+
+
+async def test_eager_commit_off_waits_for_tick():
+    eng = _eager_engine(False)
+    peers = _voters(9930)
+    conf = Configuration(list(peers))
+    commits: list[int] = []
+    box = eng.ballot_box_factory()(commits.append)
+    box.update_conf(conf, Configuration())
+    box.reset_pending_index(1)
+    for p in peers:
+        box.commit_at(p, 4, conf, Configuration())
+    assert not commits, "eager_commit=False must defer to the tick"
+    eng.tick_once()
+    assert commits == [4] and eng.eager_commits == 0
+
+
+# ---------------------------------------------------------------------------
+# pipelined apply: ack at commit, apply behind
+# ---------------------------------------------------------------------------
+
+
+async def test_fsm_caller_eager_closure_fires_at_commit():
+    from tpuraft.core.fsm_caller import FSMCaller
+    from tpuraft.core.state_machine import StateMachine
+    from tpuraft.entity import EntryType, LogEntry, LogId
+
+    release = asyncio.Event()
+    applied: list[int] = []
+
+    class SlowFSM(StateMachine):
+        async def on_apply(self, it):
+            await release.wait()
+            while it.valid():
+                applied.append(it.index())
+                it.next()
+
+    entries = {i: LogEntry(type=EntryType.DATA, data=b"x",
+                           id=LogId(i, 1)) for i in (1, 2)}
+    lm = SimpleNamespace(get_entry=lambda i: entries.get(i),
+                         set_applied_index=lambda i: None)
+    fc = FSMCaller(SlowFSM(), lm)
+    await fc.init(LogId(0, 0))
+    eager_done: list[Status] = []
+    late_done: list[Status] = []
+    fc.append_pending_closure(1, eager_done.append, ack_at_commit=True)
+    fc.append_pending_closure(2, late_done.append)
+    fc.on_committed(2)
+    # the eager closure fired synchronously AT commit; the normal one
+    # waits for its apply, which is still blocked
+    assert len(eager_done) == 1 and eager_done[0].is_ok()
+    assert fc.eager_acked == 1
+    assert not late_done and not applied
+    release.set()
+    for _ in range(100):
+        if late_done:
+            break
+        await asyncio.sleep(0.01)
+    assert late_done and late_done[0].is_ok()
+    assert applied == [1, 2]
+    assert fc.last_applied_index == 2
+    await fc.shutdown()
+
+
+async def test_fail_pending_clears_eager_queue():
+    from tpuraft.core.fsm_caller import FSMCaller
+    from tests.cluster import MockStateMachine
+    from tpuraft.entity import LogId
+
+    lm = SimpleNamespace(get_entry=lambda i: None,
+                         set_applied_index=lambda i: None)
+    fc = FSMCaller(MockStateMachine(), lm)
+    await fc.init(LogId(0, 0))
+    got: list[Status] = []
+    fc.append_pending_closure(1, got.append, ack_at_commit=True)
+    fc.fail_pending_closures(Status.error(RaftError.ENEWLEADER, "gone"))
+    assert len(got) == 1 and not got[0].is_ok()
+    fc.on_committed(1)
+    assert len(got) == 1      # never double-fired
+    assert fc.eager_acked == 0
+    await fc.shutdown()
+
+
+async def test_blind_writes_ack_at_commit_cas_waits_for_apply():
+    """RaftRawKVStore eligibility: PUT/DELETE propose ack-at-commit
+    tasks; CAS (result depends on store state) must wait for apply."""
+    from tpuraft.rheakv.raft_store import _BLIND_OPS
+    from tpuraft.rheakv.kv_operation import KVOp
+
+    assert KVOp.PUT in _BLIND_OPS and KVOp.DELETE in _BLIND_OPS
+    assert KVOp.COMPARE_PUT not in _BLIND_OPS
+    assert KVOp.GET_AND_PUT not in _BLIND_OPS
+    assert KVOp.GET_SEQUENCE not in _BLIND_OPS
+    assert KVOp.KEY_LOCK not in _BLIND_OPS
+
+
+# ---------------------------------------------------------------------------
+# integration: real cluster through the batched write plane
+# ---------------------------------------------------------------------------
+
+
+async def test_cluster_replicates_through_store_append_rounds():
+    c = TestCluster(3, append_batching=True)
+    try:
+        await c.start_all()
+        leader = await c.wait_leader()
+        for i in range(10):
+            st = await c.apply_ok(leader, b"w%d" % i)
+            assert st.is_ok(), st
+        await c.wait_applied(10)
+        b = c.batchers[leader.server_id]
+        assert b.rounds > 0 and b.rows > 0 and b.entries >= 10
+        assert b.fallbacks == 0 and b.round_errors == 0
+    finally:
+        await c.stop_all()
+
+
+async def test_cluster_leader_deposed_mid_round_voids_rows():
+    """Safety edge: rows of a round built under term T resolve AFTER
+    the leader stepped down to T' > T — the replicator's term pin voids
+    them (rollback, no commit advance), the proposer is failed with
+    ENEWLEADER, and the deposed node never applies the entry."""
+    c = TestCluster(3, append_batching=True, election_timeout_ms=500)
+    try:
+        await c.start_all()
+        leader = await c.wait_leader()
+        # stall every outbound append from the leader: rounds hang
+        followers = [p for p in c.peers if p != leader.server_id]
+        c.net.partition_one_way({leader.server_id.endpoint},
+                                {p.endpoint for p in followers})
+        st_box: list = []
+        from tpuraft.entity import Task
+
+        await leader.apply(Task(data=b"doomed",
+                                done=lambda st: st_box.append(st)))
+        await asyncio.sleep(0.1)   # round submitted, blackholed
+        committed_before = leader.ballot_box.last_committed_index
+        # depose: a higher term arrives (e.g. a vote response)
+        await leader.step_down_on_higher_term(
+            leader.current_term + 1, "test depose")
+        c.net.heal()
+        await asyncio.sleep(0.3)
+        assert st_box and not st_box[0].is_ok()
+        assert st_box[0].raft_error in (RaftError.ENEWLEADER,
+                                        RaftError.ENODESHUTTING)
+        # the old leader's commit never advanced past the depose point
+        # on the voided round's acks
+        assert leader.ballot_box.pending_index == 0
+        assert len(c.fsms[leader.server_id].logs) == 0 or \
+            b"doomed" not in c.fsms[leader.server_id].logs
+        assert committed_before <= leader.ballot_box.last_committed_index
+    finally:
+        await c.stop_all()
+
+
+async def test_cluster_mixed_fleet_endpoint_downgrades():
+    """One follower's endpoint predates the write plane (its manager
+    never registered store_append): the leader's batcher downgrades
+    THAT endpoint permanently while the new endpoint keeps riding
+    rounds — and replication stays correct on both."""
+    c = TestCluster(3, append_batching=True)
+    try:
+        await c.start_all()
+        leader = await c.wait_leader()
+        followers = [p for p in c.peers if p != leader.server_id]
+        old = followers[0]
+        # simulate a pre-write-plane build on one endpoint
+        del c.managers[old].server._handlers["store_append"]
+        for i in range(6):
+            st = await c.apply_ok(leader, b"m%d" % i)
+            assert st.is_ok(), st
+        await c.wait_applied(6)
+        b = c.batchers[leader.server_id]
+        assert b.fallbacks == 1, b.describe()
+        assert b.legacy_rows > 0
+        assert b._fast_ok.get(old.endpoint) is False
+        assert b._fast_ok.get(followers[1].endpoint, True) is True
+    finally:
+        await c.stop_all()
+
+
+async def test_kv_put_acked_at_commit_read_sees_applied_state():
+    """End-to-end pipelined apply through the KV stack: a PUT acked at
+    commit is observed by an immediately-following GET (the read fence
+    waits for applied), and the eager counters prove the path ran."""
+    from tests.test_kv_client import kv_client_cluster
+    from tpuraft.rheakv.metadata import Region
+
+    regions = [Region(id=1, start_key=b"", end_key=b"")]
+    async with kv_client_cluster(regions=regions) as (c, kv):
+        await c.wait_region_leader(1)
+        for i in range(5):
+            assert await kv.put(b"k%d" % i, b"v%d" % i)
+            assert await kv.get(b"k%d" % i) == b"v%d" % i
+        # CAS still round-trips through its apply (not eager) and
+        # returns the state-dependent result
+        assert await kv.compare_and_put(b"k0", b"v0", b"v0'") is True
+        assert await kv.compare_and_put(b"k0", b"nope", b"x") is False
+        eager = sum(re.node.fsm_caller.eager_acked
+                    for s in c.stores.values()
+                    for re in s._regions.values() if re.node)
+        assert eager >= 5, "blind writes never took the eager path"
+
+
+async def test_store_engine_append_batching_off_uses_send_plane():
+    """The A/B knob: append_batching=False stores wire no batcher and
+    replication still works through the legacy endpoint lane."""
+    from tests.test_kv_client import kv_client_cluster
+    from tpuraft.rheakv.metadata import Region
+
+    regions = [Region(id=1, start_key=b"", end_key=b"")]
+    async with kv_client_cluster(
+            regions=regions,
+            store_opts={"append_batching": False,
+                        "ack_at_commit": False}) as (c, kv):
+        await c.wait_region_leader(1)
+        assert await kv.put(b"a", b"1")
+        assert await kv.get(b"a") == b"1"
+        for s in c.stores.values():
+            assert s.append_batcher is None
+            eager = sum(re.node.fsm_caller.eager_acked
+                        for re in s._regions.values() if re.node)
+            assert eager == 0

@@ -118,8 +118,11 @@ async def test_engine_backed_cluster_replicates():
             sets = [sorted(c.fsms[(gid, ep)].logs) for ep in c.endpoints]
             assert sets[0] == sets[1] == sets[2]
             assert len(sets[0]) == 5
-        # the engine actually ticked and advanced commits in batch
-        assert any(e.ticks > 0 and e.commit_advances > 0
+        # the engine actually ticked and advanced commits (ack-path
+        # eager advances + tick-discovered batch advances are both the
+        # engine plane's work)
+        assert any(e.ticks > 0
+                   and e.commit_advances + e.eager_commits > 0
                    for e in c.engines.values())
     finally:
         await c.stop_all()
@@ -208,8 +211,10 @@ async def test_engine_scale_64_groups():
             for ep in c.endpoints:
                 assert c.fsms[(g, ep)].logs[-1] == b"w-" + g.encode()
 
-        # the commits actually flowed through the batched device plane
-        total_advances = sum(e.commit_advances for e in c.engines.values())
+        # the commits actually flowed through the engine plane (eager
+        # ack-path advances + tick-discovered batch advances)
+        total_advances = sum(e.commit_advances + e.eager_commits
+                             for e in c.engines.values())
         assert total_advances >= len(c.groups), total_advances
     finally:
         await c.stop_all()
@@ -244,12 +249,16 @@ async def test_engine_mesh_sharded_quorum_matches_numpy():
                               Configuration())
         return eng, boxes, commits
 
-    opts_np = TickOptions(max_groups=G, max_peers=P, backend="numpy")
+    # eager_commit off: these tests pin the DEVICE reduce against the
+    # numpy oracle — ack-path eager advances would commit everything
+    # before either tick runs and collapse the comparison
+    opts_np = TickOptions(max_groups=G, max_peers=P, backend="numpy",
+                          eager_commit=False)
     eng_np, _, commits_np = build(opts_np)
     eng_np.tick_once()
 
     opts_mesh = TickOptions(max_groups=G, max_peers=P, backend="jax",
-                            mesh_devices=8)
+                            mesh_devices=8, eager_commit=False)
     eng_mesh, _, commits_mesh = build(opts_mesh)
     await eng_mesh.start()
     try:
@@ -295,12 +304,13 @@ async def test_engine_64k_groups_mesh_sharded_with_learners():
             box.commit_at(learner, 10_000, conf, Configuration())
         return eng, boxes, commits
 
-    opts_np = TickOptions(max_groups=G, max_peers=P, backend="numpy")
+    opts_np = TickOptions(max_groups=G, max_peers=P, backend="numpy",
+                          eager_commit=False)
     eng_np, boxes_np, commits_np = build(opts_np)
     eng_np.tick_once()
 
     opts_mesh = TickOptions(max_groups=G, max_peers=P, backend="jax",
-                            mesh_devices=8)
+                            mesh_devices=8, eager_commit=False)
     eng_mesh, boxes_mesh, commits_mesh = build(opts_mesh)
     await eng_mesh.start()
     try:
@@ -425,7 +435,8 @@ async def test_engine_adversarial_network_invariants():
             f"groups failed to converge: {set(c.groups) - converged}"
         # the device plane did the work: every engine ticked and advanced
         assert all(e.ticks > 0 for e in c.engines.values())
-        assert any(e.commit_advances > 0 for e in c.engines.values())
+        assert any(e.commit_advances + e.eager_commits > 0
+                   for e in c.engines.values())
     finally:
         await c.stop_all()
 
@@ -481,9 +492,11 @@ async def test_engine_grows_under_mesh_sharding():
     peers = [PID.parse(f"127.0.0.1:{7200 + i}") for i in range(3)]
     conf = Configuration(list(peers))
     eng = MultiRaftEngine(TickOptions(
-        max_groups=8, max_peers=4, backend="jax", mesh_devices=8))
+        max_groups=8, max_peers=4, backend="jax", mesh_devices=8,
+        eager_commit=False))
     ref = MultiRaftEngine(TickOptions(
-        max_groups=8, max_peers=4, backend="numpy"))
+        max_groups=8, max_peers=4, backend="numpy",
+        eager_commit=False))
     await eng.start()
     try:
         got: dict[int, int] = {}
@@ -603,11 +616,15 @@ async def test_engine_1k_groups_5_replicas():
             boxes.append(box)
         return eng, commits
 
+    # eager_commit off: the jax-vs-oracle reduce comparison is the
+    # point (ack-path eager advances would pre-empt both ticks)
     eng_np, commits_np = build(TickOptions(
-        max_groups=G, max_peers=8, backend="numpy"))
+        max_groups=G, max_peers=8, backend="numpy",
+        eager_commit=False))
     eng_np.tick_once()
     eng_jax, commits_jax = build(TickOptions(
-        max_groups=G, max_peers=8, backend="jax"))
+        max_groups=G, max_peers=8, backend="jax",
+        eager_commit=False))
     await eng_jax.start()
     try:
         eng_jax.tick_once()

@@ -131,7 +131,8 @@ async def test_engine_elects_4k_groups_one_process(tmp_path):
         # apply-throughput test, not a control-plane test)
         sample = nodes[:: G // 64]
         await asyncio.gather(*(_apply_ok(n, b"x") for n in sample))
-        assert engine.ticks > 0 and engine.commit_advances >= len(sample)
+        assert engine.ticks > 0
+        assert engine.commit_advances + engine.eager_commits >= len(sample)
         print(f"4k groups: init {init_s:.1f}s, all elected +{elect_s:.1f}s, "
               f"ticks={engine.ticks}")
     finally:

@@ -262,8 +262,10 @@ async def test_raft_store_public_apply_api():
         rs = leader.raft_store
         assert await rs.apply(KVOperation(KVOp.PUT, b"pub", b"1")) is True
         assert await rs._apply(KVOperation(KVOp.PUT, b"pri", b"2")) is True
-        assert rs.store.get(b"pub") == b"1"
-        assert rs.store.get(b"pri") == b"2"
+        # blind writes ack at COMMIT (ISSUE 15 pipelined apply): the
+        # fenced read path — not a raw store peek — observes the value
+        assert await rs.get(b"pub") == b"1"
+        assert await rs.get(b"pri") == b"2"
 
 
 # ---- FSM apply coalescing (unit tier) --------------------------------------

@@ -392,8 +392,10 @@ async def test_64_region_store_with_engine_plane():
         # a full scan crosses all 64 regions in order
         rows = await kv.scan(b"", b"")
         assert [k for k, _ in rows] == sorted(keys)
-        # commits flowed through the batched engine planes
+        # commits flowed through the engine planes (eager ack-path
+        # advances + tick-discovered ones are both engine-plane paths)
         advances = sum(s.multi_raft_engine.commit_advances
+                       + s.multi_raft_engine.eager_commits
                        for s in c.stores.values())
         assert advances >= 64, advances
 

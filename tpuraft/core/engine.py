@@ -121,7 +121,14 @@ class TpuBallotBox:
 
     def commit_at(self, peer: PeerId, match_index: int, conf: Configuration,
                   old_conf: Configuration) -> bool:
-        """Record the ack; actual quorum reduce happens on device."""
+        """Record the ack.  With ``TickOptions.eager_commit`` (default)
+        the ack that completes a quorum advances the commit point RIGHT
+        HERE — one scalar order statistic over this slot's [P] row, the
+        same joint math the device tick reduces — instead of waiting
+        out the tick pace.  The tick remains the batch plane (and the
+        safety net: it recomputes the same value); a hot group's
+        quorum closes on the ack path, event-driven, exactly like the
+        scalar BallotBox."""
         if self.pending_index == 0:
             return False
         e = self._engine
@@ -130,8 +137,20 @@ class TpuBallotBox:
             return False
         if match_index > e.match_abs[self.slot, col]:
             e.match_abs[self.slot, col] = match_index
+            if e.opts.eager_commit:
+                # the ack path IS the commit tally now — no dirty mark:
+                # a per-ack tick would re-reduce all [G] rows just to
+                # find the commit this call already advanced (measured:
+                # ack-driven ticks were ~2/3 of the loop's CPU at 1024
+                # regions under write load).  Deadline-driven work
+                # (beats, elections, snapshots) wakes the tick loop on
+                # its own clock, and set_conf/role transitions keep
+                # their explicit mark_dirty — a conf shrink that
+                # advances the quorum without a new ack still gets its
+                # discovery tick from set_conf's own mark.
+                return e.eager_commit_slot(self.slot)
             e.mark_dirty()
-        return False  # advancement is reported asynchronously by the tick
+        return False
 
     def update_conf(self, conf: Configuration, old_conf: Configuration) -> None:
         self._engine.set_conf(self.slot, conf, old_conf)
@@ -754,6 +773,10 @@ class MultiRaftEngine:
         self._params_dev = None
         self.ticks = 0
         self.commit_advances = 0
+        # event-driven commit advancement (TickOptions.eager_commit):
+        # quorums closed on the ack path by eager_commit_slot, without
+        # waiting for the next device tick
+        self.eager_commits = 0
         # device-tick profiling (fleet observability): per-tick wall
         # time attributed to the three phases every tick pays — host
         # state build, device dispatch (jit call + output transfer, or
@@ -1165,6 +1188,7 @@ class MultiRaftEngine:
                 f"backend={self.opts.backend} "
                 f"mesh={self.opts.mesh_devices or 1} "
                 f"ticks={self.ticks} commit_advances={self.commit_advances} "
+                f"eager_commits={self.eager_commits} "
                 f"leaders={int((self.role == ROLE_LEADER).sum())} "
                 f"quiescent={int(self.quiescent.sum())} "
                 f"quiesce_events={self.quiesce_events} "
@@ -1574,6 +1598,36 @@ class MultiRaftEngine:
             & (now >= self.snap_deadline),
             q_ack=q_ack,
         )
+
+    def eager_commit_slot(self, s: int) -> bool:
+        """Event-driven commit advancement for ONE slot, on the ack path
+        (TickOptions.eager_commit): the scalar mirror of the device
+        tick's joint quorum reduce over this slot's [P] match row —
+        joint-consensus aware (both quorums while ``old_voter_mask`` is
+        populated), gated on the leadership window (``pending_rel``)
+        exactly like ops/tick.py's ``can_commit``.  ~O(P log P) per
+        ack on one row; the win is that a hot group's quorum closes on
+        the ack that completes it instead of waiting out the tick
+        pace.  The next tick recomputes the same value and finds
+        nothing to advance (``commit_abs`` already moved)."""
+        row = self.match_abs[s]
+
+        def order_stat(mask: np.ndarray) -> int:
+            vals = np.sort(row[mask])[::-1]
+            n = vals.size
+            return int(vals[n // 2]) if n else -1
+
+        q = order_stat(self.voter_mask[s])
+        if self.old_voter_mask[s].any():
+            q = min(q, order_stat(self.old_voter_mask[s]))
+        if q < self.base[s] + self.pending_rel[s] or q <= self.commit_abs[s]:
+            return False
+        self.commit_abs[s] = q
+        self.eager_commits += 1
+        box = self._boxes[s]
+        if box is not None:
+            box._advance(q)
+        return True
 
     def _apply_commits(self, out) -> int:
         advanced = 0

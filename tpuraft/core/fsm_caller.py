@@ -47,6 +47,13 @@ class FSMCaller:
         self.apply_batches = 0
         self.applied_entries = 0
         self._closures: dict[int, Callable[[Status], None]] = {}
+        # pipelined apply (Task.ack_at_commit): indices whose closure
+        # fires at COMMIT, with the FSM apply running behind in
+        # coalesced batches.  Staged in increasing index order (the
+        # node stages entries monotonically under its lock), so firing
+        # is a popleft scan, not a dict walk.
+        self._eager: deque = deque()
+        self.eager_acked = 0   # closures fired at commit (observability)
         # demand-spawned drain (r4): a standing task per FSMCaller was
         # O(nodes) standing tasks per process — at 16K groups x 3
         # replicas that alone is 48K idle tasks (the election-starvation
@@ -88,9 +95,11 @@ class FSMCaller:
 
     # -- producers (called from node / ballot box) ---------------------------
 
-    def append_pending_closure(self, index: int, done: Callable[[Status], None]
-                               ) -> None:
+    def append_pending_closure(self, index: int, done: Callable[[Status], None],
+                               ack_at_commit: bool = False) -> None:
         self._closures[index] = done
+        if ack_at_commit:
+            self._eager.append(index)
 
     def fail_pending_closures(self, status: Status) -> None:
         """New leader emerged / stepping down: pending tasks won't commit here."""
@@ -100,6 +109,7 @@ class FSMCaller:
             except Exception:
                 LOG.exception("closure failed")
         self._closures.clear()
+        self._eager.clear()
 
     def on_committed(self, index: int) -> None:
         if index <= self._committed_index:
@@ -107,6 +117,21 @@ class FSMCaller:
         self._committed_index = index
         if self._health is not None:
             self._health.note_apply_depth(index - self.last_applied_index)
+        if self._eager and self._error is None:
+            # ack-at-commit: blind writes resolve their proposers NOW —
+            # commitment is their linearization point and their result
+            # is known a priori — while the FSM applies behind in
+            # coalesced batches.  A poisoned pipeline skips this (those
+            # closures fail through fail_pending_closures instead).
+            while self._eager and self._eager[0] <= index:
+                done = self._closures.pop(self._eager.popleft(), None)
+                if done is None:
+                    continue
+                self.eager_acked += 1
+                try:
+                    done(Status.OK())
+                except Exception:
+                    LOG.exception("eager closure failed")
         self._enqueue(("committed", index))
 
     def on_leader_start(self, term: int) -> None:

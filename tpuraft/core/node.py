@@ -253,6 +253,12 @@ class Node:
         self.snapshot_executor = None  # set in init when snapshot_uri given
         self.read_only_service = None
         self.node_manager = None  # set by RaftGroupService (file service)
+        # store-wide write plane (AppendBatcher): when the hosting store
+        # attaches one, this node's replicators submit their windows to
+        # it instead of the per-endpoint send-plane lane — one windowed
+        # store_append round per destination carries every led group's
+        # pending entries (the read plane's ReadConfirmBatcher mirror)
+        self.append_batcher = None
 
         self._meta: RaftMetaStorage = None  # type: ignore[assignment]
         self._lock = asyncio.Lock()
@@ -587,7 +593,8 @@ class Node:
             for i, task in enumerate(good):
                 if task.done:
                     self.fsm_caller.append_pending_closure(
-                        first_index + i, task.done)
+                        first_index + i, task.done,
+                        ack_at_commit=task.ack_at_commit)
             self.replicators.wake_all()
         # fsync outside the lock; batched with concurrent appliers
         await self.log_manager.flush_staged(last_id.index)

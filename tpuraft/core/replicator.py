@@ -96,8 +96,16 @@ class Replicator:
         self._running = True
         node = self._node
         if node.node_manager is not None:
-            self._sender = node.node_manager.send_plane.sender(
-                self.peer.endpoint)
+            if node.append_batcher is not None:
+                # store-wide write plane: this group's windows join the
+                # store's windowed per-destination append rounds
+                # (AppendBatcher) instead of the send plane's
+                # stop-and-wait endpoint lane — same submit/response
+                # contract either way
+                self._sender = node.append_batcher
+            else:
+                self._sender = node.node_manager.send_plane.sender(
+                    self.peer.endpoint)
         else:
             self._sender = _DirectSender(self.peer.endpoint)
         self.wake()  # initial probe

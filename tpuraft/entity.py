@@ -366,3 +366,9 @@ class Task:
     # trace plane: carried onto the staged LogEntry (util/trace); 0 =
     # untraced (the steady state)
     trace_id: int = field(default=0, compare=False, repr=False)
+    # pipelined apply (write plane): ``done`` fires the moment the entry
+    # COMMITS instead of after the FSM applies it — only valid for ops
+    # whose result is known a priori (blind writes); the read-fence
+    # machinery (read_index + wait_applied) keeps reads observing
+    # applied state.  See FSMCaller's eager-ack path.
+    ack_at_commit: bool = field(default=False, compare=False, repr=False)

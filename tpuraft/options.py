@@ -120,6 +120,14 @@ class TickOptions:
     # instead of per-group RepeatedTimers — the SURVEY §8.1 device
     # plane.  False = commit-reduce only (legacy: host timers).
     drive_protocol: bool = True
+    # Event-driven commit advancement: an ack that completes a quorum
+    # advances that group's commit point ON THE ACK PATH (one scalar
+    # order statistic over the slot's [P] row — the same joint math the
+    # device tick reduces) instead of waiting out the tick pace.  The
+    # tick stays the batch plane and recomputes the same value as a
+    # safety net.  False = tick-cadence commits (the pre-write-plane
+    # behavior; also what the device-vs-oracle parity tests pin).
+    eager_commit: bool = True
     # Density-aware timeout floors: the engine derives a minimum election
     # timeout from the REGISTERED group count and the measured tick
     # dispatch cost, and raises any group whose requested timeout sits

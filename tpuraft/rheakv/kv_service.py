@@ -475,9 +475,15 @@ class KVCommandProcessor:
                 if served and self._heat is not None:
                     self._heat.note_read(rid, served, out_bytes)
 
-            await asyncio.gather(
-                *([run_writes()] if writes else []),
-                *([run_reads()] if reads else []))
+            if not reads:
+                # the pure-write sub-batch (the w256 shape): no gather
+                # layer — one less task per region per RPC on the
+                # saturated write path
+                await run_writes()
+            elif not writes:
+                await run_reads()
+            else:
+                await asyncio.gather(run_writes(), run_reads())
 
         await asyncio.gather(*(run_region(rid, items)
                                for rid, items in groups.items()))

@@ -29,11 +29,29 @@ class KVStoreError(Exception):
         self.status = status
 
 
+# blind writes: ops whose FSM result is known a priori (always True) —
+# the set eligible for ack-at-commit (the pipelined-apply fast path);
+# anything whose result depends on store state (CAS, sequences, locks,
+# reads-via-log) must wait for its apply
+_BLIND_OPS = frozenset((KVOp.PUT, KVOp.DELETE, KVOp.PUT_LIST,
+                        KVOp.DELETE_LIST, KVOp.DELETE_RANGE, KVOp.MERGE))
+
+_NOT_EAGER = object()
+
+
 class RaftRawKVStore:
     def __init__(self, node: Node, store: RawKVStore,
-                 apply_batch: int = 32, multi_entries: bool = True):
+                 apply_batch: int = 32, multi_entries: bool = True,
+                 ack_at_commit: bool = True):
         self.node = node
         self.store = store
+        # pipelined apply: blind writes ack their proposer at COMMIT
+        # (the entry's linearization point — the result is known a
+        # priori) and the FSM applies behind in coalesced batches;
+        # reads still observe applied state through the read fence
+        # (read_index + wait_applied).  False = ack after apply (the
+        # pre-write-plane behavior).
+        self._ack_at_commit = ack_at_commit
         # multi_entries=False is the mixed-version escape hatch: a
         # KVOp.MULTI log entry replicated to a pre-batch replica would
         # fail its apply (unknown op) and silently diverge state — in a
@@ -58,11 +76,20 @@ class RaftRawKVStore:
 
     # -- write path (through the log) ---------------------------------------
 
-    async def apply(self, op: KVOperation):
+    async def apply(self, op: KVOperation, eager_result=_NOT_EAGER):
         """Replicate one KVOperation through the region's raft group and
         return its FSM result (public API — the KV command processors
         drive proposals through here).  Raises :class:`KVStoreError` on
-        a failed proposal or a failed apply."""
+        a failed proposal or a failed apply.
+
+        ``eager_result``: pipelined-apply fast path — when set (or
+        derived below for blind ops), the proposal acks at COMMIT with
+        this pre-known result instead of waiting for the FSM apply."""
+        if eager_result is _NOT_EAGER and self._ack_at_commit \
+                and op.op in _BLIND_OPS:
+            eager_result = True  # blind writes always apply to True
+        elif not self._ack_at_commit:
+            eager_result = _NOT_EAGER
         fut = asyncio.get_running_loop().create_future()
         # encode HERE, not in the drainer: a malformed op (bad key
         # type) must fail its own caller, not kill the drain task and
@@ -73,7 +100,7 @@ class RaftRawKVStore:
         # + stage + fsync wait) + quorum round + FSM apply, ending when
         # the closure resolves — the server-side submit→ack envelope
         t0 = time.perf_counter() if tid else 0.0
-        self._pending.append((blob, fut, tid))
+        self._pending.append((blob, fut, tid, eager_result))
         if self._drainer is None or self._drainer.done():
             self._drainer = asyncio.ensure_future(self._drain())
         status, result = await fut
@@ -125,7 +152,12 @@ class RaftRawKVStore:
         # sub-op's (the whole sub-batch shares one log entry / quorum
         # round, so its flush/quorum/apply stages are genuinely shared)
         mop.trace_id = next((o.trace_id for o in ops if o.trace_id), 0)
-        outs = await self.apply(mop)
+        eager = _NOT_EAGER
+        if self._ack_at_commit and all(o.op in _BLIND_OPS for o in ops):
+            # an all-blind MULTI's per-op outcomes are known a priori
+            # too — ack the whole sub-batch at commit, apply behind
+            eager = [(0, "", True)] * len(ops)
+        outs = await self.apply(mop, eager_result=eager)
         return [(Status.OK() if code == 0 else Status(code, msg), result)
                 for code, msg, result in outs]
 
@@ -138,13 +170,22 @@ class RaftRawKVStore:
             del self._pending[:len(batch)]
             self.propose_drains += 1
             self.proposed_ops += len(batch)
-            tasks = [Task(data=blob, done=KVClosure(fut), trace_id=tid)
-                     for blob, fut, tid in batch]
+            tasks = []
+            for blob, fut, tid, eager_result in batch:
+                closure = KVClosure(fut)
+                if eager_result is not _NOT_EAGER:
+                    # ack-at-commit: the result is pre-known, so the
+                    # closure carries it from the start — the commit
+                    # fires it, the apply behind finds the future done
+                    closure.result = eager_result
+                tasks.append(Task(data=blob, done=closure, trace_id=tid,
+                                  ack_at_commit=eager_result
+                                  is not _NOT_EAGER))
             try:
                 await self.node.apply_batch(tasks)
             except Exception as e:  # noqa: BLE001 — fail THIS batch only
                 st = Status.error(RaftError.EINTERNAL, f"apply: {e!r}")
-                for _, fut, _tid in batch:
+                for _, fut, _tid, _eager in batch:
                     if not fut.done():
                         fut.set_result((st, None))
 

@@ -57,8 +57,14 @@ class RegionEngine:
             # store-wide SAFE read amortization: this group's quorum
             # confirmations ride the store's shared beat-plane rounds
             node.read_only_service.attach_confirm_batcher(se.read_batcher)
+        if se.append_batcher is not None:
+            # store-wide write amortization (the read batcher's mirror):
+            # this group's replicators submit their entry windows to the
+            # store's windowed per-destination append rounds
+            node.append_batcher = se.append_batcher
         self.raft_store = RaftRawKVStore(
-            node, se.raw_store, multi_entries=se.opts.multi_op_entries)
+            node, se.raw_store, multi_entries=se.opts.multi_op_entries,
+            ack_at_commit=se.opts.ack_at_commit)
         LOG.info("region engine started: %s on %s", self.region,
                  se.server_id)
 

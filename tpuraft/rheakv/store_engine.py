@@ -99,6 +99,19 @@ class StoreEngineOptions:
     # (ReadConfirmBatcher) instead of one quorum heartbeat round per
     # group.  False = per-group rounds (the pre-batch behavior).
     read_confirm_batching: bool = True
+    # store-wide WRITE amortization (the read plane's mirror): every led
+    # group's pending entry windows toward one destination endpoint ride
+    # ONE windowed store_append round (core/append_batcher.AppendBatcher)
+    # instead of the send plane's stop-and-wait endpoint lane.  Receivers
+    # that predate the RPC get permanent per-group AppendEntries
+    # fallback.  False = the pre-write-plane send-plane lane.
+    append_batching: bool = True
+    # pipelined FSM apply: blind writes (PUT/DELETE/... — result known a
+    # priori) ack the client the moment their entry COMMITS; the FSM
+    # applies behind in coalesced batches, and the read fence
+    # (read_index + wait_applied) keeps reads observing applied state.
+    # False = ack after apply (the pre-write-plane behavior).
+    ack_at_commit: bool = True
     # -- gray-failure survival (fail-slow detection + mitigation) ------------
     # score this store {HEALTHY, DEGRADED, SICK} from hot-path signals
     # (append/fsync latency, peer ack RTTs, apply backlog — see
@@ -487,6 +500,16 @@ class StoreEngine:
             from tpuraft.util import describer
 
             describer.register(self.read_batcher)
+        # store-wide write plane (the read batcher's mirror): every
+        # region node's replicators submit their windows here
+        # (RegionEngine.start attaches it to each node)
+        self.append_batcher = None
+        if opts.append_batching:
+            from tpuraft.core.append_batcher import AppendBatcher
+            from tpuraft.util import describer
+
+            self.append_batcher = AppendBatcher()
+            describer.register(self.append_batcher)
         # gray-failure plane: one HealthTracker per store, fed by the
         # hot path (LogManager flush timing, beat-plane ack RTTs, FSM
         # apply backlog) and acted on by the health loop below
@@ -505,6 +528,9 @@ class StoreEngine:
             describer.register(self.health)
             if self.read_batcher is not None:
                 self.read_batcher.health = self.health
+            if self.append_batcher is not None:
+                # write-plane rounds double as per-endpoint RTT probes
+                self.append_batcher.health = self.health
         self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
         if self.health is not None:
             self.health.register_gauges(self.metrics)
@@ -657,6 +683,11 @@ class StoreEngine:
 
             describer.unregister(self.read_batcher)
             await self.read_batcher.shutdown()
+        if self.append_batcher is not None:
+            from tpuraft.util import describer
+
+            describer.unregister(self.append_batcher)
+            await self.append_batcher.shutdown()
         for engine in list(self._regions.values()):
             await engine.shutdown()
         self._regions.clear()
@@ -810,24 +841,28 @@ class StoreEngine:
         # per-region O(regions) aggregation (the pass metrics_text's
         # TTL cache bounds): apply/propose plane totals across every
         # hosted region — entries-per-batch amortization, live
-        apply_batches = applied_entries = 0
+        apply_batches = applied_entries = eager_acked = 0
         propose_drains = proposed_ops = 0
         for eng in list(self._regions.values()):
             node = eng.node
             if node is not None and node.fsm_caller is not None:
                 apply_batches += node.fsm_caller.apply_batches
                 applied_entries += node.fsm_caller.applied_entries
+                eager_acked += node.fsm_caller.eager_acked
             if eng.raft_store is not None:
                 propose_drains += eng.raft_store.propose_drains
                 proposed_ops += eng.raft_store.proposed_ops
         counters.update({
             "fsm_apply_batches": apply_batches,
             "fsm_applied_entries": applied_entries,
+            "fsm_eager_acked": eager_acked,
             "propose_drains": propose_drains,
             "proposed_ops": proposed_ops,
         })
         if self.read_batcher is not None:
             counters.update(self.read_batcher.counters())
+        if self.append_batcher is not None:
+            counters.update(self.append_batcher.counters())
         counters.update(self.node_manager.heartbeat_hub.counters())
         counters.update(TRACER.counters())
         counters.update(RECORDER.counters())
@@ -862,6 +897,7 @@ class StoreEngine:
             eng = self.multi_raft_engine
             counters["engine_ticks"] = eng.ticks
             counters["engine_commit_advances"] = eng.commit_advances
+            counters["engine_eager_commits"] = eng.eager_commits
             gauges.update({f"engine_{k}": v
                            for k, v in eng.lane_stats().items()})
         return counters, gauges

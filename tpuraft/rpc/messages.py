@@ -334,6 +334,32 @@ class BatchResponse:
     items: list[msg]  # noqa: F821
 
 
+@dataclass
+class StoreAppendRequest:
+    """Store-wide append round (the WRITE-plane mirror of the read
+    plane's ``multi_beat_fast`` fence round): one RPC per destination
+    endpoint carries the pending entry windows of EVERY led group on
+    the sending store whose follower lives there.  Each row is a full
+    ``AppendEntriesRequest`` — per-group prev-log/term semantics are
+    unchanged, so safety is exactly per-group AppendEntries; only the
+    RPC round trip is shared.  Dispatched by ``AppendBatcher``
+    (tpuraft/core/append_batcher.py); a receiver that predates it
+    answers ENOMETHOD and the sender downgrades PERMANENTLY to
+    per-group ``append_entries`` for that endpoint (the PD delta-batch
+    / kv_batch mixed-fleet pattern)."""
+
+    rows: list[msg]  # noqa: F821 — AppendEntriesRequest rows
+
+
+@dataclass
+class StoreAppendResponse:
+    """One ack per request row, in order: an ``AppendEntriesResponse``,
+    or an ``ErrorResponse`` for a row whose node was unroutable or busy
+    on the receiver."""
+
+    acks: list[msg]  # noqa: F821
+
+
 for _i, _t in enumerate([
     AppendEntriesRequest,
     AppendEntriesResponse,
@@ -356,6 +382,8 @@ for _i, _t in enumerate([
     BeatAck,
     StoreLeaseBeat,
     StoreLeaseAck,
+    StoreAppendRequest,
+    StoreAppendResponse,
 ]):
     register_message(_i, _t)
 
