@@ -14,7 +14,9 @@
 #                      coverage, host-sync + donated-read); <10s
 #   make san        -> sanitizer drivers only
 #   make chaos-smoke-> storage-plane crash-consistency harness + short
-#                      power-loss soak (<60s)
+#                      power-loss soak + multi-process chaos soak
+#                      (leader SIGKILL -> supervised restart ->
+#                      linearizable history)
 #   make bench      -> the device-plane headline benchmark (one JSON line)
 #   make bench-gate -> short e2e + KV serving benches; fails on >20%
 #                      regression vs the committed BENCH_E2E.json /
@@ -68,6 +70,7 @@ chaos-smoke:
 	$(PY) -m examples.soak --duration 20 --seed 3 --churn --power-loss
 	$(PY) -m examples.soak --duration 20 --seed 5 --regions 48 --engine --quiesce --kv-batching
 	$(PY) -m examples.soak --duration 20 --seed 2 --geo 3 --witness
+	$(PY) -m examples.proc_supervisor --soak --seconds 6 --apply-lane
 	$(PY) -m examples.soak --duration 20 --seed 4 --read-mix 0.95 --kv-batching
 	$(PY) -m examples.soak --duration 20 --seed 6 --gray
 	$(PY) -m examples.soak --duration 16 --seed 7 --regions 24 --hotspot

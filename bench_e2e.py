@@ -658,6 +658,7 @@ def main() -> None:
             "metric": "e2e_multiraft_commits_per_sec",
             "value": round(cps, 1),
             "unit": "commits/s",
+            "topology": "single-process",
             "vs_baseline": round(cps / 1e5, 3),
             "extra": {
                 "groups": args.groups, "stores": args.stores,

@@ -15,6 +15,16 @@ Rows:
                         regions) vs its calibration, so write-plane
                         regressions (ISSUE 15's append rounds + eager
                         commits + ack-at-commit) gate like the rest.
+  kv_mp_write_ops_per_sec — the SAME saturated pure-write shape with
+                        each store a real OS process (bench_multiproc:
+                        examples.proc_supervisor children over real
+                        sockets) vs its calibration, so the process
+                        fabric (ISSUE 16: READY probes, drain contract,
+                        per-process CPU attribution) gates alongside
+                        the in-process rows.  Calibration is same-host:
+                        on a 1-CPU container the mp shape pays socket +
+                        context-switch cost with no parallelism to buy,
+                        and the floor reflects that honestly.
   kv_ops_traced       — tracing-overhead gate: the untraced rows above
                         run with the trace plane DISABLED (the
                         zero-cost claim — any always-on cost regresses
@@ -127,6 +137,37 @@ def _run_kv_once(extra: dict, duration: float,
     return float(row["ops_per_sec"])
 
 
+def _run_mp_once(extra: dict, duration: float) -> float:
+    """One short bench_multiproc run at the gate shape: real OS-process
+    stores (examples.proc_supervisor) serving the saturated pure-write
+    workload over real sockets; returns cross-process KV ops/s."""
+    regions = int(extra.get("gate_mp_regions", 128))
+    out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_mp_"),
+                            "gate_mp.json")
+    cmd = [sys.executable, os.path.join(REPO, "bench_multiproc.py"),
+           "--regions", str(regions),
+           "--duration", str(duration),
+           "--workers", "256",
+           # calibration shape: long eto keeps timer-mode standing load
+           # flat so the short window measures serving, not elections
+           "--election-timeout-ms",
+           str(extra.get("gate_mp_eto_ms", 10000)),
+           "--json-out", out_path]
+    key = ("row_mp" if regions == 1024 else f"row_mp_{regions}") \
+        + "_w256_r0"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    print("bench-gate:", " ".join(cmd), flush=True)
+    rc = subprocess.call(cmd, env=env)
+    if rc != 0 or not os.path.exists(out_path):
+        raise RuntimeError(f"mp bench run failed (rc={rc})")
+    with open(out_path) as f:
+        data = json.load(f)
+    row = data.get(key, {})
+    if "ops_per_sec" not in row:
+        raise RuntimeError(f"mp bench produced no {key}.ops_per_sec")
+    return float(row["ops_per_sec"])
+
+
 def _gate(name: str, committed: float, run_once, threshold: float,
           retries: int) -> tuple[int, dict]:
     floor = committed * (1.0 - threshold)
@@ -186,6 +227,8 @@ def main() -> int:
             write_best = max(_run_kv_once(kv_extra, duration,
                                           read_frac=0.0, workers=256)
                              for _ in range(2))
+            mp_best = max(_run_mp_once(kv_extra, duration)
+                          for _ in range(2))
         except RuntimeError as exc:
             print(f"bench-gate: {exc}")
             return 2
@@ -198,6 +241,7 @@ def main() -> int:
         kv_extra["gate_kv_ops_per_sec"] = round(kv_best, 1)
         kv_extra["gate_read_ops_per_sec"] = round(read_best, 1)
         kv_extra["gate_write_ops_per_sec"] = round(write_best, 1)
+        kv_extra["gate_mp_write_ops_per_sec"] = round(mp_best, 1)
         kv_extra["gate_duration_s"] = duration
         kv_extra.setdefault("gate_regions", 128)
         kv_extra.setdefault("gate_eto_ms", 1000)
@@ -213,6 +257,8 @@ def main() -> int:
                               kv_extra["gate_read_ops_per_sec"],
                           "gate_write_ops_per_sec":
                               kv_extra["gate_write_ops_per_sec"],
+                          "gate_mp_write_ops_per_sec":
+                              kv_extra["gate_mp_write_ops_per_sec"],
                           "duration_s": duration}))
         return 0
 
@@ -310,6 +356,24 @@ def main() -> int:
                         float(kv_extra["gate_write_ops_per_sec"]),
                         lambda: _run_kv_once(kv_extra, duration,
                                              read_frac=0.0, workers=256),
+                        threshold, retries)
+        worst = max(worst, rc)
+        reports.append(rep)
+    if "gate_mp_write_ops_per_sec" not in kv_extra:
+        # the process fabric (ISSUE 16) needs its own regression row:
+        # the cross-process topology exercises READY probes, framed
+        # sockets, and the drain contract that no in-process row touches
+        print("bench-gate[kv_mp_write_ops_per_sec]: no calibration "
+              "(run `python bench_gate.py --record`)")
+        worst = max(worst, 2)
+        reports.append({"gate": "kv_mp_write_ops_per_sec",
+                        "verdict": "BROKEN",
+                        "error": "no gate_mp_write_ops_per_sec "
+                                 "calibration"})
+    else:
+        rc, rep = _gate("kv_mp_write_ops_per_sec",
+                        float(kv_extra["gate_mp_write_ops_per_sec"]),
+                        lambda: _run_mp_once(kv_extra, duration),
                         threshold, retries)
         worst = max(worst, rc)
         reports.append(rep)

@@ -282,6 +282,10 @@ async def run_config(args) -> dict:
     res = {
         "regions": R,
         "stores": S,
+        # client + every store multiplexed onto ONE loop in ONE process
+        # — compare against row_mp_* (bench_multiproc) for the same
+        # stack across real OS processes
+        "topology": "single-process",
         "leaders": led,
         "boot_s": round(boot_s, 1),
         "elect_s": round(elect_s, 1),

@@ -17,6 +17,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import os
+import signal
 import sys
 
 from examples.rheakv_bench import make_regions
@@ -52,11 +55,23 @@ async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
     )
     pd = PlacementDriverServer(opts, endpoint, server, transport)
     await pd.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+    except NotImplementedError:   # non-unix event loop
+        pass
+    # machine-readable readiness line first (same supervisor contract as
+    # examples.rheakv_server), the human line after
+    print("READY " + json.dumps({
+        "endpoint": endpoint, "pid": os.getpid(),
+        "metrics_port": getattr(pd, "metrics_http_port", None)}),
+        flush=True)
     print(f"pd member {endpoint} up ({len(pd_endpoints)}-member cluster)",
           flush=True)
     try:
-        while True:
-            await asyncio.sleep(3600)
+        await stop.wait()
     finally:
         await pd.shutdown()
         await server.stop()

@@ -18,6 +18,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import os
+import signal
 import sys
 
 from examples.rheakv_bench import make_regions
@@ -38,7 +41,16 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
                 store_kind: str = "memory",
                 pd_endpoints: list[str] | None = None,
                 log_scheme: str = "file",
-                metrics_port: int | None = None) -> None:
+                metrics_port: int | None = None,
+                eto_ms: int = 1000,
+                apply_lane: bool = False,
+                drain_timeout_s: float = 10.0,
+                boot_delay_s: float = 0.0) -> None:
+    if boot_delay_s:
+        # fault-injection hook: a supervised restart that comes up slow
+        # (cold page cache, crash-loop backoff) — lets tests prove the
+        # readiness probe really gates client traffic
+        await asyncio.sleep(boot_delay_s)
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -53,13 +65,12 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         server_id=endpoint,
         initial_regions=derive_regions(stores, n_regions),
         data_path=data_path,
-        election_timeout_ms=1000,
+        election_timeout_ms=eto_ms,
         log_scheme=log_scheme,
         metrics_port=metrics_port,
+        apply_lane=apply_lane,
     )
     if store_kind == "native":
-        import os
-
         from tpuraft.rheakv.native_store import NativeRawKVStore
         base = f"{data_path}/kv_{endpoint.replace(':', '_')}"
         # the C++ engine mkdirs only the leaf — ensure the parents exist
@@ -71,13 +82,30 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
         pd_client = RemotePlacementDriverClient(transport, pd_endpoints)
     engine = StoreEngine(opts, server, transport, pd_client=pd_client)
     await engine.start()
+    # SIGTERM = drain: bounce NEW work retryably (ERR_STORE_BUSY), wait
+    # for everything already admitted to ack, then exit 0 — the process
+    # supervisor's clean-stop contract (SIGKILL is the crash path)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+    except NotImplementedError:   # non-unix event loop
+        pass
+    # machine-readable readiness line FIRST (supervisors parse it to
+    # gate client traffic), the human line after
+    print("READY " + json.dumps({
+        "endpoint": endpoint, "pid": os.getpid(),
+        "metrics_port": engine.metrics_http_port,
+        "regions": n_regions}), flush=True)
     print(f"rheakv store {endpoint} up "
           f"({n_regions} regions, {len(stores)} stores)"
           + (f", /metrics on :{engine.metrics_http_port}"
              if engine.metrics_http_port else ""), flush=True)
     try:
-        while True:
-            await asyncio.sleep(3600)
+        await stop.wait()
+        clean = await engine.drain(drain_timeout_s)
+        print("DRAINED " + json.dumps({"clean": bool(clean)}), flush=True)
     finally:
         await engine.shutdown()
         await server.stop()
@@ -116,6 +144,17 @@ def main() -> None:
                          "port (0 = ephemeral, printed at boot); "
                          "omit = off — `admin.py metrics` still scrapes "
                          "over the admin transport")
+    ap.add_argument("--eto-ms", type=int, default=1000,
+                    help="election timeout (ms)")
+    ap.add_argument("--apply-lane", action="store_true",
+                    help="run FSM applies + fenced reads on a dedicated "
+                         "worker lane thread (one hot store saturates "
+                         ">1 core)")
+    ap.add_argument("--drain-timeout", type=float, default=10.0,
+                    help="seconds to wait for in-flight work on SIGTERM")
+    ap.add_argument("--boot-delay", type=float, default=0.0,
+                    help="sleep this long before serving (fault-injection "
+                         "hook for readiness-gating tests)")
     args = ap.parse_args()
     stores = [s for s in args.stores.split(",") if s]
     if args.serve not in stores:
@@ -126,7 +165,11 @@ def main() -> None:
                           args.transport, args.store,
                           [e for e in args.pd.split(",") if e] or None,
                           log_scheme=args.log_scheme,
-                          metrics_port=args.metrics_port))
+                          metrics_port=args.metrics_port,
+                          eto_ms=args.eto_ms,
+                          apply_lane=args.apply_lane,
+                          drain_timeout_s=args.drain_timeout,
+                          boot_delay_s=args.boot_delay))
     except KeyboardInterrupt:
         pass
 

@@ -109,6 +109,17 @@ class HeartbeatHub:
         self.beats_sent = 0     # individual group beats carried
         self.fast_beats_sent = 0
         self.fast_fallbacks = 0
+        # -- load-adaptive cadence widening ---------------------------------
+        # at density (1024 groups x 3 replicas) the hub builds ~2000 beat
+        # rows/s of pure standing load; when a pulse carries many rows the
+        # hub stretches its sleep toward load_widen_max x the base interval.
+        # The base interval is eto/factor/2 (register() above), so the cap
+        # of 2.0 only relaxes cadence back to the classic per-group
+        # heartbeat interval — still half the election timeout, still safe.
+        self.load_widen_rows = 512   # rows/pulse that saturate the widening
+        self.load_widen_max = 2.0
+        self._widen = 1.0            # EMA'd widening factor (>= 1.0)
+        self.widened_pulses = 0      # pulses sent while meaningfully widened
         self._fast_ok: dict[str, bool] = {}  # dst lacks multi_beat_fast
         # -- store-level liveness lease (quiescence) -------------------------
         # sender: dst endpoint -> {id(engine): [engine, transport,
@@ -155,9 +166,10 @@ class HeartbeatHub:
         for name in ("rpcs_sent", "beats_sent", "fast_beats_sent",
                      "fast_fallbacks", "groups_quiesced", "groups_woken",
                      "lease_rpcs_sent", "lease_acks", "lease_beats_seen",
-                     "lease_expiries", "lease_suppressed"):
+                     "lease_expiries", "lease_suppressed", "widened_pulses"):
             self.metrics.gauge(f"hub.{name}",
                                lambda n=name: getattr(self, n))
+        self.metrics.gauge("hub.widen_factor", lambda: self._widen)
         describer.register(self)
 
     def register(self, replicator: "Replicator") -> None:
@@ -215,7 +227,9 @@ class HeartbeatHub:
                 f"lease_expiries={self.lease_expiries} "
                 f"lease_suppressed={self.lease_suppressed} "
                 f"lease_targets={len(self._lease_targets)} "
-                f"lease_deps={sum(map(len, self._lease_deps.values()))}>")
+                f"lease_deps={sum(map(len, self._lease_deps.values()))} "
+                f"widen={self._widen:.2f} "
+                f"widened_pulses={self.widened_pulses}>")
 
     def counters(self) -> dict:
         """Counter snapshot (soak stats line / tests)."""
@@ -231,6 +245,7 @@ class HeartbeatHub:
             "lease_beats_seen": self.lease_beats_seen,
             "lease_expiries": self.lease_expiries,
             "lease_suppressed": self.lease_suppressed,
+            "widened_pulses": self.widened_pulses,
         }
 
     # -- store-level liveness lease (sender side) ----------------------------
@@ -422,7 +437,10 @@ class HeartbeatHub:
     async def _loop(self) -> None:
         try:
             while True:
-                await asyncio.sleep(self._interval_s)
+                # widened sleep: load_widen_max caps at the classic
+                # per-group cadence (see ctor), so stretching under row
+                # load never risks follower election timeouts
+                await asyncio.sleep(self._interval_s * self._widen)
                 await self.tick_once()
         except asyncio.CancelledError:
             return
@@ -500,6 +518,16 @@ class HeartbeatHub:
                 if ctrl is not None and hasattr(ctrl, "abort_quiesce"):
                     ctrl.abort_quiesce()
             classic.append(r)
+        # fold this pulse's row count into the cadence-widening EMA: a
+        # hub carrying load_widen_rows+ rows per pulse converges on
+        # load_widen_max x its base interval (timer-mode standing-load
+        # relief at region density); an idling hub decays back to 1.0
+        rows = sum(map(len, by_dst_fast.values())) + len(classic)
+        target = 1.0 + (min(1.0, rows / self.load_widen_rows)
+                        * (self.load_widen_max - 1.0))
+        self._widen += 0.25 * (target - self._widen)
+        if self._widen > 1.05:
+            self.widened_pulses += 1
         for dst, pairs in by_dst_fast.items():
             for ci in range(0, len(pairs), self.max_fast_beats_per_rpc):
                 chunk = pairs[ci:ci + self.max_fast_beats_per_rpc]

@@ -383,7 +383,8 @@ class Node:
             apply_batch=opts.raft_options.apply_batch,
             on_error=self._on_fsm_error,
             health=opts.health,
-            trace_proc=self._trace_proc)
+            trace_proc=self._trace_proc,
+            apply_lane=opts.apply_lane)
         self.fsm_caller.on_configuration_applied = self._on_configuration_applied
 
         # snapshot subsystem

@@ -203,6 +203,13 @@ class NodeOptions:
     # campaigning anyway (the liveness escape when every peer is worse
     # off) — the election-priority face of gray-failure mitigation
     sick_election_rounds: int = 2
+    # store-level FSM apply lane (tpuraft.core.lanes.WorkerLane), shared
+    # by every node the hosting store runs: when set AND the FSM exposes
+    # a sync ``apply_sync``, committed DATA runs execute on the lane
+    # thread instead of the event loop (StoreEngineOptions.apply_lane).
+    # The lane then OWNS the state the FSM mutates — all other access
+    # must be submitted through it.  None = apply on the loop.
+    apply_lane: Optional[object] = None
 
 
 @dataclass

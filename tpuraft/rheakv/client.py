@@ -30,7 +30,6 @@ from tpuraft.rheakv.kv_service import (
     KVCommandBatchRequest,
     KVCommandRequest,
     ListRegionsOnStoreRequest,
-    decode_batch_item,
     decode_batch_reply,
     decode_result,
     encode_batch_item,
@@ -87,6 +86,13 @@ class BatchingOptions:
     # slow region's quorum must not idle the whole store pipe — same
     # reasoning as the send plane's multi-lane vote dispatch
     max_store_inflight: int = 4
+    # optional WorkerLane (tpuraft.core.lanes): batch-item encode moves
+    # off the event loop onto the lane thread, one hop per send window —
+    # the client-side half of the store's apply lane (a hot client loop
+    # spends a measurable slice purely serializing op blobs).  Items
+    # whose encode fails are failed INDIVIDUALLY at send time, same
+    # attribution contract as the inline encode path.
+    encode_lane: Optional[object] = None
 
 
 # graftcheck: loop-confined
@@ -126,6 +132,13 @@ class _Batcher:
                     if not fut.done():
                         fut.set_exception(e)
 
+        # the common round fits one chunk: await it directly instead of
+        # paying a gather + task wrap per drain (per-op task-fan thinning
+        # — at w256 this is ~one task per loop iteration saved per
+        # batcher, and the drain itself is already a task)
+        if len(batch) <= self._max:
+            await flush(batch)
+            return
         # chunks are independent: flush them concurrently
         await asyncio.gather(*[
             flush(batch[i:i + self._max])
@@ -168,20 +181,25 @@ class _StoreSender:
         # encode HERE, not in the send path: a malformed op (bad key
         # type) must fail its OWN caller, never poison the unrelated
         # items sharing its lane (the same invariant RaftRawKVStore.
-        # apply holds one layer down)
-        try:
-            blob = encode_batch_item(region.id, region.epoch.conf_ver,
-                                     region.epoch.version, op.encode())
-        except Exception as e:  # noqa: BLE001
-            fut.set_result(RheaKVError(Status.error(
-                RaftError.EINVAL, f"malformed op: {e!r}")))
-            return fut
+        # apply holds one layer down).  With an encode_lane configured
+        # the serialize moves to the lane thread at send time — _send
+        # keeps the same per-item attribution there.
+        if self._client._batch_opts.encode_lane is None:
+            try:
+                blob = encode_batch_item(region.id, region.epoch.conf_ver,
+                                         region.epoch.version, op.encode())
+            except Exception as e:  # noqa: BLE001
+                fut.set_result(RheaKVError(Status.error(
+                    RaftError.EINVAL, f"malformed op: {e!r}")))
+                return fut
+        else:
+            blob = None
         # trace plane: only a SAMPLED op's context rides the row (and
         # the wire) — unsampled slow-candidates keep the serving path
         # untouched (wire_ctx masks them to 0)
         tid = wire_ctx(op.trace_id)
         self._q.append((region, peer, blob, fut, spread, tid,
-                        time.perf_counter() if tid else 0.0))
+                        time.perf_counter() if tid else 0.0, op))
         self._arrival.set()
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._drain())
@@ -224,6 +242,24 @@ class _StoreSender:
 
     async def _send(self, batch: list) -> None:
         client = self._client
+        lane = client._batch_opts.encode_lane
+        if lane is not None:
+            # one lane hop serializes the whole window off-loop; a row
+            # whose encode raises fails its OWN future here (same
+            # attribution the inline path gives at submit time) and is
+            # dropped from the RPC
+            blobs = await lane.submit(_encode_rows, batch)
+            keep = []
+            for row, blob in zip(batch, blobs):
+                if isinstance(blob, Exception):
+                    if not row[3].done():
+                        row[3].set_result(RheaKVError(Status.error(
+                            RaftError.EINVAL, f"malformed op: {blob!r}")))
+                    continue
+                keep.append(row[:2] + (blob,) + row[3:])
+            batch = keep
+            if not batch:
+                return
         req = KVCommandBatchRequest(
             items=[row[2] for row in batch])
         rpc0 = 0.0
@@ -247,16 +283,14 @@ class _StoreSender:
                 client._batch_ok = False
                 client.batch_fallbacks += 1
                 outs = await asyncio.gather(
-                    *(client._call_region_outcome(
-                        region,
-                        KVOperation.decode(decode_batch_item(blob)[3]))
-                      for region, _p, blob, _f, _s, _t, _ts in batch))
+                    *(client._call_region_outcome(region, op)
+                      for region, _p, _b, _f, _s, _t, _ts, op in batch))
                 for row, out in zip(batch, outs):
                     if not row[3].done():
                         row[3].set_result(out)
                 return
-            for region, _p, _b, fut, spread, _t, _ts in batch:  # dead store:
-                if not spread:                                  # retryable
+            for region, _p, _b, fut, spread, _t, _ts, _op in batch:
+                if not spread:          # dead store: retryable
                     client._leaders.pop(region.id, None)
                 if not fut.done():
                     fut.set_result(_Retry(status=e.status))
@@ -292,11 +326,26 @@ class _StoreSender:
                 if not row[3].done():
                     row[3].set_result(RheaKVError(st))
             return
-        for (region, peer, _b, fut, spread, _t, _ts), blob \
+        for (region, peer, _b, fut, spread, _t, _ts, _op), blob \
                 in zip(batch, resp.items):
             if not fut.done():
                 fut.set_result(client._decode_outcome(region, peer, blob,
                                                       spread=spread))
+
+
+def _encode_rows(rows: list) -> list:
+    """Serialize a send window's op blobs (runs ON the encode lane
+    thread — touches only the rows' immutable region/op fields).  A
+    failed encode yields its exception in place so the caller can fail
+    that item individually."""
+    out = []
+    for region, _p, _b, _f, _s, _t, _ts, op in rows:
+        try:
+            out.append(encode_batch_item(region.id, region.epoch.conf_ver,
+                                         region.epoch.version, op.encode()))
+        except Exception as e:  # noqa: BLE001 — attributed per item
+            out.append(e)
+    return out
 
 
 # graftcheck: loop-confined — route table, batchers and store senders
@@ -497,33 +546,6 @@ class RheaKVStore:
             s = self._senders[endpoint] = _StoreSender(self, endpoint)
         return s
 
-    async def _dispatch_one(self, region: Region, op: KVOperation,
-                            attempt: int):
-        """One attempt cycle for one (region, op) pair through the store
-        senders: a RETRYABLE bounce (not leader, electing) re-submits to
-        the next candidate store WITHIN the cycle — the batch analog of
-        _call_region probing every endpoint in one attempt, so a cold
-        leader cache costs extra round trips, never the outer backoff
-        sleep.  Read-only ops under read_from=follower/learner route to
-        the nearest data replica instead of the leader store (served
-        there after a forwarded-ReadIndex fence), still riding the
-        store-grouped batch plane."""
-        spread = (self.read_from in ("follower", "learner")
-                  and op.op in _READONLY_OPS)
-        cands = (self._read_candidates(region, attempt) if spread
-                 else self._store_candidates(region, attempt))
-        out = None
-        for peer in cands:
-            out = await self._sender(_endpoint(peer)).submit(
-                region, peer, op, spread=spread)
-            if not self._batch_ok:
-                # the fleet downgraded mid-flight; the sender already
-                # served this item through the per-op path
-                return out
-            if not (isinstance(out, _Retry) and not out.refresh):
-                return out
-        return out
-
     async def _dispatch_region_ops(self, pairs: list, attempt: int = 0
                                    ) -> list:
         """One attempt cycle over many (region, op) pairs, each routed
@@ -532,14 +554,18 @@ class RheaKVStore:
         window (the raft plane's ``multi_append`` pattern one layer up),
         and every pair resolves independently (a slow region on one
         store never convoys its neighbours).  Pairs that can't ride a
-        batch — spread reads (per-region round-robin) or a downgraded
-        fleet — go through _call_region.  Returns one outcome per pair
-        (see _call_region_outcome)."""
-        def is_direct(region, op):
-            return (not self._batch_ok
-                    or (self.read_from == "any"
-                        and op.op in _READONLY_OPS))
+        batch — 'any'-spread reads (per-region round-robin) or a
+        downgraded fleet — go through _call_region.  Returns one
+        outcome per pair (see _call_region_outcome).
 
+        Task-fan shape: sender submits are SYNCHRONOUS (each returns a
+        plain future), so a round over N pairs is N submit calls plus
+        ONE gather of futures — no per-pair coroutine/task.  A pair
+        bounced RETRYABLY (not leader, electing) advances to its next
+        candidate store in the next round, the batch analog of
+        _call_region probing every endpoint within one attempt cycle:
+        a cold leader cache costs extra round trips, never the outer
+        backoff sleep."""
         if TRACER.enabled:
             # one trace per (region, op) dispatch cycle: the root span
             # opens here (sampling + slow-op candidacy decided inside)
@@ -547,11 +573,46 @@ class RheaKVStore:
             for _region, op in pairs:
                 if not op.trace_id:
                     op.trace_id = TRACER.begin_op("kv_op", proc="client")
-        outs = list(await asyncio.gather(
-            *(self._call_region_outcome(region, op)
-              if is_direct(region, op)
-              else self._dispatch_one(region, op, attempt)
-              for region, op in pairs)))
+        outs: list = [None] * len(pairs)
+        direct: list[int] = []
+        live: list[list] = []   # [pair index, candidates, cursor, spread]
+        for i, (region, op) in enumerate(pairs):
+            if (not self._batch_ok
+                    or (self.read_from == "any"
+                        and op.op in _READONLY_OPS)):
+                direct.append(i)
+                continue
+            spread = (self.read_from in ("follower", "learner")
+                      and op.op in _READONLY_OPS)
+            cands = (self._read_candidates(region, attempt) if spread
+                     else self._store_candidates(region, attempt))
+            live.append([i, cands, 0, spread])
+        # the per-op escape hatch still needs real tasks (one coroutine
+        # each); batched pairs never do
+        direct_gather = asyncio.gather(
+            *(self._call_region_outcome(*pairs[i]) for i in direct)) \
+            if direct else None
+        while live:
+            futs = [self._sender(_endpoint(row[1][row[2]])).submit(
+                        pairs[row[0]][0], row[1][row[2]],
+                        pairs[row[0]][1], spread=row[3])
+                    for row in live]
+            round_outs = await asyncio.gather(*futs)
+            nxt = []
+            for row, out in zip(live, round_outs):
+                outs[row[0]] = out
+                # a mid-flight ENOMETHOD downgrade means the sender
+                # already served the item through the per-op path:
+                # outcome is final regardless of shape
+                if (self._batch_ok
+                        and isinstance(out, _Retry) and not out.refresh
+                        and row[2] + 1 < len(row[1])):
+                    row[2] += 1
+                    nxt.append(row)
+            live = nxt
+        if direct_gather is not None:
+            for i, out in zip(direct, await direct_gather):
+                outs[i] = out
         if TRACER.enabled:
             for (_region, op), out in zip(pairs, outs):
                 if op.trace_id:

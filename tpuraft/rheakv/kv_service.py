@@ -292,8 +292,11 @@ class KVCommandProcessor:
                 result = await rs.apply(op)
             else:
                 # ONE dispatch table for reads: fence here, then the
-                # same local-serve path the batched fast path uses
+                # same local-serve path the batched fast path uses —
+                # on the apply lane when one owns the store
                 await rs.node.read_index()
+                if rs.lane is not None:
+                    return await rs.lane.submit(_serve_read_local, rs, op)
                 return _serve_read_local(rs, op)
         except KVStoreError as e:
             return e.status.code, e.status.error_msg, None
@@ -307,6 +310,13 @@ class KVCommandProcessor:
 
     async def handle(self, req: KVCommandRequest) -> KVCommandResponse:
         self.single_rpcs += 1
+        if self._se.draining:
+            # SIGTERM drain: bounce NEW work with a retryable busy (the
+            # client re-offers it to the surviving stores) while already
+            # admitted items finish and ack — see StoreEngine.drain
+            return KVCommandResponse(
+                code=ERR_STORE_BUSY,
+                msg="store draining (retry-after-ms=100)")
         shed, retry_ms = self._se.should_shed()
         if shed:
             self.shed_items += 1
@@ -349,6 +359,10 @@ class KVCommandProcessor:
         through sequential ``kv_command`` handlers."""
         self.batch_rpcs += 1
         self.batch_items += len(req.items)
+        if self._se.draining:
+            bounce = encode_batch_reply(
+                ERR_STORE_BUSY, "store draining (retry-after-ms=100)")
+            return KVCommandBatchResponse(items=[bounce] * len(req.items))
         # serving-plane degradation: under a SICK local score with the
         # pipe already backed up, SHED — a deadline-aware EBUSY with a
         # retry-after hint beats queueing 256 workers behind a stalling
@@ -460,18 +474,40 @@ class KVCommandProcessor:
                         TRACER.span(tid, "srv_read_fence", f0, f1,
                                     proc=self._proc)
                 served = out_bytes = 0
-                for i, op in reads:
-                    s0 = time.perf_counter() if op.trace_id else 0.0
-                    code, msg, result = _serve_read_local(rs, op)
-                    if op.trace_id:
-                        TRACER.span(op.trace_id, "srv_read_serve", s0,
-                                    time.perf_counter(), proc=self._proc)
-                    replies[i] = (
-                        encode_batch_reply(0, result=encode_result(result))
-                        if code == 0 else encode_batch_reply(code, msg))
-                    if code == 0:
-                        served += 1
-                        out_bytes += len(replies[i])
+                lane = rs.lane
+                if lane is not None:
+                    # lane mode: the lane thread owns the store — serve
+                    # the whole fenced sub-batch in ONE lane hop (one
+                    # shared serve-span envelope for traced ops)
+                    s0 = time.perf_counter() if rtids else 0.0
+                    outs = await lane.submit(_serve_reads_sync, rs, reads)
+                    if rtids:
+                        s1 = time.perf_counter()
+                        for tid in rtids:
+                            TRACER.span(tid, "srv_read_serve", s0, s1,
+                                        proc=self._proc)
+                    for (i, _op), (code, msg, result) in zip(reads, outs):
+                        replies[i] = (
+                            encode_batch_reply(0,
+                                               result=encode_result(result))
+                            if code == 0 else encode_batch_reply(code, msg))
+                        if code == 0:
+                            served += 1
+                            out_bytes += len(replies[i])
+                else:
+                    for i, op in reads:
+                        s0 = time.perf_counter() if op.trace_id else 0.0
+                        code, msg, result = _serve_read_local(rs, op)
+                        if op.trace_id:
+                            TRACER.span(op.trace_id, "srv_read_serve", s0,
+                                        time.perf_counter(), proc=self._proc)
+                        replies[i] = (
+                            encode_batch_reply(0,
+                                               result=encode_result(result))
+                            if code == 0 else encode_batch_reply(code, msg))
+                        if code == 0:
+                            served += 1
+                            out_bytes += len(replies[i])
                 if served and self._heat is not None:
                     self._heat.note_read(rid, served, out_bytes)
 
@@ -485,8 +521,49 @@ class KVCommandProcessor:
             else:
                 await asyncio.gather(run_writes(), run_reads())
 
-        await asyncio.gather(*(run_region(rid, items)
-                               for rid, items in groups.items()))
+        # pure-write region groups skip the task layer ENTIRELY:
+        # submit_multi queues the region's ONE MULTI entry synchronously
+        # and hands back a plain future — a kv_command_batch spanning
+        # hundreds of regions (the w256 shape at 1024 regions) costs one
+        # gather over futures instead of one task per region.  Mixed and
+        # read groups keep the run_region coroutine (the read fence must
+        # be awaited per region).
+        lite: list[tuple[list, asyncio.Future]] = []
+        tasks = []
+        for rid, items in groups.items():
+            fut = None
+            if all(op.op in _WRITE_OPS for _, op in items):
+                engine = self._se.get_region_engine(rid)
+                if engine is None:  # vanished between validation and here
+                    for i, _ in items:
+                        replies[i] = encode_batch_reply(
+                            ERR_NO_REGION, f"region {rid} dropped mid-batch")
+                    continue
+                fut = engine.raft_store.submit_multi(
+                    [op for _, op in items])
+            if fut is None:
+                tasks.append(run_region(rid, items))
+            else:
+                lite.append((items, fut))
+        if lite or tasks:
+            results = await asyncio.gather(
+                *(f for _, f in lite), *tasks, return_exceptions=True)
+            for (items, _f), res in zip(lite, results):
+                if isinstance(res, KVStoreError):
+                    for i, _ in items:
+                        replies[i] = encode_batch_reply(res.status.code,
+                                                        res.status.error_msg)
+                elif isinstance(res, BaseException):
+                    for i, _ in items:
+                        replies[i] = encode_batch_reply(
+                            int(RaftError.EINTERNAL), str(res))
+                else:
+                    for (i, _), (st, result) in zip(items, res):
+                        replies[i] = (
+                            encode_batch_reply(0,
+                                               result=encode_result(result))
+                            if st.is_ok()
+                            else encode_batch_reply(st.code, st.error_msg))
         return KVCommandBatchResponse(items=replies)
 
 
@@ -512,6 +589,11 @@ def _serve_read_local(rs, op: KVOperation) -> tuple[int, str, object]:
     except Exception as e:  # noqa: BLE001
         return int(RaftError.EINTERNAL), str(e), None
     return 0, "", result
+
+
+def _serve_reads_sync(rs, reads: list) -> list[tuple[int, str, object]]:
+    """One lane job serving a whole fenced region read sub-batch."""
+    return [_serve_read_local(rs, op) for _, op in reads]
 
 
 _SINGLE_KEY_OPS = {

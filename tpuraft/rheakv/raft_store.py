@@ -42,9 +42,15 @@ _NOT_EAGER = object()
 class RaftRawKVStore:
     def __init__(self, node: Node, store: RawKVStore,
                  apply_batch: int = 32, multi_entries: bool = True,
-                 ack_at_commit: bool = True):
+                 ack_at_commit: bool = True, lane=None):
         self.node = node
         self.store = store
+        # apply worker lane (StoreEngineOptions.apply_lane): when set,
+        # the lane thread owns the raw store — local reads below are
+        # SUBMITTED through it (queue FIFO is the happens-before edge
+        # past the read fence) instead of touching the store from the
+        # loop while another region's apply mutates it
+        self.lane = lane
         # pipelined apply: blind writes ack their proposer at COMMIT
         # (the entry's linearization point — the result is known a
         # priori) and the FSM applies behind in coalesced batches;
@@ -161,6 +167,63 @@ class RaftRawKVStore:
         return [(Status.OK() if code == 0 else Status(code, msg), result)
                 for code, msg, result in outs]
 
+    def submit_multi(self, ops: list[KVOperation]
+                     ) -> Optional[asyncio.Future]:
+        """Task-free region sub-batch submission: encode ONE MULTI log
+        entry, queue it for the propose drainer, and return a plain
+        future resolving to per-op ``(Status, result)`` (or raising
+        :class:`KVStoreError` on a failed PROPOSAL, like
+        :meth:`apply_multi`).  The batch handler collects MANY regions'
+        futures into ONE gather instead of spawning a task per region —
+        the server half of the per-op task fan the loop profile blamed.
+
+        Returns ``None`` when multi-op entries are disabled (the
+        mixed-version escape hatch) — the caller falls back to the
+        task-per-region path."""
+        if not self._multi_entries:
+            return None
+        loop = asyncio.get_running_loop()
+        out = loop.create_future()
+        if not ops:
+            out.set_result([])
+            return out
+        mop = KVOperation.multi(ops)
+        mop.trace_id = next((o.trace_id for o in ops if o.trace_id), 0)
+        eager = _NOT_EAGER
+        if self._ack_at_commit and all(o.op in _BLIND_OPS for o in ops):
+            eager = [(0, "", True)] * len(ops)
+        try:
+            blob = mop.encode()
+        except Exception as e:  # noqa: BLE001 — fail this batch only
+            out.set_exception(KVStoreError(
+                Status.error(RaftError.EINVAL, f"encode: {e!r}")))
+            return out
+        tid = mop.trace_id
+        t0 = time.perf_counter() if tid else 0.0
+        inner = loop.create_future()
+        self._pending.append((blob, inner, tid, eager))
+        if self._drainer is None or self._drainer.done():
+            self._drainer = asyncio.ensure_future(self._drain())
+        proc = self._proc
+
+        def _resolve(f: asyncio.Future) -> None:
+            if f.cancelled():
+                return
+            status, result = f.result()
+            if tid:
+                TRACER.span(tid, "srv_propose", t0, time.perf_counter(),
+                            proc=proc, ok=status.is_ok())
+            if out.done():
+                return
+            if not status.is_ok():
+                out.set_exception(KVStoreError(status))
+                return
+            out.set_result([(Status.OK() if code == 0 else Status(code, msg),
+                             res) for code, msg, res in result])
+
+        inner.add_done_callback(_resolve)
+        return out
+
     async def _drain(self) -> None:
         # same drain-until-empty invariant as ReadOnlyService's rounds:
         # ops queued while a batch is in flight are picked up by the
@@ -244,27 +307,32 @@ class RaftRawKVStore:
 
     # -- read path (readIndex barrier + local read) --------------------------
 
-    async def get(self, key: bytes) -> Optional[bytes]:
+    async def _read(self, fn, *args):
+        """Fenced local read: read_index barrier, then the store call —
+        on the apply lane when one owns the store, else inline."""
         await self.node.read_index()
-        return self.store.get(key)
+        if self.lane is not None:
+            return await self.lane.submit(fn, *args)
+        return fn(*args)
+
+    async def get(self, key: bytes) -> Optional[bytes]:
+        return await self._read(self.store.get, key)
 
     async def multi_get(self, keys: list[bytes]
                         ) -> dict[bytes, Optional[bytes]]:
-        await self.node.read_index()
-        return self.store.multi_get(keys)
+        return await self._read(self.store.multi_get, keys)
 
     async def contains_key(self, key: bytes) -> bool:
-        await self.node.read_index()
-        return self.store.contains_key(key)
+        return await self._read(self.store.contains_key, key)
 
     async def scan(self, start: bytes, end: bytes, limit: int = -1,
                    return_value: bool = True
                    ) -> list[tuple[bytes, Optional[bytes]]]:
-        await self.node.read_index()
-        return self.store.scan(start, end, limit, return_value)
+        return await self._read(self.store.scan, start, end, limit,
+                                return_value)
 
     async def reverse_scan(self, start: bytes, end: bytes, limit: int = -1,
                            return_value: bool = True
                            ) -> list[tuple[bytes, Optional[bytes]]]:
-        await self.node.read_index()
-        return self.store.reverse_scan(start, end, limit, return_value)
+        return await self._read(self.store.reverse_scan, start, end, limit,
+                                return_value)

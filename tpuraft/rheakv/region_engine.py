@@ -48,6 +48,10 @@ class RegionEngine:
         self.fsm = KVStoreStateMachine(
             self.region, se.raw_store, se,
             coalesce_applies=se.opts.fsm_coalesce)
+        # apply worker lane (StoreEngineOptions.apply_lane): the lane
+        # owns the shared raw store — the FSM routes snapshot
+        # serialization through it, the raft store its fenced reads
+        self.fsm.lane = se.apply_lane
         opts = se.make_node_options(self.region, self.fsm)
         self._group_service = RaftGroupService(
             self.group_id, se.server_id, opts, se.node_manager, se.transport,
@@ -64,7 +68,7 @@ class RegionEngine:
             node.append_batcher = se.append_batcher
         self.raft_store = RaftRawKVStore(
             node, se.raw_store, multi_entries=se.opts.multi_op_entries,
-            ack_at_commit=se.opts.ack_at_commit)
+            ack_at_commit=se.opts.ack_at_commit, lane=se.apply_lane)
         LOG.info("region engine started: %s on %s", self.region,
                  se.server_id)
 

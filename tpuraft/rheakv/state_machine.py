@@ -8,6 +8,7 @@ region snapshots (range-serialized) and RANGE_SPLIT.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import struct
 from typing import Optional
@@ -23,13 +24,36 @@ LOG = logging.getLogger(__name__)
 
 class KVClosure:
     """Proposal completion carrying an op result back to the proposer
-    (reference: ``rhea:storage/KVStoreClosure#setData``)."""
+    (reference: ``rhea:storage/KVStoreClosure#setData``).
+
+    Thread-safe against worker-lane apply: when the FSM fires it from
+    the store's apply lane, the resolution hops back to the proposer's
+    loop via ``call_soon_threadsafe``.  ``_fired`` (set before the hop)
+    makes the first caller win — the FSMCaller's loop-side
+    auto-complete must not override a lane-fired error status whose
+    delivery is still in flight."""
 
     def __init__(self, fut):
         self._fut = fut
         self.result = None
+        self._fired = False
 
     def __call__(self, status: Status) -> None:
+        if self._fired:
+            return
+        self._fired = True
+        fut = self._fut
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is fut.get_loop():
+            if not fut.done():
+                fut.set_result((status, self.result))
+        else:
+            fut.get_loop().call_soon_threadsafe(self._deliver, status)
+
+    def _deliver(self, status: Status) -> None:
         if not self._fut.done():
             self._fut.set_result((status, self.result))
 
@@ -46,6 +70,11 @@ class KVStoreStateMachine(StateMachine):
         self.store = store
         self.store_engine = store_engine  # for RANGE_SPLIT
         self.leader_term = -1
+        # apply worker lane (StoreEngineOptions.apply_lane): when set,
+        # the lane thread OWNS the raw store — apply_sync runs there,
+        # and snapshot serialization below is submitted through it
+        # instead of touching the store from the loop
+        self.lane = None
         # coalesced-apply knob + counters (StoreEngineOptions.fsm_coalesce):
         # consecutive PUT/DELETE(-list) entries flush as ONE native batch
         # write instead of one store call per op
@@ -86,6 +115,26 @@ class KVStoreStateMachine(StateMachine):
         dones.clear()
 
     async def on_apply(self, it: Iterator) -> None:
+        self.on_lane_applied(self.apply_sync(it))
+
+    def on_lane_applied(self, applied_ops: int) -> None:
+        """Post-apply bookkeeping that must stay on the loop (the heat
+        tracker is loop-confined): the FSMCaller calls this after a
+        lane-submitted apply_sync returns; the loop path above calls it
+        inline."""
+        # per-region heat (fleet observability): the applied lane is the
+        # replication-side load — followers see it for regions they
+        # never serve, giving the store a full local picture; the PD
+        # only ever reads the leaders' serving rates
+        heat = getattr(self.store_engine, "heat", None)
+        if heat is not None and applied_ops:
+            heat.note_applied(self.region.id, applied_ops)
+
+    def apply_sync(self, it: Iterator) -> int:
+        """The apply body, synchronous — runnable on the loop (via
+        on_apply) or on the store's apply worker lane (FSMCaller submits
+        it when StoreEngineOptions.apply_lane is on).  Returns the
+        applied op count for on_lane_applied."""
         run_rows: list = []
         run_dones: list = []   # (done, closure) per coalesced entry
         applied_ops = 0        # heat telemetry: replication-side rate
@@ -115,13 +164,7 @@ class KVStoreStateMachine(StateMachine):
             it.next()
         if run_dones:
             self._flush_run(run_rows, run_dones)
-        # per-region heat (fleet observability): the applied lane is the
-        # replication-side load — followers see it for regions they
-        # never serve, giving the store a full local picture; the PD
-        # only ever reads the leaders' serving rates
-        heat = getattr(self.store_engine, "heat", None)
-        if heat is not None and applied_ops:
-            heat.note_applied(self.region.id, applied_ops)
+        return applied_ops
 
     def _dispatch(self, op: KVOperation):
         s = self.store
@@ -168,6 +211,19 @@ class KVStoreStateMachine(StateMachine):
             (new_region_id,) = struct.unpack("<q", op.aux)
             if self.store_engine is None:
                 raise RuntimeError("split requires a store engine")
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                # lane apply: do_split mutates loop-confined StoreEngine
+                # state (region table, heat rows, the new engine's boot
+                # task) — hop it back to the engine's loop.  The range
+                # narrowing lands a beat later; serving-side range
+                # checks re-validate per request, so the window only
+                # delays the client's epoch refresh.
+                self.store_engine.loop_call_threadsafe(
+                    self.store_engine.do_split,
+                    self.region.id, new_region_id, op.key)
+                return True
             self.store_engine.do_split(self.region.id, new_region_id, op.key)
             return True
         if code == KVOp.GET:  # linearizable-via-log read
@@ -235,8 +291,16 @@ class KVStoreStateMachine(StateMachine):
 
     async def on_snapshot_save(self, writer, done) -> None:
         try:
-            blob = self.store.serialize_range(self.region.start_key,
-                                              self.region.end_key)
+            # lane mode: the lane thread owns the store — OTHER regions'
+            # applies run there concurrently with this region's save, so
+            # the range serialization must ride the lane queue too
+            if self.lane is not None:
+                blob = await self.lane.submit(
+                    self.store.serialize_range,
+                    self.region.start_key, self.region.end_key)
+            else:
+                blob = self.store.serialize_range(self.region.start_key,
+                                                  self.region.end_key)
             writer.write_file("kv_data", blob)
             writer.write_file("region_meta", self.region.encode())
             done(Status.OK())
@@ -258,9 +322,15 @@ class KVStoreStateMachine(StateMachine):
         # exact state reset of our slice (data + sequences + locks), then
         # load — merging would leave post-snapshot keys behind and make
         # log replay after restart non-deterministic across replicas
+        if self.lane is not None:
+            await self.lane.submit(self._load_sync, blob)
+        else:
+            self._load_sync(blob)
+        return True
+
+    def _load_sync(self, blob: bytes) -> None:
         self.store.reset_range(self.region.start_key, self.region.end_key)
         self.store.load_serialized(blob)
-        return True
 
     async def on_error(self, status: Status) -> None:
         LOG.error("region %d FSM error: %s", self.region.id, status)

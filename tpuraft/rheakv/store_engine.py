@@ -112,6 +112,16 @@ class StoreEngineOptions:
     # (read_index + wait_applied) keeps reads observing applied state.
     # False = ack after apply (the pre-write-plane behavior).
     ack_at_commit: bool = True
+    # -- apply worker lane (compartmentalization) ----------------------------
+    # run FSM apply on a dedicated store-wide worker thread instead of
+    # the event loop (tpuraft/core/lanes.py): the lane thread OWNS the
+    # raw store — fenced reads, snapshot serialization and split-point
+    # probing are submitted through its FIFO queue, so the loop only
+    # pays an await per batch and a hot store saturates a second core.
+    # False = apply on the loop (the single-core default; the native
+    # store's C calls already release the GIL under the lane, the
+    # memory store still offloads the loop's share).
+    apply_lane: bool = False
     # -- gray-failure survival (fail-slow detection + mitigation) ------------
     # score this store {HEALTHY, DEGRADED, SICK} from hot-path signals
     # (append/fsync latency, peer ack RTTs, apply backlog — see
@@ -538,6 +548,18 @@ class StoreEngine:
         if opts.enable_kv_metrics:
             raw = MetricsRawKVStore(raw, self.metrics)
         self.raw_store: RawKVStore = raw
+        # apply worker lane: ONE dedicated thread per store owning the
+        # raw store's mutation order (see StoreEngineOptions.apply_lane)
+        self.apply_lane = None
+        if opts.apply_lane:
+            from tpuraft.core.lanes import WorkerLane
+
+            self.apply_lane = WorkerLane(
+                name=f"apply-{self.server_id.endpoint}")
+        # SIGTERM drain (process topology): True bounces NEW kv work
+        # with a retryable busy while admitted items finish — see drain()
+        self.draining = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.multi_raft_engine = multi_raft_engine
         self.pd_client = pd_client
         self._regions: dict[int, RegionEngine] = {}
@@ -608,9 +630,14 @@ class StoreEngine:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         if self.health is not None:
             # beat-plane RPCs double as per-endpoint RTT probes
             self.node_manager.heartbeat_hub.health = self.health
+            # event-loop lag probe: scheduling delay of a call_later
+            # chain — loop saturation becomes a scored gray-failure
+            # signal instead of a bench-only inference
+            self.health.loop_lag.start()
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.start()
         # batched-concurrent region boot: one region at a time serializes
@@ -677,6 +704,7 @@ class StoreEngine:
         if self.health is not None:
             from tpuraft.util import describer
 
+            self.health.loop_lag.stop()
             describer.unregister(self.health)
         if self.read_batcher is not None:
             from tpuraft.util import describer
@@ -693,6 +721,9 @@ class StoreEngine:
         self._regions.clear()
         if self.multi_raft_engine is not None:
             await self.multi_raft_engine.shutdown()
+        if self.apply_lane is not None:
+            # after the regions: no FSMCaller is left to submit applies
+            await self.apply_lane.aclose()
         close = getattr(self.raw_store, "close", None)
         if close is not None:
             close()  # native engine: flush + release the WAL fd
@@ -701,6 +732,33 @@ class StoreEngine:
 
             _release_journal(self._meta_journal)
             self._meta_journal = None
+
+    def loop_call_threadsafe(self, fn, *args) -> None:
+        """Hop a loop-confined engine call off a worker lane thread
+        (lane-applied RANGE_SPLIT is the one caller today)."""
+        loop = self._loop
+        if loop is None:
+            fn(*args)
+            return
+        loop.call_soon_threadsafe(fn, *args)
+
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        """SIGTERM drain: stop admitting NEW kv work (handlers bounce it
+        with a retryable busy the client re-offers elsewhere), then wait
+        until every already-admitted item has acked — bounded by
+        ``timeout_s``.  Returns True when the pipe emptied in time.
+        The caller shuts the engine down afterwards; leadership moves
+        when the silenced groups' peers time out, exactly like a crash
+        but with zero lost acks."""
+        self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while self.kv_processor.inflight_items > 0:
+            if time.monotonic() >= deadline:
+                LOG.warning("drain timed out with %d items in flight",
+                            self.kv_processor.inflight_items)
+                return False
+            await asyncio.sleep(0.01)
+        return True
 
     # -- gray-failure survival: health loop + leadership evacuation ----------
 
@@ -842,13 +900,14 @@ class StoreEngine:
         # TTL cache bounds): apply/propose plane totals across every
         # hosted region — entries-per-batch amortization, live
         apply_batches = applied_entries = eager_acked = 0
-        propose_drains = proposed_ops = 0
+        propose_drains = proposed_ops = lane_batches = 0
         for eng in list(self._regions.values()):
             node = eng.node
             if node is not None and node.fsm_caller is not None:
                 apply_batches += node.fsm_caller.apply_batches
                 applied_entries += node.fsm_caller.applied_entries
                 eager_acked += node.fsm_caller.eager_acked
+                lane_batches += node.fsm_caller.lane_batches
             if eng.raft_store is not None:
                 propose_drains += eng.raft_store.propose_drains
                 proposed_ops += eng.raft_store.proposed_ops
@@ -856,9 +915,12 @@ class StoreEngine:
             "fsm_apply_batches": apply_batches,
             "fsm_applied_entries": applied_entries,
             "fsm_eager_acked": eager_acked,
+            "fsm_lane_batches": lane_batches,
             "propose_drains": propose_drains,
             "proposed_ops": proposed_ops,
         })
+        if self.apply_lane is not None:
+            counters["lane_jobs"] = self.apply_lane.jobs
         if self.read_batcher is not None:
             counters.update(self.read_batcher.counters())
         if self.append_batcher is not None:
@@ -885,8 +947,11 @@ class StoreEngine:
             "regions": len(self._regions),
             "leader_regions": len(self._leader_regions),
             "kv_inflight_items": kp.inflight_items,
+            "draining": int(self.draining),
             **trace_gauges,
         }
+        if self.apply_lane is not None:
+            gauges["lane_depth"] = self.apply_lane.depth()
         if self.health is not None:
             gauges.update(self.health.counters())
         if self.heat is not None:
@@ -1006,6 +1071,14 @@ class StoreEngine:
             # ±10% per-round jitter: phase-locked fleets drift apart
             await asyncio.sleep(backoff * (0.9 + 0.2 * rng.random()))
 
+    async def _approx_keys(self, start: bytes, end: bytes) -> int:
+        """Range key-count probe — through the apply lane when one owns
+        the store (a loop-side index rebuild would race lane applies)."""
+        if self.apply_lane is not None:
+            return await self.apply_lane.submit(
+                self.raw_store.approximate_keys_in_range, start, end)
+        return self.raw_store.approximate_keys_in_range(start, end)
+
     def _pd_fingerprint(self, region: Region) -> tuple:
         return (region.epoch.conf_ver, region.epoch.version,
                 region.start_key, region.end_key, tuple(region.peers))
@@ -1022,8 +1095,7 @@ class StoreEngine:
             if engine is None or not engine.is_leader():
                 continue
             region = engine.region
-            keys = self.raw_store.approximate_keys_in_range(
-                region.start_key, region.end_key)
+            keys = await self._approx_keys(region.start_key, region.end_key)
             fp = self._pd_fingerprint(region)
             last = self._pd_reported.get(rid)
             # a keys move under ~12.5% (and < 64 abs) is noise, not a
@@ -1179,6 +1251,11 @@ class StoreEngine:
         # LogManager, apply depth from its FSMCaller, election gate from
         # its _allow_launch_election
         opts.health = self.health
+        # apply worker lane: every region's FSMCaller submits committed
+        # DATA runs to the ONE store-wide lane (total store order
+        # preserved by the lane's FIFO; witness regions have a null FSM
+        # with no apply_sync and stay on the loop)
+        opts.apply_lane = self.apply_lane
         if self.opts.data_path:
             store_base = (f"{self.opts.data_path}/"
                           f"{self.server_id.ip}_{self.server_id.port}")
@@ -1267,14 +1344,18 @@ class StoreEngine:
                                 f"region {new_region_id} exists")
         region = engine.region
         if split_key is None:
-            n = self.raw_store.approximate_keys_in_range(
-                region.start_key, region.end_key)
+            n = await self._approx_keys(region.start_key, region.end_key)
             if n < self.opts.least_keys_on_split:
                 return Status.error(
                     RaftError.EBUSY,
                     f"region {region_id} too small to split ({n} keys)")
-            split_key = self.raw_store.jump_over(
-                region.start_key, region.end_key, n // 2)
+            if self.apply_lane is not None:
+                split_key = await self.apply_lane.submit(
+                    self.raw_store.jump_over,
+                    region.start_key, region.end_key, n // 2)
+            else:
+                split_key = self.raw_store.jump_over(
+                    region.start_key, region.end_key, n // 2)
         if split_key is None or not region.contains_key(split_key):
             return Status.error(RaftError.EINVAL,
                                 f"bad split key {split_key!r}")

@@ -69,6 +69,17 @@ class HealthOptions:
     # apply backlog: committed-minus-applied entries (EMA) across groups
     apply_degraded: float = 256.0
     apply_sick: float = 2048.0
+    # event-loop scheduling lag EMA (ms): delay between when a timer
+    # callback was DUE and when the loop actually ran it — the direct
+    # signal of a saturated loop (the single-process store fabric's
+    # ceiling; see docs/operations.md "Process topology runbook").
+    # Thresholds are deliberately loose: test topologies multiplex many
+    # stores on one loop and boot storms spike lag transiently — the
+    # hysteresis plus these bounds keep that from flapping leadership.
+    loop_degraded_ms: float = 250.0
+    loop_sick_ms: float = 2000.0
+    # probe cadence (4 extra callbacks/s at the default)
+    loop_probe_interval_ms: float = 250.0
     # hysteresis (evaluation rounds, not seconds): worsen fast, recover
     # slowly — a DEGRADED-but-recovering store keeps its leaders
     worsen_after: int = 2
@@ -142,6 +153,69 @@ class DiskLatencyProbe:
             return self._ema_ms, age, self._samples
 
 
+# graftcheck: loop-confined — armed, ticked and sampled on the owning
+# store's event loop (call_later chain); stop() flips a flag the next
+# tick observes
+class LoopLagProbe:
+    """Event-loop scheduling delay EMA: a ``call_later`` chain measures
+    (actual - expected) run time of each tick.  A loop saturated by
+    callback herds runs timers LATE — that lateness is exactly the
+    latency every other callback on the loop is paying, so it scores
+    the store's serving plane the way the disk probe scores its log
+    plane.  Samples feed an EMA (+ a peak-hold max for triage);
+    ``snapshot()`` is the tracker's read."""
+
+    def __init__(self, alpha: float = 0.25, interval_s: float = 0.25,
+                 clock=time.monotonic):
+        self._alpha = alpha
+        self._interval = interval_s
+        self._clock = clock
+        self._ema_ms = 0.0
+        self._max_ms = 0.0
+        self._samples = 0
+        self._expected = 0.0
+        self._handle = None
+        self._running = False
+
+    def start(self) -> None:
+        """Arm the chain on the CURRENT running loop (idempotent)."""
+        if self._running:
+            return
+        import asyncio
+
+        self._running = True
+        self._arm(asyncio.get_running_loop())
+
+    def stop(self) -> None:
+        self._running = False
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    def _arm(self, loop) -> None:
+        self._expected = self._clock() + self._interval
+        self._handle = loop.call_later(self._interval, self._tick, loop)
+
+    def _tick(self, loop) -> None:
+        if not self._running:
+            return
+        lag = (self._clock() - self._expected) * 1000.0
+        if lag < 0.0:
+            lag = 0.0
+        if self._samples == 0:
+            self._ema_ms = lag
+        else:
+            self._ema_ms += self._alpha * (lag - self._ema_ms)
+        if lag > self._max_ms:
+            self._max_ms = lag
+        self._samples += 1
+        self._arm(loop)
+
+    def snapshot(self) -> tuple[float, float, int]:
+        """(ema_ms, max_ms, samples)."""
+        return self._ema_ms, self._max_ms, self._samples
+
+
 # graftcheck: loop-confined — owned by HealthTracker (self + per-peer
 # rows), folded only on the store's event loop; the cross-thread disk
 # signal stays inside the LOCKED DiskLatencyProbe above
@@ -183,6 +257,12 @@ class HealthTracker:
         # flight-recorder identity (the owning store's endpoint)
         self.label = label
         self.disk = DiskLatencyProbe(self.opts.alpha, clock=clock)
+        # event-loop lag probe: started by the owning store's engine
+        # (StoreEngine.start) — it needs a running loop to arm
+        self.loop_lag = LoopLagProbe(
+            self.opts.alpha,
+            interval_s=self.opts.loop_probe_interval_ms / 1000.0,
+            clock=clock)
         self._self_hyst = _Hysteresis(self.opts.worsen_after,
                                       self.opts.recover_after)
         # peer endpoint -> (rtt ema ms, samples, hysteresis)
@@ -230,6 +310,13 @@ class HealthTracker:
             elif self._apply_ema >= o.apply_degraded \
                     and _LEVELS[level] < _LEVELS[DEGRADED]:
                 level, cause = DEGRADED, "apply"
+        lag_ema, _lag_max, lag_samples = self.loop_lag.snapshot()
+        if lag_samples and _LEVELS[level] < _LEVELS[SICK]:
+            if lag_ema >= o.loop_sick_ms:
+                level, cause = SICK, "loop"
+            elif lag_ema >= o.loop_degraded_ms \
+                    and _LEVELS[level] < _LEVELS[DEGRADED]:
+                level, cause = DEGRADED, "loop"
         return level, cause
 
     def evaluate(self) -> str:
@@ -287,6 +374,7 @@ class HealthTracker:
 
     def counters(self) -> dict:
         ema, stall_age, samples = self.disk.snapshot()
+        lag_ema, lag_max, lag_samples = self.loop_lag.snapshot()
         return {
             "health_level": _LEVELS[self.score()],
             "health_evaluations": self.evaluations,
@@ -294,6 +382,9 @@ class HealthTracker:
             "health_disk_inflight_ms": round(stall_age, 1),
             "health_disk_samples": samples,
             "health_apply_ema": round(self._apply_ema, 1),
+            "health_loop_lag_ms": round(lag_ema, 3),
+            "health_loop_lag_max_ms": round(lag_max, 1),
+            "health_loop_samples": lag_samples,
             "health_slow_peers": len(self.slow_peers()),
         }
 
@@ -304,15 +395,21 @@ class HealthTracker:
         metrics.gauge("health.disk_inflight_ms",
                       lambda: self.disk.snapshot()[1])
         metrics.gauge("health.apply_ema", lambda: self._apply_ema)
+        metrics.gauge("health.loop_lag_ms",
+                      lambda: self.loop_lag.snapshot()[0])
+        metrics.gauge("health.loop_lag_max_ms",
+                      lambda: self.loop_lag.snapshot()[1])
         metrics.gauge("health.slow_peers",
                       lambda: float(len(self.slow_peers())))
 
     def describe(self) -> str:
         ema, stall_age, samples = self.disk.snapshot()
+        lag_ema, lag_max, _n = self.loop_lag.snapshot()
         peers = ", ".join(
             f"{ep}={ent[2].level}:{ent[0]:.1f}ms"
             for ep, ent in sorted(self._peers.items())) or "-"
         return (f"HealthTracker<{self.score()} cause={self.cause or '-'} "
                 f"disk_ema={ema:.2f}ms inflight={stall_age:.0f}ms "
                 f"samples={samples} apply_ema={self._apply_ema:.1f} "
+                f"loop_lag={lag_ema:.1f}ms max={lag_max:.0f}ms "
                 f"evals={self.evaluations} peers=[{peers}]>")
