@@ -62,7 +62,8 @@ soak:
 # (joint-consensus invariants under seeded crashes), plus short soaks
 # with power-loss faults and membership churn in the nemesis menu
 # (docs/operations.md "Crash-consistency testing" + "Elastic
-# membership runbook").
+# membership runbook"), and a short disk-pressure soak (quota shrink +
+# ENOSPC bursts -> reclaim/shed/resume; "Disk-pressure runbook").
 chaos-smoke:
 	$(PY) -m pytest tests/test_storage_fault.py tests/test_membership_chaos.py tests/test_quiescence.py tests/test_witness.py tests/test_read_only.py tests/test_gray_failure.py tests/test_append_batch.py -q
 	$(PY) -m examples.soak --duration 20 --seed 1 --power-loss
@@ -74,6 +75,7 @@ chaos-smoke:
 	$(PY) -m examples.soak --duration 20 --seed 4 --read-mix 0.95 --kv-batching
 	$(PY) -m examples.soak --duration 20 --seed 6 --gray
 	$(PY) -m examples.soak --duration 16 --seed 7 --regions 24 --hotspot
+	$(PY) -m examples.soak --duration 20 --seed 5 --disk-pressure
 
 # The PRE-MERGE bar for consensus-path changes (VERDICT r2 weak #6):
 # the multi-minute chaos soaks are what actually catch protocol bugs

@@ -39,6 +39,15 @@ Rows:
                         --no-heat and the heat-ON measurement must
                         stay within BENCH_GATE_HEAT_THRESHOLD
                         (default 3%) of the heat-OFF comparator.
+  kv_ops_disk_guard   — disk-budget gate (ISSUE 17): the DiskBudget
+                        accounting + admission check default ON, so
+                        the kv row already pays for them; this row
+                        runs the same shape with --no-disk-guard and
+                        the guard-ON measurement must stay within
+                        BENCH_GATE_DISK_THRESHOLD (default 2%) of the
+                        guard-OFF comparator — the hot-path cost of
+                        the pressure plane is a couple of integer adds
+                        and one dict lookup, and this row keeps it so.
 
 The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
@@ -96,13 +105,16 @@ def _run_kv_once(extra: dict, duration: float,
                  read_frac: float = -1.0,
                  trace_sample: float = 0.0,
                  heat_off: bool = False,
+                 disk_guard_off: bool = False,
                  workers: int = 0) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
     the read-mix shape (the amortized read plane's regression row);
     ``trace_sample > 0`` runs with product tracing sampling at that
     rate (the tracing-overhead row); ``heat_off`` disables per-region
-    heat tracking (the heat-overhead row's A/B comparator)."""
+    heat tracking (the heat-overhead row's A/B comparator);
+    ``disk_guard_off`` disables the disk budget / pressure plane (the
+    disk-guard-overhead row's A/B comparator)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -124,6 +136,9 @@ def _run_kv_once(extra: dict, duration: float,
     if heat_off:
         cmd.append("--no-heat")
         key += "_noheat"
+    if disk_guard_off:
+        cmd.append("--no-disk-guard")
+        key += "_nodg"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -324,6 +339,28 @@ def main() -> int:
                                "verdict": "BROKEN", "error": str(exc)}
             worst = max(worst, rc)
             reports.append(hrep)
+            # disk-guard-overhead row (ISSUE 17): the DiskBudget is
+            # fed from the hot path (a couple of integer adds per
+            # append/snapshot) and the shed check is one state read at
+            # admission — gate the guard-ON run against a same-session
+            # guard-OFF comparator at 2% so the pressure plane can
+            # never grow a per-op statvfs or lock without tripping CI.
+            disk_threshold = float(os.environ.get(
+                "BENCH_GATE_DISK_THRESHOLD", "0.02"))
+            try:
+                guard_off = _run_kv_once(kv_extra, duration,
+                                         disk_guard_off=True)
+                rc, drep = _gate(
+                    "kv_ops_disk_guard", guard_off,
+                    lambda: _run_kv_once(kv_extra, duration),
+                    disk_threshold, retries)
+                drep["disk_guard_off"] = round(guard_off, 1)
+            except RuntimeError as exc:
+                print(f"bench-gate[kv_ops_disk_guard]: {exc}")
+                rc, drep = 2, {"gate": "kv_ops_disk_guard",
+                               "verdict": "BROKEN", "error": str(exc)}
+            worst = max(worst, rc)
+            reports.append(drep)
     if "gate_read_ops_per_sec" not in kv_extra:
         # the amortized read plane (ISSUE 10) needs its own regression
         # row — a silent pass without a calibration would defeat it

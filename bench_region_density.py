@@ -110,6 +110,10 @@ async def run_config(args) -> dict:
             heartbeat_interval_ms=1000,
             # --no-heat: the bench-gate heat-overhead row's A/B knob
             heat_tracking=not args.no_heat,
+            # --no-disk-guard: the bench-gate disk-guard-overhead
+            # row's A/B knob (DiskBudget accounting + health-round
+            # pressure evaluation off)
+            disk_guard=not args.no_disk_guard,
             # --no-write-batch: the write-plane A/B knob — send-plane
             # stop-and-wait appends + ack-after-apply (pre-ISSUE-15)
             append_batching=not args.no_write_batch,
@@ -536,6 +540,10 @@ def main() -> None:
     ap.add_argument("--no-heat", action="store_true",
                     help="disable per-region heat tracking (the "
                          "bench-gate heat-overhead row's A/B knob)")
+    ap.add_argument("--no-disk-guard", action="store_true",
+                    help="disable the disk budget / pressure plane "
+                         "(the bench-gate disk-guard-overhead row's "
+                         "A/B knob)")
     ap.add_argument("--no-write-batch", action="store_true",
                     help="disable the write plane (store-wide append "
                          "rounds, eager commits, ack-at-commit) — the "
@@ -584,6 +592,8 @@ def main() -> None:
         cmd.append("--quiesce")
     if args.no_heat:
         cmd.append("--no-heat")
+    if args.no_disk_guard:
+        cmd.append("--no-disk-guard")
     if args.no_write_batch:
         cmd.append("--no-write-batch")
     if args.profile_ticks > 0:
@@ -625,6 +635,8 @@ def main() -> None:
         key += "_quiesce"
     if args.no_heat:
         key += "_noheat"
+    if args.no_disk_guard:
+        key += "_nodg"
     if args.no_write_batch:
         key += "_nowb"
     out[key] = row

@@ -26,6 +26,12 @@ Commands:
     metrics [endpoint]        scrape live metrics (Prometheus text)
                               from one store (default: first peer that
                               answers) over the admin transport
+    storage [endpoint]        disk-pressure dashboard: per-store disk
+                              usage, pressure level, ENOSPC count and
+                              the reclaim/shed/resume counters, parsed
+                              from the same metrics plane (default:
+                              every peer; docs/operations.md
+                              "Disk-pressure runbook")
 
 PD (fleet) commands take --pd instead of --group/--peers:
     cluster [K]               print the PD leader's ClusterView: top-K
@@ -88,6 +94,49 @@ def _print_cluster_view(view: dict) -> None:
             print(f"    region {r['region']:<8} score={r['score']:<8} "
                   f"w/s={r['writes_s']:<7} r/s={r['reads_s']:<7} "
                   f"keys={r['keys']:<8} leader={r['leader']}{flag}")
+
+
+def _prom_values(text: str) -> dict:
+    """Flatten Prometheus exposition text to {metric_name: value},
+    ignoring labels (the admin scrape targets one store at a time)."""
+    vals: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(None, 1)
+            vals[name_part.split("{", 1)[0]] = float(value)
+        except ValueError:
+            continue
+    return vals
+
+
+_PRESSURE_NAMES = {0: "OK", 1: "NEAR_FULL", 2: "FULL"}
+
+
+def _print_storage_row(ep: str, vals: dict) -> None:
+    def v(name, default=0.0):
+        return vals.get("tpuraft_" + name, default)
+
+    if "tpuraft_disk_capacity_bytes" not in vals:
+        print(f"  store {ep:<22} disk guard off (no disk_* metrics)")
+        return
+    used = v("disk_used_bytes")
+    cap = v("disk_capacity_bytes")
+    pct = f"{100.0 * used / cap:.1f}%" if cap > 0 else "-"
+    level = _PRESSURE_NAMES.get(int(v("disk_pressure_level")), "?")
+    print(f"  store {ep:<22} {level:<9} "
+          f"used={int(used)}/{int(cap)}B ({pct})")
+    print(f"    enospc={int(v('disk_enospc_events')):<6} "
+          f"reclaims={int(v('disk_reclaims')):<5} "
+          f"reclaim_rounds={int(v('disk_reclaim_rounds')):<5} "
+          f"shed_writes={int(v('kv_disk_shed_items')):<6} "
+          f"resumes={int(v('disk_pressure_resumes'))}")
+    print(f"    rounds: near_full={int(v('disk_near_full_rounds'))} "
+          f"full={int(v('disk_full_rounds'))} "
+          f"reconciles={int(v('disk_reconciles'))}  bytes: "
+          f"appended={int(v('disk_appended_bytes'))} "
+          f"reclaimed={int(v('disk_reclaimed_bytes'))}")
 
 
 async def _run_pd(args) -> int:
@@ -181,6 +230,29 @@ async def run(args) -> int:
                       f"{last_err.status if last_err else '?'}",
                       file=sys.stderr)
                 rc = 1
+        elif cmd == "storage":
+            # disk-pressure dashboard: unlike `metrics` (first peer
+            # that answers) this renders EVERY reachable store — the
+            # operator question is "which store is under pressure",
+            # not "what does one store say"
+            targets = ([args.command[1]] if len(args.command) > 1
+                       else [p.endpoint for p in conf.list_all()])
+            answered = 0
+            print(f"storage pressure ({len(targets)} store(s)):")
+            for ep in targets:
+                ep = ":".join(ep.split("/", 1)[0].split(":")[:2])
+                try:
+                    text = await cli.describe_metrics(ep)
+                except RpcError as e:
+                    print(f"  store {ep:<22} unreachable "
+                          f"({e.status.raft_error.name})")
+                    continue
+                answered += 1
+                _print_storage_row(ep, _prom_values(text))
+            if not answered:
+                print("error: no store answered describe_metrics",
+                      file=sys.stderr)
+                rc = 1
         elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer",
                      "add-witness", "remove-witness"):
             if len(args.command) < 2:
@@ -257,7 +329,7 @@ def main() -> None:
                          " | change-peers <p1,p2,...>"
                          " | add-learners <p1,...> | remove-learners <p1,...>"
                          " | reset-learners <p1,...> | metrics [endpoint]"
-                         " | cluster [K] | pd-metrics")
+                         " | storage [endpoint] | cluster [K] | pd-metrics")
     sys.exit(asyncio.run(run(ap.parse_args())))
 
 

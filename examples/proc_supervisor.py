@@ -249,14 +249,38 @@ class ProcSupervisor:
     spawn-all / ready-all / drain-all, crash detection, and supervised
     restart with exponential backoff (0.2s doubling to 2s) — the
     fabric's answer to SIGKILL: the store comes back, replays its raft
-    log, and rejoins; nothing acked is lost."""
+    log, and rejoins; nothing acked is lost.
 
-    def __init__(self, procs: list[StoreProcess]):
+    A restart-storm circuit breaker guards the respawn path: a child
+    that crashes ``storm_threshold`` times inside a rolling
+    ``storm_window_s`` window is marked FAILED (``self.failed``) and
+    left down — crash loops (poisoned journal, full volume) need an
+    operator, not another respawn."""
+
+    #: restart-storm circuit breaker defaults: a child that crashes
+    #: STORM_THRESHOLD times inside a rolling STORM_WINDOW_S window is
+    #: marked FAILED and no longer respawned — a store crash-looping on
+    #: a poisoned journal or a full volume otherwise burns CPU forever
+    #: while masquerading as "supervised" in every scrape.
+    STORM_THRESHOLD = 5
+    STORM_WINDOW_S = 30.0
+
+    def __init__(self, procs: list[StoreProcess],
+                 storm_threshold: Optional[int] = None,
+                 storm_window_s: Optional[float] = None):
         self.procs = list(procs)
         self.restarts = 0
+        self.storm_threshold = (self.STORM_THRESHOLD
+                                if storm_threshold is None
+                                else storm_threshold)
+        self.storm_window_s = (self.STORM_WINDOW_S
+                               if storm_window_s is None
+                               else storm_window_s)
+        self.failed: dict[str, str] = {}   # endpoint -> reason
         self._watch: Optional[asyncio.Task] = None
         self._stopping = False
         self._backoff: dict[str, float] = {}
+        self._crash_times: dict[str, deque[float]] = {}
 
     def by_endpoint(self, endpoint: str) -> StoreProcess:
         for p in self.procs:
@@ -283,7 +307,25 @@ class ProcSupervisor:
         try:
             while not self._stopping:
                 for p in self.procs:
+                    if p.endpoint in self.failed:
+                        continue
                     if p.proc is not None and not p.alive():
+                        now = time.monotonic()
+                        crashes = self._crash_times.setdefault(
+                            p.endpoint, deque())
+                        crashes.append(now)
+                        while crashes and \
+                                now - crashes[0] > self.storm_window_s:
+                            crashes.popleft()
+                        if len(crashes) >= self.storm_threshold:
+                            reason = (f"{len(crashes)} crashes in "
+                                      f"{self.storm_window_s:.0f}s "
+                                      f"(last rc={p.returncode()})")
+                            self.failed[p.endpoint] = reason
+                            print(f"supervisor: {p.name} FAILED — "
+                                  f"restart storm: {reason}; not "
+                                  f"respawning", flush=True)
+                            continue
                         delay = self._backoff.get(p.endpoint, 0.2)
                         self._backoff[p.endpoint] = min(delay * 2, 2.0)
                         self.restarts += 1
@@ -332,8 +374,14 @@ class ProcSupervisor:
             except Exception:  # noqa: BLE001 — scrape is best-effort
                 return p.name, {}
 
-        return dict(await asyncio.gather(
+        out = dict(await asyncio.gather(
             *(one(p) for p in self.procs if p.alive())))
+        # circuit-broken children are still part of the fleet view: a
+        # FAILED store scrapes as a sentinel row, not a silent absence
+        for p in self.procs:
+            if p.endpoint in self.failed:
+                out[p.name] = {"proc_supervisor_failed": 1.0}
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -416,7 +464,9 @@ async def _soak(seconds: float, stores_n: int, regions: int, data: str,
     print(json.dumps({
         "soak_seconds": seconds, "stores": stores_n, "regions": regions,
         "ops_total": len(ops), "ops_done": done,
-        "restarts": sup.restarts, "linearizable": bool(rep.ok),
+        "restarts": sup.restarts,
+        "failed_stores": dict(sup.failed),
+        "linearizable": bool(rep.ok),
         "cpu_seconds": cpu}, indent=2), flush=True)
     if not rep.ok:
         print(f"HISTORY NOT LINEARIZABLE: {rep}", file=sys.stderr)

@@ -43,6 +43,16 @@ from hot-path signals and EVACUATE leadership at a bounded rate; the
 run record counts evacuations, and a long drive with zero of them
 fails (gray_detection_ok).
 
+``--disk-pressure`` adds CAPACITY faults: every store runs under a
+standing ChaosDir byte quota (with a matching DiskBudget inside the
+store), and the nemesis menu gains quota-shrink (clamp the victim's
+quota to just above live usage) and seeded-ENOSPC-burst actions.  The
+pressure ladder (tpuraft/util/health.DiskBudget + StoreEngine reclaim
+/ shed) must snapshot-reclaim at NEAR_FULL, shed writes retryably at
+FULL while reads keep serving, and RESUME writes after reclaim without
+a restart — a long drive that never completes the whole arc fails
+(disk_pressure_ok).
+
 ``--geo N`` shapes the fabric through a seeded NetworkTopology
 (tpuraft/rpc/topology.py): stores tag round-robin into N zones,
 inter-zone links get ASYMMETRIC WAN latency + jitter + loss, and the
@@ -85,6 +95,13 @@ from tpuraft.util.nemesis import (
 from tpuraft.util.quorum import joint_quorums_intersect as \
     _joint_quorums_intersect  # shared with tests/oracle.py — one oracle
 
+# --disk-pressure: the standing per-store byte quota (ChaosDir) AND the
+# store's own DiskBudget ceiling — kept equal so the budget's thresholds
+# describe the same disk the fault plane enforces.  Sized so a few
+# seconds of soak write load crosses NEAR_FULL (reclaim must then keep
+# the store alive for the rest of the drive).
+_DISK_QUOTA_BYTES = 384 * 1024
+
 
 class _BaseSoakCluster:
     """Shared cluster shape for both fabrics: a stores map, the region
@@ -99,6 +116,10 @@ class _BaseSoakCluster:
         self.endpoints: list[str] = []
         self.regions: list[Region] = []
         self.stores: dict[str, StoreEngine] = {}
+        # extra StoreEngineOptions applied to EVERY store (restarts
+        # included) — how scenario modes (--disk-pressure) retune
+        # budgets/cadences without forking the option plumbing
+        self.store_extra: dict = {}
         # counters of RETIRED engines: a killed/restarted store gets a
         # fresh StoreEngine, and summing only live engines would erase
         # e.g. every gray evacuation a later leader-kill happened to
@@ -125,9 +146,21 @@ class _BaseSoakCluster:
                 + store.health.evaluations
             rc["sick_rounds"] = rc.get("sick_rounds", 0) \
                 + store.health.level_counts["sick"]
+        if store.disk_budget is not None:
+            # disk-pressure ladder counters must survive kill/restart
+            # in the run record, same as evacuations above
+            rc["disk_reclaims"] = rc.get("disk_reclaims", 0) \
+                + store.disk_reclaims
+            rc["disk_shed_items"] = rc.get("disk_shed_items", 0) \
+                + store.disk_shed_items
+            bc = store.disk_budget.counters()
+            for k in ("disk_pressure_resumes", "disk_enospc_events",
+                      "disk_full_rounds", "disk_near_full_rounds"):
+                rc[k] = rc.get(k, 0) + bc[k]
 
     def _store_opts(self, ep: str, election_timeout_ms: int,
                     **extra) -> StoreEngineOptions:
+        extra = {**self.store_extra, **extra}
         opts = StoreEngineOptions(
             server_id=ep,
             initial_regions=[r.copy() for r in self.regions],
@@ -654,6 +687,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    read_from: str = "leader",
                    gray: bool = False,
                    write_burst: bool = False,
+                   disk_pressure: bool = False,
                    trace: str = "") -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
@@ -699,6 +733,13 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             "storage interposition as --power-loss: in-proc fabric, "
             "no --engine (the multilog's fd-level fsyncs are out of "
             "Python's reach)")
+    if disk_pressure and (transport != "inproc" or engine):
+        raise ValueError(
+            "--disk-pressure drives capacity faults through the Python "
+            "storage interposition (ChaosDir quotas): in-proc fabric, "
+            "no --engine (the native multilog's quota mirror is "
+            "exercised by tests/test_storage_fault.py via "
+            "NativeJournalTracker.attach_quota)")
     if transport == "native":
         if n_regions > 1 or engine:
             raise ValueError("region-density soak runs on the in-proc "
@@ -712,19 +753,31 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                         geo_zones=geo, witness=witness, geo_seed=seed)
     chaos = {}
     try:
-        if power_loss or gray:
+        if power_loss or gray or disk_pressure:
             import os as _os
 
             from tpuraft.storage.fault import ChaosDir
 
-            if power_loss:
+            if power_loss or disk_pressure:
                 # snapshots on: prefix compaction + snapshot commit must
-                # run UNDER the crash schedule, not just appends
+                # run UNDER the crash schedule, not just appends (and
+                # they are the disk-pressure reclaim unit)
                 c.snapshot_interval_secs = 10
             for ep in c.endpoints:
                 ip, port = ep.rsplit(":", 1)
                 chaos[ep] = ChaosDir(
                     _os.path.join(data_path, f"{ip}_{port}")).install()
+            if disk_pressure:
+                # every store lives under a standing byte quota, and its
+                # OWN DiskBudget gets the same ceiling; small segments +
+                # a fast health cadence make reclaim prompt at soak scale
+                for cd in chaos.values():
+                    cd.set_quota(_DISK_QUOTA_BYTES)
+                c.store_extra.update(
+                    disk_budget_bytes=_DISK_QUOTA_BYTES,
+                    health_eval_interval_ms=100,
+                    log_segment_max_bytes=32 * 1024,
+                    disk_reclaim_cooldown_rounds=4)
         if gray and getattr(c, "topology", None) is None:
             # slow-endpoint events need a topology even zoneless: a
             # bare one shapes nothing until degrade_endpoint fires
@@ -737,7 +790,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
             kv_batching, geo, witness, read_mix, read_from,
             gray=gray, power_loss=power_loss, write_burst=write_burst,
-            trace=trace)
+            disk_pressure=disk_pressure, trace=trace)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -752,7 +805,7 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           kv_batching=False, geo=0, witness=False,
                           read_mix=0.0, read_from="leader", gray=False,
                           power_loss=False, write_burst=False,
-                          trace="") -> dict:
+                          disk_pressure=False, trace="") -> dict:
     if trace:
         # sampled product tracing through the whole drive; exported as
         # perfetto-loadable JSON next to the result
@@ -1159,6 +1212,74 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         while gray_limped:
             c.topology.heal_endpoint(gray_limped.pop())
 
+    # -- disk-pressure fault surface (--disk-pressure): capacity faults.
+    # The standing per-store quota (installed by run_soak) already makes
+    # the budget/reclaim machinery work for a living; these actions push
+    # a store over the edge — clamping its quota to just above live
+    # usage, or bursting seeded ENOSPC into its writes — and the ladder
+    # must shed writes retryably, reclaim, and RESUME with no restart. --------
+    disk_squeezed: list[str] = []
+    disk_bursting: list[str] = []
+
+    def _disk_victim():
+        up = [ep for ep in c.endpoints if ep in c.stores]
+        if not up:
+            raise SkipFault
+        # prefer a store that currently LEADS something — a full
+        # follower sheds nothing and reclaims nothing
+        leaders = [ep for ep in up
+                   if c.stores[ep].leader_region_ids()]
+        return rng.choice(leaders or up)
+
+    async def disk_quota_shrink():
+        """Clamp the victim's quota to live usage + a sliver: the next
+        seconds of appends hit the wall, ENOSPC latches the budget FULL,
+        and reclaim has just enough headroom to free its way out."""
+        ep = _disk_victim()
+        limit, used = chaos[ep].quota_state()
+        if limit is None:
+            raise SkipFault
+        target = used + 24 * 1024
+        if target >= limit:
+            raise SkipFault        # already squeezed near usage
+        chaos[ep].shrink_quota(limit - target)
+        # the store SEES the resize (its DiskBudget ceiling follows the
+        # emulated volume, as statvfs capacity would on a real disk) —
+        # used/target lands in NEAR_FULL territory, so the reclaim
+        # ladder fires inside the reserved headroom instead of riding
+        # blind into the hard wall
+        st = c.stores.get(ep)
+        if st is not None and st.disk_budget is not None:
+            st.disk_budget.set_budget(target)
+        disk_squeezed.append(ep)
+        say(f"  nemesis: disk-quota-shrink on {ep} -> {target}b")
+
+    async def disk_quota_restore():
+        while disk_squeezed:
+            ep = disk_squeezed.pop()
+            cd = chaos.get(ep)
+            if cd is not None:
+                cd.set_quota(_DISK_QUOTA_BYTES)
+            st = c.stores.get(ep)
+            if st is not None and st.disk_budget is not None:
+                st.disk_budget.set_budget(_DISK_QUOTA_BYTES)
+
+    async def disk_enospc_burst():
+        """Intermittent ENOSPC: ~25% of the victim's writes/renames fail
+        while real usage sits under quota — the flaky-filesystem shape;
+        flush failures must fail pending writes retryably (leader steps
+        down, nothing acks) and never wedge the store."""
+        ep = _disk_victim()
+        say(f"  nemesis: disk-enospc-burst on {ep}")
+        chaos[ep].set_enospc_burst(0.25, seed=rng.randrange(1 << 30))
+        disk_bursting.append(ep)
+
+    async def disk_burst_heal():
+        while disk_bursting:
+            cd = chaos.get(disk_bursting.pop())
+            if cd is not None:
+                cd.set_enospc_burst(0.0)
+
     if churn:
         churn_driver = MembershipChurn(c, sampled_regions[0], rng, say)
 
@@ -1193,6 +1314,19 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           check=with_conf_check(None)),
             NemesisAction("gray-slow-endpoint", gray_slow_endpoint,
                           gray_heal, dwell_s=3.0, weight=1.0,
+                          check=with_conf_check(None)),
+        ]
+    if disk_pressure:
+        # dwell spans the whole arc at the 100ms health cadence: fill ->
+        # FULL (writes shed) -> pressure-triggered snapshot reclaim ->
+        # usage drops -> hysteresis folds back -> writes RESUME — all
+        # while the fault still holds
+        actions += [
+            NemesisAction("disk-quota-shrink", disk_quota_shrink,
+                          disk_quota_restore, dwell_s=6.0, weight=1.5,
+                          check=with_conf_check(None)),
+            NemesisAction("disk-enospc-burst", disk_enospc_burst,
+                          disk_burst_heal, dwell_s=2.5, weight=1.0,
                           check=with_conf_check(None)),
         ]
     if churn_driver is not None:
@@ -1344,6 +1478,45 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             # mitigation is broken — fail the run, don't just log it
             result["gray_detection_ok"] = (evac > 0
                                            or duration_s < 120)
+        if disk_pressure:
+            # pressure-ladder counters: live stores + everything retired
+            # by kill/restart (the gray retired-counter lesson), plus
+            # the fault plane's own injection counts
+            rc = c.retired_counters
+            bsum: dict[str, int] = {}
+            for s in c.stores.values():
+                if s.disk_budget is not None:
+                    for k, v in s.disk_budget.counters().items():
+                        bsum[k] = bsum.get(k, 0) + v
+            reclaims = rc.get("disk_reclaims", 0) \
+                + sum(s.disk_reclaims for s in c.stores.values())
+            sheds = rc.get("disk_shed_items", 0) \
+                + sum(s.disk_shed_items for s in c.stores.values())
+            resumes = rc.get("disk_pressure_resumes", 0) \
+                + bsum.get("disk_pressure_resumes", 0)
+            enospc_inj: dict[str, int] = {}
+            for cd in chaos.values():
+                for k, v in cd.enospc_counts.items():
+                    enospc_inj[k] = enospc_inj.get(k, 0) + v
+            result["disk"] = {
+                "quota_bytes": _DISK_QUOTA_BYTES,
+                "enospc_injections": enospc_inj,
+                "enospc_observed": rc.get("disk_enospc_events", 0)
+                + bsum.get("disk_enospc_events", 0),
+                "near_full_rounds": rc.get("disk_near_full_rounds", 0)
+                + bsum.get("disk_near_full_rounds", 0),
+                "full_rounds": rc.get("disk_full_rounds", 0)
+                + bsum.get("disk_full_rounds", 0),
+                "reclaims": reclaims,
+                "shed_writes": sheds,
+                "resumes": resumes,
+            }
+            # acceptance gate: a long drive must show the WHOLE ladder
+            # — >=1 pressure-triggered reclaim, >=1 FULL shed, and >=1
+            # FULL->resume WITHOUT a restart — or the run fails
+            result["disk_pressure_ok"] = (
+                (reclaims > 0 and sheds > 0 and resumes > 0)
+                or duration_s < 120)
         if churn_driver is not None:
             result["membership"] = churn_driver.summary()
         # beat-plane + quiescence counters (HeartbeatHub.counters() via
@@ -1399,14 +1572,17 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         # note_anomaly snapshots the ring so later teardown events
         # can't churn the incident context away.
         if not result["linearizable"] \
-                or not result.get("gray_detection_ok", True):
+                or not result.get("gray_detection_ok", True) \
+                or not result.get("disk_pressure_ok", True):
             from tpuraft.util.trace import RECORDER
 
             RECORDER.note_anomaly(
                 "soak_failure",
                 ("oracle: " + result.get("violation", ""))[:200]
                 if not result["linearizable"]
-                else "gray detection never fired")
+                else ("gray detection never fired"
+                      if not result.get("gray_detection_ok", True)
+                      else "disk-pressure ladder never completed"))
             result["flight_recorder"] = RECORDER.dump(256)
             result["recorder_anomalies"] = [
                 {"ts": a["ts"], "reason": a["reason"],
@@ -1659,6 +1835,15 @@ def main() -> None:
                          "'alive' while limping; store health scoring "
                          "must detect it and evacuate leadership "
                          "(in-proc fabric, no --engine)")
+    ap.add_argument("--disk-pressure", action="store_true",
+                    help="capacity-fault nemesis menu: every store runs "
+                         "under a standing ChaosDir byte quota (matched "
+                         "by its DiskBudget ceiling), plus quota-shrink "
+                         "and seeded-ENOSPC-burst faults; the pressure "
+                         "ladder must reclaim at NEAR_FULL, shed writes "
+                         "retryably at FULL (reads keep serving), and "
+                         "resume after reclaim without a restart "
+                         "(in-proc fabric, no --engine)")
     ap.add_argument("--kv-batching", action="store_true",
                     help="drive load through the batching client: ops "
                          "coalesce into store-grouped kv_command_batch "
@@ -1725,11 +1910,14 @@ def main() -> None:
                                   read_from=args.read_from,
                                   gray=args.gray,
                                   write_burst=args.write_burst,
+                                  disk_pressure=args.disk_pressure,
                                   trace=args.trace))
     import json
 
     print(json.dumps(result))
-    ok = result["linearizable"] and result.get("gray_detection_ok", True)
+    ok = result["linearizable"] \
+        and result.get("gray_detection_ok", True) \
+        and result.get("disk_pressure_ok", True)
     raise SystemExit(0 if ok else 1)
 
 

@@ -614,3 +614,505 @@ def test_chaosdir_lost_fsync_and_survival(tmp_path):
         assert open(p, "rb").read() == b"durable+volatile"
         chaos.crash(rng)
         assert open(p, "rb").read() == b"durable"
+
+
+# ---------------------------------------------------------------------------
+# disk-pressure fault plane: quota / ENOSPC (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def test_chaosdir_quota_partial_write_then_enospc(tmp_path):
+    """The capacity fault plane itself: a write crossing the budget
+    commits the fitting prefix (short write) then fails ENOSPC; deletes
+    refund the budget."""
+    import errno as _errno
+
+    root = str(tmp_path / "quota")
+    with ChaosDir(root) as chaos:
+        p = os.path.join(root, "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 60)
+        chaos.set_quota(100)
+        try:
+            with open(p, "ab") as f:
+                f.write(b"y" * 80)
+            raise AssertionError("over-budget write admitted whole")
+        except OSError as e:
+            assert e.errno == _errno.ENOSPC
+        # the fitting 40-byte prefix landed before the error
+        assert os.path.getsize(p) == 100
+        assert chaos.enospc_counts.get("write", 0) == 1
+        limit, used = chaos.quota_state()
+        assert limit == 100 and used >= 100
+        # refund on remove: the budget frees and writes admit again
+        os.remove(p)
+        with open(os.path.join(root, "g.bin"), "wb") as f:
+            f.write(b"z" * 50)
+        assert os.path.getsize(os.path.join(root, "g.bin")) == 50
+
+
+def test_chaosdir_quota_shrink_and_burst(tmp_path):
+    """quota-shrink-over-time tightens the wall; seeded bursts fail
+    writes wholesale regardless of budget and heal at rate 0."""
+    root = str(tmp_path / "sq")
+    with ChaosDir(root) as chaos:
+        chaos.set_quota(1000)
+        assert chaos.shrink_quota(400) == 600
+        p = os.path.join(root, "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"a" * 500)
+        try:
+            with open(p, "ab") as f:
+                f.write(b"b" * 200)
+            raise AssertionError("shrunk quota not enforced")
+        except OSError:
+            pass
+        chaos.set_enospc_burst(1.0, seed=9)
+        try:
+            with open(os.path.join(root, "h.bin"), "wb") as f:
+                f.write(b"c")
+            raise AssertionError("burst rate 1.0 admitted a write")
+        except OSError:
+            pass
+        assert chaos.enospc_counts.get("burst", 0) >= 1
+        chaos.set_enospc_burst(0.0)
+        chaos.clear_quota()
+        with open(os.path.join(root, "h.bin"), "wb") as f:
+            f.write(b"c" * 300)  # healed
+
+
+def test_chaosdir_quota_rename_enospc(tmp_path):
+    """Creating a fresh directory entry on a full tree fails ENOSPC
+    (the path snapshot commit / meta compaction renames exercise)."""
+    root = str(tmp_path / "rq")
+    with ChaosDir(root) as chaos:
+        src = os.path.join(root, "src.bin")
+        with open(src, "wb") as f:
+            f.write(b"x" * 100)
+        chaos.set_quota(100)  # exactly full
+        try:
+            os.rename(src, os.path.join(root, "dst.bin"))
+            raise AssertionError("rename to fresh entry on full tree")
+        except OSError:
+            pass
+        assert chaos.enospc_counts.get("rename", 0) == 1
+        # replacing an EXISTING entry stays allowed (no new inode)
+        dst = os.path.join(root, "src.bin")  # self-replace: dst exists
+        os.replace(src, dst)
+
+
+def test_filelog_enospc_append_fails_clean_and_retries(tmp_path):
+    """An append that dies ENOSPC leaves the storage view unchanged
+    (no phantom index advance) and the SAME batch retries cleanly after
+    space frees — partial garbage at the tail is overwritten, never
+    served."""
+    root = str(tmp_path / "flq")
+    with ChaosDir(root) as chaos:
+        st = FileLogStorage(os.path.join(root, "log"))
+        st.init()
+        st.append_entries([_entry(i, 0) for i in range(1, 6)], sync=True)
+        base = st.last_log_index()
+        chaos.set_quota(chaos.quota_state()[1] + 20)  # ~half an entry
+        batch = [_entry(base + 1, 1), _entry(base + 2, 1)]
+        try:
+            st.append_entries(batch, sync=True)
+            raise AssertionError("ENOSPC append reported success")
+        except OSError:
+            pass
+        assert st.last_log_index() == base
+        for i in range(1, base + 1):
+            assert st.get_entry(i).data == _entry(i, 0).data
+        chaos.clear_quota()
+        st.append_entries(batch, sync=True)  # same batch, now fits
+        assert st.last_log_index() == base + 2
+        for e in batch:
+            assert st.get_entry(e.id.index).data == e.data
+        st.shutdown()
+        # and the healed tail survives a reopen (no torn garbage kept)
+        st2 = FileLogStorage(os.path.join(root, "log"))
+        st2.init()
+        assert st2.last_log_index() == base + 2
+        st2.shutdown()
+
+
+def test_filelog_shutdown_and_reopen_on_full_disk(tmp_path):
+    """A store must SHUT DOWN and BOOT on a genuinely full disk: the
+    non-sync watermark saves (init scan, clean shutdown) only advance a
+    stale-LOW-safe optimization, so ENOSPC on ``synced.tmp`` must not
+    propagate.  Caught by the 300s --disk-pressure --power-loss soak:
+    the power-loss kill's graceful stop died mid-shutdown on the
+    watermark write and the store never came back."""
+    root = str(tmp_path / "flfull")
+    with ChaosDir(root) as chaos:
+        st = FileLogStorage(os.path.join(root, "log"))
+        st.init()
+        st.append_entries([_entry(i, 0) for i in range(1, 8)], sync=True)
+        chaos.set_quota(chaos.quota_state()[1])  # zero headroom
+        st.shutdown()                            # must not raise
+        # boot on the still-full disk: init's watermark refresh is also
+        # best-effort; the log itself is read back intact
+        st2 = FileLogStorage(os.path.join(root, "log"))
+        st2.init()
+        assert st2.last_log_index() == 7
+        for i in range(1, 8):
+            assert st2.get_entry(i).data == _entry(i, 0).data
+        st2.shutdown()
+    # and with the quota lifted the watermark heals on the next cycle
+    st3 = FileLogStorage(os.path.join(root, "log"))
+    st3.init()
+    assert st3.last_log_index() == 7
+    st3.shutdown()
+
+
+def test_meta_journal_close_on_full_disk(tmp_path):
+    """MetaJournal.close() on a full disk: the fsync lands (durability
+    holds), the watermark save is best-effort, close does not raise,
+    and the values replay on reopen."""
+    root = str(tmp_path / "mjfull")
+    with ChaosDir(root) as chaos:
+        j = MetaJournal(root)
+        j.stage("g1", 7, PeerId.parse("127.0.0.1:1"))
+        j.sync()
+        chaos.set_quota(chaos.quota_state()[1])  # zero headroom
+        try:
+            # the staged append itself fails ENOSPC (the vote-save
+            # handler surfaces that as a refused grant) — the landed
+            # prefix is torn-tail garbage the replay discards
+            j.stage("g2", 9, PeerId.parse("127.0.0.1:2"))
+        except OSError:
+            pass
+        j.close()     # must not raise (watermark tmp hits ENOSPC)
+    j2 = MetaJournal(root)
+    term, voted = j2.get("g1")
+    assert term == 7 and str(voted) == "127.0.0.1:1"
+    j2.close()
+
+
+def test_native_quota_mirror_enospc(tmp_path):
+    """The native multilog's quota mirror: attach_quota installs the
+    engine fault gate; appends past the journal budget fail ENOSPC,
+    acked entries stay readable, clear_quota heals."""
+    d = str(tmp_path / "natq")
+    s = MultiLogStorage(d, "g")
+    s.init()
+    s.append_entries([_entry(i, 0) for i in range(1, 4)], sync=True)
+    tracker = NativeJournalTracker(d)
+    tracker.attach_quota(s.engine, limit_bytes=tracker._dir_usage() + 16)
+    try:
+        s.append_entries([_entry(4, 0)], sync=True)
+        raise AssertionError("native append past journal budget")
+    except OSError:
+        pass
+    assert s.last_log_index() == 3
+    for i in range(1, 4):
+        assert s.get_entry(i).data == _entry(i, 0).data
+    tracker.clear_quota()
+    s.append_entries([_entry(4, 0)], sync=True)
+    assert s.get_entry(4).data == _entry(4, 0).data
+    # burst mirror: whole-op seeded failures, rate 0 heals
+    tracker.attach_quota(s.engine, burst_rate=1.0, seed=3)
+    try:
+        s.append_entries([_entry(5, 0)], sync=True)
+        raise AssertionError("burst rate 1.0 admitted a native append")
+    except OSError:
+        pass
+    tracker.attach_quota(s.engine, burst_rate=0.0)
+    s.append_entries([_entry(5, 0)], sync=True)
+    s.shutdown()
+
+
+def test_snapshot_save_enospc_keeps_old_snapshot(tmp_path):
+    """ENOSPC mid snapshot save: the previous snapshot stays loadable,
+    the aborted temp is swept, and the save succeeds once space frees."""
+    from tpuraft.rpc.messages import SnapshotMeta
+    from tpuraft.storage.snapshot import LocalSnapshotStorage
+
+    root = str(tmp_path / "snapq")
+    with ChaosDir(root) as chaos:
+        stor = LocalSnapshotStorage(os.path.join(root, "snap"))
+        stor.init()
+        w = stor.create()
+        w.write_file("kv", b"gen1" * 50)
+        stor.commit(w, SnapshotMeta(last_included_index=10,
+                                    last_included_term=1))
+        assert stor.open().load_meta().last_included_index == 10
+
+        chaos.set_quota(chaos.quota_state()[1] + 30)
+        w2 = stor.create()
+        try:
+            w2.write_file("kv", b"gen2" * 200)
+            raise AssertionError("over-budget snapshot write admitted")
+        except OSError:
+            pass
+        # old snapshot intact, correct bytes
+        r = stor.open()
+        assert r.load_meta().last_included_index == 10
+        assert r.read_file("kv") == b"gen1" * 50
+
+        chaos.clear_quota()
+        stor.init()  # sweeps the aborted temp dir
+        w3 = stor.create()
+        w3.write_file("kv", b"gen2" * 200)
+        stor.commit(w3, SnapshotMeta(last_included_index=20,
+                                     last_included_term=1))
+        assert stor.open().load_meta().last_included_index == 20
+
+
+def test_snapshot_storage_init_sweeps_orphans(tmp_path):
+    """init() removes crash-orphaned snapshot_<N> dirs: stale older
+    dirs the post-commit prune never got to, and unreadable newer dirs
+    whose manifest never became durable."""
+    from tpuraft.rpc.messages import SnapshotMeta
+    from tpuraft.storage.snapshot import LocalSnapshotStorage
+
+    root = str(tmp_path / "sweep")
+    stor = LocalSnapshotStorage(root)
+    stor.init()
+    w = stor.create()
+    w.write_file("kv", b"live")
+    stor.commit(w, SnapshotMeta(last_included_index=10,
+                                last_included_term=1))
+    # stale older dir (prune-after-replace never ran) + manifestless
+    # newer dir (replace landed, manifest lost to the crash)
+    os.makedirs(os.path.join(root, "snapshot_5"))
+    with open(os.path.join(root, "snapshot_5", "kv"), "wb") as f:
+        f.write(b"stale")
+    os.makedirs(os.path.join(root, "snapshot_20"))
+
+    stor2 = LocalSnapshotStorage(root)
+    stor2.init()
+    names = sorted(n for n in os.listdir(root) if n.startswith("snapshot_"))
+    assert names == ["snapshot_10"], names
+    assert stor2.open().read_file("kv") == b"live"
+
+    # nothing loadable at all -> keep everything for forensics
+    root2 = str(tmp_path / "sweep2")
+    os.makedirs(os.path.join(root2, "snapshot_7"))
+    s3 = LocalSnapshotStorage(root2)
+    s3.init()
+    assert os.path.isdir(os.path.join(root2, "snapshot_7"))
+
+
+def test_meta_journal_enospc_mid_compaction(tmp_path):
+    """ENOSPC during the journal's compaction rewrite must not fail the
+    sync round or hurt the journal: values stay readable, the partial
+    tmp is dropped, and compaction succeeds after space frees."""
+    root = str(tmp_path / "mjq")
+    with ChaosDir(root) as chaos:
+        j = MetaJournal(root)
+        j.COMPACT_MIN_BYTES = 512
+        peer = PeerId.parse("10.0.0.1:80")
+        # pile up garbage records well past the compaction threshold
+        for t in range(1, 120):
+            j.stage("g0", t, peer)
+            j.stage("g1", t, peer)
+        chaos.set_quota(chaos.quota_state()[1])  # zero headroom
+        j.sync()   # fsync ok (bytes already staged); compaction dies
+        assert j.get("g0") == (119, peer)
+        assert j.get("g1") == (119, peer)
+        assert not os.path.exists(os.path.join(root, "meta.jnl.tmp"))
+        # journal still ACCEPTS overwrites of staged bytes... heal and
+        # prove full service: stage + sync + eventual compaction
+        chaos.clear_quota()
+        j.stage("g0", 200, peer)
+        j.sync()
+        assert j.get("g0") == (200, peer)
+        j.close()
+        j2 = MetaJournal(root)
+        assert j2.get("g0") == (200, peer)
+        assert j2.get("g1") == (119, peer)
+        j2.close()
+
+
+def test_disk_budget_thresholds_hysteresis_resume():
+    from tpuraft.util.health import (
+        PRESSURE_FULL,
+        PRESSURE_NEAR_FULL,
+        PRESSURE_OK,
+        DiskBudget,
+        DiskBudgetOptions,
+    )
+
+    b = DiskBudget(DiskBudgetOptions(budget_bytes=1000, worsen_after=1,
+                                     recover_after=2))
+    b.note_append(500)
+    assert b.evaluate() == PRESSURE_OK
+    b.note_append(350)            # 850/1000 >= 0.80
+    assert b.evaluate() == PRESSURE_NEAR_FULL
+    b.note_append(100)            # 950/1000 >= 0.92
+    assert b.evaluate() == PRESSURE_FULL
+    # recovery is hysteretic: reclaim must PROVE space recover_after
+    # consecutive rounds before pressure relaxes (then: one resume)
+    b.note_reclaimed(500)         # 450/1000
+    assert b.evaluate() == PRESSURE_FULL
+    assert b.evaluate() == PRESSURE_OK
+    c = b.counters()
+    assert c["disk_pressure_resumes"] == 1
+    assert c["disk_reclaimed_bytes"] == 500
+    # reconcile re-bases the estimate (rmtree deletes the hot path
+    # never saw), and set_budget adopts an operator resize
+    b.reconcile(900)
+    assert b.used_bytes() == 900
+    b.set_budget(2000)
+    assert b.evaluate() == PRESSURE_OK   # 900/2000: headroom again
+    assert b.capacity_bytes() == 2000
+
+
+def test_disk_budget_enospc_latch_pins_full():
+    """An observed ENOSPC pins raw FULL for enospc_latch_rounds no
+    matter what the byte estimate says — the errno outranks it."""
+    from tpuraft.util.health import (
+        PRESSURE_FULL,
+        PRESSURE_OK,
+        DiskBudget,
+        DiskBudgetOptions,
+    )
+
+    b = DiskBudget(DiskBudgetOptions(budget_bytes=1000, worsen_after=1,
+                                     recover_after=1, enospc_latch_rounds=2))
+    b.note_append(10)             # estimate says: nearly empty
+    assert b.evaluate() == PRESSURE_OK
+    b.note_enospc()
+    assert b.evaluate() == PRESSURE_FULL
+    assert b.evaluate() == PRESSURE_FULL     # latch round 2
+    assert b.evaluate() == PRESSURE_OK       # latch expired, estimate rules
+    assert b.counters()["disk_enospc_events"] == 1
+    assert b.counters()["disk_pressure_resumes"] == 1
+
+
+async def test_log_manager_enospc_flush_rolls_back_frontier(tmp_path):
+    """Regression for the non-contiguous-append wedge the disk-pressure
+    soak found: a flush that dies ENOSPC must fail its waiters AND roll
+    the in-memory frontier back to what storage holds — otherwise the
+    next append passes the in-memory contiguity check, trips storage's
+    gap check, and the node is wedged in ERROR forever."""
+    from tpuraft.errors import RaftException
+    from tpuraft.storage.log_manager import LogManager
+
+    root = str(tmp_path / "lmq")
+    with ChaosDir(root) as chaos:
+        st = FileLogStorage(os.path.join(root, "log"))
+        lm = LogManager(st)
+        await lm.init()
+        await lm.append_entries_follower(
+            0, 0, [_entry(i, 0, term=2) for i in range(1, 6)])
+        assert lm.last_log_index() == 5
+        chaos.set_quota(chaos.quota_state()[1] + 10)
+        try:
+            await lm.append_entries_follower(
+                5, 2, [_entry(i, 0, term=2) for i in range(6, 9)])
+            raise AssertionError("ENOSPC flush reported success")
+        except RaftException:
+            pass
+        # frontier converged back onto storage: no phantom suffix
+        assert lm.last_log_index() == st.last_log_index()
+        # heal -> the SAME entries re-append cleanly (leader retry)
+        chaos.clear_quota()
+        base = lm.last_log_index()
+        ok = await lm.append_entries_follower(
+            base, 2, [_entry(i, 0, term=2) for i in range(base + 1, 9)])
+        assert ok and lm.last_log_index() == 8
+        assert lm.check_consistency().is_ok()
+        for i in range(1, 9):
+            assert lm.get_term(i) == 2
+        await lm.shutdown()
+
+
+def _filelog_quota_crash_lifetime(root: str, rng: random.Random,
+                                  gens: int) -> int:
+    """Seeded-crash matrix with quota faults layered in: every
+    generation runs under a shifting byte budget (including seeded
+    ENOSPC bursts), appends tolerate ENOSPC without model drift, and a
+    power-loss crash ends the generation.  Invariants are the usual
+    acked floor / staged ceiling / byte-match set."""
+    first, entries, acked_last = 1, {}, 0
+
+    def staged_last():
+        return max(entries) if entries else first - 1
+
+    with ChaosDir(root) as chaos:
+        for gen in range(gens):
+            chaos.clear_quota()
+            chaos.set_enospc_burst(0.0)
+            st = FileLogStorage(os.path.join(root, "log"),
+                                segment_max_bytes=200)
+            st.init()
+            rf, rl = st.first_log_index(), st.last_log_index()
+            assert rf == first, f"gen {gen}: first {rf} != {first}"
+            assert acked_last <= rl <= staged_last(), \
+                f"gen {gen}: last {rl} not in [{acked_last}, {staged_last()}]"
+            for i in range(rf, rl + 1):
+                e = st.get_entry(i)
+                assert e is not None and e.data == entries[i], \
+                    f"gen {gen}: entry {i} mismatch"
+            for i in list(entries):
+                if i > rl:
+                    del entries[i]
+            acked_last = rl
+
+            # quota fault for this generation: tight budget, seeded
+            # burst, or free-running (the original matrix)
+            mode = rng.random()
+            if mode < 0.4:
+                chaos.set_quota(chaos.quota_state()[1]
+                                + rng.randrange(0, 400))
+            elif mode < 0.6:
+                chaos.set_enospc_burst(0.3, seed=rng.randrange(1 << 30))
+
+            for _ in range(rng.randrange(1, 5)):
+                n = rng.randrange(1, 6)
+                batch = [_entry(staged_last() + 1 + k, gen)
+                         for k in range(n)]
+                try:
+                    st.append_entries(batch, sync=True)
+                except OSError:
+                    # ENOSPC: storage contract says the view advanced
+                    # only to what landed whole — adopt ITS frontier
+                    # (landed entries are staged, NOT acked: the batch
+                    # fsync never ran)
+                    landed = st.last_log_index()
+                    for e in batch:
+                        if e.id.index <= landed:
+                            entries[e.id.index] = e.data
+                    if rng.random() < 0.5:
+                        chaos.clear_quota()
+                        chaos.set_enospc_burst(0.0)
+                    continue
+                for e in batch:
+                    entries[e.id.index] = e.data
+                acked_last = staged_last()
+
+            if rng.random() < 0.5:
+                batch = [_entry(staged_last() + 1 + k, gen, term=2)
+                         for k in range(rng.randrange(1, 4))]
+                try:
+                    st.append_entries(batch, sync=False)
+                    for e in batch:
+                        entries[e.id.index] = e.data
+                except OSError:
+                    landed = st.last_log_index()
+                    for e in batch:
+                        if e.id.index <= landed:
+                            entries[e.id.index] = e.data
+
+            plan = chaos.capture_crash(rng)   # power dies (quota live)
+            # the faults die with the power: shutdown's own writes are
+            # discarded by the image anyway, but they must not blow up
+            # the harness on the still-armed quota
+            chaos.clear_quota()
+            chaos.set_enospc_burst(0.0)
+            st.shutdown()
+            chaos.apply_crash(plan)
+        return chaos.crash_count
+
+
+def test_filelog_quota_crash_matrix():
+    import tempfile
+
+    crashes = 0
+    for seed in range(3):
+        with tempfile.TemporaryDirectory() as tmp:
+            crashes += _filelog_quota_crash_lifetime(
+                os.path.join(tmp, f"qlog{seed}"),
+                random.Random(4000 + seed), gens=20)
+    assert crashes >= 60

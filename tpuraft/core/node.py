@@ -363,8 +363,12 @@ class Node:
                 opts.raft_options.max_logs_in_memory_bytes),
             health=opts.health,
             trace_proc=self._trace_proc,
+            disk_budget=opts.disk_budget,
         )
         await self.log_manager.init()
+        # storage-flush failure (ENOSPC, EIO) -> leader step-down with
+        # retryable client errors, never process death (ISSUE 17 layer 4)
+        self.log_manager.on_storage_error = self._on_log_storage_error
 
         # fsm pipeline
         self.ballot_box = self._ballot_box_factory(self._on_committed)
@@ -598,7 +602,14 @@ class Node:
                         ack_at_commit=task.ack_at_commit)
             self.replicators.wake_all()
         # fsync outside the lock; batched with concurrent appliers
-        await self.log_manager.flush_staged(last_id.index)
+        try:
+            await self.log_manager.flush_staged(last_id.index)
+        except RaftException:
+            # flush failed (ENOSPC/EIO): the flush loop's
+            # on_storage_error hook steps this leader down, failing the
+            # pending closures with retryable ENEWLEADER — nothing here
+            # may count toward commit
+            return
         async with self._lock:
             if self.state in (State.LEADER, State.TRANSFERRING) \
                     and self.current_term == term:
@@ -937,7 +948,18 @@ class Node:
         self.current_term += 1
         self.voted_for = self.server_id
         self.leader_id = EMPTY_PEER
-        await self._persist_meta(self.current_term, self.server_id)
+        try:
+            await self._persist_meta(self.current_term, self.server_id)
+        except Exception:
+            # ENOSPC/EIO mid self-vote save: abort the campaign cleanly
+            # (no votes were solicited; a full disk must not kill the
+            # node or campaign on an unpersisted term).  In-memory term
+            # stays bumped, which is safe — it can only refuse stale
+            # traffic — and the retry timer fires the next attempt.
+            LOG.exception("%s election aborted: meta persist failed", self)
+            self.state = State.FOLLOWER
+            self._ctrl.on_follower()
+            return
         term = self.current_term
         last_id = self.log_manager.last_log_id()
         # tally: TimerControl checks quorum inline per grant; the
@@ -1059,10 +1081,36 @@ class Node:
         asyncio.ensure_future(self._flush_and_self_commit(term, last_id.index))
 
     async def _flush_and_self_commit(self, term: int, index: int) -> None:
-        await self.log_manager.flush_staged(index)
+        try:
+            await self.log_manager.flush_staged(index)
+        except RaftException:
+            # storage flush failed: the on_storage_error hook handles
+            # the step-down; this fire-and-forget task must not die
+            # with an unhandled exception
+            return
         async with self._lock:
             if self.is_leader() and self.current_term == term:
                 self._commit_at_self(index)
+
+    def _on_log_storage_error(self, exc: BaseException) -> None:
+        """LogManager on_storage_error hook (runs in the flush loop's
+        except path): a flush that failed ENOSPC/EIO already failed its
+        waiters with retryable EIO — here the LEADERSHIP is surrendered
+        so clients re-route while the store sheds/reclaims, instead of
+        the process dying or the leader lying about durability."""
+        t = asyncio.ensure_future(self._step_down_on_storage_error(str(exc)))
+        t.add_done_callback(lambda tt: tt.cancelled() or tt.exception())
+
+    async def _step_down_on_storage_error(self, msg: str) -> None:
+        async with self._lock:
+            if self.state not in (State.LEADER, State.TRANSFERRING):
+                return
+            # same-term step-down: deliberately NOT a term bump — a
+            # bump would persist meta, i.e. another write on the disk
+            # that just refused one
+            await self._step_down(
+                self.current_term,
+                Status.error(RaftError.EIO, f"log storage failed: {msg}"))
 
     # graftcheck: holds(_lock)
     async def _step_down(self, term: int, status: Status,
@@ -1215,7 +1263,21 @@ class Node:
             if (log_ok and self.voted_for.is_empty()
                     and self.state == State.FOLLOWER):
                 self.voted_for = candidate
-                await self._persist_meta(self.current_term, candidate)
+                try:
+                    await self._persist_meta(self.current_term, candidate)
+                except Exception:
+                    # ENOSPC/EIO mid vote-save: the on-disk {term, vote}
+                    # pair is intact (tmp+rename / journal tail never
+                    # acked) and no grant left this node — forget the
+                    # tentative in-memory vote and refuse; the
+                    # candidate simply retries elsewhere.  Acking
+                    # without durability would be a double-vote hazard
+                    # after a crash.
+                    LOG.exception("%s vote persist failed; refusing grant",
+                                  self)
+                    self.voted_for = EMPTY_PEER
+                    return RequestVoteResponse(term=self.current_term,
+                                               granted=False)
                 self._last_leader_timestamp = time.monotonic()  # grant => reset
                 self._ctrl.note_leader_contact()
                 return RequestVoteResponse(term=self.current_term, granted=True)
@@ -1353,6 +1415,17 @@ class Node:
                 ok = await lm.append_entries_follower(
                     req.prev_log_index, req.prev_log_term, entries)
             except RaftException as e:
+                if e.status.code == RaftError.EIO:
+                    # transient storage failure (ENOSPC/EIO flush): the
+                    # entries were NOT journaled and NOT acked — reject
+                    # the round so the leader backs off and retries.
+                    # Once pressure clears (reclaim freed disk, burst
+                    # healed) the retry lands; the replica must NOT be
+                    # condemned to ERROR for a full volume.
+                    return AppendEntriesResponse(
+                        multi_hb=mh,
+                        term=self.current_term, success=False,
+                        last_log_index=lm.last_log_index())
                 # conflict below the applied index: this replica's state
                 # machine has diverged from the leader's committed log —
                 # unrecoverable (only reachable through storage loss /

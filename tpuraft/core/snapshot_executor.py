@@ -24,6 +24,7 @@ from tpuraft.rpc.messages import (
     SnapshotMeta,
 )
 from tpuraft.rpc.transport import RpcError
+from tpuraft.storage.log_manager import _is_enospc
 from tpuraft.storage.snapshot import (
     LocalSnapshotStorage,
     RemoteFileCopier,
@@ -109,7 +110,17 @@ class SnapshotExecutor:
             async def save_wrapper(w, d):
                 meta_box["id"] = LogId(node.fsm_caller.last_applied_index,
                                        node.fsm_caller.last_applied_term)
-                await node.options.fsm.on_snapshot_save(w, d)
+                try:
+                    await node.options.fsm.on_snapshot_save(w, d)
+                except Exception as exc:
+                    # a failed SAVE (ENOSPC on the temp dir, most
+                    # likely) must not escape into the FSMCaller drain
+                    # loop — that poisons the queue and ERRORs the
+                    # whole node.  The old snapshot is untouched; fail
+                    # just this attempt and let reclaim retry.
+                    LOG.exception("%s snapshot save failed", node)
+                    d(Status.error(RaftError.EIO,
+                                   f"snapshot save failed: {exc}"))
 
             node.fsm_caller._enqueue(
                 ("snapshot_save_custom", (writer, done, save_wrapper)))
@@ -133,7 +144,22 @@ class SnapshotExecutor:
                 old_witnesses=[str(p) for p in conf_entry.old_conf.witnesses],
             )
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(None, self._storage.commit, writer, meta)
+            budget = getattr(node.options, "disk_budget", None)
+            try:
+                await loop.run_in_executor(
+                    None, self._storage.commit, writer, meta)
+            except Exception as exc:
+                # commit failed (ENOSPC on manifest write / rename):
+                # the previous snapshot_<N> is intact and the temp dir
+                # is swept at next init/create — report, don't crash
+                LOG.exception("%s snapshot commit failed", node)
+                if budget is not None and _is_enospc(exc):
+                    budget.note_enospc()
+                return Status.error(RaftError.EIO,
+                                    f"snapshot commit failed: {exc}")
+            if budget is not None:
+                budget.note_snapshot(self._storage.last_commit_bytes
+                                     - self._storage.last_reclaimed_bytes)
             self.last_snapshot_id = snap_id
             await node.log_manager.set_snapshot(
                 snap_id, conf_entry,

@@ -199,6 +199,12 @@ class NodeOptions:
     # heartbeat paths their peer RTTs, and the node's election gate
     # consults the score.  None = no health scoring (bare nodes).
     health: Optional[object] = None
+    # store-level disk-capacity tracker (tpuraft.util.health.
+    # DiskBudget), shared by every node the hosting store runs: the
+    # LogManager feeds append bytes + ENOSPC observations, the snapshot
+    # executor feeds commit/prune deltas, and the store's health task
+    # reconciles + folds pressure.  None = no capacity accounting.
+    disk_budget: Optional[object] = None
     # a SICK store skips this many consecutive election rounds before
     # campaigning anyway (the liveness escape when every peer is worse
     # off) — the election-priority face of gray-failure mitigation

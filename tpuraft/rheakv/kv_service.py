@@ -337,6 +337,20 @@ class KVCommandProcessor:
             # produces spans where the local tracer is armed
             op.trace_id = req.trace_id
         is_write = op.op in _WRITE_OPS
+        if is_write:
+            # disk-pressure admission (FULL): shed WRITES retryably,
+            # keep serving reads — a full store remains a useful read
+            # replica while reclaim frees space (ISSUE 17 layer 3)
+            wshed, wretry = self._se.should_shed_writes()
+            if wshed:
+                self._se.disk_shed_items += 1
+                RECORDER.record_coalesced("disk_shed",
+                                          str(self._se.server_id),
+                                          items=1, retry_ms=wretry)
+                return KVCommandResponse(
+                    code=ERR_STORE_BUSY,
+                    msg=f"store disk full: shedding writes "
+                        f"(retry-after-ms={wretry})")
         if self._heat is not None and is_write:
             self._heat.note_write(req.region_id, 1, len(req.op_blob))
         self.inflight_items += 1
@@ -395,6 +409,12 @@ class KVCommandProcessor:
         tids = (unpack_ctx(req.trace_ctx, len(req.items))
                 if TRACER.enabled and req.trace_ctx else None)
         v0 = time.perf_counter() if tids else 0.0
+        # disk-pressure admission (FULL): per-ITEM, not whole-batch —
+        # the batch's reads keep serving while its writes bounce with
+        # the retryable busy (ISSUE 17: a full store stays a read
+        # replica; the client re-offers writes after retry-after)
+        wshed, wretry = self._se.should_shed_writes()
+        wsheds = 0
         for i, blob in enumerate(req.items):
             region_id, conf_ver, version, op_blob = decode_batch_item(blob)
             rejected, engine, op = self._validate(
@@ -403,11 +423,22 @@ class KVCommandProcessor:
                 code, msg, meta = rejected
                 replies[i] = encode_batch_reply(code, msg, region_meta=meta)
                 continue
+            if wshed and op.op in _WRITE_OPS:
+                wsheds += 1
+                replies[i] = encode_batch_reply(
+                    ERR_STORE_BUSY,
+                    f"store disk full: shedding writes "
+                    f"(retry-after-ms={wretry})")
+                continue
             if tids and tids[i]:
                 op.trace_id = tids[i]
             if self._heat is not None and op.op in _WRITE_OPS:
                 self._heat.note_write(region_id, 1, len(op_blob))
             groups.setdefault(region_id, []).append((i, op))
+        if wsheds:
+            self._se.disk_shed_items += wsheds
+            RECORDER.record_coalesced("disk_shed", str(self._se.server_id),
+                                      items=wsheds, retry_ms=wretry)
         if tids:
             v1 = time.perf_counter()
             for tid in tids:

@@ -45,6 +45,19 @@ from tpuraft.rheakv.region_engine import RegionEngine
 LOG = logging.getLogger(__name__)
 
 
+def _dir_usage_bytes(root: str) -> int:
+    """Recursive file-size sum (the disk reconcile's 'du'); runs on an
+    executor thread — never call from the event loop."""
+    total = 0
+    for dirpath, _dirs, names in os.walk(root):
+        for n in names:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, n))
+            except OSError:
+                pass
+    return total
+
+
 @dataclass
 class StoreEngineOptions:
     cluster_name: str = "rheakv"
@@ -71,6 +84,11 @@ class StoreEngineOptions:
     # regions, O(bytes/segment) fds (the reference's single-RocksDB
     # role; storage/multilog.py).  Only used when data_path is set.
     log_scheme: str = "file"
+    # cap per-region log segment size (file/native schemes; 0 = the
+    # storage default, 64MB).  Prefix compaction frees disk in whole-
+    # segment units, so tight storage budgets want small segments —
+    # reclaim can then actually return bytes between snapshots.
+    log_segment_max_bytes: int = 0
     # group quiescence (engine-driven regions only): an idle, fully
     # replicated region hibernates after this many consecutive fully-
     # acked beat rounds — see RaftOptions.quiesce_after_rounds.  0 = off.
@@ -150,6 +168,37 @@ class StoreEngineOptions:
     # timing out 256 workers at p99=inf.
     shed_backlog_items: int = 512
     shed_retry_after_ms: int = 250
+    # -- disk-pressure survival (capacity accounting + reaction ladder) ------
+    # account this store's on-disk usage into hysteretic {OK, NEAR_FULL,
+    # FULL} pressure (tpuraft/util/health.py DiskBudget; hot-path fed:
+    # log-append bytes, snapshot commit/prune deltas, ENOSPC
+    # observations; periodically reconciled against real usage).  The
+    # reaction ladder: NEAR_FULL floors health DEGRADED (PD stops
+    # placing leaders here) and starts urgent snapshot+compaction
+    # reclaim; FULL floors SICK (evacuation) and sheds WRITES at
+    # kv_service admission with retryable ERR_STORE_BUSY while reads
+    # keep serving.  Requires data_path; False = no tracker.  See
+    # docs/operations.md "Disk-pressure runbook".
+    disk_guard: bool = True
+    # byte budget for this store's data directory.  0 = derive capacity
+    # from os.statvfs at reconcile (whole filesystem — production);
+    # tests/soaks set an explicit budget matching the chaos quota.
+    disk_budget_bytes: int = 0
+    # pressure thresholds as fractions of the budget.  full_frac < 1.0
+    # is the RESERVED HEADROOM: admission stops at full_frac so
+    # reclaim's own writes (snapshot temp dirs, compaction tmp files)
+    # still fit under the hard budget — the can't-compact-when-full
+    # deadlock guard.
+    disk_near_full_frac: float = 0.80
+    disk_full_frac: float = 0.92
+    # reconcile real usage (directory walk / statvfs, on an executor
+    # thread) every N health rounds
+    disk_reconcile_rounds: int = 4
+    # pressure reclaim: urgent snapshot+log-compaction across led
+    # regions, at most this many per health round, with a per-region
+    # cooldown so one region isn't re-snapshotted every round
+    disk_reclaim_rate: int = 2
+    disk_reclaim_cooldown_rounds: int = 8
     # -- live metrics exposition ---------------------------------------------
     # serve Prometheus text at GET /metrics on a stdlib HTTP listener:
     # None = off (the default — the describe_metrics admin RPC and
@@ -541,9 +590,31 @@ class StoreEngine:
             if self.append_batcher is not None:
                 # write-plane rounds double as per-endpoint RTT probes
                 self.append_batcher.health = self.health
+        # disk-pressure plane: one DiskBudget per store, fed by the hot
+        # path (LogManager append bytes, snapshot commit/prune deltas,
+        # ENOSPC observations) and reconciled + acted on by the health
+        # loop's _disk_round below
+        self.disk_budget = None
+        self.disk_reclaims = 0        # pressure snapshots that completed
+        self.disk_reclaim_rounds = 0  # rounds that attempted reclaim
+        self.disk_shed_items = 0      # writes bounced at FULL admission
+        self._reclaim_cooldown: dict[int, int] = {}  # region -> round gate
+        if opts.disk_guard and opts.data_path:
+            from tpuraft.util import describer
+            from tpuraft.util.health import DiskBudget, DiskBudgetOptions
+
+            self.disk_budget = DiskBudget(
+                DiskBudgetOptions(
+                    budget_bytes=opts.disk_budget_bytes,
+                    near_full_frac=opts.disk_near_full_frac,
+                    full_frac=opts.disk_full_frac),
+                label=str(self.server_id))
+            describer.register(self.disk_budget)
         self.metrics = MetricRegistry(enabled=opts.enable_kv_metrics)
         if self.health is not None:
             self.health.register_gauges(self.metrics)
+        if self.disk_budget is not None:
+            self.disk_budget.register_gauges(self.metrics)
         raw: RawKVStore = opts.raw_store_factory()
         if opts.enable_kv_metrics:
             raw = MetricsRawKVStore(raw, self.metrics)
@@ -662,6 +733,7 @@ class StoreEngine:
                 self._heartbeat_loop())
         if self.health is not None:
             self._wire_multilog_probe()
+        if self.health is not None or self.disk_budget is not None:
             self._health_task = asyncio.ensure_future(self._health_loop())
         if self.opts.metrics_port is not None:
             self._start_metrics_http()
@@ -706,6 +778,10 @@ class StoreEngine:
 
             self.health.loop_lag.stop()
             describer.unregister(self.health)
+        if self.disk_budget is not None:
+            from tpuraft.util import describer
+
+            describer.unregister(self.disk_budget)
         if self.read_batcher is not None:
             from tpuraft.util import describer
 
@@ -774,6 +850,10 @@ class StoreEngine:
             try:
                 await asyncio.sleep(interval)
                 self._evac_round += 1
+                if self.disk_budget is not None:
+                    await self._disk_round(self._evac_round)
+                if self.health is None:
+                    continue
                 level = self.health.evaluate()
                 if level == SICK and self.opts.evacuate_on_sick:
                     await self._evacuate_leaders()
@@ -781,6 +861,98 @@ class StoreEngine:
                 return
             except Exception:  # noqa: BLE001 — scoring must never die
                 LOG.exception("health loop round failed")
+
+    # -- disk-pressure survival: accounting + reaction ladder ----------------
+
+    def _store_base(self) -> str:
+        return (f"{self.opts.data_path}/"
+                f"{self.server_id.ip}_{self.server_id.port}")
+
+    async def _disk_round(self, round_no: int) -> None:
+        """One disk-pressure round: periodic usage reconciliation
+        (directory walk, off-loop), pressure fold, health floor
+        (NEAR_FULL => DEGRADED stops PD leader placement; FULL => SICK
+        engages the evacuation machinery), and rate-bounded urgent
+        reclaim while under pressure."""
+        from tpuraft.util.health import (DEGRADED, HEALTHY, SICK,
+                                         PRESSURE_FULL, PRESSURE_NEAR_FULL,
+                                         PRESSURE_OK)
+
+        b = self.disk_budget
+        if round_no % max(1, self.opts.disk_reconcile_rounds) == 1:
+            loop = asyncio.get_running_loop()
+            base = self._store_base()
+            if self.opts.disk_budget_bytes > 0:
+                used = await loop.run_in_executor(
+                    None, _dir_usage_bytes, base)
+                b.reconcile(used)
+            else:
+                # no explicit budget: whole-filesystem statvfs view
+                try:
+                    sv = await loop.run_in_executor(None, os.statvfs, base)
+                    b.reconcile((sv.f_blocks - sv.f_bavail) * sv.f_frsize,
+                                sv.f_blocks * sv.f_frsize)
+                except OSError:
+                    pass
+        level = b.evaluate()
+        if self.health is not None:
+            if level == PRESSURE_FULL:
+                self.health.set_floor(SICK, "disk_full")
+            elif level == PRESSURE_NEAR_FULL:
+                self.health.set_floor(DEGRADED, "disk_near_full")
+            else:
+                self.health.set_floor(HEALTHY)
+        if level != PRESSURE_OK:
+            await self._reclaim_round(level)
+
+    async def _reclaim_round(self, pressure: str) -> None:
+        """Urgent reclaim under pressure: snapshot + log-compact up to
+        ``disk_reclaim_rate`` led regions this round (cooldown-gated
+        per region).  Triggered already at NEAR_FULL — i.e. inside the
+        reserved headroom below full_frac — so the snapshot/compaction
+        writes themselves still fit under the hard budget."""
+        self.disk_reclaim_rounds += 1
+        done = 0
+        for rid in self.leader_region_ids():
+            if done >= max(1, self.opts.disk_reclaim_rate):
+                break
+            if self._reclaim_cooldown.get(rid, 0) > self._evac_round:
+                continue
+            engine = self._regions.get(rid)
+            if engine is None or engine.node is None:
+                continue
+            # cooldown on ATTEMPT: a save that bounces (EBUSY, or
+            # ENOSPC inside the headroom) must not be hammered every
+            # round
+            self._reclaim_cooldown[rid] = (
+                self._evac_round
+                + max(1, self.opts.disk_reclaim_cooldown_rounds))
+            try:
+                st = await engine.node.snapshot()
+            except Exception:  # noqa: BLE001 — reclaim must never die
+                LOG.exception("pressure reclaim snapshot failed (region %d)",
+                              rid)
+                continue
+            if st.is_ok():
+                done += 1
+                self.disk_reclaims += 1
+                RECORDER.record("disk_reclaim", engine.group_id,
+                                node=str(self.server_id), pressure=pressure)
+                LOG.warning("disk-pressure reclaim: region %d snapshotted + "
+                            "log-compacted (store %s is %s)", rid,
+                            self.server_id, pressure)
+
+    def should_shed_writes(self) -> tuple[bool, int]:
+        """FULL-disk admission gate (kv_service): WRITE ops bounce with
+        the retryable busy while reads keep serving — a full store
+        stays a useful read replica while reclaim frees space.
+        Returns (shed?, retry_after_ms)."""
+        from tpuraft.util.health import PRESSURE_FULL
+
+        if self.disk_budget is None \
+                or self.disk_budget.pressure() != PRESSURE_FULL:
+            return False, 0
+        return True, self.opts.shed_retry_after_ms
 
     async def _evacuate_leaders(self) -> int:
         """Proactive leadership evacuation: move up to
@@ -891,6 +1063,9 @@ class StoreEngine:
             "pd_heat_rows_sent": self.pd_heat_rows_sent,
             "evacuations": self.evacuations,
             "evacuation_rounds": self.evacuation_rounds,
+            "disk_reclaims": self.disk_reclaims,
+            "disk_reclaim_rounds": self.disk_reclaim_rounds,
+            "kv_disk_shed_items": self.disk_shed_items,
             "metrics_renders": self.metrics_renders,
             "metrics_cache_hits": self.metrics_cache_hits,
         }
@@ -954,6 +1129,8 @@ class StoreEngine:
             gauges["lane_depth"] = self.apply_lane.depth()
         if self.health is not None:
             gauges.update(self.health.counters())
+        if self.disk_budget is not None:
+            gauges.update(self.disk_budget.counters())
         if self.heat is not None:
             gauges.update(self.heat.gauges())
         if self.multi_raft_engine is not None:
@@ -1251,6 +1428,10 @@ class StoreEngine:
         # LogManager, apply depth from its FSMCaller, election gate from
         # its _allow_launch_election
         opts.health = self.health
+        # disk-pressure plane: every region node feeds the ONE
+        # store-level capacity tracker (LogManager append bytes,
+        # snapshot executor commit/prune deltas, ENOSPC observations)
+        opts.disk_budget = self.disk_budget
         # apply worker lane: every region's FSMCaller submits committed
         # DATA runs to the ONE store-wide lane (total store order
         # preserved by the lane's FIFO; witness regions have a null FSM
@@ -1280,6 +1461,9 @@ class StoreEngine:
                 self._migrate_legacy_meta(store_base, base, region.id)
             else:
                 opts.log_uri = f"{self.opts.log_scheme}://{base}/log"
+                if self.opts.log_segment_max_bytes > 0:
+                    opts.log_uri += \
+                        f"?seg={self.opts.log_segment_max_bytes}"
                 opts.raft_meta_uri = f"file://{base}/meta"
             opts.snapshot_uri = f"file://{base}/snapshot"
         else:

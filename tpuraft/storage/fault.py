@@ -50,6 +50,7 @@ Model simplifications (documented, deliberate):
 from __future__ import annotations
 
 import builtins
+import errno
 import os
 import random
 import shutil
@@ -158,15 +159,30 @@ class FaultInjectingFile:
     def write(self, data):
         self._owner._slow_sleep("write")
         pos = self._real.tell()
+        admitted = self._owner._quota_admit(self._path, pos, len(data))
+        if admitted < len(data):
+            # partial write at the quota boundary: a real disk commits
+            # what fit before returning the short count / ENOSPC, so the
+            # admitted prefix LANDS (and is modeled) before the error
+            if admitted > 0:
+                self._real.write(bytes(memoryview(data)[:admitted]))
+                self._owner._note_write(self._path, pos, pos + admitted)
+            raise OSError(errno.ENOSPC,
+                          f"no space left on device (chaos quota): "
+                          f"{self._path}")
         n = self._real.write(data)
         self._owner._note_write(self._path, pos, pos + len(data))
         return n
 
     def truncate(self, size=None):
+        try:
+            pre = os.path.getsize(self._path)
+        except OSError:
+            pre = 0
         r = self._real.truncate(size)
-        self._owner._note_truncate(self._path,
-                                   self._real.tell() if size is None
-                                   else size)
+        new = self._real.tell() if size is None else size
+        self._owner._quota_refund(pre - new)
+        self._owner._note_truncate(self._path, new)
         return r
 
     def close(self):
@@ -351,25 +367,40 @@ class _Interposer:
         return None      # modeled; skip the real (slow) fsync
 
     def _replace(self, src, dst, **kw):
-        owner = self.owner(dst) or self.owner(src)
-        r = self._real["replace"](src, dst, **kw)
-        if owner is not None:
-            owner._note_replace(os.path.abspath(os.fspath(src)),
-                                os.path.abspath(os.fspath(dst)))
-        return r
+        return self._renamish("replace", src, dst, **kw)
 
     def _rename(self, src, dst, **kw):
+        return self._renamish("rename", src, dst, **kw)
+
+    def _renamish(self, which, src, dst, **kw):
         owner = self.owner(dst) or self.owner(src)
-        r = self._real["rename"](src, dst, **kw)
+        freed = 0
         if owner is not None:
+            owner._quota_admit_rename(os.path.abspath(os.fspath(src)),
+                                      os.path.abspath(os.fspath(dst)))
+            try:  # replacing an existing file frees its bytes
+                if os.path.isfile(dst):
+                    freed = os.path.getsize(dst)
+            except OSError:
+                pass
+        r = self._real[which](src, dst, **kw)
+        if owner is not None:
+            owner._quota_refund(freed)
             owner._note_replace(os.path.abspath(os.fspath(src)),
                                 os.path.abspath(os.fspath(dst)))
         return r
 
     def _remove(self, path, **kw):
         owner = self.owner(path)
+        freed = 0
+        if owner is not None:
+            try:
+                freed = os.path.getsize(path)
+            except OSError:
+                pass
         r = self._real["remove"](path, **kw)
         if owner is not None:
+            owner._quota_refund(freed)
             owner._note_remove(os.path.abspath(os.fspath(path)))
         return r
 
@@ -410,6 +441,20 @@ class ChaosDir:
         self._fsync_gate = threading.Event()
         self._fsync_gate.set()
         self.slow_counts: dict[str, int] = {}
+        # -- capacity faults (ENOSPC) ----------------------------------------
+        # byte budget across the tree, charged at write/append/rename;
+        # once exceeded writes fail ENOSPC with the fitting prefix
+        # committed (real short writes).  Usage is tracked by extension
+        # bytes and lazily re-based from the live tree — deletes that
+        # bypass the interposer (shutil.rmtree) are picked up on the
+        # next over-budget admission, which is how reclaim un-wedges a
+        # full store without an explicit refund hook.
+        self._quota_limit: Optional[int] = None   # guarded-by: _lock
+        self._quota_used = 0                      # guarded-by: _lock
+        self._quota_refreshed = 0.0               # guarded-by: _lock
+        self._burst_rate = 0.0                    # guarded-by: _lock
+        self._burst_rng = random.Random(0)        # guarded-by: _lock
+        self.enospc_counts: dict[str, int] = {}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -484,6 +529,132 @@ class ChaosDir:
             self.slow_counts[f"{kind}_slowed"] = \
                 self.slow_counts.get(f"{kind}_slowed", 0) + 1
         time.sleep(delay / 1000.0)
+
+    # -- capacity faults (ENOSPC) --------------------------------------------
+
+    def set_quota(self, limit_bytes: int) -> None:
+        """Byte budget for the whole tree: current usage is measured
+        now, and any write/append/rename that would grow the tree past
+        the budget fails ENOSPC — with the fitting prefix of the write
+        committed first (real disks do short writes at the boundary).
+        Overwrites within a file's current size are free."""
+        with self._lock:
+            self._quota_limit = max(0, int(limit_bytes))
+            self._quota_used = self._disk_usage_locked()
+            self._quota_refreshed = time.monotonic()
+
+    def shrink_quota(self, delta_bytes: int) -> int:
+        """Tighten the budget by ``delta_bytes`` (quota-shrink-over-time
+        nemesis); returns the new limit.  No-op without a quota."""
+        with self._lock:
+            if self._quota_limit is None:
+                return 0
+            self._quota_limit = max(0, self._quota_limit - int(delta_bytes))
+            return self._quota_limit
+
+    def clear_quota(self) -> None:
+        """Lift the byte budget (bursts configured separately)."""
+        with self._lock:
+            self._quota_limit = None
+
+    def set_enospc_burst(self, rate: float, seed: int = 0) -> None:
+        """Seeded intermittent ENOSPC: each write/rename under the root
+        independently fails with probability ``rate`` (whole-op, no
+        partial).  ``rate=0`` heals.  Models transient quota races /
+        reservation failures rather than a genuinely full disk."""
+        with self._lock:
+            self._burst_rate = max(0.0, float(rate))
+            self._burst_rng = random.Random(seed)
+
+    def quota_state(self) -> tuple[Optional[int], int]:
+        """(limit, used-estimate) snapshot for assertions/telemetry."""
+        with self._lock:
+            return self._quota_limit, self._quota_used
+
+    def _disk_usage_locked(self) -> int:
+        total = 0
+        for dirpath, _dirs, names in os.walk(self.root):
+            for n in names:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, n))
+                except OSError:
+                    pass
+        return total
+
+    def _refresh_quota_used_locked(self) -> None:
+        # re-base from the live tree (rate-limited: this runs on every
+        # over-budget admission, and full stores see write storms)
+        now = time.monotonic()
+        if now - self._quota_refreshed < 0.05:
+            return
+        self._quota_refreshed = now
+        self._quota_used = self._disk_usage_locked()
+
+    def _quota_admit(self, path: str, pos: int, n: int) -> int:
+        """How many of the ``n`` bytes at ``pos`` may land (wrapped-file
+        write hook).  Charges only extension bytes past the file's
+        current size; returns ``n`` when unconstrained."""
+        with self._lock:
+            if self._burst_rate > 0.0 \
+                    and self._burst_rng.random() < self._burst_rate:
+                self.enospc_counts["burst"] = \
+                    self.enospc_counts.get("burst", 0) + 1
+                return 0
+            if self._quota_limit is None:
+                return n
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            ext = pos + n - size
+            if ext <= 0:
+                return n
+            free = self._quota_limit - self._quota_used
+            if ext > free:
+                # maybe stale: reclaim deletes (rmtree) bypass the
+                # interposer — re-measure before refusing
+                self._refresh_quota_used_locked()
+                free = self._quota_limit - self._quota_used
+            if ext <= free:
+                self._quota_used += ext
+                return n
+            self.enospc_counts["write"] = \
+                self.enospc_counts.get("write", 0) + 1
+            fits = max(0, free)
+            self._quota_used += fits
+            return n - (ext - fits)
+
+    def _quota_refund(self, nbytes: int) -> None:
+        """Bytes freed by a tracked remove/truncate/replace-overwrite.
+        (rmtree deletes bypass the interposer and are picked up by the
+        lazy re-measure in :meth:`_quota_admit` instead.)"""
+        if nbytes <= 0:
+            return
+        with self._lock:
+            if self._quota_limit is not None:
+                self._quota_used = max(0, self._quota_used - nbytes)
+
+    def _quota_admit_rename(self, src: str, dst: str) -> None:
+        """Pre-op gate for rename/replace under the root: creating a
+        fresh directory entry on a full disk fails ENOSPC (and bursts
+        hit renames too — meta compaction / snapshot commit exercise
+        their failure paths)."""
+        with self._lock:
+            key = None
+            if self._burst_rate > 0.0 \
+                    and self._burst_rng.random() < self._burst_rate:
+                key = "burst"
+            elif self._quota_limit is not None \
+                    and not os.path.lexists(dst):
+                if self._quota_used >= self._quota_limit:
+                    self._refresh_quota_used_locked()
+                if self._quota_used >= self._quota_limit:
+                    key = "rename"
+            if key is not None:
+                self.enospc_counts[key] = self.enospc_counts.get(key, 0) + 1
+                raise OSError(errno.ENOSPC,
+                              f"no space left on device (chaos quota): "
+                              f"rename to {dst}")
 
     def __exit__(self, *exc) -> bool:
         self.uninstall()
@@ -693,6 +864,71 @@ class NativeJournalTracker:
         self.dir = dir_path
         self.modes = modes
         self.floors: dict[str, int] = {}
+        # -- capacity mirror (ENOSPC) ----------------------------------------
+        # the C++ fd writes are unpatachable, so the quota is enforced
+        # one layer up: MultiLogStorage._stage consults the engine's
+        # ``fault_gate`` before tlm_append.  Single-threaded per store
+        # loop + engine lock upstream — no lock needed here.
+        self._quota_limit: Optional[int] = None
+        self._quota_used = 0
+        self._burst_rate = 0.0
+        self._burst_rng = random.Random(0)
+        self.enospc_counts: dict[str, int] = {}
+
+    # -- capacity faults (ENOSPC), mirroring ChaosDir ------------------------
+
+    def attach_quota(self, engine, limit_bytes: Optional[int] = None,
+                     burst_rate: float = 0.0, seed: int = 0) -> None:
+        """Install this tracker as the engine's append fault gate (see
+        ``MultiLogEngine.fault_gate``) with an optional byte budget over
+        the journal dir and/or a seeded intermittent ENOSPC burst."""
+        if limit_bytes is not None:
+            self._quota_limit = max(0, int(limit_bytes))
+            self._quota_used = self._dir_usage()
+        self._burst_rate = max(0.0, float(burst_rate))
+        self._burst_rng = random.Random(seed)
+        engine.fault_gate = self.charge_append
+
+    def clear_quota(self) -> None:
+        self._quota_limit = None
+        self._burst_rate = 0.0
+
+    def _dir_usage(self) -> int:
+        total = 0
+        try:
+            for n in os.listdir(self.dir):
+                try:
+                    total += os.path.getsize(os.path.join(self.dir, n))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return total
+
+    def charge_append(self, nbytes: int) -> None:
+        """Engine fault gate: account ``nbytes`` about to be staged and
+        raise ENOSPC once the journal dir would exceed the budget (the
+        native append is all-or-nothing, so no partial admission)."""
+        if self._burst_rate > 0.0 \
+                and self._burst_rng.random() < self._burst_rate:
+            self.enospc_counts["burst"] = \
+                self.enospc_counts.get("burst", 0) + 1
+            raise OSError(errno.ENOSPC,
+                          "no space left on device (chaos burst): "
+                          f"{self.dir}")
+        if self._quota_limit is None:
+            return
+        if self._quota_used + nbytes > self._quota_limit:
+            # journal GC deletes files underneath us — re-measure
+            # before refusing, so reclaim un-wedges the quota
+            self._quota_used = self._dir_usage()
+        if self._quota_used + nbytes > self._quota_limit:
+            self.enospc_counts["append"] = \
+                self.enospc_counts.get("append", 0) + 1
+            raise OSError(errno.ENOSPC,
+                          "no space left on device (chaos quota): "
+                          f"{self.dir}")
+        self._quota_used += nbytes
 
     def _journals(self, root: Optional[str] = None) -> list[str]:
         root = root or self.dir

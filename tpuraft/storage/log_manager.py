@@ -29,6 +29,13 @@ from tpuraft.util.trace import TRACER as _TRACE
 LOG = logging.getLogger(__name__)
 
 
+def _is_enospc(exc: BaseException) -> bool:
+    import errno
+
+    return getattr(exc, "errno", None) == errno.ENOSPC \
+        or "ENOSPC" in str(exc) or "no space left" in str(exc).lower()
+
+
 @dataclass
 class _FlushReq:
     entries: list[LogEntry]
@@ -49,8 +56,13 @@ class LogManager:
         max_logs_in_memory_bytes: int = 256 * 1024,
         health=None,
         trace_proc: str = "",
+        disk_budget=None,
     ):
         self._storage = storage
+        # capacity accounting: the store-level DiskBudget this flusher
+        # feeds append bytes into (and ENOSPC observations — the
+        # pressure ladder trusts the errno over its own estimate)
+        self._disk_budget = disk_budget
         # trace-plane process identity for flush spans (the owning
         # node's store endpoint; "" for bare/legacy constructions)
         self._trace_proc = trace_proc or "log"
@@ -100,6 +112,11 @@ class LogManager:
         # (tpuraft.parallel.replica_plane; SURVEY §6 "ships (groupId,
         # peerId, lastLogIndex) tick-tensors ... into the JAX process")
         self.on_stable = None  # Optional[Callable[[int], None]]
+        # storage-failure hook: called (with the exception) after a
+        # flush round fails and its futures/waiters were failed — the
+        # node maps this to leader step-down (clients get retryable
+        # errors) instead of process death; see ISSUE 17 layer 4
+        self.on_storage_error = None  # Optional[Callable[[BaseException], None]]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -417,22 +434,61 @@ class LogManager:
                                         proc=self._trace_proc,
                                         entries=len(entries))
                     self._stable_index = max(self._stable_index, entries[-1].id.index)
+                    if self._disk_budget is not None:
+                        # ~32B/entry framing+index overhead on top of
+                        # payload — an estimate; the periodic reconcile
+                        # re-bases on real usage
+                        self._disk_budget.note_append(
+                            sum(len(e.data) for e in entries)
+                            + 32 * len(entries))
                     if self.on_stable is not None:
                         self.on_stable(self._stable_index)
                 for r in batch:
                     if not r.future.done():
                         r.future.set_result(True)
                 self._wake_stable_waiters()
-            except Exception as exc:  # storage failure is fatal for the node
+            except Exception as exc:
+                # storage failure is fatal for the LEADERSHIP, not the
+                # process: every waiter gets a retryable error and the
+                # on_storage_error hook steps the node down — never ack,
+                # never silently drop (ISSUE 17 layer 4)
                 LOG.exception("log flush failed")
+                if self._disk_budget is not None and _is_enospc(exc):
+                    self._disk_budget.note_enospc()
                 err = RaftException(Status.error(RaftError.EIO, str(exc)))
+                # Fail EVERYTHING in flight — this batch, every queued
+                # request, the staged-but-unflushed tail — then roll the
+                # in-memory frontier back to what storage actually
+                # holds.  None of the failed suffix was ever acked, so
+                # dropping it is the follower-conflict-truncate case,
+                # not data loss; KEEPING it permanently desyncs memory
+                # from disk — the next append dies "non-contiguous" in
+                # storage and the node wedges in ERROR state (found by
+                # the --disk-pressure soak's ENOSPC bursts).
+                while self._queue:
+                    batch.append(self._queue.popleft())
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(err)
+                self._staged.clear()
+                durable = max(self._storage.last_log_index(),
+                              self._first_index - 1)
+                for i in range(durable + 1, self._last_index + 1):
+                    self._mem_pop(i)
+                if durable < self._last_index:
+                    self.conf_manager.truncate_suffix(durable)
+                self._last_index = durable
+                self._stable_index = min(self._stable_index, durable)
                 for _, fut in self._stable_waiters:
                     if not fut.done():
                         fut.set_exception(err)
                 self._stable_waiters.clear()
+                cb = self.on_storage_error
+                if cb is not None:
+                    try:
+                        cb(exc)
+                    except Exception:
+                        LOG.exception("on_storage_error hook failed")
 
     def _wake_stable_waiters(self) -> None:
         rest = []

@@ -19,6 +19,7 @@ A tiny ``meta`` file persists first_log_index for prefix truncation.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
@@ -27,6 +28,8 @@ from abc import ABC, abstractmethod
 from typing import Optional
 
 from tpuraft.entity import EntryType, LogEntry
+
+LOG = logging.getLogger(__name__)
 
 _FRAME = struct.Struct("<I")
 # durable_end sentinel: "this whole segment was complete at watermark time"
@@ -499,8 +502,26 @@ class FileLogStorage(LogStorage):
         return struct.unpack("<qq", vals)
 
     def _save_watermark(self, sync: bool = False) -> None:  # graftcheck: holds(_lock)
-        save_crc_watermark(self._watermark_path(), self._dir,
-                           struct.pack("<qq", *self._synced), sync)
+        try:
+            save_crc_watermark(self._watermark_path(), self._dir,
+                               struct.pack("<qq", *self._synced), sync)
+        except OSError:
+            # sync=True saves are the destructive-op FLOORS (prefix/
+            # suffix truncation, reset): a floor that can't be
+            # persisted must abort its operation, so propagate.
+            if sync:
+                raise
+            # every non-sync save ADVANCES the watermark after the
+            # fact (init scan, clean shutdown, post-truncation
+            # refresh), and stale-LOW is always safe — a full disk
+            # (ENOSPC on synced.tmp) must not fail boot or shutdown
+            # over a scan-avoidance optimization
+            LOG.warning("watermark save failed (stale-LOW, non-fatal)",
+                        exc_info=True)
+            try:
+                os.remove(self._watermark_path() + ".tmp")
+            except OSError:
+                pass
 
     def _meta_path(self) -> str:
         return os.path.join(self._dir, "meta")
@@ -605,6 +626,15 @@ class FileLogStorage(LogStorage):
                 # frontier candidate captured under the lock: only bytes
                 # written BEFORE our fsync below may be claimed synced
                 frontier = (touched[-1].first_index, touched[-1].size)
+                # prefix durability: segments between the synced
+                # frontier and this batch can carry staged-unsynced
+                # bytes this batch never touched (an ENOSPC-failed
+                # batch's landed prefix, with the segment rolled since).
+                # Advancing the watermark over them without an fsync
+                # would claim them durable — a crash then drops them,
+                # leaving the acked suffix stranded past a hole.
+                touched = [s for s in self._segments
+                           if (s.first_index, s.size) > self._synced]
         # fsync oldest-first so a crash leaves a prefix, never a hole
         for seg in touched:
             seg.sync()
@@ -735,13 +765,26 @@ class FileLogStorage(LogStorage):
         self._save_meta()
 
 
+def _split_seg(rest: str) -> tuple[str, Optional[int]]:
+    """Split an optional ``?seg=<bytes>`` suffix off a storage path.
+
+    The suffix caps the segment size — prefix compaction returns disk
+    in whole-segment units, so small segments make reclaim prompt
+    enough for tight storage budgets (the disk-pressure plane)."""
+    if "?seg=" not in rest:
+        return rest, None
+    path, _, val = rest.rpartition("?seg=")
+    return path, int(val)
+
+
 def create_log_storage(uri: str) -> LogStorage:
     """SPI-style factory by URI scheme (reference: DefaultJRaftServiceFactory
     #createLogStorage via JRaftServiceLoader)."""
     if uri == "memory://":
         return MemoryLogStorage()
     if uri.startswith("file://"):
-        return FileLogStorage(uri[len("file://"):])
+        path, seg = _split_seg(uri[len("file://"):])
+        return FileLogStorage(path, segment_max_bytes=seg)
     if uri.startswith("native://"):
         try:
             from tpuraft.storage.native_log import NativeLogStorage
@@ -751,7 +794,8 @@ def create_log_storage(uri: str) -> LogStorage:
                 "(build with `make -C native`); falling back is deliberate "
                 f"not automatic: {exc}"
             ) from exc
-        return NativeLogStorage(uri[len("native://"):])
+        path, seg = _split_seg(uri[len("native://"):])
+        return NativeLogStorage(path, segment_max_bytes=seg or None)
     if uri.startswith("multilog://"):
         # shared multi-group journal engine: multilog://<dir>#<group_id>
         # — every group of a process shares one engine and one fsync per

@@ -37,6 +37,7 @@ rename) once garbage dominates.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
@@ -58,6 +59,8 @@ _CRC = struct.Struct("<I")
 
 _JNL = "meta.jnl"
 _WM = "meta.jnl.synced"
+
+LOG = logging.getLogger(__name__)
 
 
 def _record(group: bytes, term: int, voted: bytes) -> bytes:
@@ -116,8 +119,24 @@ class MetaJournal:
         return struct.unpack("<q", vals)[0] if vals is not None else 0
 
     def _save_wm(self, sync: bool) -> None:  # graftcheck: holds(_lock)
-        save_crc_watermark(self._wm_path(), self.dir,
-                           struct.pack("<q", self._synced), sync)
+        try:
+            save_crc_watermark(self._wm_path(), self.dir,
+                               struct.pack("<q", self._synced), sync)
+        except OSError:
+            # same policy as FileLogStorage._save_watermark: the
+            # sync=True save is the compaction FLOOR and must abort the
+            # compaction on failure; the non-sync saves (open, close,
+            # post-compaction refresh) only ADVANCE the watermark, and
+            # stale-LOW always degrades to torn-tail scan semantics —
+            # ENOSPC on the watermark tmp must not fail close/boot
+            if sync:
+                raise
+            LOG.warning("meta watermark save failed (stale-LOW, "
+                        "non-fatal)", exc_info=True)
+            try:
+                os.remove(self._wm_path() + ".tmp")
+            except OSError:
+                pass
 
     # graftcheck: allow(guarded-by) — construction-time: runs inside __init__, before the journal is shared
     def _open(self) -> None:
@@ -220,7 +239,19 @@ class MetaJournal:
                     # file handle out from under stagers and fsyncers):
                     # rare — threshold-gated — and bounded by the live
                     # set's size, unlike the per-round fsync above
-                    self._compact_locked()
+                    try:
+                        self._compact_locked()
+                    except OSError:
+                        # compaction is an optimization: a rewrite that
+                        # dies ENOSPC (tmp copy on a full disk) must not
+                        # fail the sync round that already fsynced — the
+                        # journal handle and staged bytes are untouched
+                        # (os.replace either never ran or landed whole).
+                        # Drop the partial tmp; a later round retries.
+                        try:
+                            os.remove(self._path() + ".tmp")
+                        except OSError:
+                            pass
 
     def _compact_locked(self) -> None:
         # floor the watermark (fsynced) BEFORE replacing the file: if the

@@ -277,6 +277,11 @@ class MultiLogEngine:
             raise IOError(f"multilog open failed: {err.value.decode()}")
         self.dir = dir_path
         self.group_commit = _GroupCommit(self)
+        # capacity-fault hook (tests/soak): a callable taking the byte
+        # count about to be staged, raising OSError(ENOSPC) to refuse it
+        # — the C++ fd writes are out of Python interposition's reach,
+        # so NativeJournalTracker.attach_quota enforces budgets here
+        self.fault_gate = None
         self._refs = 0
         # serializes sync vs close: tlm_close deletes the native Store,
         # so closing while an fsync round is mid-flight in any thread
@@ -423,6 +428,9 @@ class MultiLogStorage(LogStorage):
             parts.append(_FRAME.pack(len(blob)))
             parts.append(blob)
         frames = b"".join(parts)
+        gate = self._eng.fault_gate
+        if gate is not None:
+            gate(len(frames))
         err = ctypes.create_string_buffer(256)
         n = self._lib.tlm_append(self._eng._h, self._gid, frames,
                                  len(frames), err, 256)

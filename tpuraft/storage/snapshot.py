@@ -205,6 +205,12 @@ class LocalSnapshotStorage:
 
     def __init__(self, root: str):
         self._root = root
+        # byte deltas of the most recent commit (committed dir size,
+        # bytes reclaimed by the prune) — the SnapshotExecutor reads
+        # these into the store's DiskBudget; plain attrs, single commit
+        # in flight per storage (the executor serializes saves)
+        self.last_commit_bytes = 0
+        self.last_reclaimed_bytes = 0
 
     def init(self) -> None:
         os.makedirs(self._root, exist_ok=True)
@@ -212,6 +218,26 @@ class LocalSnapshotStorage:
         tmp = os.path.join(self._root, "temp")
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
+        # sweep orphans a crash mid-commit leaves behind: stale
+        # snapshot_<N> dirs older than the newest LOADABLE one (the
+        # prune after os.replace never ran), and unreadable newer dirs
+        # (replace landed but the manifest never got durable).  Without
+        # this they leak until the disk fills — the disk-pressure soak
+        # finds the leak first.
+        dirs = self._snapshot_dirs()
+        newest_valid = None
+        for idx, path in reversed(dirs):
+            try:
+                SnapshotReader(path)
+                newest_valid = idx
+                break
+            except (IOError, ValueError):
+                continue
+        if newest_valid is None:
+            return  # nothing loadable: keep everything for forensics
+        for idx, path in dirs:
+            if idx != newest_valid:
+                shutil.rmtree(path, ignore_errors=True)
 
     def _snapshot_dirs(self) -> list[tuple[int, str]]:
         out = []
@@ -230,6 +256,19 @@ class LocalSnapshotStorage:
             shutil.rmtree(tmp)
         return SnapshotWriter(tmp)
 
+    @staticmethod
+    def _dir_bytes(path: str) -> int:
+        total = 0
+        try:
+            for n in os.listdir(path):
+                try:
+                    total += os.path.getsize(os.path.join(path, n))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return total
+
     def commit(self, writer: SnapshotWriter, meta: SnapshotMeta) -> str:
         writer.save_meta(meta)
         dst = os.path.join(self._root, f"snapshot_{meta.last_included_index}")
@@ -241,9 +280,13 @@ class LocalSnapshotStorage:
             os.fsync(fd)
         finally:
             os.close(fd)
+        self.last_commit_bytes = self._dir_bytes(dst)
         # keep only the newest snapshot (reference keeps last 1 by default)
+        reclaimed = 0
         for idx, path in self._snapshot_dirs()[:-1]:
+            reclaimed += self._dir_bytes(path)
             shutil.rmtree(path, ignore_errors=True)
+        self.last_reclaimed_bytes = reclaimed
         return dst
 
     def open(self) -> Optional[SnapshotReader]:

@@ -47,6 +47,16 @@ SICK = "sick"
 
 _LEVELS = {HEALTHY: 0, DEGRADED: 1, SICK: 2}
 
+# disk-pressure states (DiskBudget) — same hysteresis machinery, its
+# own axis: pressure feeds health as an external FLOOR (NEAR_FULL =>
+# DEGRADED, FULL => SICK) rather than mixing into the latency signals
+PRESSURE_OK = "ok"
+PRESSURE_NEAR_FULL = "near_full"
+PRESSURE_FULL = "full"
+
+_PRESSURE_LEVELS = {PRESSURE_OK: 0, PRESSURE_NEAR_FULL: 1,
+                    PRESSURE_FULL: 2}
+
 
 @dataclass
 class HealthOptions:
@@ -220,13 +230,19 @@ class LoopLagProbe:
 # rows), folded only on the store's event loop; the cross-thread disk
 # signal stays inside the LOCKED DiskLatencyProbe above
 class _Hysteresis:
-    """Evaluation-count hysteresis around a raw level stream."""
+    """Evaluation-count hysteresis around a raw level stream.
 
-    __slots__ = ("level", "_pending", "_streak", "_up", "_down")
+    ``levels`` maps level name -> rank (worse = higher); defaults to the
+    health axis, and DiskBudget reuses the machinery with the pressure
+    axis (OK/NEAR_FULL/FULL)."""
 
-    def __init__(self, worsen_after: int, recover_after: int):
-        self.level = HEALTHY
-        self._pending = HEALTHY
+    __slots__ = ("level", "_pending", "_streak", "_up", "_down", "_levels")
+
+    def __init__(self, worsen_after: int, recover_after: int,
+                 levels: dict | None = None, initial: str = HEALTHY):
+        self._levels = levels if levels is not None else _LEVELS
+        self.level = initial
+        self._pending = initial
         self._streak = 0
         self._up = max(1, worsen_after)
         self._down = max(1, recover_after)
@@ -238,7 +254,8 @@ class _Hysteresis:
         if raw != self._pending:
             self._pending, self._streak = raw, 0
         self._streak += 1
-        need = self._up if _LEVELS[raw] > _LEVELS[self.level] else self._down
+        need = self._up if self._levels[raw] > self._levels[self.level] \
+            else self._down
         if self._streak >= need:
             self.level = raw
             self._streak = 0
@@ -274,8 +291,21 @@ class HealthTracker:
         # the current level ("disk" / "stall" / "apply" / "")
         self.level_counts = {HEALTHY: 0, DEGRADED: 0, SICK: 0}
         self.cause = ""
+        # external raw floor (disk pressure): the DiskBudget ladder
+        # pins the raw level at least this bad each round, so NEAR_FULL
+        # rides the existing health heartbeat wire to the PD (stops new
+        # leader placement) and FULL engages the SICK machinery
+        # (evacuation + shed) without a second reporting channel
+        self._floor = HEALTHY
+        self._floor_cause = ""
 
     # -- signal intake -------------------------------------------------------
+
+    def set_floor(self, level: str, cause: str = "") -> None:
+        """Pin the RAW level at least this bad (hysteresis still
+        applies).  HEALTHY clears the floor."""
+        self._floor = level
+        self._floor_cause = cause if level != HEALTHY else ""
 
     def note_peer_rtt(self, endpoint: str, rtt_s: float) -> None:
         ent = self._peers.get(endpoint)
@@ -317,6 +347,8 @@ class HealthTracker:
             elif lag_ema >= o.loop_degraded_ms \
                     and _LEVELS[level] < _LEVELS[DEGRADED]:
                 level, cause = DEGRADED, "loop"
+        if _LEVELS[self._floor] > _LEVELS[level]:
+            level, cause = self._floor, self._floor_cause
         return level, cause
 
     def evaluate(self) -> str:
@@ -413,3 +445,213 @@ class HealthTracker:
                 f"samples={samples} apply_ema={self._apply_ema:.1f} "
                 f"loop_lag={lag_ema:.1f}ms max={lag_max:.0f}ms "
                 f"evals={self.evaluations} peers=[{peers}]>")
+
+
+# ---------------------------------------------------------------------------
+# disk-pressure accounting (capacity, not latency)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DiskBudgetOptions:
+    """Thresholds + hysteresis for one store's capacity tracker.
+
+    See docs/operations.md "Disk-pressure runbook"."""
+
+    # byte budget for the store's data directory.  0 = derive capacity
+    # from statvfs at reconcile time (whole-filesystem accounting)
+    budget_bytes: int = 0
+    # pressure thresholds as fractions of the budget.  full_frac < 1.0
+    # is the RESERVED HEADROOM: admission stops at full_frac so that
+    # reclaim's own writes (snapshot temp dirs, journal-compaction tmp
+    # files) still fit under the hard budget — otherwise a full store
+    # could never compact its way back out (the classic deadlock)
+    near_full_frac: float = 0.80
+    full_frac: float = 0.92
+    # hysteresis (evaluation rounds): worsen fast — usage is monotonic
+    # between reclaims, not noisy — recover only once reclaim has
+    # PROVEN space back
+    worsen_after: int = 1
+    recover_after: int = 2
+    # rounds the raw level is pinned FULL after an observed ENOSPC,
+    # regardless of the usage estimate: the disk itself voted
+    enospc_latch_rounds: int = 2
+
+
+# Fed from EXECUTOR threads (the LogManager flush loop accounts append
+# bytes off-loop; snapshot commits run in the executor) as well as the
+# store's event loop — cross-thread like DiskLatencyProbe, so it
+# carries its own lock.
+class DiskBudget:
+    """Per-store disk usage estimate -> hysteretic {OK, NEAR_FULL,
+    FULL} pressure.
+
+    Hot-path fed like the HealthTracker (the PR 11 lesson: signals the
+    hot path already produces, measured where they happen): log-append
+    bytes, snapshot commit/prune deltas, journal-compaction reclaim —
+    plus a periodic ``reconcile()`` against real directory/statvfs
+    usage that re-bases the estimate (rmtree-style deletes and native
+    journal GC never report through the hot path)."""
+
+    def __init__(self, opts: DiskBudgetOptions | None = None,
+                 label: str = ""):
+        self.opts = opts or DiskBudgetOptions()
+        self.label = label
+        self._lock = threading.Lock()
+        self._base = 0             # reconciled usage      guarded-by: _lock
+        self._delta = 0            # hot-path bytes since  guarded-by: _lock
+        self._capacity = int(self.opts.budget_bytes)  # guarded-by: _lock
+        self._enospc_latch = 0     # rounds pinned FULL    guarded-by: _lock
+        self._hyst = _Hysteresis(self.opts.worsen_after,
+                                 self.opts.recover_after,
+                                 levels=_PRESSURE_LEVELS,
+                                 initial=PRESSURE_OK)  # guarded-by: _lock
+        # observability (all guarded-by: _lock)
+        self.evaluations = 0
+        self.reconciles = 0
+        self.enospc_events = 0
+        self.appended_bytes = 0
+        self.reclaimed_bytes = 0
+        self.full_rounds = 0
+        self.near_full_rounds = 0
+        self.resumes = 0           # FULL -> better transitions
+
+    # -- signal intake (hot paths, any thread) -------------------------------
+
+    def note_append(self, nbytes: int) -> None:
+        """Log bytes flushed to storage (LogManager flush loop)."""
+        with self._lock:
+            self._delta += nbytes
+            self.appended_bytes += nbytes
+
+    def note_snapshot(self, delta_bytes: int) -> None:
+        """Snapshot commit (+bytes) or prune/delete (-bytes)."""
+        with self._lock:
+            self._delta += delta_bytes
+            if delta_bytes < 0:
+                self.reclaimed_bytes += -delta_bytes
+
+    def note_reclaimed(self, nbytes: int) -> None:
+        """Bytes freed by log/journal compaction."""
+        with self._lock:
+            self._delta -= nbytes
+            self.reclaimed_bytes += nbytes
+
+    def note_enospc(self) -> None:
+        """The disk itself refused a write: pin raw FULL for the next
+        ``enospc_latch_rounds`` evaluations whatever the estimate says
+        — the estimate is wrong, the errno is not."""
+        with self._lock:
+            self.enospc_events += 1
+            self._enospc_latch = max(self._enospc_latch,
+                                     self.opts.enospc_latch_rounds)
+
+    def set_budget(self, budget_bytes: int) -> None:
+        """Operator resize: adopt a new explicit byte ceiling mid-run
+        (volume grown/shrunk under the store).  0 switches to the
+        reconcile-reported capacity (statvfs mode)."""
+        with self._lock:
+            self.opts.budget_bytes = int(budget_bytes)
+            if budget_bytes > 0:
+                self._capacity = int(budget_bytes)
+
+    def reconcile(self, used_bytes: int,
+                  capacity_bytes: int | None = None) -> None:
+        """Re-base the estimate on measured usage (directory walk or
+        statvfs, taken OFF the hot path by the store's health task)."""
+        with self._lock:
+            self._base = max(0, int(used_bytes))
+            self._delta = 0
+            if self.opts.budget_bytes <= 0 and capacity_bytes:
+                self._capacity = int(capacity_bytes)
+            self.reconciles += 1
+
+    # -- scoring -------------------------------------------------------------
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return max(0, self._base + self._delta)
+
+    def capacity_bytes(self) -> int:
+        with self._lock:
+            return self._capacity
+
+    def pressure(self) -> str:
+        """Current hysteretic pressure (no new evaluation round)."""
+        with self._lock:
+            return self._hyst.level
+
+    def evaluate(self) -> str:
+        """One pressure round (the store's health task cadence): fold
+        the usage estimate — or the ENOSPC latch — through the
+        thresholds and the hysteresis; records flight-recorder
+        ``disk_pressure`` events on transitions."""
+        from tpuraft.util.trace import RECORDER
+
+        with self._lock:
+            used = max(0, self._base + self._delta)
+            cap = self._capacity
+            if self._enospc_latch > 0:
+                self._enospc_latch -= 1
+                raw = PRESSURE_FULL
+            elif cap <= 0:
+                raw = PRESSURE_OK
+            elif used >= cap * self.opts.full_frac:
+                raw = PRESSURE_FULL
+            elif used >= cap * self.opts.near_full_frac:
+                raw = PRESSURE_NEAR_FULL
+            else:
+                raw = PRESSURE_OK
+            prev = self._hyst.level
+            level = self._hyst.fold(raw)
+            self.evaluations += 1
+            if level == PRESSURE_FULL:
+                self.full_rounds += 1
+            elif level == PRESSURE_NEAR_FULL:
+                self.near_full_rounds += 1
+            if prev == PRESSURE_FULL and level != PRESSURE_FULL:
+                self.resumes += 1
+        if level != prev:
+            RECORDER.record("disk_pressure", self.label,
+                            level=level, was=prev, used=used, capacity=cap)
+            if level == PRESSURE_FULL:
+                RECORDER.note_anomaly(
+                    "disk_full",
+                    f"{self.label or 'store'}: {used}/{cap} bytes "
+                    f"(+{self.enospc_events} enospc)")
+        return level
+
+    # -- observability -------------------------------------------------------
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "disk_pressure_level": _PRESSURE_LEVELS[self._hyst.level],
+                "disk_used_bytes": max(0, self._base + self._delta),
+                "disk_capacity_bytes": self._capacity,
+                "disk_enospc_events": self.enospc_events,
+                "disk_appended_bytes": self.appended_bytes,
+                "disk_reclaimed_bytes": self.reclaimed_bytes,
+                "disk_reconciles": self.reconciles,
+                "disk_full_rounds": self.full_rounds,
+                "disk_near_full_rounds": self.near_full_rounds,
+                "disk_pressure_resumes": self.resumes,
+            }
+
+    def register_gauges(self, metrics) -> None:
+        metrics.gauge("disk.pressure_level",
+                      lambda: float(_PRESSURE_LEVELS[self.pressure()]))
+        metrics.gauge("disk.used_bytes", lambda: float(self.used_bytes()))
+        metrics.gauge("disk.capacity_bytes",
+                      lambda: float(self.capacity_bytes()))
+        metrics.gauge("disk.enospc_events",
+                      lambda: float(self.enospc_events))
+
+    def describe(self) -> str:
+        with self._lock:
+            used = max(0, self._base + self._delta)
+            return (f"DiskBudget<{self._hyst.level} used={used} "
+                    f"cap={self._capacity} enospc={self.enospc_events} "
+                    f"appended={self.appended_bytes} "
+                    f"reclaimed={self.reclaimed_bytes} "
+                    f"reconciles={self.reconciles} resumes={self.resumes}>")
