@@ -48,6 +48,16 @@ Rows:
                         guard-OFF comparator — the hot-path cost of
                         the pressure plane is a couple of integer adds
                         and one dict lookup, and this row keeps it so.
+  kv_ops_clocked      — injected-clock gate (ISSUE 18): the default
+                        rows run on the zero-indirection SYSTEM clock
+                        (module-level staticmethods bound to the C
+                        time functions), and this row re-runs the kv
+                        shape with --chaos-clock (a per-store
+                        ChaosClock at rate 1.0 — the full virtual-
+                        clock arithmetic with no behavior change),
+                        which must stay within
+                        BENCH_GATE_CLOCK_THRESHOLD (default 2%) of
+                        the same-session uninjected measurement.
 
 The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
@@ -106,6 +116,7 @@ def _run_kv_once(extra: dict, duration: float,
                  trace_sample: float = 0.0,
                  heat_off: bool = False,
                  disk_guard_off: bool = False,
+                 chaos_clock: bool = False,
                  workers: int = 0) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
@@ -114,7 +125,9 @@ def _run_kv_once(extra: dict, duration: float,
     rate (the tracing-overhead row); ``heat_off`` disables per-region
     heat tracking (the heat-overhead row's A/B comparator);
     ``disk_guard_off`` disables the disk budget / pressure plane (the
-    disk-guard-overhead row's A/B comparator)."""
+    disk-guard-overhead row's A/B comparator); ``chaos_clock`` routes
+    every store's timing reads through an injected ChaosClock at rate
+    1.0 (the clock-overhead row's A/B comparator)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -139,6 +152,9 @@ def _run_kv_once(extra: dict, duration: float,
     if disk_guard_off:
         cmd.append("--no-disk-guard")
         key += "_nodg"
+    if chaos_clock:
+        cmd.append("--chaos-clock")
+        key += "_ck"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -361,6 +377,24 @@ def main() -> int:
                                "verdict": "BROKEN", "error": str(exc)}
             worst = max(worst, rc)
             reports.append(drep)
+            # injected-clock-overhead row (ISSUE 18): the kv row above
+            # runs on the zero-indirection SYSTEM clock; this row runs
+            # the SAME shape through a per-store ChaosClock at rate
+            # 1.0 (full virtual-clock arithmetic, no behavior change)
+            # and must stay within 2% of the same-session uninjected
+            # measurement — the clock fabric can never grow a lock or
+            # a syscall per read without tripping CI.
+            clock_threshold = float(os.environ.get(
+                "BENCH_GATE_CLOCK_THRESHOLD", "0.02"))
+            rc, crep = _gate(
+                "kv_ops_clocked",
+                float(rep["measured"]),
+                lambda: _run_kv_once(kv_extra, duration,
+                                     chaos_clock=True),
+                clock_threshold, retries)
+            worst = max(worst, rc)
+            crep["uninjected"] = rep["measured"]
+            reports.append(crep)
     if "gate_read_ops_per_sec" not in kv_extra:
         # the amortized read plane (ISSUE 10) needs its own regression
         # row — a silent pass without a calibration would defeat it

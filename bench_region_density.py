@@ -119,6 +119,14 @@ async def run_config(args) -> dict:
             append_batching=not args.no_write_batch,
             ack_at_commit=not args.no_write_batch,
         )
+        if args.chaos_clock:
+            # --chaos-clock: the bench-gate clock-overhead row's A/B
+            # knob — every timing read pays the injected-clock
+            # indirection (ChaosClock at rate 1.0 == real time), so
+            # the row isolates the virtual-clock cost from any fault
+            from tpuraft.util.clock import ChaosClock
+
+            opts.clock = ChaosClock(seed=i)
         if args.lease_reads:
             from tpuraft.options import ReadOnlyOption
 
@@ -540,6 +548,11 @@ def main() -> None:
     ap.add_argument("--no-heat", action="store_true",
                     help="disable per-region heat tracking (the "
                          "bench-gate heat-overhead row's A/B knob)")
+    ap.add_argument("--chaos-clock", action="store_true",
+                    help="install a per-store injected ChaosClock at "
+                         "rate 1.0 (real time through the virtual-"
+                         "clock indirection) — the bench-gate clock-"
+                         "overhead row's A/B knob")
     ap.add_argument("--no-disk-guard", action="store_true",
                     help="disable the disk budget / pressure plane "
                          "(the bench-gate disk-guard-overhead row's "
@@ -594,6 +607,8 @@ def main() -> None:
         cmd.append("--no-heat")
     if args.no_disk_guard:
         cmd.append("--no-disk-guard")
+    if args.chaos_clock:
+        cmd.append("--chaos-clock")
     if args.no_write_batch:
         cmd.append("--no-write-batch")
     if args.profile_ticks > 0:
@@ -637,6 +652,8 @@ def main() -> None:
         key += "_noheat"
     if args.no_disk_guard:
         key += "_nodg"
+    if args.chaos_clock:
+        key += "_ck"
     if args.no_write_batch:
         key += "_nowb"
     out[key] = row

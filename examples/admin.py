@@ -32,6 +32,13 @@ Commands:
                               from the same metrics plane (default:
                               every peer; docs/operations.md
                               "Disk-pressure runbook")
+    clocks [endpoint]         clock-discipline dashboard: per-store
+                              sentinel verdict (OK / SUSPECT), worst
+                              estimated peer skew, fenced-lease count
+                              and the per-peer skew estimates the beat
+                              probes produced (default: every peer;
+                              docs/operations.md "Clock discipline
+                              runbook")
 
 PD (fleet) commands take --pd instead of --group/--peers:
     cluster [K]               print the PD leader's ClusterView: top-K
@@ -137,6 +144,27 @@ def _print_storage_row(ep: str, vals: dict) -> None:
           f"reconciles={int(v('disk_reconciles'))}  bytes: "
           f"appended={int(v('disk_appended_bytes'))} "
           f"reclaimed={int(v('disk_reclaimed_bytes'))}")
+
+
+_PEER_SKEW_PREFIX = "tpuraft_clock_peer_skew_s_"
+
+
+def _print_clock_row(ep: str, vals: dict) -> None:
+    if "tpuraft_clock_suspect" not in vals:
+        print(f"  store {ep:<22} no clock sentinel "
+              f"(pre-time-chaos build)")
+        return
+    suspect = vals["tpuraft_clock_suspect"] > 0
+    verdict = "SUSPECT" if suspect else "OK"
+    print(f"  store {ep:<22} {verdict:<8} "
+          f"max|skew|={vals.get('tpuraft_clock_max_abs_skew_s', 0.0):.3f}s "
+          f"leases_fenced={int(vals.get('tpuraft_clock_lease_fenced', 0))}")
+    # per-peer estimates (gauge names carry the sanitized peer
+    # endpoint: tpuraft_clock_peer_skew_s_127_0_0_1_6301)
+    for name, v in sorted(vals.items()):
+        if name.startswith(_PEER_SKEW_PREFIX):
+            peer = name[len(_PEER_SKEW_PREFIX):]
+            print(f"    peer {peer:<24} skew={v:+.3f}s")
 
 
 async def _run_pd(args) -> int:
@@ -253,6 +281,29 @@ async def run(args) -> int:
                 print("error: no store answered describe_metrics",
                       file=sys.stderr)
                 rc = 1
+        elif cmd == "clocks":
+            # clock-discipline dashboard: like `storage`, every
+            # reachable store renders — the operator question is
+            # "whose clock is off and by how much", answered by each
+            # store's OWN sentinel estimate of its peers
+            targets = ([args.command[1]] if len(args.command) > 1
+                       else [p.endpoint for p in conf.list_all()])
+            answered = 0
+            print(f"clock discipline ({len(targets)} store(s)):")
+            for ep in targets:
+                ep = ":".join(ep.split("/", 1)[0].split(":")[:2])
+                try:
+                    text = await cli.describe_metrics(ep)
+                except RpcError as e:
+                    print(f"  store {ep:<22} unreachable "
+                          f"({e.status.raft_error.name})")
+                    continue
+                answered += 1
+                _print_clock_row(ep, _prom_values(text))
+            if not answered:
+                print("error: no store answered describe_metrics",
+                      file=sys.stderr)
+                rc = 1
         elif cmd in ("snapshot", "transfer", "add-peer", "remove-peer",
                      "add-witness", "remove-witness"):
             if len(args.command) < 2:
@@ -329,7 +380,8 @@ def main() -> None:
                          " | change-peers <p1,p2,...>"
                          " | add-learners <p1,...> | remove-learners <p1,...>"
                          " | reset-learners <p1,...> | metrics [endpoint]"
-                         " | storage [endpoint] | cluster [K] | pd-metrics")
+                         " | storage [endpoint] | clocks [endpoint]"
+                         " | cluster [K] | pd-metrics")
     sys.exit(asyncio.run(run(ap.parse_args())))
 
 

@@ -120,6 +120,11 @@ class _BaseSoakCluster:
         # included) — how scenario modes (--disk-pressure) retune
         # budgets/cadences without forking the option plumbing
         self.store_extra: dict = {}
+        # --clock-chaos: endpoint -> injected ChaosClock.  Owned by the
+        # CLUSTER, not the store: a killed store restarts on the SAME
+        # skewed timebase (real machines do not reset their oscillator
+        # on process restart)
+        self.clocks: dict[str, object] = {}
         # counters of RETIRED engines: a killed/restarted store gets a
         # fresh StoreEngine, and summing only live engines would erase
         # e.g. every gray evacuation a later leader-kill happened to
@@ -146,6 +151,16 @@ class _BaseSoakCluster:
                 + store.health.evaluations
             rc["sick_rounds"] = rc.get("sick_rounds", 0) \
                 + store.health.level_counts["sick"]
+        sentinel = getattr(store, "clock_sentinel", None)
+        if sentinel is not None:
+            # clock-plane counters (anomalies, fenced leases) must
+            # survive kill/restart in the run record too
+            for k, v in sentinel.counters().items():
+                rc[k] = rc.get(k, 0) + v
+        for re_ in store._regions.values():
+            if re_.node is not None:
+                rc["lease_fallbacks"] = rc.get("lease_fallbacks", 0) \
+                    + re_.node.read_only_service.lease_fallbacks
         if store.disk_budget is not None:
             # disk-pressure ladder counters must survive kill/restart
             # in the run record, same as evacuations above
@@ -161,6 +176,8 @@ class _BaseSoakCluster:
     def _store_opts(self, ep: str, election_timeout_ms: int,
                     **extra) -> StoreEngineOptions:
         extra = {**self.store_extra, **extra}
+        if ep in self.clocks:
+            extra.setdefault("clock", self.clocks[ep])
         opts = StoreEngineOptions(
             server_id=ep,
             initial_regions=[r.copy() for r in self.regions],
@@ -688,6 +705,7 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                    gray: bool = False,
                    write_burst: bool = False,
                    disk_pressure: bool = False,
+                   clock_chaos: bool = False,
                    trace: str = "") -> dict:
     rng = random.Random(seed)
     if geo and transport != "inproc":
@@ -740,6 +758,13 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             "no --engine (the native multilog's quota mirror is "
             "exercised by tests/test_storage_fault.py via "
             "NativeJournalTracker.attach_quota)")
+    if clock_chaos and (transport != "inproc" or engine):
+        raise ValueError(
+            "--clock-chaos installs per-store injected ChaosClocks "
+            "through StoreEngineOptions.clock, which drives timer-mode "
+            "nodes: in-proc fabric, no --engine (the engine's device "
+            "TickClock takes its own TickOptions.clock — wire it "
+            "explicitly for an engine-mode clock soak)")
     if transport == "native":
         if n_regions > 1 or engine:
             raise ValueError("region-density soak runs on the in-proc "
@@ -751,6 +776,17 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
                         election_timeout_ms=election_timeout_ms,
                         quiesce_after_rounds=4 if quiesce else 0,
                         geo_zones=geo, witness=witness, geo_seed=seed)
+    if clock_chaos:
+        from tpuraft.util.clock import ChaosClock
+
+        # every store gets its OWN seeded virtual clock, installed for
+        # the whole drive (restarts keep it — see _BaseSoakCluster);
+        # every store also pads its leases for a declared 5% worst-case
+        # drift, the bound the nemesis menu deliberately exceeds so the
+        # sentinel fence / SAFE fallback paths must carry safety
+        for i, ep in enumerate(c.endpoints):
+            c.clocks[ep] = ChaosClock(seed=seed * 1000 + i)
+        c.store_extra.setdefault("clock_drift_bound", 0.05)
     chaos = {}
     try:
         if power_loss or gray or disk_pressure:
@@ -790,7 +826,8 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             lease_reads, n_regions, rng, c, chaos, churn, quiesce,
             kv_batching, geo, witness, read_mix, read_from,
             gray=gray, power_loss=power_loss, write_burst=write_burst,
-            disk_pressure=disk_pressure, trace=trace)
+            disk_pressure=disk_pressure, clock_chaos=clock_chaos,
+            trace=trace)
     finally:
         # uninstall on EVERY exit path, startup failures included: a
         # leaked install leaves builtins.open/os.fsync patched process-
@@ -805,7 +842,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           kv_batching=False, geo=0, witness=False,
                           read_mix=0.0, read_from="leader", gray=False,
                           power_loss=False, write_burst=False,
-                          disk_pressure=False, trace="") -> dict:
+                          disk_pressure=False, clock_chaos=False,
+                          trace="") -> dict:
     if trace:
         # sampled product tracing through the whole drive; exported as
         # perfetto-loadable JSON next to the result
@@ -1280,6 +1318,49 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             if cd is not None:
                 cd.set_enospc_burst(0.0)
 
+    # -- time-chaos fault surface (--clock-chaos): per-store injected
+    # clocks drift/jump/freeze — composed with the leader kills and
+    # partitions above — while lease reads keep flowing.  Safety must
+    # come from the drift-bound lease shrink and the sentinel fence
+    # (SAFE fallbacks), never from the clocks behaving. ------------------------
+    clock_frozen: list[object] = []
+
+    async def clock_chaos_step():
+        """One seeded fault (drift / forward jump / freeze) on a random
+        store's clock; a frozen clock unfreezes on the next hit."""
+        clocks = list(getattr(c, "clocks", {}).items())
+        if not clocks:
+            raise SkipFault
+        ep, ck = rng.choice(clocks)
+        what = ck.chaos_step()
+        say(f"  nemesis: clock {what} on {ep}")
+        if ck.frozen:
+            clock_frozen.append(ck)
+
+    async def clock_leader_fast():
+        """The classic lease hazard, aimed: the LEADER's clock runs 25%
+        fast — past the declared 5% bound — so its unshrunk lease would
+        outlive what followers granted in real time.  The shrunk window
+        plus the sentinel fence must keep every lease read honest."""
+        ep = c.leader_endpoint(rng.choice(sampled_regions))
+        ck = getattr(c, "clocks", {}).get(ep)
+        if ck is None:
+            raise SkipFault
+        say(f"  nemesis: clock leader-fast x1.25 on {ep}")
+        if ck.frozen:
+            ck.unfreeze()
+        ck.set_rate(1.25)
+
+    async def clock_unfreeze():
+        # heal only LIVENESS faults: frozen clocks park election/beat
+        # timers, so they thaw after the dwell — but accumulated drift
+        # and jumps PERSIST across faults (real skew does not heal
+        # itself), which is the regime the drift bound must survive
+        while clock_frozen:
+            ck = clock_frozen.pop()
+            if ck.frozen:
+                ck.unfreeze()
+
     if churn:
         churn_driver = MembershipChurn(c, sampled_regions[0], rng, say)
 
@@ -1327,6 +1408,17 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                           check=with_conf_check(None)),
             NemesisAction("disk-enospc-burst", disk_enospc_burst,
                           disk_burst_heal, dwell_s=2.5, weight=1.0,
+                          check=with_conf_check(None)),
+        ]
+    if clock_chaos:
+        # high weight: clock faults should land MORE often than any
+        # single network/kill fault so skew states overlap with them
+        actions += [
+            NemesisAction("clock-chaos", clock_chaos_step,
+                          clock_unfreeze, dwell_s=1.2, weight=2.0,
+                          check=with_conf_check(None)),
+            NemesisAction("clock-leader-fast", clock_leader_fast,
+                          clock_unfreeze, dwell_s=1.5, weight=1.5,
                           check=with_conf_check(None)),
         ]
     if churn_driver is not None:
@@ -1517,6 +1609,44 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
             result["disk_pressure_ok"] = (
                 (reclaims > 0 and sheds > 0 and resumes > 0)
                 or duration_s < 120)
+        if clock_chaos:
+            # clock plane: what the nemesis injected vs what the stores
+            # detected (sentinel) and refused to serve on (fenced
+            # leases + SAFE fallbacks) — live stores plus everything
+            # retired by kill/restart (the gray retired-counter lesson)
+            rc = c.retired_counters
+            clock_inj: dict[str, int] = {}
+            for ck in getattr(c, "clocks", {}).values():
+                for k, v in ck.faults.items():
+                    clock_inj[k] = clock_inj.get(k, 0) + v
+            sent = {k: rc.get(k, 0)
+                    for k in ("clock_skew_samples", "clock_anomalies",
+                              "clock_lease_fenced")}
+            for s in c.stores.values():
+                for k, v in s.clock_sentinel.counters().items():
+                    if k in sent:
+                        sent[k] += v
+            fallbacks = rc.get("lease_fallbacks", 0)
+            for s in c.stores.values():
+                for re_ in s._regions.values():
+                    if re_.node is not None:
+                        fallbacks += \
+                            re_.node.read_only_service.lease_fallbacks
+            result["clock"] = {
+                "injections": clock_inj,
+                **sent,
+                "lease_fallbacks": fallbacks,
+                "peer_skews": {ep: s.clock_sentinel.peers()
+                               for ep, s in sorted(c.stores.items())},
+            }
+            # acceptance gate: with every clock broken on purpose past
+            # the declared bound, at least one lease check must have
+            # refused the fast path (sentinel fence) or fallen back to
+            # a SAFE quorum round — a long drive where every lease
+            # check still passed means the hardening never engaged
+            result["clock_detection_ok"] = (
+                sent["clock_lease_fenced"] + fallbacks > 0
+                or duration_s < 120)
         if churn_driver is not None:
             result["membership"] = churn_driver.summary()
         # beat-plane + quiescence counters (HeartbeatHub.counters() via
@@ -1573,7 +1703,8 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
         # can't churn the incident context away.
         if not result["linearizable"] \
                 or not result.get("gray_detection_ok", True) \
-                or not result.get("disk_pressure_ok", True):
+                or not result.get("disk_pressure_ok", True) \
+                or not result.get("clock_detection_ok", True):
             from tpuraft.util.trace import RECORDER
 
             RECORDER.note_anomaly(
@@ -1582,7 +1713,9 @@ async def _run_soak_inner(duration_s, n_keys, verbose, transport,
                 if not result["linearizable"]
                 else ("gray detection never fired"
                       if not result.get("gray_detection_ok", True)
-                      else "disk-pressure ladder never completed"))
+                      else ("disk-pressure ladder never completed"
+                            if not result.get("disk_pressure_ok", True)
+                            else "clock hardening never engaged")))
             result["flight_recorder"] = RECORDER.dump(256)
             result["recorder_anomalies"] = [
                 {"ts": a["ts"], "reason": a["reason"],
@@ -1844,6 +1977,18 @@ def main() -> None:
                          "retryably at FULL (reads keep serving), and "
                          "resume after reclaim without a restart "
                          "(in-proc fabric, no --engine)")
+    ap.add_argument("--clock-chaos", action="store_true",
+                    help="time-chaos nemesis menu: every store runs on "
+                         "its own injected ChaosClock (survives "
+                         "restarts) with seeded drift / forward-jump / "
+                         "freeze faults plus a targeted leader-fast "
+                         "fault, composed with leader kills and "
+                         "partitions; stores declare a 5%% drift bound "
+                         "and the run fails unless the shrunk lease "
+                         "window / clock sentinel forced at least one "
+                         "clock-independent serve (in-proc fabric, no "
+                         "--engine); combine with --lease-reads "
+                         "--read-mix for the stale-read oracle")
     ap.add_argument("--kv-batching", action="store_true",
                     help="drive load through the batching client: ops "
                          "coalesce into store-grouped kv_command_batch "
@@ -1911,13 +2056,15 @@ def main() -> None:
                                   gray=args.gray,
                                   write_burst=args.write_burst,
                                   disk_pressure=args.disk_pressure,
+                                  clock_chaos=args.clock_chaos,
                                   trace=args.trace))
     import json
 
     print(json.dumps(result))
     ok = result["linearizable"] \
         and result.get("gray_detection_ok", True) \
-        and result.get("disk_pressure_ok", True)
+        and result.get("disk_pressure_ok", True) \
+        and result.get("clock_detection_ok", True)
     raise SystemExit(0 if ok else 1)
 
 

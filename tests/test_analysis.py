@@ -804,3 +804,67 @@ class TestTreeGate:
             for w in m.waivers:
                 assert w.reason, f"{m.rel}:{w.line}: allow({w.rule}) " \
                     f"without justification"
+
+
+# ---- 4. raw-clock (ISSUE 18: injectable time plane) -------------------------
+
+
+class TestRawClock:
+    """Direct real-clock reads inside the clock-disciplined tree are
+    findings; waivers (including multi-line comment blocks) and
+    perf_counter are exempt; out-of-scope files are never flagged."""
+
+    _SRC = '''import time
+
+
+class Consumer:
+    def bad_monotonic(self):
+        return time.monotonic()          # finding
+
+    def bad_wall(self):
+        return time.time()               # finding
+
+    def bad_loop(self, loop):
+        return loop.time()               # finding
+
+    def fine_perf(self):
+        return time.perf_counter()       # exempt: trace-only timing
+
+    def waived_inline(self):
+        # graftcheck: allow(raw-clock) — fixture: real-time by design
+        return time.monotonic()
+
+    def waived_block(self):
+        # graftcheck: allow(raw-clock) — fixture: a wrapped multi-line
+        # justification whose marker sits on the FIRST comment line
+        return time.monotonic()
+'''
+
+    def _check(self, rel):
+        from tpuraft.analysis import raw_clock
+        from tpuraft.analysis.core import Module
+
+        mod = Module("/dev/null", rel, self._SRC)
+        return raw_clock.check([mod])
+
+    def test_in_scope_raw_reads_are_findings(self):
+        found = self._check("tpuraft/core/fixture_probe.py")
+        msgs = [(f.rule, f.message) for f in found]
+        assert len(found) == 3, msgs
+        assert all(f.rule == "raw-clock" for f in found)
+        assert any("time.monotonic" in f.message for f in found)
+        assert any("time.time" in f.message for f in found)
+        assert any("loop.time" in f.message for f in found)
+
+    def test_rheakv_and_health_are_in_scope(self):
+        assert self._check("tpuraft/rheakv/fixture_probe.py")
+        assert self._check("tpuraft/util/health.py")
+
+    def test_out_of_scope_is_clean(self):
+        assert self._check("tpuraft/util/trace.py") == []
+        assert self._check("examples/soak.py") == []
+
+    def test_tree_baseline_is_zero(self):
+        mods, _ = load_modules([os.path.join(REPO, "tpuraft")])
+        found = [f for f in run_checkers(mods, rules={"raw-clock"})]
+        assert found == [], [str(f) for f in found]

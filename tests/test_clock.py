@@ -1,0 +1,497 @@
+"""Time-chaos plane (ISSUE 18): ChaosClock algebra, the peer-skew
+sentinel, timers under injected clocks, and the lease boundary / drift
+bound regressions the plane exists to catch."""
+
+import asyncio
+
+import pytest
+
+from tpuraft.util.clock import SYSTEM, ChaosClock, ClockSentinel, resolve
+
+
+class FakeClock:
+    """Hand-cranked base clock for deterministic algebra tests."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+        self.w = 1_000_000.0 + t
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def wall(self) -> float:
+        return self.w + self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- ChaosClock algebra -------------------------------------------------------
+
+
+def test_resolve_defaults_to_system():
+    assert resolve(None) is SYSTEM
+    fake = FakeClock()
+    assert resolve(fake) is fake
+
+
+def test_chaos_clock_tracks_base_at_rate_one():
+    base = FakeClock(10.0)
+    c = ChaosClock(base=base)
+    assert c.monotonic() == pytest.approx(10.0)
+    base.advance(2.5)
+    assert c.monotonic() == pytest.approx(12.5)
+
+
+def test_chaos_clock_rate_drift_piecewise():
+    base = FakeClock()
+    c = ChaosClock(base=base)
+    base.advance(10.0)            # 10 virtual s at rate 1
+    c.set_rate(1.1)
+    base.advance(10.0)            # 11 virtual s at rate 1.1
+    assert c.monotonic() == pytest.approx(21.0)
+    c.set_rate(0.5)
+    base.advance(4.0)             # 2 virtual s at rate 0.5
+    assert c.monotonic() == pytest.approx(23.0)
+    assert c.faults["drift"] == 2
+
+
+def test_chaos_clock_jump_is_forward_only():
+    base = FakeClock()
+    c = ChaosClock(base=base)
+    base.advance(1.0)
+    c.jump(5.0)
+    assert c.monotonic() == pytest.approx(6.0)
+    with pytest.raises(ValueError):
+        c.jump(-0.1)
+    with pytest.raises(ValueError):
+        c.set_rate(-1.0)
+
+
+def test_chaos_clock_freeze_unfreeze_restores_prior_rate():
+    base = FakeClock()
+    c = ChaosClock(base=base)
+    c.set_rate(1.25)
+    base.advance(4.0)             # 5 virtual s
+    c.freeze()
+    assert c.frozen
+    base.advance(100.0)           # frozen: no virtual progress
+    assert c.monotonic() == pytest.approx(5.0)
+    c.unfreeze()
+    assert c.rate == pytest.approx(1.25)   # freeze remembers the drift
+    base.advance(4.0)
+    assert c.monotonic() == pytest.approx(10.0)
+
+
+def test_chaos_clock_heal_keeps_accumulated_offset():
+    base = FakeClock()
+    c = ChaosClock(base=base)
+    c.jump(30.0)
+    c.set_rate(2.0)
+    base.advance(5.0)
+    c.heal()
+    assert c.rate == 1.0
+    # healed forward-skewed clock NEVER steps backwards
+    before = c.monotonic()
+    base.advance(1.0)
+    assert c.monotonic() == pytest.approx(before + 1.0)
+    assert c.monotonic() > 40.0
+
+
+def test_chaos_clock_never_runs_backwards_through_chaos_steps():
+    base = FakeClock()
+    c = ChaosClock(seed=7, base=base)
+    last = c.monotonic()
+    for _ in range(200):
+        c.chaos_step()
+        base.advance(0.05)
+        now = c.monotonic()
+        assert now >= last
+        last = now
+
+
+def test_chaos_step_is_seeded_deterministic():
+    a = ChaosClock(seed=42, base=FakeClock())
+    b = ChaosClock(seed=42, base=FakeClock())
+    assert [a.chaos_step() for _ in range(20)] \
+        == [b.chaos_step() for _ in range(20)]
+
+
+def test_chaos_clock_wall_mirrors_monotonic_displacement():
+    base = FakeClock()
+    c = ChaosClock(base=base)
+    w0 = c.wall()
+    c.jump(10.0)
+    assert c.wall() - w0 == pytest.approx(10.0)
+
+
+# -- ClockSentinel ------------------------------------------------------------
+
+
+def _feed(sent, peer, local_t, peer_t, rtt=0.002):
+    """One beat-ack probe with a tiny symmetric RTT."""
+    sent.observe(peer, peer_t, local_t - rtt / 2, local_t + rtt / 2)
+
+
+def test_sentinel_estimates_peer_rate_and_skew():
+    clk = FakeClock()
+    s = ClockSentinel(drift_bound=0.05, clock=clk, label="s1")
+    # peer clock runs exactly with ours, offset +3s
+    for i in range(8):
+        t = i * 1.0
+        _feed(s, "p1", t, t + 3.0)
+    assert s.rate_of("p1") == pytest.approx(1.0, abs=1e-6)
+    assert s.skew_of("p1") == pytest.approx(3.0, abs=1e-3)
+    assert not s.suspect()
+
+
+def test_sentinel_minority_fast_peer_does_not_fence_local():
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="s1")
+    for i in range(10):
+        t = i * 1.0
+        _feed(s, "fast", t, t * 1.5)      # one broken peer, 50% fast
+        _feed(s, "ok1", t, t)
+        _feed(s, "ok2", t, t)
+    # the MEDIAN peer ratio is ~1.0: the local clock is fine
+    assert not s.suspect()
+    assert s.lease_check()
+    assert s.lease_fenced == 0
+
+
+def test_sentinel_median_deviation_means_local_clock_suspect():
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="s1")
+    # EVERY peer appears ~0.8x slow == the LOCAL clock is ~25% fast
+    for i in range(10):
+        t = i * 1.0
+        for p in ("a", "b", "c"):
+            _feed(s, p, t, t * 0.8)
+    assert s.suspect()
+    assert not s.lease_check()
+    assert s.lease_fenced == 1
+    assert s.counters()["clock_anomalies"] == 1
+    assert s.counters()["clock_suspect"] == 1
+
+
+def test_sentinel_recovers_when_estimates_reconverge():
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="s1")
+    t = 0.0
+    peer = 0.0
+    for _ in range(10):                    # local 25% fast
+        t += 1.0
+        peer += 0.8
+        for p in ("a", "b", "c"):
+            _feed(s, p, t, peer)
+    assert s.suspect()
+    for _ in range(60):                    # healed: rates re-converge
+        t += 1.0
+        peer += 1.0
+        for p in ("a", "b", "c"):
+            _feed(s, p, t, peer)
+    assert not s.suspect()
+    assert s.lease_check()
+
+
+def test_sentinel_detects_frozen_local_clock():
+    """A frozen local clock yields near-zero local deltas while peers
+    advance: rate math breaks down, but the signature must still read
+    as an extreme ratio (the one fault division cannot see)."""
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="s1")
+    for i in range(6):                     # healthy warm-up
+        t = i * 1.0
+        for p in ("a", "b", "c"):
+            _feed(s, p, t, t)
+    # local clock freezes at t=5; peers keep advancing seconds apart
+    for j in range(1, 8):
+        for p in ("a", "b", "c"):
+            _feed(s, p, 5.0 + j * 1e-4, 5.0 + j * 1.0)
+    assert s.suspect()
+    assert not s.lease_check()
+
+
+def test_sentinel_detection_only_without_drift_bound():
+    """drift_bound=0 deployments observe (gauges, skew estimates) but
+    NEVER fence — exact legacy lease behavior."""
+    s = ClockSentinel(drift_bound=0.0, clock=FakeClock(), label="s1")
+    for i in range(10):
+        t = i * 1.0
+        for p in ("a", "b", "c"):
+            _feed(s, p, t, t * 0.5)
+    assert not s.suspect()
+    assert s.lease_check()
+    assert s.samples > 0
+
+
+def test_sentinel_ignores_pre_clock_peers_and_forgets():
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="s1")
+    _feed(s, "old", 1.0, 0.0)      # clock_ms=0 decodes as 0.0 reading
+    assert s.samples == 0
+    _feed(s, "p", 1.0, 1.0)
+    _feed(s, "p", 2.0, 2.0)
+    assert s.rate_of("p") is not None
+    s.forget("p")
+    assert s.rate_of("p") is None
+    assert s.skew_of("p") is None
+
+
+def test_sentinel_gauges_and_describe():
+    from tpuraft.util.metrics import MetricRegistry
+
+    s = ClockSentinel(drift_bound=0.05, clock=FakeClock(), label="st")
+    m = MetricRegistry()
+    s.register_gauges(m)
+    for i in range(6):
+        t = i * 1.0
+        _feed(s, "p", t, t + 2.0)
+    g = m.snapshot()["gauges"]
+    assert g["clock.suspect"] == 0.0
+    assert g["clock.max_abs_skew_s"] == pytest.approx(2.0, abs=1e-2)
+    assert "ClockSentinel<st" in s.describe()
+    snap = s.snapshot()
+    assert snap["peers"]["p"]["skew_s"] == pytest.approx(2.0, abs=1e-2)
+
+
+# -- RepeatedTimer under injected clocks -------------------------------------
+
+
+async def test_timer_fires_early_under_fast_clock():
+    from tpuraft.util.timer import RepeatedTimer
+
+    base = SYSTEM
+    chaos = ChaosClock(base=base)
+    chaos.set_rate(10.0)            # 10x fast: 1.5s timeout ~ 0.15s real
+    fired = asyncio.Event()
+
+    async def trig():
+        fired.set()
+
+    t = RepeatedTimer("t", 1500, trig, clock=chaos)
+    t.start()
+    try:
+        await asyncio.wait_for(fired.wait(), timeout=1.0)
+    finally:
+        await t.destroy()
+
+
+async def test_timer_parks_under_frozen_clock():
+    from tpuraft.util.timer import RepeatedTimer
+
+    chaos = ChaosClock()
+    chaos.freeze()
+    fired = asyncio.Event()
+
+    async def trig():
+        fired.set()
+
+    t = RepeatedTimer("t", 50, trig, clock=chaos)
+    t.start()
+    await asyncio.sleep(0.3)        # frozen: 50ms deadline never arrives
+    assert not fired.is_set()
+    chaos.unfreeze()
+    try:
+        await asyncio.wait_for(fired.wait(), timeout=1.0)
+    finally:
+        await t.destroy()
+
+
+async def test_timer_jump_fires_immediately():
+    from tpuraft.util.timer import RepeatedTimer
+
+    chaos = ChaosClock()
+    fired = asyncio.Event()
+
+    async def trig():
+        fired.set()
+
+    t = RepeatedTimer("t", 3_000, trig, clock=chaos)
+    t.start()
+    await asyncio.sleep(0.1)
+    assert not fired.is_set()
+    chaos.jump(10.0)                # deadline is long past now
+    try:
+        await asyncio.wait_for(fired.wait(), timeout=1.0)
+    finally:
+        await t.destroy()
+
+
+# -- lease boundaries / drift-bound hardening --------------------------------
+
+
+def _fake_timer_node(eto_ms=1000, ratio=0.9, rho=0.0, sentinel=None,
+                     clock=None):
+    """Minimal node double for TimerControl lease math."""
+    from types import SimpleNamespace
+
+    from tpuraft.conf import Configuration
+    from tpuraft.core.node import TimerControl
+    from tpuraft.entity import PeerId
+    from tpuraft.options import NodeOptions
+
+    opts = NodeOptions(election_timeout_ms=eto_ms)
+    opts.raft_options.leader_lease_time_ratio = ratio
+    opts.raft_options.clock_drift_bound = rho
+    opts.clock = clock
+    opts.clock_sentinel = sentinel
+    conf = Configuration.parse("127.0.0.1:1,127.0.0.2:2,127.0.0.3:3")
+    node = SimpleNamespace(
+        options=opts,
+        server_id=PeerId.parse("127.0.0.1:1"),
+        conf_entry=SimpleNamespace(conf=conf,
+                                   old_conf=Configuration()),
+        list_peers=lambda: list(conf.peers),
+        _handle_election_timeout=None,
+        _handle_vote_timeout=None,
+        _check_dead_nodes=None,
+    )
+    return node, TimerControl(node)
+
+
+def test_lease_expires_exactly_at_deadline():
+    """Boundary: quorum ack age == lease window must read INVALID (the
+    comparison is strict <) — at the edge there is zero margin left, so
+    serving there is serving on margin that does not exist."""
+    clk = FakeClock(100.0)
+    node, ctrl = _fake_timer_node(eto_ms=1000, ratio=0.9, clock=clk)
+    peers = node.list_peers()
+    # quorum (2 of 3, self included): one peer acked at t=100
+    ctrl.record_ack(peers[1], 100.0)
+    clk.advance(0.8999)
+    assert ctrl.lease_valid()
+    clk.t = 100.9               # age == 0.9 == eto * ratio exactly
+    assert not ctrl.lease_valid()
+
+
+def test_drift_bound_shrinks_leader_lease_window():
+    clk = FakeClock(0.0)
+    node, ctrl = _fake_timer_node(eto_ms=1000, ratio=0.9, rho=0.1,
+                                  clock=clk)
+    peers = node.list_peers()
+    ctrl.record_ack(peers[1], 0.0)
+    clk.t = 0.85                # inside 0.9 but OUTSIDE 0.9 * (1-0.1)
+    assert not ctrl.lease_valid()
+    clk.t = 0.80
+    assert ctrl.lease_valid()
+
+
+def test_frozen_clock_leader_serves_forever_without_drift_bound():
+    """REGRESSION (the bug the chaos plane flushed out): a leader whose
+    clock freezes right after a quorum ack sees quorum_ack_age_s pinned
+    at ~0 forever — without the sentinel it would serve lease reads
+    past any real expiry.  With the drift bound + sentinel the fence
+    closes the hole."""
+    base = FakeClock(0.0)
+    chaos = ChaosClock(base=base)
+    node, ctrl = _fake_timer_node(eto_ms=1000, ratio=0.9, clock=chaos)
+    peers = node.list_peers()
+    ctrl.record_ack(peers[1], chaos.monotonic())
+    chaos.freeze()
+    base.advance(3600.0)        # an hour of real time
+    # unfenced: the frozen clock says the ack is still fresh — this IS
+    # the unsafe serve the regression pins down
+    assert ctrl.lease_valid()
+    # the hardened config routes the same check through the sentinel
+    sent = ClockSentinel(drift_bound=0.05, clock=chaos, label="s")
+    sent._suspect = True        # the frozen-local signature flipped it
+    node2, ctrl2 = _fake_timer_node(eto_ms=1000, ratio=0.9, rho=0.05,
+                                    sentinel=sent, clock=chaos)
+    ctrl2.record_ack(node2.list_peers()[1], chaos.monotonic())
+    assert not ctrl2.lease_valid()
+    assert sent.lease_fenced == 1
+
+
+def test_jump_forward_expires_lease_instead_of_stale_serve():
+    """A forward clock jump makes every ack look ancient: the lease
+    must read EXPIRED (forcing the SAFE fallback), never stale-valid."""
+    base = FakeClock(0.0)
+    chaos = ChaosClock(base=base)
+    node, ctrl = _fake_timer_node(eto_ms=1000, ratio=0.9, clock=chaos)
+    ctrl.record_ack(node.list_peers()[1], chaos.monotonic())
+    assert ctrl.lease_valid()
+    chaos.jump(5.0)
+    assert not ctrl.lease_valid()
+
+
+def test_hub_receiver_pads_store_lease_by_drift_bound():
+    """The satellite fix: the receiver times out a duration GRANTED on
+    the sender's clock — it must honor only (1 - rho) of it."""
+    from tpuraft.core.heartbeat_hub import HeartbeatHub
+
+    clk = FakeClock(50.0)
+    hub = HeartbeatHub(clock=clk)
+    hub.clock_drift_bound = 0.1
+    hub.note_lease_from("s1", 1000)          # 1s grant -> 0.9s held
+    assert hub.lease_fresh("s1")
+    clk.advance(0.95)
+    assert not hub.lease_fresh("s1")         # unpadded would still hold
+    hub.note_lease_from("s2", 1000)
+    clk.advance(0.85)
+    assert hub.lease_fresh("s2")
+
+
+def test_hub_sender_lease_ack_window_shrinks_by_drift_bound():
+    from tpuraft.core.heartbeat_hub import HeartbeatHub
+
+    clk = FakeClock(10.0)
+    hub = HeartbeatHub(clock=clk)
+    hub.clock_drift_bound = 0.1
+    hub._lease_ack_at["dst"] = clk.monotonic()
+    clk.advance(0.95)
+    assert not hub.lease_ack_fresh("dst", 1000)  # 0.95 >= 1.0 * (1-0.1)
+    hub._lease_ack_at["dst"] = clk.monotonic()
+    clk.advance(0.85)
+    assert hub.lease_ack_fresh("dst", 1000)      # inside the 0.9s pad
+
+
+def test_hub_zero_bound_keeps_legacy_windows():
+    from tpuraft.core.heartbeat_hub import HeartbeatHub
+
+    clk = FakeClock(0.0)
+    hub = HeartbeatHub(clock=clk)
+    hub.note_lease_from("s1", 1000)
+    clk.advance(0.99)
+    assert hub.lease_fresh("s1")
+    clk.advance(0.02)
+    assert not hub.lease_fresh("s1")
+
+
+# -- wire compatibility -------------------------------------------------------
+
+
+def test_beat_ack_clock_ms_decodes_old_wire_format():
+    """BeatAck/StoreLeaseAck encoded BEFORE clock_ms existed must decode
+    with clock_ms=0 ('no reading'), and the sentinel must ignore it."""
+    from tpuraft.rpc.messages import (BeatAck, StoreLeaseAck,
+                                      decode_message, encode_message)
+
+    ack = BeatAck(ok=True, term=3, clock_ms=123456)
+    wire = encode_message(ack)
+    assert decode_message(wire) == ack
+    old = decode_message(wire[:-8])          # strip the trailing i64
+    assert old == BeatAck(ok=True, term=3, clock_ms=0)
+
+    lack = StoreLeaseAck(ok=True, dependents=2, clock_ms=99_000)
+    lwire = encode_message(lack)
+    assert decode_message(lwire) == lack
+    lold = decode_message(lwire[:-8])
+    assert lold == StoreLeaseAck(ok=True, dependents=2, clock_ms=0)
+
+
+def test_engine_control_lease_shrinks_and_fences(monkeypatch):
+    """EngineControl mirrors TimerControl: drift bound shrinks _lease_ms
+    at registration; a suspect sentinel fails lease_valid closed."""
+    from tpuraft.core.engine import EngineControl
+
+    class _Sent:
+        def __init__(self):
+            self.fenced = 0
+
+        def lease_check(self):
+            self.fenced += 1
+            return False
+
+    sent = _Sent()
+    ctrl = EngineControl.__new__(EngineControl)
+    ctrl.node = type("N", (), {})()
+    ctrl.node.options = type("O", (), {})()
+    ctrl.node.options.clock_sentinel = sent
+    assert ctrl.lease_valid() is False
+    assert sent.fenced == 1

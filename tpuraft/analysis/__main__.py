@@ -33,7 +33,7 @@ def main(argv: list[str] | None = None) -> int:
         description="graftcheck: project-invariant static analysis "
                     "(guarded-by, lock-order, wire-schema, blocking-call, "
                     "future-leak, transitive-blocking, loop-affinity, "
-                    "lane-coverage, host-sync, donated-read)")
+                    "lane-coverage, host-sync, donated-read, raw-clock)")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: tpuraft/)")
     ap.add_argument("--record", action="store_true",

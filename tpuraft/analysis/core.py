@@ -30,6 +30,7 @@ RULES = (
     "lane-coverage",
     "host-sync",
     "donated-read",
+    "raw-clock",
     "waiver",
 )
 
@@ -204,7 +205,7 @@ def run_checkers(mods: list[Module], record: bool = False,
     from the live tree before verifying."""
     from tpuraft.analysis import (blocking_calls, callgraph, concurrency,
                                   future_leaks, guarded_by, lanes,
-                                  lock_order, wire_schema)
+                                  lock_order, raw_clock, wire_schema)
 
     def want(*ids: str) -> bool:
         """Skip checkers whose rules are all filtered out — a targeted
@@ -226,6 +227,8 @@ def run_checkers(mods: list[Module], record: bool = False,
         findings.extend(blocking_calls.check(mods))
     if want("future-leak"):
         findings.extend(future_leaks.check(mods))
+    if want("raw-clock"):
+        findings.extend(raw_clock.check(mods))
     run_concurrency = want("transitive-blocking", "loop-affinity",
                            "guarded-by")
     run_lanes = want("lane-coverage", "host-sync", "donated-read")

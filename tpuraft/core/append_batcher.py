@@ -43,11 +43,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Optional
 
 from tpuraft.rpc.messages import ErrorResponse, StoreAppendRequest
 from tpuraft.rpc.transport import RpcError, is_no_method
+from tpuraft.util import clock as clockmod
 from tpuraft.util.metrics import MetricRegistry
 
 LOG = logging.getLogger(__name__)
@@ -86,6 +86,9 @@ class AppendBatcher:
         # gray-failure signal sink (HealthTracker): every round's RPC
         # doubles as a per-endpoint RTT probe
         self.health = None
+        # store clock (ISSUE 18): the owning StoreEngine re-points this
+        # so the RTT probes ride the store's time plane
+        self.clock = clockmod.SYSTEM
         # counters (describe() + MetricRegistry + bench/soak stats)
         self.rounds = 0          # store_append RPCs sent
         self.rows = 0            # (group, peer) frames carried
@@ -205,7 +208,7 @@ class AppendBatcher:
         self.rounds += 1
         self.rows += len(rows)
         self.entries += sum(len(r.entries) for r in rows)
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         try:
             resp = await transport.call(
                 dst, "store_append", StoreAppendRequest(rows=rows),
@@ -232,7 +235,7 @@ class AppendBatcher:
             self._fail_batch(batch)
             return
         if self.health is not None:
-            self.health.note_peer_rtt(dst, time.monotonic() - t0)
+            self.health.note_peer_rtt(dst, self.clock.monotonic() - t0)
         acks = resp.acks
         if len(acks) != len(rows):
             # short/overlong reply reads as failure for the whole round

@@ -54,6 +54,7 @@ import numpy as np
 from tpuraft.conf import Configuration
 from tpuraft.entity import PeerId
 from tpuraft.options import TickOptions
+from tpuraft.util import clock as clockmod
 from tpuraft.util.trace import RECORDER as _RECORDER
 from tpuraft.ops.tick import (
     ROLE_CANDIDATE,
@@ -211,9 +212,13 @@ class EngineControl:
         # the lease is per-NODE (eto x ratio): the engine-wide lease_ms
         # param only feeds the device lease_valid mask, and a node whose
         # eto is shorter than the engine's must not inherit a lease
-        # longer than its own election timeout (stale LEASE_BASED reads)
+        # longer than its own election timeout (stale LEASE_BASED reads).
+        # The (1 - rho) factor is the clock-drift safety margin (ISSUE
+        # 18): the quorum granted us eto*ratio on THEIR clocks; ours may
+        # run up to rho fast, so we only trust that fraction of it.
         self._lease_ms = int(self._eto_ms
-                             * opts.raft_options.leader_lease_time_ratio)
+                             * opts.raft_options.leader_lease_time_ratio
+                             * (1.0 - opts.raft_options.clock_drift_bound))
         self._jitter_range = max(1, min(opts.raft_options.max_election_delay_ms,
                                         self._eto_ms))
         self._jitter = random.randrange(self._jitter_range)
@@ -233,7 +238,8 @@ class EngineControl:
             hb_ms=max(1, self._eto_ms
                       // opts.raft_options.election_heartbeat_factor),
             lease_ms=int(self._eto_ms
-                         * opts.raft_options.leader_lease_time_ratio),
+                         * opts.raft_options.leader_lease_time_ratio
+                         * (1.0 - opts.raft_options.clock_drift_bound)),
             snapshot_ms=snap_ms)
         if eff != self._eto_ms:
             self._adopt_eto(eff)
@@ -404,6 +410,12 @@ class EngineControl:
         return max(0.0, (self.engine.now_ms() - q) / 1000.0)
 
     def lease_valid(self) -> bool:
+        # a suspect local clock invalidates every timing argument the
+        # lease rests on: fail closed (reads fall back to SAFE quorum
+        # confirmation, which is clock-independent) — ISSUE 18
+        sentinel = self.node.options.clock_sentinel
+        if sentinel is not None and not sentinel.lease_check():
+            return False
         e = self.engine
         # device lane fast path: the last tick's fused q_ack reduction
         # (ops/tick.py lease_valid lane) is a LOWER bound on the current
@@ -604,7 +616,7 @@ class EngineControl:
         leader_alive = self.quiescent_leader_alive()
         self._clear_quiesce_state()
         if leader_alive:
-            self.node._last_leader_timestamp = time.monotonic()
+            self.node._last_leader_timestamp = self.node._clock.monotonic()
         if e.role[s] == ROLE_LEADER:
             e.hb_deadline[s] = now   # beat NOW; followers wake on it
         else:
@@ -840,12 +852,16 @@ class MultiRaftEngine:
         # lane: no-conf — snapshot cadence is registration-driven, not
         # membership-driven (the deadline row IS epoch-shifted)
         self.snap_deadline = np.zeros(g, np.int64)
-        self._t0 = time.monotonic()
+        # injectable store clock (ISSUE 18): the engine's whole time
+        # plane — deadlines, ack stamps, leases — runs on this clock,
+        # so a ChaosClock skews the STORE exactly like a bad machine
+        self._clock = clockmod.resolve(self.opts.clock)
+        self._t0 = self._clock.monotonic()
 
     # -- time ----------------------------------------------------------------
 
     def now_ms(self) -> int:
-        return int((time.monotonic() - self._t0) * 1000)
+        return int((self._clock.monotonic() - self._t0) * 1000)
 
     def to_ms(self, monotonic_time: float) -> int:
         return int((monotonic_time - self._t0) * 1000)

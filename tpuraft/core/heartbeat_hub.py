@@ -68,8 +68,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import TYPE_CHECKING, Optional
+
+from tpuraft.util import clock as clockmod
 
 from tpuraft.rpc.messages import (
     BatchRequest,
@@ -91,7 +92,19 @@ LOG = logging.getLogger(__name__)
 # graftcheck: loop-confined — one hub per NodeManager, driven by its
 # loop's clock task / engine tick; counters and lease maps are lockless
 class HeartbeatHub:
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        # injectable time plane (ISSUE 18): ALL lease bookkeeping below
+        # runs on the store's clock so a per-store clock fault skews
+        # sender- and receiver-side lease math coherently
+        self.clock = clockmod.resolve(clock)
+        # worst-case inter-store clock rate error rho (RaftOptions.
+        # clock_drift_bound, threaded by StoreEngine): every lease
+        # duration granted BY another store's clock but timed on OURS is
+        # shrunk by (1 - rho) — zero-margin legacy accounting at 0.0
+        self.clock_drift_bound = 0.0
+        # peer-skew estimator (ClockSentinel) fed by every beat ack that
+        # carries the responder's clock reading; None = no detection
+        self.clock_sentinel = None
         # (id(replicator)) -> replicator; grouped by endpoint per tick so
         # registration order never matters
         self._members: dict[int, "Replicator"] = {}
@@ -279,8 +292,26 @@ class HeartbeatHub:
             del self._lease_targets[dst]
 
     def lease_ack_fresh(self, dst: str, within_ms: int) -> bool:
+        """Sender-side store-lease freshness: the window shrinks by the
+        drift bound — ``within_ms`` is what the RECEIVER grants on ITS
+        clock, and ours may run up to rho slow, so trusting the full
+        window would let our 'fresh' outlive the receiver's grant (the
+        heartbeat_hub.py:283-vs-379 zero-margin hole, ISSUE 18)."""
         at = self._lease_ack_at.get(dst)
-        return at is not None and (time.monotonic() - at) * 1000 < within_ms
+        if at is None:
+            return False
+        within_ms *= (1.0 - self.clock_drift_bound)
+        return (self.clock.monotonic() - at) * 1000 < within_ms
+
+    def _note_peer_clock(self, dst: str, ack, t0: float, now: float) -> None:
+        """Feed the skew estimator from an ack's piggybacked clock
+        reading (BeatAck/StoreLeaseAck ``clock_ms``, 0 = old peer)."""
+        sentinel = self.clock_sentinel
+        if sentinel is None:
+            return
+        clock_ms = getattr(ack, "clock_ms", 0)
+        if clock_ms:
+            sentinel.observe(dst, clock_ms / 1000.0, t0, now)
 
     async def _lease_loop(self) -> None:
         """ONE store_lease RPC per dst endpoint per interval — the whole
@@ -308,7 +339,7 @@ class HeartbeatHub:
                     # is unchanged, the idle RPC rate halves.
                     if ents[0][2] > dst:
                         margin = (self._lease_from.get(dst, 0.0)
-                                  - time.monotonic())
+                                  - self.clock.monotonic())
                         if margin > min(e[4] for e in ents) / 2000.0:
                             self.lease_suppressed += 1
                             continue
@@ -335,22 +366,27 @@ class HeartbeatHub:
         src = ents[0][2]
         lease_ms = min(ent[4] for ent in ents)
         self.lease_rpcs_sent += 1
+        t0 = self.clock.monotonic()
         try:
-            await transport.call(
+            ack = await transport.call(
                 dst, "store_lease",
                 StoreLeaseBeat(endpoint=src, lease_ms=lease_ms),
                 timeout_ms=max(1, lease_ms // 2))
         except RpcError:
             return  # silence: rows go stale -> step_down, as designed
         self.lease_acks += 1
-        now = time.monotonic()
+        now = self.clock.monotonic()
+        self._note_peer_clock(dst, ack, t0, now)
         self._lease_ack_at[dst] = now
         for engine in engine_list:
             engine.note_store_ack(dst)
         # the ack also proves dst alive for OUR quiescent followers
         # (pair dedupe: dst may be riding these beats instead of
-        # sending its own, so this re-arm is their only refresh)
-        deadline = now + lease_ms / 1000.0
+        # sending its own, so this re-arm is their only refresh) —
+        # drift-padded like note_lease_from: the duration is granted on
+        # OUR clock here but consumed against dst's liveness, and the
+        # symmetric pad keeps both arming paths identical
+        deadline = now + lease_ms / 1000.0 * (1.0 - self.clock_drift_bound)
         if deadline > self._lease_from.get(dst, 0.0):
             self._lease_from[dst] = deadline
 
@@ -360,8 +396,13 @@ class HeartbeatHub:
         """An incoming store_lease beat: re-arm ``src``'s lease.
         Returns the dependent count (ack observability)."""
         self.lease_beats_seen += 1
-        now = time.monotonic()
-        deadline = now + lease_ms / 1000.0
+        now = self.clock.monotonic()
+        # receiver-side drift pad (ISSUE 18 satellite): ``lease_ms`` is
+        # a duration granted on the SENDER's clock but timed out on
+        # ours — if ours runs up to rho slow, the unpadded deadline
+        # silently extends the lease past the sender's intent, so the
+        # receiver honors only (1 - rho) of the grant
+        deadline = now + lease_ms / 1000.0 * (1.0 - self.clock_drift_bound)
         if deadline > self._lease_from.get(src, 0.0):
             self._lease_from[src] = deadline
         # the beat also proves src alive for OUR quiescent leaders
@@ -376,7 +417,7 @@ class HeartbeatHub:
         return len(self._lease_deps.get(src, ()))
 
     def lease_fresh(self, src: str) -> bool:
-        return self._lease_from.get(src, 0.0) > time.monotonic()
+        return self._lease_from.get(src, 0.0) > self.clock.monotonic()
 
     def lease_depend(self, src: str, ctrl, lease_ms: int) -> None:
         """A local quiescent follower group delegates liveness of its
@@ -410,14 +451,14 @@ class HeartbeatHub:
             while self._lease_deps:
                 horizon = min(self._lease_from.get(src, 0.0)
                               for src in self._lease_deps)
-                wait = max(0.02, horizon - time.monotonic())
+                wait = max(0.02, horizon - self.clock.monotonic())
                 self._lease_watch_nudge.clear()
                 try:
                     await asyncio.wait_for(
                         self._lease_watch_nudge.wait(), wait)
                 except asyncio.TimeoutError:
                     pass
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 for src in [s for s in list(self._lease_deps)
                             if self._lease_from.get(s, 0.0) <= now]:
                     ctrls = self._lease_deps.pop(src, set())
@@ -602,7 +643,7 @@ class HeartbeatHub:
         node = reps[0]._node
         self.rpcs_sent += 1
         self.fast_beats_sent += len(items)
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         try:
             resp = await node.transport.call(
                 dst, "multi_beat_fast", BatchRequest(items=items),
@@ -616,7 +657,7 @@ class HeartbeatHub:
                 self.pulse(reps)
             return  # else: silence — dead-node detection, as direct
         if self.health is not None:
-            self.health.note_peer_rtt(dst, time.monotonic() - t0)
+            self.health.note_peer_rtt(dst, self.clock.monotonic() - t0)
         if len(resp.items) != len(items):
             # short/overlong response: zip would silently drop trailing
             # replicators' acks — treat the whole chunk as deviating
@@ -626,7 +667,9 @@ class HeartbeatHub:
             self.fast_fallbacks += len(reps)
             self._pulse_classic(reps)
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
+        if resp.items:
+            self._note_peer_clock(dst, resp.items[0], t0, now)
         fallback: list["Replicator"] = []
         for (r, beat), ack in zip(pairs, resp.items):
             if not r._running or not r._node.is_leader():
@@ -673,7 +716,7 @@ class HeartbeatHub:
         node = reps[0]._node
         self.rpcs_sent += 1
         self.beats_sent += len(frames)
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         try:
             # half-election-timeout budget, like the direct heartbeat
             # path: with the inflight-chunk skip, a lost request must
@@ -686,7 +729,7 @@ class HeartbeatHub:
         except RpcError:
             return  # no acks: dead-node detection sees silence, as direct
         if self.health is not None:
-            self.health.note_peer_rtt(dst, time.monotonic() - t0)
+            self.health.note_peer_rtt(dst, self.clock.monotonic() - t0)
         if len(resp.acks) != len(frames):
             # a short ack list must read as silence for the WHOLE chunk
             # (dead-node detection semantics), not as acks for whichever

@@ -47,6 +47,7 @@ from tpuraft.rpc.messages import (
     TimeoutNowResponse,
 )
 from tpuraft.rpc.transport import RpcError
+from tpuraft.util import clock as clockmod
 from tpuraft.util import describer
 from tpuraft.util.trace import (RECORDER, TRACER, adopt_entry_ctx,
                                 store_proc)
@@ -108,17 +109,20 @@ class TimerControl:
     def __init__(self, node: "Node"):
         self._node = node
         opts = node.options
+        self._clock = clockmod.resolve(opts.clock)
         self._acks: dict[PeerId, float] = {}
         self._vote_ctx: Optional[_VoteCtx] = None
         self._election_timer = RepeatedTimer(
             f"election-{node.server_id}", opts.election_timeout_ms,
-            node._handle_election_timeout, adjust=RepeatedTimer.random_adjust)
+            node._handle_election_timeout, adjust=RepeatedTimer.random_adjust,
+            clock=opts.clock)
         self._vote_timer = RepeatedTimer(
             f"vote-{node.server_id}", opts.election_timeout_ms,
-            node._handle_vote_timeout, adjust=RepeatedTimer.random_adjust)
+            node._handle_vote_timeout, adjust=RepeatedTimer.random_adjust,
+            clock=opts.clock)
         self._stepdown_timer = RepeatedTimer(
             f"stepdown-{node.server_id}", opts.election_timeout_ms // 2 or 1,
-            node._check_dead_nodes)
+            node._check_dead_nodes, clock=opts.clock)
 
     # -- role transitions ----------------------------------------------------
 
@@ -140,7 +144,7 @@ class TimerControl:
 
     def on_leader(self) -> None:
         self._vote_timer.stop()
-        self._acks = {self._node.server_id: time.monotonic()}
+        self._acks = {self._node.server_id: self._clock.monotonic()}
         self._stepdown_timer.start()
 
     def on_step_down(self, was_candidate: bool, was_leader: bool) -> None:
@@ -180,7 +184,7 @@ class TimerControl:
         """Age of the q-th newest voter ack (joint-consensus aware);
         self counts as acked now (NodeImpl#checkDeadNodes)."""
         node = self._node
-        now = time.monotonic()
+        now = self._clock.monotonic()
         self._acks[node.server_id] = now
         conf, old_conf = node.conf_entry.conf, node.conf_entry.old_conf
 
@@ -196,14 +200,24 @@ class TimerControl:
 
     def lease_valid(self) -> bool:
         node = self._node
+        ro = node.options.raft_options
         lease_s = (node.options.election_timeout_ms
-                   * node.options.raft_options.leader_lease_time_ratio
-                   / 1000.0)
+                   * ro.leader_lease_time_ratio / 1000.0)
+        # drift bound (ISSUE 18): the holder trusts its lease for
+        # (1 - rho) of the granted window so a clock running up to rho
+        # slow can never stretch the real window past the grant
+        lease_s *= (1.0 - ro.clock_drift_bound)
+        sentinel = node.options.clock_sentinel
+        if sentinel is not None and not sentinel.lease_check():
+            # the local clock is drift-suspect beyond rho: the bound's
+            # premise is broken — fail closed (reads take SAFE)
+            return False
         return self.quorum_ack_age_s() < lease_s
 
     def alive_peers(self) -> list[PeerId]:
         node = self._node
-        horizon = time.monotonic() - node.options.election_timeout_ms / 1000.0
+        horizon = (self._clock.monotonic()
+                   - node.options.election_timeout_ms / 1000.0)
         return [p for p in node.list_peers()
                 if p == node.server_id or self._acks.get(p, 0.0) > horizon]
 
@@ -233,6 +247,9 @@ class Node:
         # the node is untouched by the device plane
         self._ballot_box_factory = ballot_box_factory or BallotBox
         self.metrics = MetricRegistry(options.enable_metrics)
+        # injectable time plane (ISSUE 18): ONE store-level clock feeds
+        # every lease/timer comparison this node makes; SYSTEM when none
+        self._clock = clockmod.resolve(options.clock)
 
         # Protocol state below is guarded-by the node lock in WRITE mode
         # (graftcheck guarded-by): every rebind happens under
@@ -268,7 +285,7 @@ class Node:
         self._note_append_start = None  # replica-plane hooks (init())
         self._note_attested = None
         self._snapshot_timer: Optional[RepeatedTimer] = None
-        self._last_leader_timestamp = time.monotonic()  # guarded-by: _lock (writes)
+        self._last_leader_timestamp = self._clock.monotonic()  # guarded-by: _lock (writes)
         # index of the first entry appended in THIS leadership term (the
         # election no-op); reads are unsafe until it commits
         self._term_first_index: int = 0         # guarded-by: _lock (writes)
@@ -444,11 +461,11 @@ class Node:
             # RepeatedTimer, no unstaggered snapshot herd at high G)
             self._snapshot_timer = RepeatedTimer(
                 f"snapshot-{self.server_id}", opts.snapshot.interval_secs * 1000,
-                self._handle_snapshot_timeout)
+                self._handle_snapshot_timeout, clock=opts.clock)
             self._snapshot_timer.start()
 
         self.state = State.FOLLOWER
-        self._last_leader_timestamp = time.monotonic()
+        self._last_leader_timestamp = self._clock.monotonic()
         self._ctrl.start_follower()
         LOG.info("%s initialized: term=%d conf=%s", self, self.current_term,
                  self.conf_entry.conf)
@@ -683,7 +700,8 @@ class Node:
                 return Status.error(RaftError.EINVAL, f"no replicator for {peer}")
             self.state = State.TRANSFERRING
             self._transfer_deadline = (
-                time.monotonic() + self.options.election_timeout_ms / 1000.0)
+                self._clock.monotonic()
+                + self.options.election_timeout_ms / 1000.0)
             r.transfer_leadership(self.log_manager.last_log_index())
             r.wake()
             LOG.info("%s transferring leadership to %s", self, peer)
@@ -747,7 +765,7 @@ class Node:
     # ======================================================================
 
     def _leader_lease_valid(self) -> bool:
-        if (time.monotonic() - self._last_leader_timestamp
+        if (self._clock.monotonic() - self._last_leader_timestamp
                 < self.options.election_timeout_ms
                 * self.options.raft_options.leader_lease_time_ratio / 1000.0):
             return True
@@ -1139,7 +1157,7 @@ class Node:
             self.fsm_caller.on_leader_stop(status)
         self.state = State.FOLLOWER
         self.leader_id = new_leader
-        self._last_leader_timestamp = time.monotonic()
+        self._last_leader_timestamp = self._clock.monotonic()
         self._refresh_target_priority()
         if term > self.current_term:
             self.current_term = term
@@ -1278,7 +1296,7 @@ class Node:
                     self.voted_for = EMPTY_PEER
                     return RequestVoteResponse(term=self.current_term,
                                                granted=False)
-                self._last_leader_timestamp = time.monotonic()  # grant => reset
+                self._last_leader_timestamp = self._clock.monotonic()  # grant => reset
                 self._ctrl.note_leader_contact()
                 return RequestVoteResponse(term=self.current_term, granted=True)
             granted = log_ok and self.voted_for == candidate
@@ -1353,7 +1371,7 @@ class Node:
                     multi_hb=mh,
                     term=self.current_term, success=False,
                     last_log_index=self.log_manager.last_log_index())
-            self._last_leader_timestamp = time.monotonic()
+            self._last_leader_timestamp = self._clock.monotonic()
             self._ctrl.note_leader_contact()
             # an incoming full-semantics append (entries, probe, or
             # classic beat) means the leader is ACTIVE: a quiescent

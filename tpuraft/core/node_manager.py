@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Optional
 
 from tpuraft.core.node import Node, State
@@ -99,7 +98,7 @@ class NodeManager:
                     and b.committed_index
                     <= node.ballot_box.last_committed_index):
                 node._ctrl.note_leader_contact()
-                node._last_leader_timestamp = time.monotonic()
+                node._last_leader_timestamp = node._clock.monotonic()
                 ok = True
                 if getattr(b, "quiesce", False):
                     # quiesce handshake: join the hibernation ONLY when
@@ -122,12 +121,19 @@ class NodeManager:
                     # still hibernating (aborted handshake, leader woke)
                     # resumes fault detection with it
                     node._ctrl.note_activity()
-                acks.append(BeatAck(ok=bool(ok), term=node.current_term))
+                acks.append(BeatAck(ok=bool(ok), term=node.current_term,
+                                    clock_ms=self._clock_ms()))
             else:
                 acks.append(BeatAck(
                     ok=False,
-                    term=node.current_term if node is not None else 0))
+                    term=node.current_term if node is not None else 0,
+                    clock_ms=self._clock_ms()))
         return BatchResponse(items=acks)
+
+    def _clock_ms(self) -> int:
+        """This store's clock reading (monotonic ms) for ack piggyback —
+        the peer-skew estimator's raw sample (ISSUE 18)."""
+        return int(self.heartbeat_hub.clock.monotonic() * 1000)
 
     async def _handle_store_lease(self, request):
         """Receiver side of the store-level liveness lease: re-arm the
@@ -137,7 +143,8 @@ class NodeManager:
 
         deps = self.heartbeat_hub.note_lease_from(
             request.endpoint, request.lease_ms)
-        return StoreLeaseAck(ok=True, dependents=deps)
+        return StoreLeaseAck(ok=True, dependents=deps,
+                             clock_ms=self._clock_ms())
 
     async def _handle_multi_vote(self, request):
         """Fan a vote BatchRequest out concurrently; vote handlers only

@@ -57,6 +57,11 @@ class ReadOnlyService:
         self.batched_confirms = 0  # SAFE confirms amortized store-wide
         self.fwd_rounds = 0       # forward RPCs sent (follower side)
         self.fwd_redirects = 0    # leader-hint re-probes after rejection
+        # LEASE_BASED configured but the lease didn't hold (expired,
+        # drift-bound shrank it, or the clock sentinel fenced it):
+        # the read fell back to a SAFE quorum round — the soak's
+        # clock-chaos oracle counts these (ISSUE 18)
+        self.lease_fallbacks = 0
 
     def attach_confirm_batcher(self, batcher) -> None:
         """Route this group's SAFE quorum confirmations through a
@@ -72,6 +77,7 @@ class ReadOnlyService:
             "batched_confirms": self.batched_confirms,
             "fwd_rounds": self.fwd_rounds,
             "fwd_redirects": self.fwd_redirects,
+            "lease_fallbacks": self.lease_fallbacks,
         }
 
     async def shutdown(self) -> None:
@@ -212,14 +218,16 @@ class ReadOnlyService:
         if read_index < node._term_first_index:
             return False, read_index
         opt = node.options.raft_options.read_only_option
-        if opt == ReadOnlyOption.LEASE_BASED and node.leader_lease_is_valid():
-            # served off the lease alone — NO quorum round, and no wake:
-            # a HIBERNATING leader's lease rides the store-level
-            # liveness lease (EngineControl.lease_valid consults
-            # store_lease_quorum_ok while quiescent), so a pure-read
-            # load leaves quiescent groups hibernated
-            self.lease_serves += 1
-            return True, read_index
+        if opt == ReadOnlyOption.LEASE_BASED:
+            if node.leader_lease_is_valid():
+                # served off the lease alone — NO quorum round, and no
+                # wake: a HIBERNATING leader's lease rides the
+                # store-level liveness lease (EngineControl.lease_valid
+                # consults store_lease_quorum_ok while quiescent), so a
+                # pure-read load leaves quiescent groups hibernated
+                self.lease_serves += 1
+                return True, read_index
+            self.lease_fallbacks += 1
         # SAFE quorum round (or the lease lapsed): the round beats the
         # followers directly, and a beaten follower WAKES — the leader
         # must wake with it or its hibernation outlives its followers'

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Optional
 
 from tpuraft.entity import PeerId, strip_entry_payload
@@ -59,10 +58,13 @@ class Replicator:
     def __init__(self, node, peer: PeerId):
         self._node = node
         self.peer = peer
+        # ack stamps share the NODE's clock: quorum_ack_age_s compares
+        # them against the same (possibly injected) timeline
+        self._clock = node._clock
         self.next_index = node.log_manager.last_log_index() + 1
         self.match_index = 0
         self._matched = False  # True after the first successful probe/append
-        self.last_rpc_ack = time.monotonic()
+        self.last_rpc_ack = self._clock.monotonic()
         self._running = False
         self._hub = None  # HeartbeatHub when coalescing is enabled
         self._hb_task: Optional[asyncio.Task] = None
@@ -312,7 +314,7 @@ class Replicator:
                     self._delayed_pump(eto_s / 10)
                 return
             self._note_peer_caps(ack)
-            self.last_rpc_ack = time.monotonic()
+            self.last_rpc_ack = self._clock.monotonic()
             node.on_peer_ack(self.peer, self.last_rpc_ack)
             if ack.term > node.current_term:
                 self._rollback()
@@ -459,7 +461,7 @@ class Replicator:
             await node.step_down_on_higher_term(
                 resp.term, f"heartbeat response from {self.peer}")
             return False
-        self.last_rpc_ack = time.monotonic()
+        self.last_rpc_ack = self._clock.monotonic()
         node.on_peer_ack(self.peer, self.last_rpc_ack)
         if not resp.success and self._matched:
             # follower's log no longer matches (e.g. restarted): re-probe
@@ -480,7 +482,7 @@ class Replicator:
         if not node.is_leader():
             return False
         req = self.build_heartbeat_request()
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         try:
             resp = await node.transport.append_entries(
                 self.peer.endpoint, req,
@@ -492,7 +494,8 @@ class Replicator:
             # gray-failure signal: the beat's RTT scores the PEER's
             # endpoint — a limping follower shows up here long before
             # it goes silent
-            health.note_peer_rtt(self.peer.endpoint, time.monotonic() - t0)
+            health.note_peer_rtt(self.peer.endpoint,
+                                 self._clock.monotonic() - t0)
         return await self.process_heartbeat_response(resp)
 
     # -- catch-up (membership change) ----------------------------------------

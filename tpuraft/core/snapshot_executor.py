@@ -236,7 +236,7 @@ class SnapshotExecutor:
                 await node._step_down(req.term, Status.error(
                     RaftError.EHIGHERTERMREQUEST, "install_snapshot"),
                     new_leader=PeerId.parse(req.server_id))
-            node._last_leader_timestamp = time.monotonic()
+            node._last_leader_timestamp = node._clock.monotonic()
             if self.installing or self._saving:
                 # save and install share the storage temp dir — mutual
                 # exclusion both ways (reference: savingSnapshot /

@@ -86,6 +86,17 @@ class RaftOptions:
     priority_transfer_rounds: int = 2
     # lease safety margin: leader lease = election_timeout * ratio
     leader_lease_time_ratio: float = 0.9
+    # Assumed worst-case clock RATE error between any two stores
+    # (rho): every lease the HOLDER trusts shrinks by (1 - rho), and
+    # every lease a RECEIVER times against its own clock is padded the
+    # same way, so sender and receiver disagreeing by up to rho per
+    # second can never let a lease outlive its grant (ISSUE 18; see
+    # docs/architecture.md "Lease safety under bounded drift").  Also
+    # arms the ClockSentinel: a store whose clock deviates from the
+    # peer median by MORE than rho fails lease checks closed (reads
+    # fall back to the SAFE quorum path) until the estimate heals.
+    # 0.0 = legacy zero-margin accounting, sentinel never fences.
+    clock_drift_bound: float = 0.0
 
 
 @dataclass
@@ -148,6 +159,10 @@ class TickOptions:
     # Fraction of one core the idle beat plane may consume before the
     # floor starts raising timeouts.
     beat_cpu_budget: float = 0.10
+    # Injectable time source for the engine's tick deadlines / epoch
+    # math (tpuraft.util.clock.Clock-shaped: .monotonic()/.wall()).
+    # None = tpuraft.util.clock.SYSTEM (real time, zero-overhead path).
+    clock: Optional[object] = None
     backend: str = "auto"         # "auto" | "jax" | "numpy" (numpy for tiny tests)
     donate_state: bool = True     # donate state buffers to the tick kernel
     # Shard the engine's [G, P] planes over a device mesh along the group
@@ -209,6 +224,18 @@ class NodeOptions:
     # campaigning anyway (the liveness escape when every peer is worse
     # off) — the election-priority face of gray-failure mitigation
     sick_election_rounds: int = 2
+    # Injectable time source (tpuraft.util.clock: .monotonic()/.wall())
+    # shared by everything timing-sensitive this node runs — election
+    # timers, _last_leader_timestamp, lease math, health hysteresis.
+    # StoreEngine threads ONE clock to every node it hosts so a
+    # per-store clock fault (ChaosClock) skews the whole store
+    # coherently.  None = tpuraft.util.clock.SYSTEM (real time).
+    clock: Optional[object] = None
+    # store-level clock sentinel (tpuraft.util.clock.ClockSentinel),
+    # shared like ``health``: the HeartbeatHub feeds it beat-ack skew
+    # probes and lease checks consult it to fail closed when the local
+    # clock is drift-suspect.  None = no detection.
+    clock_sentinel: Optional[object] = None
     # store-level FSM apply lane (tpuraft.core.lanes.WorkerLane), shared
     # by every node the hosting store runs: when set AND the FSM exposes
     # a sync ``apply_sync``, committed DATA runs execute on the lane

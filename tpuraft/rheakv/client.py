@@ -1218,11 +1218,15 @@ class DistributedLock:
                    timeout_ms: Optional[float] = None) -> bool:
         """Block until acquired (or timeout)."""
         loop = asyncio.get_running_loop()
-        deadline = None if timeout_ms is None \
-            else loop.time() + timeout_ms / 1000.0
+        deadline = None
+        if timeout_ms is not None:
+            # graftcheck: allow(raw-clock) — client-side retry budget:
+            # the CALLER's real deadline
+            deadline = loop.time() + timeout_ms / 1000.0
         while True:
             if await self.try_lock(watchdog=watchdog):
                 return True
+            # graftcheck: allow(raw-clock) — client-side retry budget: the CALLER's real deadline
             if deadline is not None and loop.time() >= deadline:
                 return False
             await asyncio.sleep(retry_interval_ms / 1000.0)

@@ -319,6 +319,7 @@ class NativeRawKVStore(RawKVStore):
 
     def try_lock_with(self, key: bytes, locker_id: bytes, lease_ms: int,
                       keep_lease: bool) -> tuple[bool, int, bytes]:
+        # graftcheck: allow(raw-clock) — KV lock-lease deadline: process-local TTL, not consensus timing
         now = time.time()
         owner = self._load_lock(key)
         if owner is not None and not owner.expired(now):
@@ -339,6 +340,7 @@ class NativeRawKVStore(RawKVStore):
         owner = self._load_lock(key)
         if owner is None:
             return True
+        # graftcheck: allow(raw-clock) — KV lock-lease deadline: process-local TTL, not consensus timing
         if owner.locker_id != locker_id and not owner.expired(time.time()):
             return False
         owner.acquires -= 1
@@ -376,6 +378,7 @@ class NativeRawKVStore(RawKVStore):
             out += _U32.pack(len(k)) + k + _U32.pack(len(v)) + v
         for k, v in seqs:
             out += _U32.pack(len(k)) + k + _I64.pack(v)
+        # graftcheck: allow(raw-clock) — lock-lease persisted as REMAINING duration; wall stamps never cross stores
         now = time.time()
         for k, o in locks:
             out += _U32.pack(len(k)) + k
@@ -408,6 +411,7 @@ class NativeRawKVStore(RawKVStore):
             (v,) = _I64.unpack_from(buf, off)
             off += 8
             ops.append((_OP_PUT, _COL_SEQ, k, _I64.pack(v)))
+        # graftcheck: allow(raw-clock) — lock-lease persisted as REMAINING duration; wall stamps never cross stores
         now = time.time()
         max_token = 0
         for _ in range(nlock):

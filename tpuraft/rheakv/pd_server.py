@@ -351,6 +351,7 @@ class ClusterStatsManager:
         if term == self._leader_term:
             return
         self._leader_term = term
+        # graftcheck: allow(raw-clock) — PD-side post-failover grace window (real time)
         self._grace_until = time.monotonic() + cooldown_s
         self._transfer_cooldown.clear()
         self._pending_moves.clear()
@@ -373,6 +374,7 @@ class ClusterStatsManager:
         ent.reads_s = reads_s
         ent.bytes_in_s = bytes_in_s
         ent.bytes_out_s = bytes_out_s
+        # graftcheck: allow(raw-clock) — PD-side heat-report age stamp (real time)
         ent.heat_at = time.monotonic()
         self._note_hot(region_id, ent.score)
 
@@ -403,6 +405,7 @@ class ClusterStatsManager:
         """Run the staleness/threshold sweep if one is due (rate-bound
         to 1/s); called from heat intake AND from the view build, so a
         fleet that went silent still ages its standing rates out."""
+        # graftcheck: allow(raw-clock) — PD-side heat staleness sweep (real time)
         now = time.monotonic()
         if now >= self._hot_recalc_at:
             self._hot_sweep(now)
@@ -492,6 +495,7 @@ class ClusterStatsManager:
     def should_split(self, region_id: int) -> bool:
         if self.split_threshold_keys <= 0:
             return False
+        # graftcheck: allow(raw-clock) — PD-side split cooldown window (real time)
         now = time.monotonic()
         self._inflight_splits = {r: d for r, d in
                                  self._inflight_splits.items() if d > now}
@@ -501,6 +505,7 @@ class ClusterStatsManager:
 
     def mark_split_issued(self, region_id: int, cooldown_s: float = 30.0
                           ) -> None:
+        # graftcheck: allow(raw-clock) — PD-side split cooldown window (real time)
         self._inflight_splits[region_id] = time.monotonic() + cooldown_s
         ent = self._stats.get(region_id)
         if ent is not None:
@@ -544,6 +549,7 @@ class ClusterStatsManager:
         SICK *leader* is DRAINED — the least-loaded healthy peer is
         picked even when the usual >=2 leader-count imbalance is
         absent (cooldown and post-failover grace still pace it)."""
+        # graftcheck: allow(raw-clock) — PD-side cooldown pacing; the PD is not a store and has no injected clock
         now = time.monotonic()
         if now < self._grace_until:
             return None  # post-failover grace (note_leadership)

@@ -36,6 +36,7 @@ class LockOwner:
     acquires: int = 1      # reentrant acquisition count
 
     def expired(self, now: Optional[float] = None) -> bool:
+        # graftcheck: allow(raw-clock) — KV lock-lease default deadline: process-local TTL, not consensus timing
         return (now if now is not None else time.monotonic()) >= self.deadline
 
 
@@ -261,6 +262,7 @@ class MemoryRawKVStore(RawKVStore):
 
     def try_lock_with(self, key: bytes, locker_id: bytes, lease_ms: int,
                       keep_lease: bool) -> tuple[bool, int, bytes]:
+        # graftcheck: allow(raw-clock) — KV lock-lease deadline: process-local TTL, not consensus timing
         now = time.monotonic()
         owner = self._locks.get(key)
         if owner is not None and not owner.expired(now):
@@ -302,6 +304,7 @@ class MemoryRawKVStore(RawKVStore):
             out += struct.pack("<I", len(v)) + v
         for k, v in seqs:
             out += struct.pack("<I", len(k)) + k + struct.pack("<q", v)
+        # graftcheck: allow(raw-clock) — lock-lease persisted as REMAINING duration; stamps never cross stores
         now = time.monotonic()
         for k, o in locks:
             out += struct.pack("<I", len(k)) + k
@@ -333,6 +336,7 @@ class MemoryRawKVStore(RawKVStore):
             (v,) = struct.unpack_from("<q", buf, off)
             off += 8
             self._sequences[k] = v
+        # graftcheck: allow(raw-clock) — lock-lease persisted as REMAINING duration; stamps never cross stores
         now = time.monotonic()
         for _ in range(nlock):
             (kl,) = struct.unpack_from("<I", buf, off)
@@ -372,11 +376,13 @@ class MetricsRawKVStore(RawKVStore):
 
     def _timed(self, name: str, fn):
         def timed(*a, **kw):
+            # graftcheck: allow(raw-clock) — op-latency metric timing, not consensus timing
             t0 = time.monotonic()
             try:
                 return fn(*a, **kw)
             finally:
                 self._metrics.update(
+                    # graftcheck: allow(raw-clock) — op-latency metric timing, not consensus timing
                     f"kv_{name}", (time.monotonic() - t0) * 1000.0)
 
         return timed

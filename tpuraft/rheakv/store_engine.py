@@ -38,6 +38,8 @@ from tpuraft.rheakv.raw_store import (
 )
 from tpuraft.rpc.messages import BatchRequest, CompactBeat
 from tpuraft.rpc.transport import RpcError, is_no_method
+from tpuraft.util import clock as clockmod
+from tpuraft.util.clock import ClockSentinel
 from tpuraft.util.metrics import MetricRegistry, prometheus_text
 from tpuraft.util.trace import RECORDER, TRACER
 from tpuraft.rheakv.region_engine import RegionEngine
@@ -232,6 +234,23 @@ class StoreEngineOptions:
     # within ClusterStatsManager.heat_stale_s (30s), so this must stay
     # WELL below that or a steadily-hot region vanishes from the view
     heat_refresh_s: float = 10.0
+    # -- time discipline (ISSUE 18) ------------------------------------------
+    # injectable store clock (util/clock.py): EVERY timing-sensitive
+    # consumer of this store — election timers, engine tick deadlines,
+    # store-lease bookkeeping, health hysteresis — reads this clock, so
+    # a ChaosClock here skews the store exactly like a machine with a
+    # bad oscillator.  None = the process-wide SystemClock (zero
+    # indirection cost: module default, bench-gated <=2%).
+    clock: Optional[object] = None
+    # assumed maximum relative clock drift rho between any two stores
+    # (e.g. 0.05 = 5%).  Shrinks the leader's usable lease window and
+    # the receiver-side store-lease grant by (1 - rho), and arms the
+    # peer-skew sentinel's fencing: a store whose clock the beat-plane
+    # skew estimator flags as deviating beyond rho stops serving
+    # lease reads (SAFE fallback) until it recovers.  0.0 = legacy
+    # exact-clock behavior (no pads, sentinel observes but never
+    # fences).
+    clock_drift_bound: float = 0.0
 
 
 class _GroupFence:
@@ -329,6 +348,9 @@ class ReadConfirmBatcher:
         # gray-failure signal sink (HealthTracker): every fence round's
         # RPC doubles as a per-endpoint RTT probe
         self.health = None
+        # store clock (ISSUE 18): StoreEngine re-points this at its
+        # injected clock so RTT probes stay on the store's time plane
+        self.clock = clockmod.SYSTEM
         # counters (describe() + bench/soak stats lines)
         self.confirms = 0       # fences requested
         self.rounds = 0         # store-wide rounds run
@@ -481,7 +503,7 @@ class ReadConfirmBatcher:
         node = rows[0][0].node
         self.beat_rpcs += 1
         self.beats += len(rows)
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         try:
             resp = await node.transport.call(
                 dst, "multi_beat_fast",
@@ -495,14 +517,14 @@ class ReadConfirmBatcher:
                     *(self._classic(st, r) for st, r, _b in rows))
             return  # silence: the fences just miss these acks
         if self.health is not None:
-            self.health.note_peer_rtt(dst, time.monotonic() - t0)
+            self.health.note_peer_rtt(dst, self.clock.monotonic() - t0)
         if len(resp.items) != len(rows):
             # short/overlong reply reads as silence for the whole chunk
             # (zip would pair acks with the wrong fences)
             LOG.warning("read-fence multi_beat_fast %s: %d acks for %d "
                         "beats", dst, len(resp.items), len(rows))
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
         fallback: list = []
         for (st, r, _b), ack in zip(rows, resp.items):
             if getattr(ack, "ok", False):
@@ -539,8 +561,20 @@ class StoreEngine:
         self.server_id = PeerId.parse(opts.server_id)
         self.rpc_server = rpc_server
         self.transport = transport
+        # time discipline (ISSUE 18): ONE clock per store; every timing
+        # consumer below reads it.  The sentinel rides the beat-plane
+        # ack RTT probes to estimate per-peer skew; with drift_bound > 0
+        # a suspect local clock fences lease reads (SAFE fallback).
+        self.clock = clockmod.resolve(opts.clock)
+        self.clock_sentinel = ClockSentinel(
+            drift_bound=opts.clock_drift_bound,
+            clock=self.clock, label=str(opts.server_id))
         self.node_manager = NodeManager(rpc_server)
         CliProcessors(self.node_manager)
+        hub = self.node_manager.heartbeat_hub
+        hub.clock = self.clock
+        hub.clock_drift_bound = opts.clock_drift_bound
+        hub.clock_sentinel = self.clock_sentinel
         # per-region heat telemetry: ONE tracker per store, fed from
         # the KV serving paths (kv_processor binds it at construction)
         # + FSM apply, folded and reported on the PD heartbeat cadence
@@ -556,6 +590,7 @@ class StoreEngine:
         self.read_batcher: Optional[ReadConfirmBatcher] = \
             ReadConfirmBatcher() if opts.read_confirm_batching else None
         if self.read_batcher is not None:
+            self.read_batcher.clock = self.clock
             from tpuraft.util import describer
 
             describer.register(self.read_batcher)
@@ -568,6 +603,7 @@ class StoreEngine:
             from tpuraft.util import describer
 
             self.append_batcher = AppendBatcher()
+            self.append_batcher.clock = self.clock
             describer.register(self.append_batcher)
         # gray-failure plane: one HealthTracker per store, fed by the
         # hot path (LogManager flush timing, beat-plane ack RTTs, FSM
@@ -583,6 +619,7 @@ class StoreEngine:
             from tpuraft.util.health import HealthTracker
 
             self.health = HealthTracker(opts.health_options,
+                                        clock=self.clock.monotonic,
                                         label=str(self.server_id))
             describer.register(self.health)
             if self.read_batcher is not None:
@@ -615,6 +652,9 @@ class StoreEngine:
             self.health.register_gauges(self.metrics)
         if self.disk_budget is not None:
             self.disk_budget.register_gauges(self.metrics)
+        self.clock_sentinel.register_gauges(self.metrics)
+        from tpuraft.util import describer as _describer
+        _describer.register(self.clock_sentinel)
         raw: RawKVStore = opts.raw_store_factory()
         if opts.enable_kv_metrics:
             raw = MetricsRawKVStore(raw, self.metrics)
@@ -827,8 +867,12 @@ class StoreEngine:
         when the silenced groups' peers time out, exactly like a crash
         but with zero lost acks."""
         self.draining = True
+        # graftcheck: allow(raw-clock) — SIGTERM drain budget is REAL
+        # wall seconds: a frozen/slow store clock must not stretch the
+        # operator's shutdown window
         deadline = time.monotonic() + timeout_s
         while self.kv_processor.inflight_items > 0:
+            # graftcheck: allow(raw-clock) — same real-time drain budget
             if time.monotonic() >= deadline:
                 LOG.warning("drain timed out with %d items in flight",
                             self.kv_processor.inflight_items)
@@ -1131,6 +1175,14 @@ class StoreEngine:
             gauges.update(self.health.counters())
         if self.disk_budget is not None:
             gauges.update(self.disk_budget.counters())
+        # clock plane rides the unconditional exposition path (like
+        # health/disk above) — admin.py clocks must see the sentinel
+        # even on stores that never enabled the opt-in KV registry
+        gauges.update(self.clock_sentinel.gauges())
+        counters.update({
+            "clock_skew_samples": self.clock_sentinel.samples,
+            "clock_anomalies": self.clock_sentinel.anomalies,
+        })
         if self.heat is not None:
             gauges.update(self.heat.gauges())
         if self.multi_raft_engine is not None:
@@ -1173,6 +1225,8 @@ class StoreEngine:
         staleness is visible and bounded by the TTL."""
         ttl = max(0.0, self.opts.metrics_cache_ttl_ms / 1000.0)
         with self._metrics_cache_lock:
+            # graftcheck: allow(raw-clock) — scrape-cache TTL is against
+            # the scraper's real cadence, not the store's time plane
             now = time.monotonic()
             body, t = self._metrics_cache
             if body is None or now - t >= ttl:
@@ -1309,6 +1363,8 @@ class StoreEngine:
         self.pd_deltas_sent += len(deltas)
         if self._pd_heat_kwarg:
             self.pd_heat_rows_sent += len(heat_rows)
+            # graftcheck: allow(raw-clock) — keepalive bookkeeping vs
+            # the PD's REAL heat_stale_s expiry, not store time
             now = time.monotonic()
             self._pd_heat_reported.update(
                 {row[0]: (score, now) for row, score in heat_rows})
@@ -1348,6 +1404,8 @@ class StoreEngine:
         from tpuraft.util.heat import heat_changed
 
         self.heat.fold()
+        # graftcheck: allow(raw-clock) — keepalive refresh races the
+        # PD's REAL heat_stale_s expiry window
         now = time.monotonic()
         rows: list[tuple[tuple, float]] = []
         for rid in self.leader_region_ids():
@@ -1423,6 +1481,12 @@ class StoreEngine:
         opts.raft_options.read_only_option = self.opts.read_only_option
         opts.raft_options.quiesce_after_rounds = \
             self.opts.quiesce_after_rounds
+        # time discipline: every region node of this store runs on the
+        # ONE store clock and consults the ONE skew sentinel before
+        # trusting its leader lease (ISSUE 18)
+        opts.clock = self.opts.clock
+        opts.clock_sentinel = self.clock_sentinel
+        opts.raft_options.clock_drift_bound = self.opts.clock_drift_bound
         # gray-failure plane: every region node of this store feeds (and
         # consults) the ONE store-level tracker — disk probe from its
         # LogManager, apply depth from its FSMCaller, election gate from

@@ -285,6 +285,10 @@ class CompactBeat:
 class BeatAck:
     ok: bool            # False => send a full beat (slow path)
     term: int           # receiver's current term (observability only)
+    # responder's store clock (monotonic ms) at ack time: piggybacked
+    # sample for the sender's peer-skew estimator (ISSUE 18).  Trailing
+    # + defaulted: old peers decode as 0 ("no reading").
+    clock_ms: int = 0
 
 
 @dataclass
@@ -309,6 +313,9 @@ class StoreLeaseAck:
     # how many quiescent groups on the receiver currently depend on the
     # sender's lease (observability: hub counters / describe)
     dependents: int = 0
+    # responder's store clock (monotonic ms) at ack time — same skew
+    # probe as BeatAck.clock_ms; 0 = old peer / no reading
+    clock_ms: int = 0
 
 
 @dataclass

@@ -5,6 +5,14 @@ snapshot timers — SURVEY.md §3.1 "Timers & queues").  asyncio-native: one
 task per timer instead of a hashed wheel; the multi-raft engine replaces
 per-group timers with tick-tensor deadlines (tpuraft.ops.tick), so this
 class only backs the single-group host runtime and the snapshot cadence.
+
+Time discipline (ISSUE 18): the delay is a DEADLINE on the injected
+clock, slept toward in bounded real-time slices — so a store whose
+ChaosClock runs 1.1x fast fires its election timers early, a frozen
+clock never fires them, and a forward jump fires them immediately,
+exactly like a real machine with that clock.  With the default
+SystemClock one slice covers the whole delay and the loop degenerates
+to the single ``asyncio.sleep`` it always was.
 """
 
 from __future__ import annotations
@@ -13,14 +21,21 @@ import asyncio
 import random
 from typing import Awaitable, Callable, Optional
 
+from tpuraft.util import clock as _clockmod
+
 
 class RepeatedTimer:
+    #: real-seconds cap per sleep slice under an injected clock: the
+    #: lag bound between a clock fault landing and the timer noticing
+    _SLICE_S = 0.05
+
     def __init__(
         self,
         name: str,
         timeout_ms: int,
         on_trigger: Callable[[], Awaitable[None]],
         adjust: Optional[Callable[[int], int]] = None,
+        clock: Optional[object] = None,
     ):
         """``adjust`` maps the base timeout to the actual per-round delay —
         e.g. randomized election timeouts (reference: NodeImpl's
@@ -29,6 +44,7 @@ class RepeatedTimer:
         self._timeout_ms = timeout_ms
         self._on_trigger = on_trigger
         self._adjust = adjust or (lambda t: t)
+        self._clock = _clockmod.resolve(clock)
         self._task: Optional[asyncio.Task] = None
         self._stopped = True
         self._destroyed = False
@@ -48,9 +64,25 @@ class RepeatedTimer:
         delay = self._adjust(self._timeout_ms) / 1000.0
         self._task = asyncio.ensure_future(self._run(delay))
 
+    async def _sleep(self, delay: float) -> None:
+        """Sleep until ``delay`` elapses ON THE TIMER'S CLOCK."""
+        clock = self._clock
+        if clock is _clockmod.SYSTEM:
+            await asyncio.sleep(delay)
+            return
+        deadline = clock.monotonic() + delay
+        while True:
+            rem = deadline - clock.monotonic()
+            if rem <= 0:
+                return
+            # bounded slices: a frozen clock parks here (rem never
+            # shrinks) without spinning, and a rate change lands within
+            # one slice instead of after the stale full delay
+            await asyncio.sleep(min(rem, self._SLICE_S))
+
     async def _run(self, delay: float) -> None:
         try:
-            await asyncio.sleep(delay)
+            await self._sleep(delay)
             if self._stopped or self._destroyed:
                 return
             await self._on_trigger()
