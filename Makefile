@@ -107,7 +107,14 @@ soak-long:
 bench-gate:
 	$(PY) bench_gate.py
 
-check: lint san test soak bench-gate
+# Mesh-mode lane-parity dryrun: 8 virtual CPU devices, one sharded
+# engine plane, and an assertion per [G] lane (witness commit clamp,
+# stepdown/priority ticks, device read fences, election delivery) —
+# the group-axis sharding can't silently drop a protocol lane.
+multichip-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench_multichip.py --smoke
+
+check: lint san test soak multichip-smoke bench-gate
 	@echo "make check: lint + native sanitizers + suite + soak + perf gate all green"
 	@echo "(consensus-path changes: also run make soak-long before merge;"
 	@echo " storage-path changes: also run make chaos-smoke)"
@@ -118,4 +125,4 @@ bench:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native san test lint soak chaos-smoke check bench bench-gate clean
+.PHONY: all native san test lint soak chaos-smoke check bench bench-gate multichip-smoke clean

@@ -4,6 +4,13 @@ committed configurations must not regress by more than the threshold
 
 Rows:
   e2e_commits_per_sec — a short `bench_e2e.py` run vs BENCH_E2E.json
+  engine_ticks_per_sec — the single-device engine tick rate at the
+                        committed leader-heavy shape (bench_multichip
+                        --engine-shape) vs BENCH_E2E.json
+                        extra.gate_engine_ticks_per_sec, so the mesh-
+                        mode work (ISSUE 19: witness clamp, stepdown
+                        lane, fence tallies in every tick) can't tax
+                        the single-device engine unnoticed.
   kv_ops_per_sec      — a short `bench_region_density.py` run (the full
                         RheaKV serving stack: batching client →
                         kv_command_batch → propose fan-out → coalesced
@@ -199,6 +206,28 @@ def _run_mp_once(extra: dict, duration: float) -> float:
     return float(row["ops_per_sec"])
 
 
+def _run_engine_once(extra: dict) -> float:
+    """One bench_multichip --engine-shape run: the single-device engine
+    tick rate at the committed leader-heavy shape (numpy tick path, no
+    mesh).  The row pins the per-tick host cost of the [G] lanes — the
+    group-axis sharding work must not tax the single-device engine."""
+    cmd = [sys.executable, os.path.join(REPO, "bench_multichip.py"),
+           "--engine-shape",
+           "--groups", str(extra.get("gate_engine_groups", 1024)),
+           "--duration", str(extra.get("gate_engine_duration_s", 2.0))]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    print("bench-gate:", " ".join(cmd), flush=True)
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if out.returncode != 0:
+        raise RuntimeError(f"engine shape bench failed "
+                           f"(rc={out.returncode}): {out.stderr[-300:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return float(json.loads(
+                line[len("RESULT "):])["engine_ticks_per_sec"])
+    raise RuntimeError("engine shape bench produced no RESULT line")
+
+
 def _gate(name: str, committed: float, run_once, threshold: float,
           retries: int) -> tuple[int, dict]:
     floor = committed * (1.0 - threshold)
@@ -260,10 +289,13 @@ def main() -> int:
                              for _ in range(2))
             mp_best = max(_run_mp_once(kv_extra, duration)
                           for _ in range(2))
+            engine_best = max(_run_engine_once(e2e_extra)
+                              for _ in range(2))
         except RuntimeError as exc:
             print(f"bench-gate: {exc}")
             return 2
         e2e_extra["gate_commits_per_sec"] = round(e2e_best, 1)
+        e2e_extra["gate_engine_ticks_per_sec"] = round(engine_best, 1)
         e2e_extra["gate_duration_s"] = duration
         e2e_base["extra"] = e2e_extra
         with open(e2e_path, "w") as f:
@@ -282,6 +314,8 @@ def main() -> int:
         print(json.dumps({"gate": "recorded",
                           "gate_commits_per_sec":
                               e2e_extra["gate_commits_per_sec"],
+                          "gate_engine_ticks_per_sec":
+                              e2e_extra["gate_engine_ticks_per_sec"],
                           "gate_kv_ops_per_sec":
                               kv_extra["gate_kv_ops_per_sec"],
                           "gate_read_ops_per_sec":
@@ -302,6 +336,24 @@ def main() -> int:
                     threshold, retries)
     worst = max(worst, rc)
     reports.append(rep)
+    if "gate_engine_ticks_per_sec" not in e2e_extra:
+        # the single-device engine shape (ISSUE 19) needs its own row:
+        # the mesh-mode sharding work lands new [G] lanes in every tick
+        # and this is the floor that keeps them honest on one device
+        print("bench-gate[engine_ticks_per_sec]: no calibration "
+              "(run `python bench_gate.py --record`)")
+        worst = max(worst, 2)
+        reports.append({"gate": "engine_ticks_per_sec",
+                        "verdict": "BROKEN",
+                        "error": "no gate_engine_ticks_per_sec "
+                                 "calibration"})
+    else:
+        rc, rep = _gate("engine_ticks_per_sec",
+                        float(e2e_extra["gate_engine_ticks_per_sec"]),
+                        lambda: _run_engine_once(e2e_extra),
+                        threshold, retries)
+        worst = max(worst, rc)
+        reports.append(rep)
     if "gate_kv_ops_per_sec" not in kv_extra:
         # no same-shape calibration — a silent pass would defeat the row
         print("bench-gate[kv_ops_per_sec]: no calibration "

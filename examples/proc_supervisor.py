@@ -206,6 +206,7 @@ def server_argv(endpoint: str, stores: list[str], regions: int, data: str,
                 transport: str = "tcp", store: str = "memory",
                 log_scheme: str = "file", pd: str = "",
                 eto_ms: int = 1000, apply_lane: bool = False,
+                engine: bool = False,
                 drain_timeout_s: float = 10.0, boot_delay_s: float = 0.0,
                 metrics_port: Optional[int] = 0) -> list[str]:
     """Command line for one ``examples.rheakv_server`` child."""
@@ -220,6 +221,8 @@ def server_argv(endpoint: str, stores: list[str], regions: int, data: str,
         argv += ["--pd", pd]
     if apply_lane:
         argv += ["--apply-lane"]
+    if engine:
+        argv += ["--engine"]
     if boot_delay_s:
         argv += ["--boot-delay", str(boot_delay_s)]
     if metrics_port is not None:
@@ -390,7 +393,8 @@ class ProcSupervisor:
 # ---------------------------------------------------------------------------
 
 async def _soak(seconds: float, stores_n: int, regions: int, data: str,
-                transport: str, apply_lane: bool) -> int:
+                transport: str, apply_lane: bool,
+                engine: bool = False) -> int:
     from examples.rheakv_server import client_for
     from tpuraft.util.linearizability import History, check_history
 
@@ -398,7 +402,8 @@ async def _soak(seconds: float, stores_n: int, regions: int, data: str,
     sup = ProcSupervisor([
         StoreProcess(ep, server_argv(
             ep, endpoints, regions, data, transport=transport,
-            eto_ms=500, apply_lane=apply_lane, metrics_port=None))
+            eto_ms=500, apply_lane=apply_lane, engine=engine,
+            metrics_port=None))
         for ep in endpoints])
     await sup.start()
     sup.supervise()
@@ -488,13 +493,18 @@ def main() -> None:
     ap.add_argument("--transport", choices=["tcp", "native"],
                     default="tcp")
     ap.add_argument("--apply-lane", action="store_true")
+    ap.add_argument("--engine", action="store_true",
+                    help="children drive their region nodes from ONE "
+                         "MultiRaftEngine each (fused [G] tick) instead "
+                         "of per-node timers")
     args = ap.parse_args()
     if not args.soak:
         ap.error("nothing to do (pass --soak)")
     import shutil
     shutil.rmtree(args.data, ignore_errors=True)
     rc = asyncio.run(_soak(args.seconds, args.stores, args.regions,
-                           args.data, args.transport, args.apply_lane))
+                           args.data, args.transport, args.apply_lane,
+                           engine=args.engine))
     sys.exit(rc)
 
 

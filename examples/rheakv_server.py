@@ -44,6 +44,7 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
                 metrics_port: int | None = None,
                 eto_ms: int = 1000,
                 apply_lane: bool = False,
+                engine: bool = False,
                 drain_timeout_s: float = 10.0,
                 boot_delay_s: float = 0.0) -> None:
     if boot_delay_s:
@@ -80,7 +81,20 @@ async def serve(endpoint: str, stores: list[str], n_regions: int,
     if pd_endpoints:
         from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
         pd_client = RemotePlacementDriverClient(transport, pd_endpoints)
-    engine = StoreEngine(opts, server, transport, pd_client=pd_client)
+    raft_engine = None
+    if engine:
+        # ONE MultiRaftEngine drives every region node of this store
+        # with a fused [G] tick (StoreEngine starts/stops it); capacity
+        # sized to the next power of two above the region count so
+        # splits can land without an immediate _grow
+        from tpuraft.core.engine import MultiRaftEngine
+        from tpuraft.options import TickOptions
+        cap = 1 << max(4, (n_regions + 3).bit_length())
+        raft_engine = MultiRaftEngine(TickOptions(
+            max_groups=cap, max_peers=max(4, len(stores) + 1),
+            tick_interval_ms=20))
+    engine = StoreEngine(opts, server, transport,
+                         multi_raft_engine=raft_engine, pd_client=pd_client)
     await engine.start()
     # SIGTERM = drain: bounce NEW work retryably (ERR_STORE_BUSY), wait
     # for everything already admitted to ack, then exit 0 — the process
@@ -150,6 +164,12 @@ def main() -> None:
                     help="run FSM applies + fenced reads on a dedicated "
                          "worker lane thread (one hot store saturates "
                          ">1 core)")
+    ap.add_argument("--engine", action="store_true",
+                    help="drive all region nodes from ONE MultiRaftEngine "
+                         "(fused [G] device/numpy tick) instead of "
+                         "per-node timers; witness members, priority "
+                         "re-election and device read fences all ride "
+                         "the engine lanes")
     ap.add_argument("--drain-timeout", type=float, default=10.0,
                     help="seconds to wait for in-flight work on SIGTERM")
     ap.add_argument("--boot-delay", type=float, default=0.0,
@@ -168,6 +188,7 @@ def main() -> None:
                           metrics_port=args.metrics_port,
                           eto_ms=args.eto_ms,
                           apply_lane=args.apply_lane,
+                          engine=args.engine,
                           drain_timeout_s=args.drain_timeout,
                           boot_delay_s=args.boot_delay))
     except KeyboardInterrupt:

@@ -724,11 +724,6 @@ async def run_soak(duration_s: float, n_stores: int, n_keys: int,
             "--witness fixes the last store as a witness member; "
             "--churn's random add/remove would fight that placement — "
             "run them separately")
-    if witness and engine:
-        raise ValueError(
-            "--witness needs timer-mode stores: the engine's device "
-            "ballot plane is not witness-aware yet (StoreEngine would "
-            "refuse at boot)")
     if quiesce and (transport != "inproc" or not engine):
         raise ValueError(
             "--quiesce hibernates engine-driven groups (TimerControl "

@@ -190,6 +190,14 @@ def test_numpy_twin_matches_device_tick_randomized():
         # election_due identically in both formulations (step_down and
         # lease_valid stay LIVE for quiescent leaders)
         eng.quiescent = rng.random(G) < 0.3
+        # witness lane (ISSUE 19): witness columns clamp the commit
+        # reduce to the best data-replica match in both formulations
+        eng.witness_mask = rng.random((G, P)) < 0.2
+        eng._n_witness_slots = int(eng.witness_mask.any(axis=1).sum())
+        # stepdown/priority + read-fence lanes
+        eng.stepdown_deadline = rng.integers(0, 2000, G)
+        eng.fence_start = np.where(rng.random(G) < 0.4,
+                                   rng.integers(0, 1500, G), _NEG_I32)
         rel = rng.integers(0, 100, (G, P)).astype(np.int32)
         commit_now = rng.integers(0, 40, G).astype(np.int32)
         now = int(rng.integers(500, 1500))
@@ -209,14 +217,131 @@ def test_numpy_twin_matches_device_tick_randomized():
             last_ack=eng.last_ack.astype(np.int32),
             snap_deadline=eng.snap_deadline.astype(np.int32),
             quiescent=eng.quiescent.copy(),
+            witness_mask=eng.witness_mask.copy(),
+            stepdown_deadline=eng.stepdown_deadline.astype(np.int32),
+            fence_start=eng.fence_start.astype(np.int32),
         )
         _, dev_out = raft_tick(state, np.int32(now),
                                TickParams.make(eng.eto_ms, eng.hb_ms,
                                                eng.lease_ms, eng.snap_ms))
         for field in ("commit_rel", "commit_advanced", "elected",
                       "election_due", "step_down", "hb_due",
-                      "lease_valid", "snap_due", "q_ack"):
+                      "lease_valid", "snap_due", "q_ack",
+                      "stepdown_due", "fence_ok"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(dev_out, field)),
                 np.asarray(getattr(np_out, field)),
                 err_msg=f"trial {trial}: {field} diverged")
+
+
+def test_witness_clamp_enumeration_matches_host_and_quorum_math():
+    """Enumerate EVERY witness subset of 3..6-voter confs (plus seeded
+    joint-consensus variants) and cross-check the three formulations of
+    the witness commit clamp against each other:
+
+    - the device kernel: ops.ballot.joint_quorum_match_index followed
+      by ops.ballot.witness_commit_clamp, batched as one [G] row per
+      enumerated case;
+    - the scalar host oracle: ballot_box.commit_point (the BallotBox
+      data-clamp the device plane mirrors since ISSUE 19);
+    - util.quorum's enumeration-by-majorities classification: for any
+      VALID conf (witness_minority) every majority holds a data peer,
+      so the clamp provably never binds — and for degenerate
+      witness-majority rows (witness_only_majorities non-empty) the
+      clamped commit never exceeds the best data-replica match.
+    """
+    from itertools import combinations
+
+    from tpuraft.conf import Configuration
+    from tpuraft.core.ballot_box import commit_point
+    from tpuraft.entity import PeerId
+    from tpuraft.ops.ballot import (
+        joint_quorum_match_index,
+        witness_commit_clamp,
+    )
+    from tpuraft.util import quorum as uq
+
+    rng = np.random.default_rng(19)
+    COLS = 8
+    peers = [PeerId(f"10.0.0.{i + 1}", 80, 0) for i in range(COLS)]
+    col = {p: i for i, p in enumerate(peers)}
+
+    cases = []  # (conf, old_conf, match row)
+    for n in range(3, 7):
+        voters = peers[:n]
+        for wn in range(0, n + 1):
+            for wit in combinations(range(n), wn):
+                for _ in range(2):
+                    conf = Configuration(
+                        list(voters), witnesses=[voters[i] for i in wit])
+                    cases.append((conf, Configuration(),
+                                  rng.integers(0, 30, COLS)))
+    # joint variants: overlapping old/new windows, independent subsets
+    for _ in range(60):
+        n_new, n_old = int(rng.integers(3, 6)), int(rng.integers(3, 6))
+        lo = int(rng.integers(0, 3))
+        new_v, old_v = peers[:n_new], peers[lo:lo + n_old]
+        conf = Configuration(
+            list(new_v), witnesses=[p for p in new_v if rng.random() < 0.3])
+        old = Configuration(
+            list(old_v), witnesses=[p for p in old_v if rng.random() < 0.3])
+        cases.append((conf, old, rng.integers(0, 30, COLS)))
+
+    G = len(cases)
+    match_m = np.zeros((G, COLS), np.int32)
+    vm = np.zeros((G, COLS), bool)
+    ovm = np.zeros((G, COLS), bool)
+    wm = np.zeros((G, COLS), bool)
+    for g, (conf, old, match) in enumerate(cases):
+        match_m[g] = match
+        for p in conf.peers:
+            vm[g, col[p]] = True
+        for p in old.peers:
+            ovm[g, col[p]] = True
+        for p in list(conf.witnesses) + list(old.witnesses):
+            wm[g, col[p]] = True
+
+    unclamped = np.asarray(joint_quorum_match_index(
+        jnp.asarray(match_m), jnp.asarray(vm), jnp.asarray(ovm)))
+    clamped = np.asarray(witness_commit_clamp(
+        jnp.asarray(unclamped), jnp.asarray(match_m), jnp.asarray(vm),
+        jnp.asarray(ovm), jnp.asarray(wm)))
+
+    for g, (conf, old, match) in enumerate(cases):
+        md = {p: int(match[col[p]])
+              for p in set(conf.peers) | set(old.peers)}
+        want = commit_point(md, conf, old)
+        assert clamped[g] == want, (
+            f"case {g}: device clamp {clamped[g]} != host commit_point "
+            f"{want} (conf={conf}, old={old}, match={md})")
+        if not old.is_empty():
+            continue  # the majority classification below is single-conf
+        voters, wits = set(conf.peers), set(conf.witnesses)
+        if uq.witness_minority(voters, wits):
+            # valid conf: every majority has a data peer (by
+            # enumeration), so the q-th-largest match is always covered
+            # by some data replica and the clamp must be a NO-OP
+            assert uq.every_majority_has_data_peer(voters, wits)
+            assert not uq.witness_only_majorities(voters, wits)
+            assert clamped[g] == unclamped[g], (
+                f"case {g}: clamp bound on a witness_minority conf "
+                f"(conf={conf}, match={md})")
+        elif wits:
+            # degenerate witness-majority row (set_conf does not
+            # validate; node-level is_valid() does): whatever commits
+            # must be held by a data replica — never a witness-only
+            # certification
+            data_best = max((md[p] for p in conf.data_peers()), default=0)
+            assert clamped[g] <= data_best
+
+    # deterministic binding case (the bench_multichip clamp probe in
+    # miniature): 1 data voter at 3, 2 witnesses at 9 -> the unclamped
+    # order statistic says 9, the clamp must pin commit to 3
+    probe_match = jnp.asarray([[3, 9, 9]], jnp.int32)
+    probe_vm = jnp.ones((1, 3), bool)
+    probe_ovm = jnp.zeros((1, 3), bool)
+    probe_wm = jnp.asarray([[False, True, True]])
+    q_idx = joint_quorum_match_index(probe_match, probe_vm, probe_ovm)
+    assert int(q_idx[0]) == 9
+    assert int(witness_commit_clamp(
+        q_idx, probe_match, probe_vm, probe_ovm, probe_wm)[0]) == 3

@@ -10,10 +10,43 @@ from tpuraft.ops.ballot import quorum_match_index  # noqa: E402
 from tpuraft.ops.tick import (  # noqa: E402
     ROLE_LEADER,
     GroupState,
+    TickOutputs,
     TickParams,
+    raft_tick,
 )
 from tpuraft.parallel.collective import replicated_tick  # noqa: E402
 from tpuraft.parallel.mesh import make_mesh, shard_group_state, sharded_tick  # noqa: E402
+
+_NEG = -(2**30)
+
+
+def _rand_full_state(rng, g, p):
+    """Randomized GroupState with EVERY field populated — the same
+    distribution as test_ops_tick's numpy-twin differential, so all the
+    ISSUE 19 lanes (witness clamp, stepdown cadence, read fences,
+    quiescence) are live in the sharded comparison too."""
+    s = GroupState.zeros(g, p)
+    s.role = jnp.asarray(rng.integers(0, 4, g).astype(np.int32))
+    s.commit_rel = jnp.asarray(rng.integers(0, 40, g).astype(np.int32))
+    s.pending_rel = jnp.asarray(rng.integers(1, 20, g).astype(np.int32))
+    s.match_rel = jnp.asarray(rng.integers(0, 100, (g, p)).astype(np.int32))
+    s.granted = jnp.asarray(rng.random((g, p)) < 0.4)
+    s.voter_mask = jnp.asarray(rng.random((g, p)) < 0.7)
+    s.old_voter_mask = jnp.asarray(np.where(
+        (rng.random(g) < 0.2)[:, None], rng.random((g, p)) < 0.5, False))
+    s.elect_deadline = jnp.asarray(rng.integers(0, 2500, g).astype(np.int32))
+    s.hb_deadline = jnp.asarray(rng.integers(0, 2500, g).astype(np.int32))
+    s.last_ack = jnp.asarray(np.where(
+        rng.random((g, p)) < 0.8,
+        rng.integers(0, 1500, (g, p)), _NEG).astype(np.int32))
+    s.snap_deadline = jnp.asarray(rng.integers(0, 3000, g).astype(np.int32))
+    s.quiescent = jnp.asarray(rng.random(g) < 0.3)
+    s.witness_mask = jnp.asarray(rng.random((g, p)) < 0.2)
+    s.stepdown_deadline = jnp.asarray(
+        rng.integers(0, 2500, g).astype(np.int32))
+    s.fence_start = jnp.asarray(np.where(
+        rng.random(g) < 0.4, rng.integers(0, 1500, g), _NEG).astype(np.int32))
+    return s
 
 
 def test_mesh_has_8_devices():
@@ -86,3 +119,90 @@ def test_replicated_tick_3_replicas():
     want_commit = np.sort(match, axis=0)[::-1][q - 1]
     np.testing.assert_array_equal(np.asarray(commit), want_commit)
     np.testing.assert_array_equal(np.asarray(votes), granted.sum(axis=0))
+
+
+def test_sharded_tick_bitwise_matches_single_device_multiround():
+    """ISSUE 19 acceptance: the 8-way group-sharded tick must stay
+    BIT-IDENTICAL to the single-device tick across MULTI-ROUND state
+    evolution with every [G] lane populated (witness masks, stepdown
+    deadlines, read fences, quiescence, joint configs).  Odd rounds
+    feed each path's own carried state straight back in (the sharded
+    arrays stay resident on the mesh); even-round boundaries apply one
+    seeded host perturbation — fresh acks, appended entries, newly
+    armed fences — identically to both, so commits keep advancing and
+    deadline re-arms keep firing instead of the state going quiescent
+    after round one."""
+    mesh = make_mesh()
+    G, P = 64, 5
+    rng = np.random.default_rng(1907)
+    local = _rand_full_state(rng, G, P)
+    sh = shard_group_state(local, mesh)
+    tick_sh = sharded_tick(mesh, donate=False)
+    params = TickParams.make(1000, 100, 900, 1500)
+    state_fields = list(GroupState.__dataclass_fields__)
+    out_fields = list(TickOutputs.__dataclass_fields__)
+    now = 100
+    for r in range(8):
+        now += int(rng.integers(120, 400))
+        nl, ol = raft_tick(local, jnp.int32(now), params)
+        ns, os_ = tick_sh(sh, jnp.int32(now), params)
+        for f in state_fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ns, f)), np.asarray(getattr(nl, f)),
+                err_msg=f"round {r}: new_state.{f} diverged")
+        for f in out_fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(os_, f)), np.asarray(getattr(ol, f)),
+                err_msg=f"round {r}: outputs.{f} diverged")
+        # the carried result never silently gathers back to one device
+        assert len(ns.commit_rel.sharding.device_set) == 8
+        local, sh = nl, ns
+        if r % 2 == 1:
+            h = jax.tree_util.tree_map(np.asarray, nl)
+            h.match_rel = (h.match_rel
+                           + rng.integers(0, 6, (G, P))).astype(np.int32)
+            h.last_ack = np.where(rng.random((G, P)) < 0.5, now,
+                                  h.last_ack).astype(np.int32)
+            h.granted = rng.random((G, P)) < 0.4
+            h.fence_start = np.where(
+                rng.random(G) < 0.3, now - rng.integers(0, 200, G),
+                h.fence_start).astype(np.int32)
+            local = jax.tree_util.tree_map(jnp.asarray, h)
+            sh = shard_group_state(local, mesh)
+
+
+def test_sharded_deadline_fold_matches_host_scan():
+    """The mesh-mode earliest-deadline reduction (one collective min)
+    must agree with the engine's host-side numpy scan
+    (MultiRaftEngine._next_deadline) on random role/quiescence/ctrl
+    mixes — including the stepdown-deadline row ISSUE 19 added to both
+    formulations — and return the DEADLINE_NONE_I32 sentinel when no
+    slot schedules anything."""
+    from tpuraft.parallel.mesh import DEADLINE_NONE_I32, sharded_deadline_fold
+
+    mesh = make_mesh()
+    fold = sharded_deadline_fold(mesh)
+    rng = np.random.default_rng(3)
+    G = 128
+    for trial in range(8):
+        role = rng.integers(0, 4, G).astype(np.int32)
+        quiescent = rng.random(G) < 0.3
+        has_ctrl = rng.random(G) < 0.7
+        elect = rng.integers(0, 1 << 20, G).astype(np.int32)
+        hb = rng.integers(0, 1 << 20, G).astype(np.int32)
+        stepdown = rng.integers(0, 1 << 20, G).astype(np.int32)
+        got = int(fold(role, quiescent, has_ctrl, elect, hb, stepdown))
+        awake = has_ctrl & ~quiescent
+        ec = awake & (role <= 1)
+        ld = awake & (role == 2)
+        want = int(DEADLINE_NONE_I32)
+        if ec.any():
+            want = min(want, int(elect[ec].min()))
+        if ld.any():
+            want = min(want, int(hb[ld].min()))
+            want = min(want, int(stepdown[ld].min()))
+        assert got == want, f"trial {trial}: fold {got} != host scan {want}"
+    # every slot uncontrolled -> the sentinel, not a garbage min
+    none = int(fold(np.full(G, 2, np.int32), np.zeros(G, bool),
+                    np.zeros(G, bool), elect, hb, stepdown))
+    assert none == int(DEADLINE_NONE_I32)

@@ -136,3 +136,151 @@ async def test_witness_priority_never_raises_target():
                 f"({n.target_priority})")
     finally:
         await c.stop_all()
+
+
+class _EnginePriorityCluster:
+    """The engine-lane mirror of ``_priority_cluster``: 3 endpoints x 1
+    group, each endpoint hosting ONE MultiRaftEngine whose tick plane
+    schedules the periodic stepdown scan (Node._check_dead_nodes) for
+    its leaders.  Before ISSUE 19 the engine only fired that handler on
+    DEAD quorums, so engine-backed decay leaders never accrued
+    priority_transfer_rounds and leadership stuck wherever the decay
+    left it — the transfer-back test below pins the restored cadence."""
+
+    def __init__(self, prios, election_timeout_ms=150, tick_ms=5):
+        from tpuraft.rpc.transport import InProcNetwork
+
+        self.net = InProcNetwork()
+        self.peers = [PeerId("127.0.0.1", 6100 + i, 0, pr)
+                      for i, pr in enumerate(prios)]
+        self.conf = Configuration(list(self.peers))
+        self.gid = "prio_engine_group"
+        self.election_timeout_ms = election_timeout_ms
+        self.tick_ms = tick_ms
+        self.nodes = {}
+        self.engines = {}
+        self.fsms = {}
+
+    async def start(self, peer):
+        from tests.cluster import MockStateMachine
+        from tpuraft.core.engine import MultiRaftEngine
+        from tpuraft.core.node import Node
+        from tpuraft.core.node_manager import NodeManager
+        from tpuraft.options import NodeOptions, TickOptions
+        from tpuraft.rpc.transport import InProcTransport, RpcServer
+
+        server = RpcServer(peer.endpoint)
+        manager = NodeManager(server)
+        self.net.bind(server)
+        self.net.start_endpoint(peer.endpoint)
+        transport = InProcTransport(self.net, peer.endpoint)
+        # backend pinned to jax (conftest's CPU default resolves "auto"
+        # to numpy): the point is the DEVICE tick's stepdown lane
+        engine = MultiRaftEngine(TickOptions(
+            max_groups=4, max_peers=8, tick_interval_ms=self.tick_ms,
+            backend="jax"))
+        await engine.start()
+        fsm = MockStateMachine()
+        opts = NodeOptions(
+            election_timeout_ms=self.election_timeout_ms,
+            initial_conf=self.conf.copy(), fsm=fsm,
+            log_uri="memory://", raft_meta_uri="memory://")
+        node = Node(self.gid, peer, opts, transport,
+                    ballot_box_factory=engine.ballot_box_factory())
+        node.node_manager = manager
+        manager.add(node)
+        assert await node.init()
+        self.engines[peer] = engine
+        self.nodes[peer] = node
+        self.fsms[peer] = fsm
+        return node
+
+    async def start_all(self):
+        for p in self.peers:
+            await self.start(p)
+
+    async def stop(self, peer):
+        """Crash-stop the whole endpoint: node, engine, network."""
+        self.net.stop_endpoint(peer.endpoint)
+        node = self.nodes.pop(peer, None)
+        engine = self.engines.pop(peer, None)
+        if node:
+            self.net.unbind(peer.endpoint)
+            await node.shutdown()
+        if engine:
+            await engine.shutdown()
+
+    async def stop_all(self):
+        for p in list(self.nodes):
+            await self.stop(p)
+
+    async def wait_leader(self, timeout_s=5.0):
+        from tpuraft.core.node import State
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            leaders = [n for n in self.nodes.values()
+                       if n.state == State.LEADER]
+            if len(leaders) == 1:
+                return leaders[0]
+            await asyncio.sleep(0.02)
+        raise TimeoutError(
+            f"no leader in {timeout_s}s; states="
+            f"{[(str(p), n.state.value) for p, n in self.nodes.items()]}")
+
+    async def apply_ok(self, node, data, timeout_s=5.0):
+        from tpuraft.entity import Task
+        from tpuraft.errors import RaftError
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            fut = asyncio.get_running_loop().create_future()
+            await node.apply(Task(data=data,
+                                  done=lambda st: fut.set_result(st)))
+            st = await asyncio.wait_for(
+                fut, max(0.1, deadline - time.monotonic()))
+            if (st.is_ok() or st.raft_error != RaftError.EPERM
+                    or time.monotonic() >= deadline):
+                return st
+            await asyncio.sleep(0.05)
+            try:
+                node = await self.wait_leader(
+                    max(0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                return st
+
+
+@pytest.mark.asyncio
+async def test_engine_leadership_transfers_back_after_high_priority_heals():
+    """ISSUE 19 stepdown lane end-to-end: an ENGINE-backed decay leader
+    (priority 40, elected while the 80 was dead) must hand leadership
+    back once the 80-node heals — which requires the device tick's
+    stepdown_due lane to keep delivering _check_dead_nodes rounds, the
+    cadence that accrues priority_transfer_rounds.  Mirrors the
+    timer-mode test_leadership_transfers_back_after_high_priority_heals
+    above, with every node's ballot box on a MultiRaftEngine."""
+    c = _EnginePriorityCluster([80, 40, 20], election_timeout_ms=150)
+    await c.start_all()
+    try:
+        leader = await _wait_leader_priority(c, 80)
+        high = leader.server_id
+        st = await c.apply_ok(leader, b"v1")
+        assert st.is_ok()
+        await c.stop(high)
+        low_leader = await _wait_leader_priority(c, 40)
+        st = await c.apply_ok(low_leader, b"v2")
+        assert st.is_ok()
+        low_engine = c.engines[low_leader.server_id]
+        # the high-priority zone heals (amnesiac restart, like the
+        # timer-mode test: memory:// storage, caught up over the wire)
+        await c.start(high)
+        healed = await _wait_leader_priority(c, 80, timeout_s=20.0)
+        assert healed.server_id == high
+        assert low_leader.metrics.counters.get("priority-transfers", 0) >= 1
+        # and the cadence really came from the engine's device lane
+        assert low_engine.stepdown_ticks > 0, \
+            "transfer happened without a single engine stepdown tick?"
+        st = await c.apply_ok(healed, b"v3")
+        assert st.is_ok()
+    finally:
+        await c.stop_all()

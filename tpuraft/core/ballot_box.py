@@ -25,19 +25,24 @@ from tpuraft.errors import Status
 
 def commit_point(match: dict[PeerId, int], conf: Configuration,
                  old_conf: Configuration) -> int:
-    """Scalar mirror of ops.ballot.joint_quorum_match_index — PLUS the
-    witness data-clamp the device kernel does not have (which is why
-    StoreEngine refuses witness confs on engine-backed stores).
+    """Scalar mirror of ops.ballot.joint_quorum_match_index PLUS the
+    witness data-clamp — the device kernel carries the same clamp
+    (ops.ballot.witness_commit_clamp), and the two are differentially
+    enumerated against each other in test_ops_tick.
 
     Witness-aware: witnesses are ordinary voters in the order statistic
     (they ack metadata appends), but the commit point is additionally
     CLAMPED to the best DATA replica's match — an index no data voter
     has stored must never commit, however many witness acks it holds.
-    Normally a no-op (the leader is always a data replica and its own
-    match row covers the tail), so this is defense in depth against a
-    witness-only quorum certifying payload-free commits (the ISSUE's
-    witness-majority-must-not-commit case, enumerated in
-    tests/test_witness.py against util/quorum.py)."""
+    A "data replica" is a voter that is a witness in NEITHER config:
+    the replication plane strips payloads for a peer flagged witness in
+    either conf (Node#peer_is_witness), so a data-in-old voter being
+    demoted to witness holds no payload for joint-window entries and
+    must not anchor the clamp.  Normally a no-op (the leader is always
+    a data replica and its own match row covers the tail), so this is
+    defense in depth against a witness-only quorum certifying
+    payload-free commits (the ISSUE's witness-majority-must-not-commit
+    case, enumerated in tests/test_witness.py against util/quorum.py)."""
 
     def order_stat(peers: list[PeerId]) -> int:
         vals = sorted((match.get(p, 0) for p in peers), reverse=True)
@@ -49,7 +54,8 @@ def commit_point(match: dict[PeerId, int], conf: Configuration,
     if not old_conf.is_empty():
         new_q = min(new_q, order_stat(old_conf.peers))
     if conf.witnesses or old_conf.witnesses:
-        data = set(conf.data_peers()) | set(old_conf.data_peers())
+        wits = set(conf.witnesses) | set(old_conf.witnesses)
+        data = (set(conf.peers) | set(old_conf.peers)) - wits
         data_best = max((match.get(p, 0) for p in data), default=0)
         new_q = min(new_q, data_best)
     return new_q

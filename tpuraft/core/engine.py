@@ -202,6 +202,9 @@ class EngineControl:
 
     drives_heartbeats = True
     drives_snapshots = True
+    # the device tick tallies SAFE ReadIndex rounds (fence_ok lane):
+    # ReadConfirmBatcher checks this to skip its host-side per-ack set
+    drives_read_fences = True
 
     def __init__(self, engine: "MultiRaftEngine", node, box: TpuBallotBox):
         self.engine = engine
@@ -363,6 +366,9 @@ class EngineControl:
         # not instantly on a fresh leader with silent followers
         e.last_ack[s, :] = now
         e.hb_deadline[s] = now       # beat on the next tick
+        # periodic stepdown/priority cadence (the reference's
+        # stepDownTimer at eto/2): first check one half-timeout out
+        e.stepdown_deadline[s] = now + max(1, self._eto_ms // 2)
         e.granted[s, :] = False
         e.mark_dirty()
 
@@ -382,6 +388,23 @@ class EngineControl:
             ms = e.to_ms(when)
             if ms > e.last_ack[self.slot, col]:
                 e.last_ack[self.slot, col] = ms
+                # acks deliberately don't wake the tick (eager_commit
+                # note in TpuBallotBox.commit_at) — EXCEPT while a read
+                # fence is pending: its resolution IS this tick's q_ack
+                # reduction, so the ack that completes the fence quorum
+                # must drive a tick instead of waiting out a deadline
+                if e.fence_start[self.slot] > _NEG_I32:
+                    e.mark_dirty()
+
+    # -- device read-fence plane (ReadConfirmBatcher rounds) -----------------
+
+    def arm_read_fence(self, fence) -> None:
+        """Register a pending SAFE ReadIndex round: the device tick's
+        fence_ok lane calls ``fence.note_quorum()`` once the fused q_ack
+        reduction reaches the round's start time.  ``fence`` needs
+        ``note_quorum()`` and a ``done`` property (store_engine's
+        _GroupFence); round-timeout cleanup stays with the caller."""
+        self.engine.arm_read_fence(self.slot, fence)
 
     def _quorum_ack_ms(self) -> int:
         """q-th newest voter ack (joint-consensus aware), host-side from
@@ -696,7 +719,8 @@ class _NpOutputs:
     """numpy TickOutputs twin (backend="numpy" fallback)."""
 
     __slots__ = ("commit_rel", "commit_advanced", "elected", "election_due",
-                 "step_down", "hb_due", "lease_valid", "snap_due", "q_ack")
+                 "step_down", "hb_due", "lease_valid", "snap_due", "q_ack",
+                 "stepdown_due", "fence_ok")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -763,6 +787,28 @@ class MultiRaftEngine:
         self.tick_q_ack = np.full(g, _NEG_I32, np.int64)
         self.lease_lane_hits = 0     # lease reads answered off the row
         self.lease_lane_misses = 0   # fell back to the host-side sort
+        # witness voters (either config): metadata-only replicas — they
+        # vote and ack, but the device commit reduce clamps to the best
+        # DATA-replica match (ballot.witness_commit_clamp).
+        # lane: no-shift — bool mask
+        self.witness_mask = np.zeros((g, p), bool)
+        self._n_witness_slots = 0    # steady-state clamp skip when zero
+        # periodic stepdown/priority lane (the reference's stepDownTimer,
+        # eto/2): fires Node._check_dead_nodes for engine leaders —
+        # dead-quorum re-verification AND priority_transfer_rounds
+        # accrual (decay-elected leaders hand leadership back).
+        # lane: no-conf — re-armed on leadership transitions (on_leader)
+        # and every fire, never by membership changes
+        self.stepdown_deadline = np.zeros(g, np.int64)
+        self.stepdown_ticks = 0      # stepdown_due fires applied
+        # device read-fence plane: earliest pending ReadConfirmBatcher
+        # round start per slot (NEG = none); the tick's fence_ok lane
+        # resolves rounds against the fused q_ack reduction instead of a
+        # host-side per-ack set tally.
+        self.fence_start = np.full(g, _NEG_I32, np.int64)
+        self._fence_waiters: dict[int, list] = {}  # slot -> [(start, fence)]
+        self.fence_lane_armed = 0    # rounds armed on the device lane
+        self.fence_lane_resolves = 0  # rounds resolved by fence_ok
         # store-lease plumbing for QUIESCENT LEADER slots: endpoint ->
         # {slot: [cols]} of last_ack cells refreshed by one store-lease
         # ack from that endpoint (flattened index arrays cached per
@@ -782,6 +828,7 @@ class MultiRaftEngine:
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self._tick_fn = None  # jitted raft_tick outputs (None => numpy path)
+        self._deadline_fold = None  # mesh mode: sharded earliest-deadline min
         self._params_dev = None
         self.ticks = 0
         self.commit_advances = 0
@@ -875,8 +922,15 @@ class MultiRaftEngine:
         self.elect_deadline -= shift
         self.hb_deadline -= shift
         self.snap_deadline -= shift
+        self.stepdown_deadline -= shift
         np.maximum(self.last_ack - shift, _NEG_I32, out=self.last_ack)
         np.maximum(self.tick_q_ack - shift, _NEG_I32, out=self.tick_q_ack)
+        # NEG rows stay NEG (no fence pending); armed rows shift with
+        # the epoch like the ack stamps they are compared against
+        np.maximum(self.fence_start - shift, _NEG_I32, out=self.fence_start)
+        for waiters in self._fence_waiters.values():
+            waiters[:] = [(max(start - shift, _NEG_I32 + 1), fence)
+                          for start, fence in waiters]
 
     # -- registry ------------------------------------------------------------
 
@@ -1036,6 +1090,9 @@ class MultiRaftEngine:
         self.hb_deadline = pad(self.hb_deadline)
         self.last_ack = pad(self.last_ack, _NEG_I32)
         self.tick_q_ack = pad(self.tick_q_ack, _NEG_I32)
+        self.witness_mask = pad(self.witness_mask)
+        self.stepdown_deadline = pad(self.stepdown_deadline)
+        self.fence_start = pad(self.fence_start, _NEG_I32)
         self.granted = pad(self.granted)
         self.self_col = pad(self.self_col, -1)
         self.has_ctrl = pad(self.has_ctrl)
@@ -1072,6 +1129,14 @@ class MultiRaftEngine:
         self.hb_deadline[s] = 0
         self.last_ack[s] = _NEG_I32
         self.tick_q_ack[s] = _NEG_I32
+        if self.witness_mask[s].any():
+            self._n_witness_slots -= 1
+        self.witness_mask[s] = False
+        self.stepdown_deadline[s] = 0
+        self.fence_start[s] = _NEG_I32
+        # pending fences die with the slot; the batcher round's timeout
+        # sweep resolves their futures False
+        self._fence_waiters.pop(s, None)
         self.granted[s] = False
         self.quiescent[s] = False
         self.note_wake_leader(s)
@@ -1110,16 +1175,36 @@ class MultiRaftEngine:
             del cols[peer]
         vm = np.zeros(self.P, bool)
         ovm = np.zeros(self.P, bool)
+        wm = np.zeros(self.P, bool)
         for peer in conf.peers:
             vm[cols[peer]] = True
         for peer in old_conf.peers:
             ovm[cols[peer]] = True
+        # witness columns (either config): the union mirrors the host
+        # BallotBox clamp's data set `conf.data_peers + old_conf
+        # .data_peers` — a column is data only if NEITHER config marks
+        # it witness
+        for peer in getattr(conf, "witnesses", ()) or ():
+            if peer in cols:
+                wm[cols[peer]] = True
+        for peer in getattr(old_conf, "witnesses", ()) or ():
+            if peer in cols:
+                wm[cols[peer]] = True
+        had_witness = bool(self.witness_mask[slot].any())
         self.voter_mask[slot] = vm
         self.old_voter_mask[slot] = ovm
+        self.witness_mask[slot] = wm
+        self._n_witness_slots += int(wm.any()) - int(had_witness)
         # the cached read-plane q_ack was reduced over the OLD voter set;
         # a shrunk conf can make it overstate the new quorum's freshness
         # (no longer a lower bound) — drop it until the next tick
         self.tick_q_ack[slot] = _NEG_I32
+        # pending read fences were armed against the old voter set too:
+        # drop the device lane for them (the batcher round's own timeout
+        # resolves their futures; a conf change mid-round is rare)
+        if self.fence_start[slot] > _NEG_I32:
+            self.fence_start[slot] = _NEG_I32
+            self._fence_waiters.pop(slot, None)
         if self.role[slot] == ROLE_LEADER:
             # grace window for peers ADDED mid-leadership (reference:
             # addReplicator stamps lastRpcSendTimestamp at start): a
@@ -1150,6 +1235,59 @@ class MultiRaftEngine:
     def mark_dirty(self) -> None:
         self._dirty = True
         self._dirty_event.set()
+
+    # -- device read-fence plane (ReadConfirmBatcher rounds) -----------------
+
+    def arm_read_fence(self, slot: int, fence) -> None:
+        """Queue a SAFE ReadIndex round on the device tally: the round
+        is confirmed once the fused q_ack reduction shows a voter quorum
+        acked at-or-after *now*.  ``fence_start[slot]`` carries the
+        EARLIEST pending round's start (a q_ack covering it covers every
+        later round the resolve pass walks)."""
+        start = self.now_ms()
+        self._fence_waiters.setdefault(slot, []).append((start, fence))
+        cur = self.fence_start[slot]
+        self.fence_start[slot] = start if cur <= _NEG_I32 else min(cur, start)
+        self.fence_lane_armed += 1
+        self.mark_dirty()
+
+    def discard_read_fence(self, slot: int, fence) -> None:
+        """Drop one fence from the device lane (round end/timeout) and
+        re-derive the row's earliest pending start.  Idempotent — a
+        fence the resolve pass already removed just isn't found."""
+        waiters = self._fence_waiters.get(slot)
+        if not waiters:
+            return
+        keep = [(start, f) for start, f in waiters if f is not fence]
+        if keep:
+            self._fence_waiters[slot] = keep
+            self.fence_start[slot] = min(start for start, _ in keep)
+        else:
+            self._fence_waiters.pop(slot, None)
+            self.fence_start[slot] = _NEG_I32
+
+    def _resolve_fences(self, s: int) -> None:
+        """fence_ok fired for slot ``s``: confirm every pending round
+        whose start the published q_ack covers, drop abandoned fences,
+        re-arm the row to the earliest still-pending start."""
+        waiters = self._fence_waiters.get(s)
+        if not waiters:
+            self.fence_start[s] = _NEG_I32
+            return
+        qa = int(self.tick_q_ack[s])
+        keep = []
+        for start, fence in waiters:
+            if start <= qa:
+                self.fence_lane_resolves += 1
+                fence.note_quorum()
+            elif not fence.done:
+                keep.append((start, fence))
+        if keep:
+            self._fence_waiters[s] = keep
+            self.fence_start[s] = min(start for start, _ in keep)
+        else:
+            self._fence_waiters.pop(s, None)
+            self.fence_start[s] = _NEG_I32
 
     # -- store-lease plumbing (quiescent leader slots) -----------------------
 
@@ -1211,6 +1349,10 @@ class MultiRaftEngine:
                 f"wake_events={self.wake_events} "
                 f"lease_lane_hits={self.lease_lane_hits} "
                 f"lease_lane_misses={self.lease_lane_misses} "
+                f"witness_groups={self._n_witness_slots} "
+                f"stepdown_ticks={self.stepdown_ticks} "
+                f"fence_armed={self.fence_lane_armed} "
+                f"fence_resolves={self.fence_lane_resolves} "
                 f"eto_floor_ms={self._floor_applied_ms} "
                 f"tick_p99_ms={self.tick_hists['tick_total_ms'].percentile(99):.3f}>")
 
@@ -1239,6 +1381,12 @@ class MultiRaftEngine:
             "quiescent": quiescent,
             "hibernation_fraction": round(quiescent / n, 4) if n else 0.0,
             "tick_cost_ema_ms": round(self._tick_cost_ema_s * 1e3, 3),
+            "witness_groups": self._n_witness_slots,
+            "stepdown_ticks": self.stepdown_ticks,
+            "fence_lane_armed": self.fence_lane_armed,
+            "fence_lane_resolves": self.fence_lane_resolves,
+            "fences_pending": sum(len(w) for w
+                                  in self._fence_waiters.values()),
         }
         # q_ack distribution: age of the quorum-newest ack per AWAKE
         # leader row (quiescent leaders ride the store lease; their rows
@@ -1330,18 +1478,20 @@ class MultiRaftEngine:
         if self._resolve_backend() != "numpy":
             import jax
 
-            from tpuraft.ops.tick import (raft_tick_outputs,
-                                          raft_tick_outputs_jit)
-            outputs_only = raft_tick_outputs
+            from tpuraft.ops.tick import raft_tick_outputs_jit
 
             if self.opts.mesh_devices and self.opts.mesh_devices > 1:
                 # SPMD over the group axis: each chip advances its own
                 # group rows; upload scatters, download gathers (the
-                # "vote-matrix over ICI" configuration in BASELINE.md)
-                from jax.sharding import NamedSharding, PartitionSpec
-                from tpuraft.ops.tick import (GroupState, TickOutputs,
-                                              TickParams)
-                from tpuraft.parallel.mesh import group_shardings, make_mesh
+                # "vote-matrix over ICI" configuration in BASELINE.md).
+                # The whole compilation lives in parallel/mesh.py
+                # (sharded_tick) — the engine consumes only the outputs
+                # half of the (new_state, outputs) pair, so with
+                # donate_state the input buffers are recycled into the
+                # (discarded) new_state on device and nothing but the
+                # [G] output rows crosses back to host.
+                from tpuraft.parallel.mesh import (make_mesh, sharded_tick,
+                                                   sharded_deadline_fold)
 
                 n = self.opts.mesh_devices
                 if self.G % n != 0:
@@ -1349,24 +1499,14 @@ class MultiRaftEngine:
                         f"max_groups={self.G} not divisible by "
                         f"mesh_devices={n}")
                 mesh = make_mesh(n)  # raises if fewer devices exist
-                row, mat = group_shardings(mesh)
-                scalar = NamedSharding(mesh, PartitionSpec())
-                state_sh = GroupState(
-                    role=row, commit_rel=row, pending_rel=row,
-                    match_rel=mat, granted=mat, voter_mask=mat,
-                    old_voter_mask=mat, elect_deadline=row,
-                    hb_deadline=row, last_ack=mat, snap_deadline=row,
-                    quiescent=row)
-                out_sh = TickOutputs(
-                    commit_rel=row, commit_advanced=row, elected=row,
-                    election_due=row, step_down=row, hb_due=row,
-                    lease_valid=row, snap_due=row, q_ack=row)
-                self._tick_fn = jax.jit(
-                    outputs_only,
-                    in_shardings=(state_sh, scalar,
-                                  TickParams(scalar, scalar, scalar,
-                                             scalar)),
-                    out_shardings=out_sh)
+                full_tick = sharded_tick(
+                    mesh, donate=self.opts.donate_state)
+                self._tick_fn = lambda state, now, params: \
+                    full_tick(state, now, params)[1]
+                # earliest-deadline scan as one sharded fold + collective
+                # min, instead of a host gather over every sharded row
+                # per loop iteration
+                self._deadline_fold = sharded_deadline_fold(mesh)
             else:
                 # the PROCESS-WIDE jitted instance: all engines share one
                 # trace cache, so only the first engine (per [G, P]
@@ -1419,10 +1559,22 @@ class MultiRaftEngine:
             self._task = None
 
     def _next_deadline(self) -> int:
-        """Earliest engine-scheduled deadline (election or heartbeat)
-        over controlled slots; a huge sentinel when none.  Quiescent
-        slots schedule NOTHING — a fully hibernated engine sleeps until
-        a dirty mark (wake, lease round, client traffic) arrives."""
+        """Earliest engine-scheduled deadline (election, heartbeat or
+        stepdown check) over controlled slots; a huge sentinel when
+        none.  Quiescent slots schedule NOTHING — a fully hibernated
+        engine sleeps until a dirty mark (wake, lease round, client
+        traffic) arrives.  Mesh mode folds the scan on device (one
+        sharded reduction + collective min) instead of gathering every
+        sharded row back per loop iteration."""
+        if self._deadline_fold is not None:
+            from tpuraft.parallel.mesh import DEADLINE_NONE_I32
+
+            nxt = int(self._deadline_fold(
+                self.role, self.quiescent, self.has_ctrl,
+                self.elect_deadline.astype(np.int32),
+                self.hb_deadline.astype(np.int32),
+                self.stepdown_deadline.astype(np.int32)))
+            return (1 << 60) if nxt >= int(DEADLINE_NONE_I32) else nxt
         hc = self.has_ctrl & ~self.quiescent
         ec = hc & ((self.role == ROLE_FOLLOWER) | (self.role == ROLE_CANDIDATE))
         ld = hc & (self.role == ROLE_LEADER)
@@ -1431,6 +1583,7 @@ class MultiRaftEngine:
             nxt = min(nxt, int(self.elect_deadline[ec].min()))
         if ld.any():
             nxt = min(nxt, int(self.hb_deadline[ld].min()))
+            nxt = min(nxt, int(self.stepdown_deadline[ld].min()))
         return nxt
 
     async def _loop(self) -> None:
@@ -1563,6 +1716,9 @@ class MultiRaftEngine:
             last_ack=self.last_ack.astype(np.int32),
             snap_deadline=self.snap_deadline.astype(np.int32),
             quiescent=self.quiescent,
+            witness_mask=self.witness_mask,
+            stepdown_deadline=self.stepdown_deadline.astype(np.int32),
+            fence_start=self.fence_start.astype(np.int32),
         )
         with jax.profiler.TraceAnnotation("tpuraft.raft_tick"):
             out = self._tick_fn(state, np.int32(now), self._params_dev)
@@ -1577,6 +1733,17 @@ class MultiRaftEngine:
         is_candidate = self.role == ROLE_CANDIDATE
 
         q = _np_joint_quorum(rel, vm, ovm)
+        if self._n_witness_slots:
+            # witness commit clamp (ballot.witness_commit_clamp's numpy
+            # twin): acked-by-witnesses-only indexes are not durable —
+            # clamp to the best data-replica match.  Skipped entirely
+            # while no registered conf carries witnesses (the steady
+            # state for most engines).
+            voters = vm | ovm
+            wm = self.witness_mask
+            has_w = (voters & wm).any(axis=1)
+            data_best = np.where(voters & ~wm, rel, 0).max(axis=1)
+            q = np.where(has_w, np.minimum(q, data_best), q).astype(np.int32)
         can_commit = is_leader & (q >= self.pending_rel)
         new_commit = np.where(can_commit, np.maximum(commit_rel_now, q),
                               commit_rel_now)
@@ -1613,6 +1780,9 @@ class MultiRaftEngine:
             snap_due=(self.role != ROLE_INACTIVE) & (self.snap_ms > 0)
             & (now >= self.snap_deadline),
             q_ack=q_ack,
+            stepdown_due=is_leader & awake & (now >= self.stepdown_deadline),
+            fence_ok=is_leader & (self.fence_start > _NEG_I32) & have_ack
+            & (q_ack >= self.fence_start),
         )
 
     def eager_commit_slot(self, s: int) -> bool:
@@ -1636,6 +1806,14 @@ class MultiRaftEngine:
         q = order_stat(self.voter_mask[s])
         if self.old_voter_mask[s].any():
             q = min(q, order_stat(self.old_voter_mask[s]))
+        if self._n_witness_slots:
+            # witness commit clamp, absolute-index domain (the scalar
+            # mirror of the device tick's ballot.witness_commit_clamp)
+            wm = self.witness_mask[s]
+            voters = self.voter_mask[s] | self.old_voter_mask[s]
+            if (voters & wm).any():
+                data = voters & ~wm
+                q = min(q, int(row[data].max()) if data.any() else 0)
         if q < self.base[s] + self.pending_rel[s] or q <= self.commit_abs[s]:
             return False
         self.commit_abs[s] = q
@@ -1680,6 +1858,22 @@ class MultiRaftEngine:
             if ctrl is not None:
                 ctrl.schedule("quorum_dead",
                               ctrl.node._on_engine_quorum_dead)
+        for s in np.nonzero(np.asarray(out.stepdown_due) & hc)[0]:
+            ctrl = self._ctrls[s]
+            if ctrl is None:
+                continue
+            # re-arm the host mirror NOW (the handler runs async; a
+            # same-deadline refire every tick would storm) on the
+            # timer-mode cadence: eto/2, the reference stepDownTimer.
+            self.stepdown_deadline[s] = now + max(1, int(self.eto_ms[s]) // 2)
+            self.stepdown_ticks += 1
+            # _check_dead_nodes re-verifies the quorum under the node
+            # lock AND accrues priority_transfer_rounds — the exact
+            # handler timer-mode runs, so decay-elected engine leaders
+            # transfer back with zero node-side special casing
+            ctrl.schedule("stepdown_tick", ctrl.node._check_dead_nodes)
+        for s in np.nonzero(np.asarray(out.fence_ok) & hc)[0]:
+            self._resolve_fences(int(s))
         hb_slots = np.nonzero(np.asarray(out.hb_due) & hc)[0]
         if hb_slots.size:
             self._flush_heartbeats(hb_slots, now)

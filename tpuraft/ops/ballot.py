@@ -83,6 +83,37 @@ def joint_vote_quorum(
     return jnp.where(in_joint, new_ok & old_ok, new_ok)
 
 
+def witness_commit_clamp(
+    quorum_idx: jnp.ndarray,
+    match: jnp.ndarray,
+    voter_mask: jnp.ndarray,
+    old_voter_mask: jnp.ndarray,
+    witness_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Clamp the commit point to the best DATA-replica match for groups
+    with witness voters.
+
+    Witnesses (util.quorum.witness_minority: a strict minority of
+    metadata-only voters) count toward vote and ack quorums but hold no
+    log payload, so an index acked only by witnesses must not commit —
+    the host BallotBox clamps its quorum index to ``max(match[data])``
+    (ballot_box.commit_point), and this is that clamp vectorized over
+    the [G] axis.  Data peers are every voter (either config — the
+    joint union mirrors the host's ``conf.data_peers + old_conf
+    .data_peers``) not marked witness; groups without witnesses pass
+    through untouched.  The max over an all-False data row is 0, like
+    the host's ``max(..., default=0)`` — a witness-only quorum can
+    never commit anything.
+    """
+    voters = voter_mask | old_voter_mask
+    has_witness = (voters & witness_mask).any(axis=-1)
+    data = voters & ~witness_mask
+    data_best = jnp.where(data, match.astype(jnp.int32),
+                          jnp.int32(0)).max(axis=-1)
+    return jnp.where(has_witness, jnp.minimum(quorum_idx, data_best),
+                     quorum_idx)
+
+
 def quorum_ack_time(last_ack: jnp.ndarray, voter_mask: jnp.ndarray) -> jnp.ndarray:
     """q-th most recent peer ack timestamp — the leader-lease / step-down
     primitive (reference: ``NodeImpl#checkDeadNodes``): the leader's lease

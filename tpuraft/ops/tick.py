@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpuraft.ops.ballot import NEG_INF_I32
+from tpuraft.ops.ballot import NEG_INF_I32, witness_commit_clamp
 from tpuraft.ops.quorum_pallas import fused_quorum
 
 # Role encoding (device plane). Learners are not a role: they sit in peer
@@ -65,6 +65,18 @@ class GroupState:
     # step_down stays LIVE for quiescent leaders — the host refreshes
     # their last_ack rows from store-lease acks, so a dead store still
     # deposes its quiescent leaders through ordinary ack staleness.
+    witness_mask: jnp.ndarray  # bool [G,P] witness voters (either config):
+    # metadata-only replicas that vote and ack but hold no log payload —
+    # the commit point is clamped to the best data-replica match
+    # (ballot.witness_commit_clamp, the vectorized BallotBox clamp)
+    stepdown_deadline: jnp.ndarray  # int32 [G] ms: leader's next periodic
+    # stepdown/priority check (the reference's stepDownTimer cadence,
+    # eto/2) — fires Node._check_dead_nodes, which re-verifies the quorum
+    # AND accrues priority_transfer_rounds toward transfer-back
+    fence_start: jnp.ndarray   # int32 [G] ms: earliest pending read-fence
+    # start time, NEG_INF when no fence is pending — the device resolves
+    # a ReadConfirmBatcher round when the fused q_ack reduction reaches
+    # it (fence_ok), replacing the per-round host-side ack-set tally
 
     @staticmethod
     def zeros(g: int, p: int) -> "GroupState":
@@ -81,6 +93,9 @@ class GroupState:
             last_ack=jnp.zeros((g, p), jnp.int32),
             snap_deadline=jnp.zeros((g,), jnp.int32),
             quiescent=jnp.zeros((g,), bool),
+            witness_mask=jnp.zeros((g, p), bool),
+            stepdown_deadline=jnp.zeros((g,), jnp.int32),
+            fence_start=jnp.full((g,), NEG_INF_I32, jnp.int32),
         )
 
 
@@ -126,6 +141,10 @@ class TickOutputs:
     # the last tick's row as a LOWER bound on the current quorum-ack time,
     # so per-read lease checks (ReadOnlyOption.LEASE_BASED) answer off the
     # fused reduction instead of re-sorting a [P] row per read
+    stepdown_due: jnp.ndarray   # bool [G] leader's periodic stepdown/
+    # priority check fired (Node._check_dead_nodes slow path)
+    fence_ok: jnp.ndarray       # bool [G] pending read fence satisfied:
+    # the quorum-ack point reached fence_start (host resolves + re-arms)
 
 
 def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
@@ -149,6 +168,14 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     # Entries before pending_rel belong to prior leaderships: never counted
     # (this IS the Raft §5.4.2 current-term commit gate — pending_rel is set
     # to lastLogIndex+1 at becomeLeader, mirroring BallotBox#resetPendingIndex).
+    # Witness confs: votes and acks count every voter (quorums above are
+    # correct as-is), but the COMMIT point is clamped to the best
+    # data-replica match — an index held only by metadata witnesses is
+    # not durable on any log.  Applied after fused_quorum so the fused
+    # reduction (including its pallas backend) stays witness-agnostic.
+    quorum_idx = witness_commit_clamp(
+        quorum_idx, state.match_rel, state.voter_mask,
+        state.old_voter_mask, state.witness_mask)
     can_commit = is_leader & (quorum_idx >= state.pending_rel)
     new_commit = jnp.where(
         can_commit, jnp.maximum(state.commit_rel, quorum_idx), state.commit_rel
@@ -178,6 +205,32 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
     step_down = is_leader & have_quorum_ack & (
         now_ms - q_ack >= params.election_timeout_ms
     )
+
+    # --- periodic stepdown/priority lane (RepeatedTimer stepDownTimer) -----
+    # Timer-mode nodes run _check_dead_nodes every eto/2 regardless of
+    # quorum health, and that cadence is what accrues
+    # priority_transfer_rounds (a decay-elected leader hands leadership
+    # back when a higher-priority peer recovers).  The engine previously
+    # only fired the handler on DEAD quorums, so engine leaders never
+    # transferred back — this lane restores the periodic cadence on
+    # device.  Quiescent leaders skip it: their quorum rides the store
+    # lease, and waking for a priority scan would defeat hibernation.
+    stepdown_due = is_leader & ~state.quiescent & (
+        now_ms >= state.stepdown_deadline)
+    new_stepdown_deadline = jnp.where(
+        stepdown_due,
+        now_ms + jnp.maximum(params.election_timeout_ms // 2, 1),
+        state.stepdown_deadline)
+
+    # --- device read-fence tally (ReadConfirmBatcher rounds) ---------------
+    # A pending SAFE ReadIndex round armed fence_start = its start time;
+    # the round is confirmed once a voter quorum acked AT OR AFTER it —
+    # exactly the fused q_ack order statistic already computed above, so
+    # the tally rides the existing reduction instead of a host-side
+    # per-round ack-set.  The host clears/re-arms fence_start (it owns
+    # the pending-fence queue); the row passes through unchanged.
+    fence_ok = is_leader & (state.fence_start > NEG_INF_I32) & \
+        have_quorum_ack & (q_ack >= state.fence_start)
 
     # --- heartbeat scheduling ---------------------------------------------
     # Quiescent leaders beat nothing: idle beat traffic collapses from
@@ -211,6 +264,9 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         last_ack=state.last_ack,
         snap_deadline=new_snap_deadline,
         quiescent=state.quiescent,
+        witness_mask=state.witness_mask,
+        stepdown_deadline=new_stepdown_deadline,
+        fence_start=state.fence_start,
     )
     outputs = TickOutputs(
         commit_rel=new_commit,
@@ -222,6 +278,8 @@ def raft_tick(state: GroupState, now_ms: jnp.ndarray, params: TickParams,
         lease_valid=lease_valid,
         snap_due=snap_due,
         q_ack=q_ack,
+        stepdown_due=stepdown_due,
+        fence_ok=fence_ok,
     )
     return new_state, outputs
 
@@ -243,3 +301,15 @@ def raft_tick_outputs(state: GroupState, now_ms: jnp.ndarray,
 # re-trace/re-compile (a ~0.5s event-loop stall per engine that round-1
 # style multi-engine tests turned into election storms).
 raft_tick_outputs_jit = jax.jit(raft_tick_outputs)
+
+
+def witness_lanes_available() -> bool:
+    """Does the loaded device plane carry the witness/priority/fence
+    parity lanes?  StoreEngine consults this before accepting a witness
+    conf on an engine-backed store: against an older tick kernel (e.g. a
+    stale deployment mixing wheel versions) the [G,P] ballot plane would
+    count witness acks as durable and commit unreplicated entries, so
+    the boot refusal stays — with an error that names the missing lane
+    rather than a blanket "engines can't do witnesses"."""
+    return ("witness_mask" in GroupState.__dataclass_fields__
+            and "fence_ok" in TickOutputs.__dataclass_fields__)

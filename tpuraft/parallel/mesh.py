@@ -66,11 +66,13 @@ def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
             role=row, commit_rel=row, pending_rel=row, match_rel=mat,
             granted=mat, voter_mask=mat, old_voter_mask=mat,
             elect_deadline=row, hb_deadline=row, last_ack=mat,
-            snap_deadline=row, quiescent=row)
+            snap_deadline=row, quiescent=row, witness_mask=mat,
+            stepdown_deadline=row, fence_start=row)
 
     out_outputs = TickOutputs(
         commit_rel=row, commit_advanced=row, elected=row, election_due=row,
-        step_down=row, hb_due=row, lease_valid=row, snap_due=row, q_ack=row)
+        step_down=row, hb_due=row, lease_valid=row, snap_due=row, q_ack=row,
+        stepdown_due=row, fence_ok=row)
     params_sharding = TickParams(scalar, scalar, scalar, scalar)
     return jax.jit(
         raft_tick,
@@ -78,3 +80,42 @@ def sharded_tick(mesh: Mesh, axis_name: str = "groups", donate: bool = True):
         out_shardings=(state_shardings(), out_outputs),
         donate_argnums=(0,) if donate else (),
     )
+
+
+# deadline-fold sentinel: "no engine-scheduled deadline on this shard" —
+# int32 max, NOT the engine's 1<<60 host sentinel (the fold runs in the
+# device's int32 time domain)
+DEADLINE_NONE_I32 = np.int32(2**31 - 1)
+
+
+def sharded_deadline_fold(mesh: Mesh, axis_name: str = "groups"):
+    """Compile the engine's earliest-deadline scan as ONE sharded
+    reduction: each device folds its own group rows (election deadlines
+    for awake followers/candidates, heartbeat + stepdown deadlines for
+    awake leaders) and a single collective min produces the scalar the
+    tick loop sleeps toward.  The host-side numpy equivalent
+    (MultiRaftEngine._next_deadline) would gather every sharded row back
+    to host per loop iteration — the exact per-iteration sync the mesh
+    mode exists to avoid.
+
+    Returns a jitted fn: (role, quiescent, has_ctrl, elect_deadline,
+    hb_deadline, stepdown_deadline) int32 [G] rows -> int32 scalar
+    (DEADLINE_NONE_I32 when no slot schedules anything).
+    """
+    row = NamedSharding(mesh, P(axis_name))
+    scalar = NamedSharding(mesh, P())
+
+    def fold(role, quiescent, has_ctrl, elect_deadline, hb_deadline,
+             stepdown_deadline):
+        awake = has_ctrl & ~quiescent
+        # ROLE_FOLLOWER == 0, ROLE_CANDIDATE == 1, ROLE_LEADER == 2
+        ec = awake & (role <= 1)
+        ld = awake & (role == 2)
+        none = jnp.int32(DEADLINE_NONE_I32)
+        nxt = jnp.min(jnp.where(ec, elect_deadline, none))
+        nxt = jnp.minimum(nxt, jnp.min(jnp.where(ld, hb_deadline, none)))
+        nxt = jnp.minimum(
+            nxt, jnp.min(jnp.where(ld, stepdown_deadline, none)))
+        return nxt
+
+    return jax.jit(fold, in_shardings=(row,) * 6, out_shardings=scalar)

@@ -260,7 +260,8 @@ class _GroupFence:
     joint) has acked IN TERM — stragglers then only delay other groups,
     never this one's readers."""
 
-    __slots__ = ("node", "term", "futs", "new_peers", "old_peers", "acked")
+    __slots__ = ("node", "term", "futs", "new_peers", "old_peers", "acked",
+                 "device")
 
     def __init__(self, node, futs: list) -> None:
         self.node = node
@@ -269,6 +270,9 @@ class _GroupFence:
         self.new_peers = set(node.conf_entry.conf.peers)
         self.old_peers = set(node.conf_entry.old_conf.peers)
         self.acked = {node.server_id}
+        # True when the quorum tally runs on the engine's device fence
+        # lane (EngineControl.arm_read_fence) instead of this host set
+        self.device = False
 
     def _quorum(self) -> bool:
         ok_new = (len(self.acked & self.new_peers)
@@ -288,6 +292,16 @@ class _GroupFence:
         self.acked.add(peer)
         if self._quorum():
             self.resolve(True)
+
+    def note_quorum(self) -> None:
+        """Device fence lane callback: the engine tick's fused q_ack
+        reduction covered this round's start.  Same (is_leader, term)
+        gate as the per-ack path — the device counts raw ack arrival
+        times, the host still vouches for the leadership pin."""
+        node = self.node
+        if not node.is_leader() or node.current_term != self.term:
+            return
+        self.resolve(True)
 
     def resolve(self, ok: bool) -> None:
         for fut in self.futs:
@@ -358,10 +372,11 @@ class ReadConfirmBatcher:
         self.beats = 0          # CompactBeat fence rows carried
         self.classic_beats = 0  # classic per-peer follow-ups/fallbacks
         self.failed = 0         # fences that ended unconfirmed
+        self.device_fences = 0  # fences tallied on the engine device lane
         # gauges bound to the live counters (the HeartbeatHub idiom)
         self.metrics = MetricRegistry()
         for name in ("confirms", "rounds", "beat_rpcs", "beats",
-                     "classic_beats", "failed"):
+                     "classic_beats", "failed", "device_fences"):
             self.metrics.gauge(f"read_batcher.{name}",
                                lambda n=name: getattr(self, n))
         self.metrics.gauge(
@@ -376,6 +391,7 @@ class ReadConfirmBatcher:
             "read_beats": self.beats,
             "read_classic_beats": self.classic_beats,
             "read_failed": self.failed,
+            "read_device_fences": self.device_fences,
         }
 
     def describe(self) -> str:
@@ -383,7 +399,8 @@ class ReadConfirmBatcher:
         return (f"ReadConfirmBatcher<confirms={self.confirms} "
                 f"rounds={self.rounds} reads_per_round={amort:.2f} "
                 f"beat_rpcs={self.beat_rpcs} beats={self.beats} "
-                f"classic={self.classic_beats} failed={self.failed}>")
+                f"classic={self.classic_beats} failed={self.failed} "
+                f"device_fences={self.device_fences}>")
 
     async def confirm(self, node) -> bool:
         """Enqueue one group's SAFE leadership fence; resolves True once
@@ -462,6 +479,16 @@ class ReadConfirmBatcher:
                 if not node.is_leader():
                     st.resolve(False)
                     continue
+                # engine-backed group: the quorum tally rides the device
+                # tick's fused q_ack reduction (the fence_ok lane) — the
+                # beats below still go out (they ARE the acks the lane
+                # counts), but the per-ack host set arithmetic is skipped
+                ctrl = getattr(node, "_ctrl", None)
+                if ctrl is not None and getattr(ctrl, "drives_read_fences",
+                                                False):
+                    ctrl.arm_read_fence(st)
+                    st.device = True
+                    self.device_fences += 1
                 voters = st.new_peers | st.old_peers
                 committed = node.ballot_box.last_committed_index
                 for r in node.replicators.all():
@@ -479,13 +506,36 @@ class ReadConfirmBatcher:
                                           ).append((st, r, beat))
                     else:
                         classic.append((st, r))
-                st.note_ack(node.server_id)  # self-only quorum case
+                if not st.device:
+                    st.note_ack(node.server_id)  # self-only quorum case
             await asyncio.gather(
                 *(self._beat_dst(dst, rows) for dst, rows in by_dst.items()),
                 *(self._classic(st, r) for st, r in classic))
         finally:
+            # device fences: the RPCs completed, so every ack this round
+            # can produce is already in the engine's last_ack rows — one
+            # forced tick per distinct engine reduces them and fires
+            # fence_ok NOW (the adaptive loop's own tick may be a task
+            # behind), so resolution is deterministic before the sweep
+            dev_pending = [st for st in order
+                           if st.device and not st.done]
+            if dev_pending:
+                engines = {id(st.node._ctrl.engine): st.node._ctrl.engine
+                           for st in dev_pending}
+                for eng in engines.values():
+                    try:
+                        eng.tick_once()
+                    except Exception:  # noqa: BLE001 — fall to the sweep
+                        LOG.exception("fence-resolve tick failed")
             failed_groups = 0
             for st in order:
+                if st.device:
+                    # the fence dies with the round either way; a void
+                    # entry left armed would pin fence_start and spin
+                    # dirty marks on every later ack
+                    ctrl = getattr(st.node, "_ctrl", None)
+                    if ctrl is not None:
+                        ctrl.engine.discard_read_fence(ctrl.slot, st)
                 if not st.done:
                     self.failed += 1
                     failed_groups += 1
@@ -530,9 +580,12 @@ class ReadConfirmBatcher:
             if getattr(ack, "ok", False):
                 # inline ack bookkeeping, exactly like the hub's fast
                 # path: the lease plane sees the (peer, when) write too
+                # (for device fences on_peer_ack IS the tally — it lands
+                # in the engine's last_ack row the fence_ok lane reduces)
                 r.last_rpc_ack = now
                 st.node.on_peer_ack(r.peer, now)
-                st.note_ack(r.peer)
+                if not st.device:
+                    st.note_ack(r.peer)
             else:
                 fallback.append((st, r))
         if fallback:
@@ -549,7 +602,9 @@ class ReadConfirmBatcher:
             ok = await r.send_heartbeat()
         except Exception:  # noqa: BLE001 — one peer's beat only
             return
-        if ok:
+        if ok and not st.device:
+            # device fences: send_heartbeat already recorded the ack
+            # arrival into the engine row the fence_ok lane reduces
             st.note_ack(r.peer)
 
 
@@ -1466,18 +1521,24 @@ class StoreEngine:
         # WITNESS — metadata-only journal, null FSM, never campaigns
         opts.witness = conf.is_witness(self.server_id)
         if conf.witnesses and self.multi_raft_engine is not None:
-            # the device ballot plane (ops/ballot, TpuBallotBox) has no
-            # witness-aware commit clamp: witness rows would count as
-            # plain data matches on device, silently dropping the third
-            # safety layer (ballot_box.commit_point's data clamp).
-            # Refuse LOUDLY instead of running witness regions with
-            # weaker guarantees than documented.
-            raise ValueError(
-                f"region {region.id}: witness members "
-                f"{[str(p) for p in conf.witnesses]} on an engine-backed "
-                f"store — the [G, P] device ballot plane is not "
-                f"witness-aware yet (ROADMAP item 4); host witness "
-                f"regions on timer-mode stores (no MultiRaftEngine)")
+            # the device plane is witness-aware since ISSUE 19 (the tick
+            # carries a witness_mask and clamps the commit reduce to the
+            # best DATA-replica match, mirroring ballot_box.commit_point)
+            # — but only on a tick module that actually has those lanes.
+            # A stale ops plane would count witness rows as plain data
+            # matches on device, silently dropping the third safety
+            # layer, so refuse LOUDLY rather than run witness regions
+            # with weaker guarantees than documented.
+            from tpuraft.ops.tick import witness_lanes_available
+            if not witness_lanes_available():
+                raise ValueError(
+                    f"region {region.id}: witness members "
+                    f"{[str(p) for p in conf.witnesses]} on an "
+                    f"engine-backed store, but this device tick plane "
+                    f"predates the witness commit clamp (no "
+                    f"witness_mask/fence_ok lanes) — upgrade tpuraft.ops "
+                    f"or host witness regions on timer-mode stores (no "
+                    f"MultiRaftEngine)")
         opts.raft_options.read_only_option = self.opts.read_only_option
         opts.raft_options.quiesce_after_rounds = \
             self.opts.quiesce_after_rounds
