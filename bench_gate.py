@@ -65,6 +65,15 @@ Rows:
                         which must stay within
                         BENCH_GATE_CLOCK_THRESHOLD (default 2%) of
                         the same-session uninjected measurement.
+  kv_ops_lifecycle_overhead — region-lifecycle gate (ISSUE 20): the kv
+                        row runs against a counting fake PD; this row
+                        re-runs the shape against a REAL placement
+                        driver with the lifecycle policy loop on and
+                        every actuator held idle, and must stay within
+                        BENCH_GATE_LIFECYCLE_THRESHOLD (default 3%) of
+                        the same-session fake-PD measurement — policy
+                        evaluation at 128 regions is pure PD-side scan
+                        work and must never tax the serving path.
 
 The committed JSONs are the contract, but gate runs are SHORT (boot +
 elections amortize worse over a 6 s window than over a full bench), so
@@ -124,6 +133,7 @@ def _run_kv_once(extra: dict, duration: float,
                  heat_off: bool = False,
                  disk_guard_off: bool = False,
                  chaos_clock: bool = False,
+                 lifecycle_pd: bool = False,
                  workers: int = 0) -> float:
     """One short bench_region_density run at the gate shape; returns
     KV ops/s through the full serving stack.  ``read_frac >= 0`` runs
@@ -134,7 +144,10 @@ def _run_kv_once(extra: dict, duration: float,
     ``disk_guard_off`` disables the disk budget / pressure plane (the
     disk-guard-overhead row's A/B comparator); ``chaos_clock`` routes
     every store's timing reads through an injected ChaosClock at rate
-    1.0 (the clock-overhead row's A/B comparator)."""
+    1.0 (the clock-overhead row's A/B comparator); ``lifecycle_pd``
+    replaces the counting fake PD with a real placement driver whose
+    lifecycle policy loop runs with every actuator held idle (the
+    lifecycle-overhead row's A/B comparator)."""
     regions = int(extra.get("gate_regions", 128))
     out_path = os.path.join(tempfile.mkdtemp(prefix="tpuraft_gate_kv_"),
                             "gate_regions.json")
@@ -162,6 +175,9 @@ def _run_kv_once(extra: dict, duration: float,
     if chaos_clock:
         cmd.append("--chaos-clock")
         key += "_ck"
+    if lifecycle_pd:
+        cmd.append("--lifecycle-pd")
+        key += "_lcpd"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     print("bench-gate:", " ".join(cmd), flush=True)
     rc = subprocess.call(cmd, env=env)
@@ -447,6 +463,26 @@ def main() -> int:
             worst = max(worst, rc)
             crep["uninjected"] = rep["measured"]
             reports.append(crep)
+            # lifecycle-overhead row (ISSUE 20): the kv row above runs
+            # against a counting FAKE PD; this row re-runs the SAME
+            # shape against a real placement driver whose lifecycle
+            # policy loop evaluates every heartbeat round with every
+            # actuator held idle (split/merge/move thresholds no run
+            # can cross), and must stay within 3% of the same-session
+            # fake-PD measurement — the policy scan over 128 regions'
+            # heat/stats can never grow per-op cost on the serving
+            # path without tripping CI.
+            lifecycle_threshold = float(os.environ.get(
+                "BENCH_GATE_LIFECYCLE_THRESHOLD", "0.03"))
+            rc, lrep = _gate(
+                "kv_ops_lifecycle_overhead",
+                float(rep["measured"]),
+                lambda: _run_kv_once(kv_extra, duration,
+                                     lifecycle_pd=True),
+                lifecycle_threshold, retries)
+            worst = max(worst, rc)
+            lrep["fake_pd"] = rep["measured"]
+            reports.append(lrep)
     if "gate_read_ops_per_sec" not in kv_extra:
         # the amortized read plane (ISSUE 10) needs its own regression
         # row — a silent pass without a calibration would defeat it

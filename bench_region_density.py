@@ -85,6 +85,42 @@ async def run_config(args) -> dict:
             CountingPD.heat_rows += len(heat or [])
             return [], False
 
+    # --lifecycle-pd: swap the counting fake for a REAL single-member
+    # placement driver running the region-lifecycle policy loop with
+    # every actuator held idle (thresholds/floors no run can cross), so
+    # the A/B row isolates the pure policy-evaluation cost riding the
+    # heartbeat stream — heat scoring, merge/move candidate scans —
+    # from any actual split/merge/move churn.
+    pd_server = None
+    pd_ep = "127.0.0.1:7600"
+    if args.lifecycle_pd:
+        from tpuraft.rheakv.pd_server import (PlacementDriverOptions,
+                                              PlacementDriverServer)
+
+        os.makedirs(f"{args.dir}/pd", exist_ok=True)
+        pd_rpc = RpcServer(pd_ep)
+        net.bind(pd_rpc)
+        pd_server = PlacementDriverServer(
+            PlacementDriverOptions(
+                endpoints=[pd_ep],
+                election_timeout_ms=args.election_timeout_ms,
+                data_path=f"{args.dir}/pd",
+                initial_regions=[r.copy() for r in regions],
+                lifecycle=True,
+                # actuation-idle knobs: the policy evaluates every
+                # heartbeat round but no decision can ever fire
+                lifecycle_heat_split_min_keys=1 << 30,
+                lifecycle_min_regions=R + 1,
+                lifecycle_move_imbalance=1 << 30,
+            ),
+            pd_ep, pd_rpc, InProcTransport(net, pd_ep))
+        await pd_server.start()
+        deadline = time.monotonic() + 30
+        while not (pd_server.node and pd_server.node.is_leader()):
+            if time.monotonic() > deadline:
+                raise RuntimeError("lifecycle PD failed to elect")
+            await asyncio.sleep(0.05)
+
     t0 = time.monotonic()
     engines, stores = [], []
     cap = 1 << max(4, (R + 3).bit_length())
@@ -133,10 +169,15 @@ async def run_config(args) -> dict:
             opts.read_only_option = ReadOnlyOption.LEASE_BASED
         if args.quiesce:
             opts.quiesce_after_rounds = 4
+        if args.lifecycle_pd:
+            from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+
+            pd_client = RemotePlacementDriverClient(transport, [pd_ep])
+        else:
+            pd_client = CountingPD([r.copy() for r in regions])
         store = StoreEngine(opts, server, transport,
                             multi_raft_engine=engine,
-                            pd_client=CountingPD(
-                                [r.copy() for r in regions]))
+                            pd_client=pd_client)
         # defer elections past boot (the bench_scale pattern): engine
         # deadlines move en masse after every store is up
         orig_start_region = store._start_region
@@ -381,6 +422,17 @@ async def run_config(args) -> dict:
                 s.heat.reads_noted for s in stores if s.heat),
         },
     }
+    if args.lifecycle_pd and pd_server is not None:
+        # the row's evidence: a real PD saw the whole fleet and ran the
+        # policy every round, yet ordered zero actuations (pure
+        # evaluation cost is the only delta vs the base kv row)
+        res["lifecycle_pd"] = {
+            "regions_known": len(pd_server.fsm.regions),
+            "heat_splits_ordered": pd_server.heat_splits_ordered,
+            "merges_ordered": pd_server.merges_ordered,
+            "merges_completed": pd_server.merges_completed,
+            "moves_ordered": pd_server.moves_ordered,
+        }
     if args.quiesce:
         res["quiescent_replicas_before"] = quiesced_before
         res["quiescent_replicas_after"] = quiesced_after
@@ -557,6 +609,11 @@ def main() -> None:
                     help="disable the disk budget / pressure plane "
                          "(the bench-gate disk-guard-overhead row's "
                          "A/B knob)")
+    ap.add_argument("--lifecycle-pd", action="store_true",
+                    help="run a REAL placement driver (lifecycle "
+                         "policy loop on, every actuator held idle) "
+                         "instead of the counting fake — the bench-"
+                         "gate lifecycle-overhead row's A/B knob")
     ap.add_argument("--no-write-batch", action="store_true",
                     help="disable the write plane (store-wide append "
                          "rounds, eager commits, ack-at-commit) — the "
@@ -611,6 +668,8 @@ def main() -> None:
         cmd.append("--chaos-clock")
     if args.no_write_batch:
         cmd.append("--no-write-batch")
+    if args.lifecycle_pd:
+        cmd.append("--lifecycle-pd")
     if args.profile_ticks > 0:
         cmd += ["--profile-ticks", str(args.profile_ticks)]
         if args.profile_ticks_out:
@@ -656,6 +715,8 @@ def main() -> None:
         key += "_ck"
     if args.no_write_batch:
         key += "_nowb"
+    if args.lifecycle_pd:
+        key += "_lcpd"
     out[key] = row
     with open(path, "w") as f:
         json.dump(out, f, indent=1)

@@ -44,6 +44,14 @@ PD (fleet) commands take --pd instead of --group/--peers:
     cluster [K]               print the PD leader's ClusterView: top-K
                               hot/cold regions, per-zone rates, store
                               health roster, hibernation fraction
+    regions                   per-region lifecycle view: keyspace range,
+                              epoch (version/conf_ver), leader, heat
+                              score and replica placement for EVERY
+                              region, plus pending merges and the PD's
+                              recent lifecycle decisions (heat splits /
+                              cold merges / cross-store moves;
+                              docs/operations.md "Region lifecycle
+                              runbook")
     pd-metrics                scrape the PD leader's Prometheus text
 """
 
@@ -118,6 +126,65 @@ def _prom_values(text: str) -> dict:
     return vals
 
 
+def _fmt_key(k: bytes, end: bool = False) -> str:
+    if not k:
+        # an empty key means -inf as a start bound, +inf as an end bound
+        return "+inf" if end else "-inf"
+    try:
+        return k.decode("ascii")
+    except UnicodeDecodeError:
+        return k.hex()
+
+
+def _print_regions_view(regions: list, view: dict) -> None:
+    heat = {r["region"]: r
+            for r in view.get("hot", []) + view.get("cold", [])}
+    hot_flagged = set(view.get("hot_flagged", []))
+    leaders = {r["region"]: r.get("leader", "") for r in heat.values()}
+    lc = view.get("lifecycle")
+    pending = (lc or {}).get("pending_merges", {})
+    print(f"regions: {len(regions)} "
+          f"(lifecycle {'ON' if lc is not None else 'off'}, "
+          f"{len(pending)} pending merge(s))")
+    for r in sorted(regions, key=lambda r: r.start_key):
+        h = heat.get(r.id)
+        score = f"{h['score']:<6}" if h else "-     "
+        rates = (f"w/s={h['writes_s']:<7} r/s={h['reads_s']:<7} "
+                 f"keys={h['keys']:<7}" if h
+                 else "w/s=-       r/s=-       keys=-      ")
+        flags = ""
+        if r.id in hot_flagged:
+            flags += " HOT"
+        if str(r.id) in pending or r.id in pending:
+            flags += f" MERGING->{pending.get(str(r.id), pending.get(r.id))}"
+        print(f"  region {r.id:<8} "
+              f"[{_fmt_key(r.start_key)} .. "
+              f"{_fmt_key(r.end_key, end=True)}) "
+              f"v{r.epoch.version}/c{r.epoch.conf_ver} "
+              f"leader={leaders.get(r.id, '') or '?':<22} "
+              f"score={score} {rates}{flags}")
+        print(f"    peers: {', '.join(r.peers) or '-'}")
+    if lc is None:
+        print("  (lifecycle engine off or pre-lifecycle PD: no "
+              "placement decisions to show)")
+        return
+    print(f"  actuations: heat_splits={lc.get('heat_splits_ordered', 0)} "
+          f"merges={lc.get('merges_completed', 0)}"
+          f"/{lc.get('merges_ordered', 0)} ordered "
+          f"moves={lc.get('moves_ordered', 0)} "
+          f"retired={lc.get('retired_regions', 0)}")
+    recent = lc.get("recent", [])
+    if recent:
+        print("  recent decisions (oldest first):")
+        for d in recent:
+            extra = {k: v for k, v in d.items()
+                     if k not in ("kind", "term", "region")}
+            detail = " ".join(f"{k}={v}" for k, v in extra.items())
+            print(f"    term {d.get('term', '?'):<4} "
+                  f"{d.get('kind', '?'):<11} region {d.get('region', '?')}"
+                  f"  {detail}")
+
+
 _PRESSURE_NAMES = {0: "OK", 1: "NEAR_FULL", 2: "FULL"}
 
 
@@ -190,6 +257,28 @@ async def _run_pd(args) -> int:
                 print(json.dumps(view, indent=1))
             else:
                 _print_cluster_view(view)
+        elif cmd == "regions":
+            view = await pd.cluster_describe(top_k=64)
+            if view is None:
+                print("error: PD does not serve pd_cluster_describe "
+                      "(pre-observability build)", file=sys.stderr)
+                return 1
+            regions = await pd.list_regions()
+            if args.json:
+                print(json.dumps({
+                    "regions": [{
+                        "id": r.id,
+                        "start_key": _fmt_key(r.start_key),
+                        "end_key": _fmt_key(r.end_key, end=True),
+                        "version": r.epoch.version,
+                        "conf_ver": r.epoch.conf_ver,
+                        "peers": list(r.peers),
+                    } for r in sorted(regions,
+                                      key=lambda r: r.start_key)],
+                    "lifecycle": view.get("lifecycle"),
+                }, indent=1))
+            else:
+                _print_regions_view(regions, view)
         else:  # pd-metrics
             text = await pd.describe_metrics()
             if text is None:
@@ -209,7 +298,7 @@ async def run(args) -> int:
     from tpuraft.rpc.transport import RpcError
 
     cmd0 = args.command[0]
-    if cmd0 in ("cluster", "pd-metrics"):
+    if cmd0 in ("cluster", "regions", "pd-metrics"):
         if not args.pd:
             print(f"{cmd0} needs --pd (comma-separated PD endpoints)",
                   file=sys.stderr)

@@ -34,7 +34,10 @@ async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
                 balance_leaders: bool = False,
                 seed_regions: int = 0,
                 transport_kind: str = "tcp",
-                metrics_port: int | None = None) -> None:
+                metrics_port: int | None = None,
+                lifecycle: bool = False,
+                lifecycle_min_regions: int = 4,
+                lifecycle_merge_cooldown_s: float = 10.0) -> None:
     if transport_kind == "native":
         from tpuraft.rpc.native_tcp import NativeTcpRpcServer as Server
         from tpuraft.rpc.native_tcp import NativeTcpTransport as Transport
@@ -52,6 +55,9 @@ async def serve(endpoint: str, pd_endpoints: list[str], data_path: str,
         balance_leaders=balance_leaders,
         initial_regions=make_regions(seed_regions) if seed_regions else [],
         metrics_port=metrics_port,
+        lifecycle=lifecycle,
+        lifecycle_min_regions=lifecycle_min_regions,
+        lifecycle_merge_cooldown_s=lifecycle_merge_cooldown_s,
     )
     pd = PlacementDriverServer(opts, endpoint, server, transport)
     await pd.start()
@@ -95,15 +101,27 @@ def main() -> None:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve PD Prometheus text at GET /metrics on "
                          "this port (0 = ephemeral; default off)")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="run the region lifecycle engine (heat splits, "
+                         "cold merges, cross-store moves)")
+    ap.add_argument("--lifecycle-min-regions", type=int, default=4,
+                    help="never merge the fleet below this many regions")
+    ap.add_argument("--lifecycle-merge-cooldown-s", type=float,
+                    default=10.0,
+                    help="per-region pause between ordered merges")
     args = ap.parse_args()
     pds = [e for e in args.pd.split(",") if e]
     if args.serve not in pds:
         print("error: --serve must be one of --pd", file=sys.stderr)
         sys.exit(2)
     try:
-        asyncio.run(serve(args.serve, pds, args.data, args.split_keys,
-                          args.balance_leaders, args.seed_regions,
-                          args.transport, metrics_port=args.metrics_port))
+        asyncio.run(serve(
+            args.serve, pds, args.data, args.split_keys,
+            args.balance_leaders, args.seed_regions,
+            args.transport, metrics_port=args.metrics_port,
+            lifecycle=args.lifecycle,
+            lifecycle_min_regions=args.lifecycle_min_regions,
+            lifecycle_merge_cooldown_s=args.lifecycle_merge_cooldown_s))
     except KeyboardInterrupt:
         pass
 

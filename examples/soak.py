@@ -226,6 +226,10 @@ class SoakCluster(_BaseSoakCluster):
         # endpoint (heat rows + cluster view) instead of running PD-less
         self.pd_endpoint = pd_endpoint
         self.heartbeat_interval_ms = heartbeat_interval_ms
+        # --lifecycle: splits mint NEW groups mid-run, so the engine's
+        # [G] capacity must leave headroom beyond len(regions) (0 =
+        # size from the static region count as before)
+        self.engine_group_cap = 0
         self.quiesce_after_rounds = quiesce_after_rounds
         self.geo_zones = geo_zones
         self.witness = witness
@@ -281,7 +285,8 @@ class SoakCluster(_BaseSoakCluster):
             from tpuraft.core.engine import MultiRaftEngine
             from tpuraft.options import TickOptions
 
-            cap = 1 << max(4, (len(self.regions) + 3).bit_length())
+            cap = self.engine_group_cap \
+                or 1 << max(4, (len(self.regions) + 3).bit_length())
             raft_engine = MultiRaftEngine(TickOptions(
                 max_groups=cap, max_peers=4, tick_interval_ms=20))
             extra["log_scheme"] = "multilog"
@@ -1893,6 +1898,226 @@ async def run_hotspot_soak(duration_s: float, n_stores: int,
     return result
 
 
+async def run_lifecycle_soak(duration_s: float, n_stores: int,
+                             n_regions: int, seed: int, data_path: str,
+                             verbose: bool) -> dict:
+    """Region-lifecycle soak (ISSUE 20): a lifecycle-enabled PD runs
+    the full actuation loop against a live fleet under a SHIFTING
+    zipfian hotspot.
+
+    Exit gates: >0 heat-driven splits, >0 cold merges, >0 cross-store
+    moves; the PD's region set still tiles the keyspace (the
+    coverage oracle); the single-writer-per-key workload observed no
+    lost ack / stale read through all the churn; and the post-shift
+    cold keyspace hibernated (engine quiescence on idle groups).
+
+    Stores 1..3 host every region initially and store 4 hosts none —
+    the imbalance the move actuator must fix (add-learner -> catch up
+    -> joint promote+remove onto the empty store)."""
+    import os as _os
+
+    from tpuraft.rheakv.keyspace import coverage_errors
+    from tpuraft.rheakv.pd_client import RemotePlacementDriverClient
+    from tpuraft.rheakv.pd_server import (PlacementDriverOptions,
+                                          PlacementDriverServer)
+
+    rng = random.Random(seed)
+    hb_ms = 300
+    n_stores = max(4, n_stores)
+    c = SoakCluster(n_stores, data_path, n_regions=n_regions,
+                    engine=True, pd_endpoint="127.0.0.1:7200",
+                    heartbeat_interval_ms=hb_ms,
+                    quiesce_after_rounds=3)
+    # heat splits mint groups mid-run: leave engine [G] headroom
+    c.engine_group_cap = 1 << max(6, (n_regions * 2 + 8).bit_length())
+    home = c.endpoints[:3]
+    for r in c.regions:
+        r.peers = list(home)   # store 4+: move destination only
+
+    def say(*a):
+        if verbose:
+            print(*a, flush=True)
+
+    pd_ep = c.pd_endpoint
+    server = RpcServer(pd_ep)
+    c.net.bind(server)
+    c.net.start_endpoint(pd_ep)
+    pd = PlacementDriverServer(
+        PlacementDriverOptions(
+            endpoints=[pd_ep], election_timeout_ms=300,
+            data_path=_os.path.join(data_path, "pd"),
+            lifecycle=True,
+            lifecycle_heat_split_min_keys=16,
+            lifecycle_merge_cooldown_s=1.0,
+            lifecycle_min_regions=max(4, n_regions // 2),
+            lifecycle_move_cooldown_s=1.0,
+            lifecycle_move_imbalance=2),
+        pd_ep, server, InProcTransport(c.net, pd_ep))
+    await pd.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if pd.node is not None and pd.node.is_leader():
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError("PD never elected")
+
+    for ep in c.endpoints:
+        await c.start_store(ep)
+    kv = RheaKVStore(
+        RemotePlacementDriverClient(
+            InProcTransport(c.net, "lifecycle-pdc:0"), [pd_ep]),
+        c.client_transport(), timeout_ms=4000, max_retries=12,
+        jitter_seed=rng.randrange(1 << 30))
+    await kv.start()
+
+    # wait until the PD learned the whole fleet from heartbeats
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if len(pd.fsm.regions) >= n_regions:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise TimeoutError("PD never learned the initial region set")
+
+    hot_n = min(3, max(1, n_regions // 4))
+    hot_a = sorted(rng.sample(range(n_regions), hot_n))
+    hot_b = sorted(rng.sample(
+        [k for k in range(n_regions) if k not in hot_a], hot_n))
+    hot_now = list(hot_a)
+
+    # single-writer-per-key linearizability proxy: every key is owned
+    # by ONE driver task; a read must never return a sequence older
+    # than the last ACKED write (lost ack) nor a missing value after
+    # one was acked (lost keyspace — the merge-bug signature)
+    acked: dict = {}
+    issued: dict = {}
+    seqs: dict = {}
+    violations: list = []
+    ops = [0]
+    errs = [0]
+    stop = asyncio.Event()
+    n_drivers = 3
+
+    def _key(k: int, j: int) -> bytes:
+        # region k+1 owns [k%06d, (k+1)%06d)
+        return b"k%06d/%03d" % (k, j)
+
+    async def driver(t: int) -> None:
+        while not stop.is_set():
+            if rng.random() < 0.85:
+                k = rng.choice(hot_now)
+                j = rng.randrange(64)
+            else:
+                k = rng.randrange(n_regions)
+                j = rng.randrange(12)
+            j = (j - j % n_drivers) + t     # task t owns its j-slice
+            key = _key(k, j)
+            try:
+                if rng.random() < 0.55:
+                    seq = seqs.get(key, 0) + 1
+                    seqs[key] = seq
+                    issued[key] = seq
+                    if await kv.put(key, b"s%010d" % seq):
+                        acked[key] = seq
+                    ops[0] += 1
+                else:
+                    got = await kv.get(key)
+                    floor = acked.get(key, -1)
+                    if got is None:
+                        if floor >= 0:
+                            violations.append(
+                                f"{key!r}: acked seq {floor} vanished")
+                    else:
+                        seen = int(got[1:])
+                        if seen < floor:
+                            violations.append(
+                                f"{key!r}: read seq {seen} < acked "
+                                f"{floor}")
+                    ops[0] += 1
+            except Exception:
+                errs[0] += 1
+            await asyncio.sleep(0.001)
+
+    drivers = [asyncio.ensure_future(driver(t)) for t in range(n_drivers)]
+    half = max(5.0, duration_s / 2.0)
+    await asyncio.sleep(half)
+    say(f"shift: hot {hot_a} -> {hot_b}; pd regions="
+        f"{len(pd.fsm.regions)} splits={pd.heat_splits_ordered} "
+        f"merges={pd.merges_completed} moves={pd.moves_ordered}")
+    hot_now[:] = hot_b
+    await asyncio.sleep(max(0.0, duration_s - half))
+    stop.set()
+    for d in drivers:
+        d.cancel()
+
+    # quiet tail: let in-flight merges finalize and idle groups (the
+    # merged-away cold keyspace's survivors) hibernate
+    await asyncio.sleep(max(3.0, hb_ms / 1000.0 * 6))
+    moves_applied = sum(s.moves_applied for s in c.stores.values())
+    merges_led = sum(s.merges_led for s in c.stores.values())
+    occ = [s.tick_occupancy() for s in c.stores.values()]
+    hibernated = sum(q for _, q in occ)
+    coverage = coverage_errors(pd.fsm.regions.values())
+    coverage_detail = {}
+    if coverage:
+        # PD-view corruption forensics: the PD's record (with epochs)
+        # next to every store's live truth for the same ids, so a
+        # stale-wide record is attributable to the exact epoch race
+        coverage_detail["pd"] = {
+            rid: [r.start_key.decode("latin1"), r.end_key.decode("latin1"),
+                  r.epoch.version, r.epoch.conf_ver]
+            for rid, r in sorted(pd.fsm.regions.items())}
+        coverage_detail["stores"] = {
+            ep: {e.region.id: [e.region.start_key.decode("latin1"),
+                               e.region.end_key.decode("latin1"),
+                               e.region.epoch.version,
+                               e.region.epoch.conf_ver]
+                 for e in s._regions.values()}
+            for ep, s in c.stores.items()}
+    view = await RemotePlacementDriverClient(
+        InProcTransport(c.net, "lifecycle-adm:0"),
+        [pd_ep]).cluster_describe(top_k=8) or {}
+    lifecycle_ok = (
+        pd.heat_splits_ordered > 0
+        and pd.merges_completed > 0 and merges_led > 0
+        and moves_applied > 0
+        and not coverage
+        and not violations)
+    hibernate_ok = hibernated > 0
+    result = {
+        "mode": "lifecycle",
+        "duration_s": duration_s,
+        "regions_initial": n_regions,
+        "regions_final": len(pd.fsm.regions),
+        "stores": n_stores,
+        "ops": ops[0],
+        "errors": errs[0],
+        "heartbeat_ms": hb_ms,
+        "true_hot_a": [k + 1 for k in hot_a],
+        "true_hot_b": [k + 1 for k in hot_b],
+        "heat_splits_ordered": pd.heat_splits_ordered,
+        "merges_ordered": pd.merges_ordered,
+        "merges_completed": pd.merges_completed,
+        "merges_led": merges_led,
+        "moves_ordered": pd.moves_ordered,
+        "moves_applied": moves_applied,
+        "coverage_errors": coverage,
+        "coverage_detail": coverage_detail,
+        "lin_violations": violations[:8],
+        "hibernated_replicas": hibernated,
+        "hibernate_ok": hibernate_ok,
+        "pd_lifecycle_view": view.get("lifecycle"),
+        "lifecycle_ok": lifecycle_ok and hibernate_ok,
+        "linearizable": not violations,
+    }
+    await kv.shutdown()
+    for ep in list(c.stores):
+        await c.stop_store(ep)
+    await pd.shutdown()
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--duration", type=float, default=30)
@@ -2020,9 +2245,25 @@ def main() -> None:
                          "shift; asserts the PD ClusterView top-K "
                          "identifies the new hot regions within 3 "
                          "heartbeat rounds (fleet observability)")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="region-lifecycle soak: lifecycle-enabled PD "
+                         "(heat splits + cold merges + cross-store "
+                         "moves) under a shifting zipfian hotspot; "
+                         "gates on >0 of each actuation, keyspace "
+                         "coverage, per-key linearizability and cold-"
+                         "group hibernation")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     data = args.data or tempfile.mkdtemp(prefix="tpuraft-soak-")
+    if args.lifecycle:
+        import json
+
+        n_regions = args.regions if args.regions > 1 else 12
+        result = asyncio.run(run_lifecycle_soak(
+            args.duration, args.stores, n_regions, args.seed, data,
+            args.verbose))
+        print(json.dumps(result))
+        raise SystemExit(0 if result["lifecycle_ok"] else 1)
     if args.hotspot:
         import json
 

@@ -141,7 +141,8 @@ class PDTestCluster(KVTestCluster):
                  split_threshold_keys: int = 0,
                  heartbeat_interval_ms: int = 100,
                  balance_leaders: bool = False,
-                 transfer_cooldown_s: float = 5.0):
+                 transfer_cooldown_s: float = 5.0,
+                 pd_opts: Optional[dict] = None):
         super().__init__(n_stores, tmp_path=tmp_path, regions=regions,
                          election_timeout_ms=election_timeout_ms)
         self.pd_endpoints = [f"127.0.0.1:{7000 + i}" for i in range(n_pd)]
@@ -149,6 +150,9 @@ class PDTestCluster(KVTestCluster):
         self.heartbeat_interval_ms = heartbeat_interval_ms
         self.balance_leaders = balance_leaders
         self.transfer_cooldown_s = transfer_cooldown_s
+        # extra PlacementDriverOptions overrides (e.g. the lifecycle_*
+        # knobs), applied via setattr like store_opts
+        self.pd_opts = dict(pd_opts or {})
         self.pd_servers: dict[str, PlacementDriverServer] = {}
 
     async def start_all(self) -> None:
@@ -170,6 +174,8 @@ class PDTestCluster(KVTestCluster):
             transfer_cooldown_s=self.transfer_cooldown_s,
             initial_regions=[r.copy() for r in self.region_template],
         )
+        for k, v in self.pd_opts.items():
+            setattr(opts, k, v)
         pd = PlacementDriverServer(opts, endpoint, server, transport)
         await pd.start()
         self.pd_servers[endpoint] = pd

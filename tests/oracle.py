@@ -76,6 +76,13 @@ from tpuraft.util.quorum import (  # noqa: F401  (re-export)
     witness_only_majorities,
 )
 
+# keyspace-coverage oracle (region lifecycle): the implementation lives
+# in tpuraft/rheakv/keyspace.py for the same soak-shares-it reason
+from tpuraft.rheakv.keyspace import (  # noqa: F401  (re-export)
+    assert_covers,
+    coverage_errors,
+)
+
 
 def check_conf_sequence(entries: Iterable[tuple[Iterable, Iterable]]) -> None:
     """Assert a committed CONFIGURATION-entry sequence is a legal chain

@@ -416,8 +416,12 @@ async def test_evacuation_rate_bounded_and_cooldown(tmp_path):
     ``evacuation_rate`` leaders per evaluation round, and a region it
     just moved (or tried to) is cooled down for
     ``evacuation_cooldown_rounds`` rounds."""
+    # park the background health loop (huge eval interval): this test
+    # drives _evacuate_leaders() by hand, and a concurrent REAL round
+    # against the forced-SICK tracker would break the count arithmetic
     async with _kv_cluster(tmp_path, n_regions=4, evacuation_rate=2,
-                           evacuation_cooldown_rounds=100) as c:
+                           evacuation_cooldown_rounds=100,
+                           health_eval_interval_ms=3_600_000) as c:
         ep0 = c.endpoints[0]
         await _concentrate_leadership(c, ep0, 4)
         store = c.stores[ep0]
@@ -461,8 +465,23 @@ async def test_degraded_recovering_store_keeps_its_leaders(tmp_path):
         for _ in range(store.health.opts.recover_after + 2):
             store.health.evaluate()
         assert store.health.score() == DEGRADED
-        evac_before = store.evacuations
+        # evacuations ordered during the SICK phase land asynchronously
+        # (the leadership transfer completes off the health loop — on a
+        # loaded host well after the score recovered): let leadership
+        # settle before snapshotting what the store still holds
         led_before = store.leader_region_ids()
+        settle_deadline = time.monotonic() + 8
+        stable_since = time.monotonic()
+        while time.monotonic() < settle_deadline:
+            store.health.disk.note(0.05)   # keep the score DEGRADED
+            await asyncio.sleep(0.05)
+            cur = store.leader_region_ids()
+            if cur != led_before:
+                led_before = cur
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since > 0.6:
+                break
+        evac_before = store.evacuations
         feed_until = time.monotonic() + 1.5
         while time.monotonic() < feed_until:
             store.health.disk.note(0.05)   # still degraded, recovering

@@ -750,3 +750,91 @@ async def test_seeded_membership_chaos_drive(tmp_path):
             checked += 1
     assert checked >= 3, "too few conf sequences recorded to mean anything"
     await c.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# region-lifecycle churn under the keyspace-coverage oracle
+# ---------------------------------------------------------------------------
+
+
+async def test_region_lifecycle_churn_keeps_keyspace_tiled():
+    """Seeded split/merge churn on a live KV cluster: after EVERY
+    lifecycle op settles, each store's region set must still tile the
+    keyspace (tests.oracle.coverage_errors — the invariant the
+    --lifecycle soak asserts live), and every key written before the
+    churn must still be served by exactly the region covering it."""
+    from tests.kv_cluster import KVTestCluster
+    from tests.oracle import coverage_errors
+    from tpuraft.rheakv.metadata import Region
+
+    rng = random.Random(20)
+    c = KVTestCluster(3, regions=[Region(id=1, start_key=b"",
+                                         end_key=b"")])
+    await c.start_all()
+    try:
+        leader = await c.wait_region_leader(1)
+        keys = [b"%03d" % i for i in range(0, 128)]
+        for k in keys:
+            assert await leader.raft_store.put(k, b"v" + k)
+
+        def tilings():
+            # every store's live view of the region set
+            return [[e.region for e in s._regions.values()]
+                    for s in c.stores.values()]
+
+        async def settle_and_check(what):
+            async def _ok():
+                views = tilings()
+                return (len({len(v) for v in views}) == 1
+                        and all(not coverage_errors(v) for v in views))
+            deadline = time.monotonic() + 10.0
+            while not await _ok():
+                assert time.monotonic() < deadline, (
+                    f"after {what}: stores never converged on a clean "
+                    f"tiling: "
+                    + "; ".join("; ".join(coverage_errors(v)) or "ok"
+                                for v in tilings()))
+                await asyncio.sleep(0.05)
+
+        next_id, splits_done, merges_done = 2, 0, 0
+        for _ in range(8):
+            regions = sorted(tilings()[0], key=lambda r: r.start_key)
+            if rng.random() < 0.5 or len(regions) < 2:
+                # SPLIT a random region (needs >= 2 resident keys)
+                parent = rng.choice(regions)
+                l = await c.wait_region_leader(parent.id)
+                st = await l.store_engine.apply_split(parent.id, next_id)
+                if st.is_ok():
+                    await c.wait_region_on_all(next_id, timeout_s=10.0)
+                    await settle_and_check(f"split {parent.id}")
+                    next_id += 1
+                    splits_done += 1
+            else:
+                # MERGE a random adjacent pair (left absorbs into right)
+                i = rng.randrange(len(regions) - 1)
+                src, tgt = regions[i], regions[i + 1]
+                ls = await c.wait_region_leader(src.id)
+                lt = await c.wait_region_leader(tgt.id)
+                st = await ls.store_engine.apply_merge(
+                    src.id, tgt.id, str(lt.node.server_id))
+                if st.is_ok():
+                    await poll(lambda: all(
+                        s.get_region_engine(src.id) is None
+                        for s in c.stores.values()),
+                        timeout_s=10.0,
+                        what=f"retirement of merged region {src.id}")
+                    await settle_and_check(f"merge {src.id}->{tgt.id}")
+                    merges_done += 1
+        assert splits_done >= 1 and merges_done >= 1, (
+            f"churn too tame: {splits_done} splits, {merges_done} merges")
+        # every pre-churn key is served by the region covering it
+        final = sorted(tilings()[0], key=lambda r: r.start_key)
+        assert coverage_errors(final) == []
+        for k in keys:
+            owner = next(r for r in final
+                         if r.start_key <= k and (r.end_key == b""
+                                                  or k < r.end_key))
+            l = await c.wait_region_leader(owner.id)
+            assert await l.raft_store.get(k) == b"v" + k
+    finally:
+        await c.stop_all()

@@ -1,0 +1,529 @@
+"""Region lifecycle tests (ISSUE 20): the keyspace-coverage oracle,
+the PD-side placement policy (cold merge / cross-store move picks and
+their mutual-exclusion busy sets), and the store-side choreography
+under churn — merge after split on the live tiling, merge deferring
+(not wedging) on an in-flight conf change, a replica move racing a
+leader kill, and a lifecycle-enabled PD merging cold regions end to
+end with the client re-resolving routes out of the merged-away region.
+"""
+
+import asyncio
+import contextlib
+import time
+
+import pytest
+
+from tests.kv_cluster import KVTestCluster, PDTestCluster
+from tests.oracle import coverage_errors
+from tpuraft.errors import RaftError
+from tpuraft.rheakv.metadata import Region
+from tpuraft.rheakv.pd_server import RegionStats
+from tpuraft.rheakv.placement import LifecycleOptions, PlacementEngine
+
+
+# ---- unit: keyspace-coverage oracle ----------------------------------------
+
+
+def _r(rid, start, end):
+    return Region(id=rid, start_key=start, end_key=end)
+
+
+def test_coverage_oracle_accepts_tiling():
+    assert coverage_errors([_r(1, b"", b"")]) == []
+    assert coverage_errors([_r(1, b"", b"m"), _r(2, b"m", b"")]) == []
+    assert coverage_errors(
+        [_r(3, b"g", b"t"), _r(1, b"", b"g"), _r(2, b"t", b"")]) == []
+
+
+def test_coverage_oracle_flags_violations():
+    assert coverage_errors([]) != []
+    # hole at the left edge, in the middle, and at the right edge
+    assert any("hole" in e for e in coverage_errors([_r(1, b"a", b"")]))
+    assert any("hole" in e for e in coverage_errors(
+        [_r(1, b"", b"g"), _r(2, b"h", b"")]))
+    assert any("hole" in e for e in coverage_errors([_r(1, b"", b"z")]))
+    # overlap (the merge-bug signature: source resurrected next to the
+    # extended target) and duplicate ids
+    assert any("overlap" in e for e in coverage_errors(
+        [_r(1, b"", b"m"), _r(2, b"g", b"")]))
+    assert any("unbounded" in e for e in coverage_errors(
+        [_r(1, b"", b""), _r(2, b"m", b"")]))
+    assert any("twice" in e for e in coverage_errors(
+        [_r(1, b"", b"m"), _r(1, b"m", b"")]))
+
+
+# ---- unit: placement policy ------------------------------------------------
+
+
+EP = ["127.0.0.1:6%03d" % i for i in range(4)]
+
+
+class _StatsStub:
+    """Duck-typed ClusterStatsManager slice the policy reads."""
+
+    def __init__(self, stats=None, hot=()):
+        self._stats = dict(stats or {})
+        self._hot = set(hot)
+
+    def hot_regions(self):
+        return set(self._hot)
+
+    def region_stats(self, rid):
+        return self._stats.get(rid) or RegionStats()
+
+    def last_keys(self, rid):
+        return self.region_stats(rid).keys
+
+
+def _three_regions():
+    peers = list(EP[:3])
+    return {
+        1: Region(id=1, start_key=b"", end_key=b"g", peers=list(peers)),
+        2: Region(id=2, start_key=b"g", end_key=b"t", peers=list(peers)),
+        3: Region(id=3, start_key=b"t", end_key=b"", peers=list(peers)),
+    }
+
+
+def test_pick_merge_cold_adjacent_pair_and_pacing():
+    eng = PlacementEngine(LifecycleOptions(min_regions=2))
+    regions = _three_regions()
+    leaders = {rid: EP[0] for rid in regions}
+    stats = _StatsStub({rid: RegionStats(keys=10) for rid in regions})
+    pick = eng.pick_merge(regions, leaders, EP[0], stats, {}, {})
+    assert pick == (1, 2)   # coldest source absorbs into its RIGHT neighbor
+    # both sides now cool: an immediate re-pick must not double-order
+    assert eng.pick_merge(regions, leaders, EP[0], stats, {}, {}) is None
+
+
+def test_pick_merge_busy_and_floor_exclusions():
+    regions = _three_regions()
+    leaders = {rid: EP[0] for rid in regions}
+    stats = _StatsStub({rid: RegionStats(keys=10) for rid in regions})
+
+    def fresh():
+        return PlacementEngine(LifecycleOptions(min_regions=2))
+
+    # a pending SPLIT on either side takes the pair off the table
+    # (merge-races-split exclusion — replicated busy sets)
+    assert fresh().pick_merge(regions, leaders, EP[0], stats,
+                              {}, {1: 99}) == (2, 3)
+    assert fresh().pick_merge(regions, leaders, EP[0], stats,
+                              {}, {1: 99, 2: 98}) is None
+    # a HOT region is never merged (either side)
+    hot = _StatsStub({rid: RegionStats(keys=10) for rid in regions},
+                     hot={1, 2})
+    assert fresh().pick_merge(regions, leaders, EP[0], hot, {}, {}) is None
+    # inflight cap
+    eng = PlacementEngine(LifecycleOptions(min_regions=2,
+                                           max_inflight_merges=1))
+    assert eng.pick_merge(regions, leaders, EP[0], stats,
+                          {7: 8}, {}) is None
+    # min_regions floor: never merge the fleet below it
+    eng = PlacementEngine(LifecycleOptions(min_regions=3))
+    assert eng.pick_merge(regions, leaders, EP[0], stats, {}, {}) is None
+    # only regions led from the heartbeating store can act
+    assert fresh().pick_merge(regions, leaders, EP[1], stats, {}, {}) is None
+
+
+def test_pick_merge_oversized_source_excluded():
+    regions = _three_regions()
+    leaders = {rid: EP[0] for rid in regions}
+    stats = _StatsStub({1: RegionStats(keys=100000),
+                        2: RegionStats(keys=10),
+                        3: RegionStats(keys=10)})
+    eng = PlacementEngine(LifecycleOptions(min_regions=2,
+                                           merge_max_keys=4096))
+    # region 1 holds too many keys to churn through the target's log
+    assert eng.pick_merge(regions, leaders, EP[0], stats, {}, {}) == (2, 3)
+
+
+def test_pick_move_imbalance_zone_and_health():
+    peers = list(EP[:3])
+    regions = {i: Region(id=i, start_key=b"%d" % i, end_key=b"%d" % (i + 1),
+                         peers=list(peers)) for i in range(1, 4)}
+    leaders = {rid: EP[0] for rid in regions}
+    eng = PlacementEngine(LifecycleOptions(move_imbalance=2))
+    mv = eng.pick_move(regions, leaders, EP[0], EP, {}, {}, {}, {})
+    assert mv is not None
+    rid, src_p, dst_ep = mv
+    assert dst_ep == EP[3]           # the only store hosting nothing
+    assert src_p != leaders[rid]     # non-leader sources preferred
+    # inflight cap: with max_inflight_moves=1 the next pick waits
+    eng2 = PlacementEngine(LifecycleOptions(move_imbalance=2,
+                                            max_inflight_moves=1))
+    assert eng2.pick_move(regions, leaders, EP[0], EP, {}, {}, {}, {})
+    assert eng2.pick_move(regions, leaders, EP[0], EP, {}, {}, {}, {}) \
+        is None
+    # a SICK destination is never targeted — here it is the only one
+    eng3 = PlacementEngine(LifecycleOptions(move_imbalance=2))
+    assert eng3.pick_move(regions, leaders, EP[0], EP, {},
+                          {EP[3]: "sick"}, {}, {}) is None
+    # zone diversity breaks ties between equally-roomy destinations
+    two = {i: Region(id=i, start_key=b"%d" % i, end_key=b"%d" % (i + 1),
+                     peers=[EP[0], EP[1]]) for i in range(1, 4)}
+    zones = {EP[0]: "z1", EP[1]: "z1", EP[2]: "z1", EP[3]: "z2"}
+    eng4 = PlacementEngine(LifecycleOptions(move_imbalance=2))
+    mv = eng4.pick_move(two, {rid: EP[0] for rid in two}, EP[0], EP,
+                        zones, {}, {}, {})
+    assert mv is not None and mv[2] == EP[3]   # the new-zone store wins
+
+
+def test_pick_move_balanced_fleet_is_left_alone():
+    regions = {1: Region(id=1, start_key=b"", end_key=b"",
+                         peers=list(EP[:3]))}
+    eng = PlacementEngine(LifecycleOptions(move_imbalance=2))
+    assert eng.pick_move(regions, {1: EP[0]}, EP[0], EP[:3],
+                         {}, {}, {}, {}) is None
+
+
+# ---- integration: store-side merge choreography ----------------------------
+
+
+@contextlib.asynccontextmanager
+async def kv_cluster(n=3, regions=None, **kw):
+    c = KVTestCluster(n, regions=regions, **kw)
+    await c.start_all()
+    try:
+        yield c
+    finally:
+        await c.stop_all()
+
+
+def _two_region_template():
+    return [Region(id=1, start_key=b"", end_key=b"m"),
+            Region(id=2, start_key=b"m", end_key=b"")]
+
+
+async def _wait(cond, timeout_s=8.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+async def test_merge_absorbs_keyspace_and_retires_source():
+    async with kv_cluster(regions=_two_region_template()) as c:
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        for i in range(8):
+            assert await l1.raft_store.put(b"a%02d" % i, b"L%d" % i)
+            assert await l2.raft_store.put(b"z%02d" % i, b"R%d" % i)
+        st = await l1.store_engine.apply_merge(
+            1, 2, str(l2.node.server_id))
+        assert st.is_ok(), str(st)
+        # every store retires its source replica and extends its target
+        await _wait(lambda: all(s.get_region_engine(1) is None
+                                for s in c.stores.values()),
+                    what="source retirement on all stores")
+        for s in c.stores.values():
+            r2 = s.get_region_engine(2).region
+            assert (r2.start_key, r2.end_key) == (b"", b"")
+            assert coverage_errors([r2]) == []
+            assert s.regions_retired == 1 or s.regions_absorbed >= 0
+        # the absorbed keyspace serves through the surviving group
+        l2 = await c.wait_region_leader(2)
+        assert await l2.raft_store.get(b"a03") == b"L3"
+        assert await l2.raft_store.get(b"z03") == b"R3"
+        assert await l2.raft_store.put(b"a99", b"post-merge")
+        assert await l2.raft_store.get(b"a99") == b"post-merge"
+        assert l1.store_engine.merges_led == 1
+
+
+async def test_merge_defers_on_inflight_conf_change():
+    async with kv_cluster(regions=_two_region_template()) as c:
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        tp = str(l2.node.server_id)
+        # pin a conf change in flight: the merge must DEFER (EBUSY, no
+        # seal proposed, nothing wedged), exactly what the PD's paced
+        # re-issue loop expects
+        l1.node._conf_ctx = object()
+        try:
+            st = await l1.store_engine.apply_merge(1, 2, tp)
+            assert st.code == RaftError.EBUSY, str(st)
+            assert getattr(l1.fsm, "sealed_into", -1) == -1
+        finally:
+            l1.node._conf_ctx = None
+        # conf change done: the re-issued instruction goes through
+        st = await l1.store_engine.apply_merge(1, 2, tp)
+        assert st.is_ok(), str(st)
+        await _wait(lambda: all(s.get_region_engine(1) is None
+                                for s in c.stores.values()),
+                    what="deferred merge completion")
+
+
+async def test_merge_rides_the_live_tiling_after_split():
+    """Merge-races-split, sequenced the way the PD's replicated busy
+    sets allow: the split lands first, then merges run on the POST-
+    split tiling (absorb right-to-left chain) — coverage holds at
+    every step and every key stays readable."""
+    async with kv_cluster(regions=_two_region_template()) as c:
+        l1 = await c.wait_region_leader(1)
+        for i in range(32):
+            assert await l1.raft_store.put(b"k%02d" % i, b"v%d" % i)
+        st = await l1.store_engine.apply_split(1, 3)
+        assert st.is_ok(), str(st)
+        await c.wait_region_on_all(3)
+        l3 = await c.wait_region_leader(3)
+        l2 = await c.wait_region_leader(2)
+        store = next(iter(c.stores.values()))
+        regs = [store.get_region_engine(i).region for i in (1, 2, 3)]
+        assert coverage_errors(regs) == []
+        # merge the split child into its right neighbor (extend LEFT)
+        st = await l3.store_engine.apply_merge(3, 2, str(l2.node.server_id))
+        assert st.is_ok(), str(st)
+        await _wait(lambda: all(s.get_region_engine(3) is None
+                                for s in c.stores.values()),
+                    what="child retirement")
+        # then the shrunken parent into the extended survivor
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        st = await l1.store_engine.apply_merge(1, 2, str(l2.node.server_id))
+        assert st.is_ok(), str(st)
+        await _wait(lambda: all(s.get_region_engine(1) is None
+                                for s in c.stores.values()),
+                    what="parent retirement")
+        for s in c.stores.values():
+            r2 = s.get_region_engine(2).region
+            assert coverage_errors([r2]) == []
+        l2 = await c.wait_region_leader(2)
+        for i in range(32):
+            assert await l2.raft_store.get(b"k%02d" % i) == b"v%d" % i
+
+
+# ---- integration: cross-store move -----------------------------------------
+
+
+EP4 = [f"127.0.0.1:{6000 + i}" for i in range(4)]
+
+
+async def test_move_replica_to_fresh_store():
+    async with kv_cluster(4, regions=[Region(id=1, peers=EP4[:3])]) as c:
+        leader = await c.wait_region_leader(1)
+        assert await leader.raft_store.put(b"k", b"v")
+        src = next(p for p in leader.region.peers
+                   if p != str(leader.node.server_id))
+        st = await leader.store_engine.apply_move(1, EP4[3], src)
+        assert st.is_ok(), str(st)
+        ce = leader.node.conf_entry
+        peers = {str(p) for p in ce.conf.peers}
+        assert EP4[3] in peers and src not in peers
+        assert ce.is_stable()   # joint change fully committed
+        assert leader.store_engine.moves_applied == 1
+        # a retried instruction (PD re-issue after a lost ack) is a no-op
+        st = await leader.store_engine.apply_move(1, EP4[3], src)
+        assert st.is_ok(), str(st)
+        assert await leader.raft_store.get(b"k") == b"v"
+
+
+async def test_move_self_leader_source_hands_off_first():
+    async with kv_cluster(4, regions=[Region(id=1, peers=EP4[:3])]) as c:
+        leader = await c.wait_region_leader(1)
+        me = str(leader.node.server_id)
+        st = await leader.store_engine.apply_move(1, EP4[3], me)
+        assert st.code == RaftError.EBUSY, str(st)
+
+        # leadership moves off the source so the re-issued move can run
+        async def _moved():
+            nl = await c.wait_region_leader(1)
+            return str(nl.node.server_id) != me
+
+        deadline = time.monotonic() + 8.0
+        while not await _moved():
+            assert time.monotonic() < deadline, \
+                "leadership never left the move source"
+            await asyncio.sleep(0.05)
+
+
+async def test_move_races_leader_kill():
+    async with kv_cluster(4, regions=[Region(id=1, peers=EP4[:3])],
+                          tmp_path=None) as c:
+        leader = await c.wait_region_leader(1)
+        leader_ep = leader.node.server_id.endpoint
+        src = next(p for p in leader.region.peers
+                   if p != str(leader.node.server_id))
+        move = asyncio.ensure_future(
+            leader.store_engine.apply_move(1, EP4[3], src))
+        await asyncio.sleep(0.05)   # land mid-catchup / mid-joint
+        await c.stop_store(leader_ep)
+        with contextlib.suppress(Exception):
+            await move
+        # a new leader emerges among the surviving conf members and the
+        # re-issued move converges (retry-safe whatever the kill hit)
+        new_leader = await c.wait_region_leader(1, timeout_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while True:
+            st = await new_leader.store_engine.apply_move(1, EP4[3], src)
+            ce = new_leader.node.conf_entry
+            peers = {str(p) for p in ce.conf.peers}
+            if st.is_ok() and EP4[3] in peers and src not in peers \
+                    and ce.is_stable():
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"move did not converge: {st} peers={peers}")
+            await asyncio.sleep(0.2)
+            new_leader = await c.wait_region_leader(1, timeout_s=10.0)
+        assert await new_leader.raft_store.put(b"post", b"kill")
+
+
+# ---- integration: lifecycle-enabled PD end to end --------------------------
+
+
+async def test_pd_lifecycle_merges_cold_regions_end_to_end():
+    """A lifecycle PD observes an all-cold 4-region fleet, orders cold
+    merges down to the floor, replicates completion, and the CLIENT
+    re-resolves routes out of the merged-away regions (satellite 1:
+    stale-route eviction on ERR_NO_REGION + PD adjudication)."""
+    from tpuraft.rheakv.client import RheaKVStore
+
+    template = [
+        Region(id=1, start_key=b"", end_key=b"g"),
+        Region(id=2, start_key=b"g", end_key=b"n"),
+        Region(id=3, start_key=b"n", end_key=b"t"),
+        Region(id=4, start_key=b"t", end_key=b""),
+    ]
+    c = PDTestCluster(
+        n_stores=3, n_pd=1, regions=template,
+        heartbeat_interval_ms=100,
+        pd_opts={
+            "lifecycle": True,
+            "lifecycle_min_regions": 2,
+            "lifecycle_merge_cooldown_s": 0.5,
+            "lifecycle_move_cooldown_s": 0.5,
+            "lifecycle_max_inflight_merges": 1,
+            # suppress moves: this test isolates the merge actuator
+            "lifecycle_move_imbalance": 99,
+        })
+    await c.start_all()
+    try:
+        pd = await c.wait_pd_leader()
+        kv = RheaKVStore(c.pd_client(), c.client_transport(),
+                         timeout_ms=3000, max_retries=16)
+        await kv.start()
+        # seed the client's route table AND data in every region
+        for k in (b"a", b"h", b"p", b"x"):
+            assert await kv.put(k, b"v-" + k)
+        # snapshot the pre-merge routes: an epoch bounce during the
+        # merge window can refresh the table early, so pin the stale
+        # view back afterwards to make the eviction path deterministic
+        stale_routes = [r.copy() for r in kv.route_table.list_regions()]
+        # the policy merges the cold fleet down to the floor
+        await _wait(lambda: len(pd.fsm.regions) <= 2
+                    and not pd.fsm.pending_merges,
+                    timeout_s=30.0, what="cold merges down to the floor")
+        assert pd.merges_completed >= 2
+        assert coverage_errors(pd.fsm.regions.values()) == []
+        kv.route_table.reset([r.copy() for r in stale_routes])
+        # every key survives, including ones whose region merged away —
+        # the client bounces off the retired group, evicts the stale
+        # route and lands in the absorbing region
+        for k in (b"a", b"h", b"p", b"x"):
+            assert await kv.get(k) == b"v-" + k
+        assert await kv.put(b"hh", b"post-merge")
+        assert await kv.get(b"hh") == b"post-merge"
+        assert kv.merged_evictions >= 1
+        # the admin surface reports the lifecycle plane
+        view = await kv.pd.cluster_describe()
+        assert view and view.get("lifecycle"), view
+        assert view["lifecycle"]["merges_completed"] >= 2
+        await kv.shutdown()
+    finally:
+        await c.stop_all()
+
+
+def test_admin_regions_view_renders(capsys):
+    """The admin `regions` renderer handles a lifecycle view, a region
+    with no heat row, pending merges, and the lifecycle-off PD."""
+    from examples.admin import _print_regions_view
+
+    regions = [Region(id=1, start_key=b"", end_key=b"m",
+                      peers=[EP[0], EP[1]]),
+               Region(id=2, start_key=b"m", end_key=b"",
+                      peers=[EP[0], EP[1]])]
+    view = {
+        "hot": [{"region": 1, "leader": EP[0], "score": 3.1,
+                 "writes_s": 9.0, "reads_s": 2.0, "keys": 64}],
+        "cold": [],
+        "hot_flagged": [1],
+        "lifecycle": {
+            "pending_merges": {"2": 1},
+            "retired_regions": 3,
+            "recent": [{"kind": "heat_split", "term": 1, "region": 1,
+                        "child": 1024},
+                       {"kind": "move", "term": 1, "region": 2,
+                        "src": EP[0], "dst": EP[1]}],
+            "heat_splits_ordered": 4, "merges_ordered": 2,
+            "merges_completed": 2, "moves_ordered": 1,
+        },
+    }
+    _print_regions_view(regions, view)
+    out = capsys.readouterr().out
+    assert "lifecycle ON" in out and "1 pending merge" in out
+    assert "HOT" in out and "MERGING->1" in out
+    assert "heat_split" in out and "child=1024" in out
+    # pre-lifecycle PD (or lifecycle off): renders without decisions
+    _print_regions_view(regions, {"hot": [], "cold": []})
+    out = capsys.readouterr().out
+    assert "lifecycle off" in out and "no placement decisions" in out
+
+
+def test_replayed_split_report_cannot_resurrect_merged_region():
+    """Regression: a mint-era split report replayed AFTER the child has
+    merged away must not resurrect it in the PD metadata.
+
+    ``do_split`` runs on every replica and every replica's async boot
+    re-reports the split; a learner moved onto the group later replays
+    the parent log and re-reports splits that are ancient history.  If
+    the child has since gone cold and been absorbed by its neighbor,
+    its record was popped (tombstoned) — ``cur is None`` — so the epoch
+    guard alone lets the stale mint-era record land and double-cover
+    the keyspace the absorber already extended over."""
+    import struct
+
+    from tpuraft.rheakv.pd_server import (
+        _CMD_MERGE, _CMD_REGION_UPSERT, _CMD_SPLIT, PDMetadataFSM, _cmd)
+
+    fsm = PDMetadataFSM()
+
+    def upsert(region, leader=EP[0]):
+        lb = leader.encode()
+        fsm._dispatch(_cmd(
+            _CMD_REGION_UPSERT,
+            struct.pack("<H", len(lb)) + lb + region.encode()))
+
+    # initial tiling: region 1 [-inf, m), region 2 [m, +inf)
+    upsert(_r(1, b"", b"m"))
+    upsert(_r(2, b"m", b""))
+
+    # region 1 splits at g -> child 1024; both halves bump to version 2
+    parent = _r(1, b"", b"g")
+    parent.epoch.version = 2
+    child = _r(1024, b"g", b"m")
+    child.epoch.version = 2
+    pb = parent.encode()
+    split_report = _cmd(
+        _CMD_SPLIT, struct.pack("<I", len(pb)) + pb + child.encode())
+    assert fsm._dispatch(split_report) is True
+    assert coverage_errors(fsm.regions.values()) == []
+
+    # the child goes cold and merges into its right neighbor: region 2
+    # extends left over [g, m) and 1024 is tombstoned
+    assert fsm._dispatch(
+        _cmd(_CMD_MERGE, struct.pack("<qq", 1024, 2))) is True
+    assert 1024 not in fsm.regions
+    assert fsm.retired_regions[1024] == 2
+    assert fsm.regions[2].start_key == b"g"
+
+    # a moved-in learner replays the parent log and re-reports the
+    # mint-era split: the tombstone must win over ``cur is None``
+    assert fsm._dispatch(split_report) is True
+    assert 1024 not in fsm.regions, "merged-away child resurrected"
+    assert fsm.regions[2].start_key == b"g"
+    assert fsm.regions[2].end_key == b""
+    assert coverage_errors(fsm.regions.values()) == []
+    # finalizing the same merge again is not "fresh" (no double count)
+    assert fsm._dispatch(
+        _cmd(_CMD_MERGE, struct.pack("<qq", 1024, 2))) is False

@@ -422,6 +422,13 @@ class RheaKVStore:
         # endpoint -> windowed batch sender (one RPC in flight each)
         self._senders: dict[str, _StoreSender] = {}
         self._refresh_inflight: Optional[asyncio.Task] = None
+        # region lifecycle (merges): region ids whose stores bounced
+        # ERR_NO_REGION — candidates for merged-away eviction.  The next
+        # PD-answered refresh adjudicates: still listed = alive (a
+        # lagging split child), gone = absorbed by a neighbor, evict it
+        # so the absorbing region's extended range takes over the route.
+        self._merge_suspects: set[int] = set()
+        self.merged_evictions = 0
 
     # ------------------------------------------------------------------
     # store-grouped batch dispatch (the kv_command_batch fast path)
@@ -486,6 +493,7 @@ class RheaKVStore:
         if code == ERR_NO_REGION:
             if not spread:
                 self._leaders.pop(region.id, None)
+            self._merge_suspects.add(region.id)
             return _Retry(refresh=True, status=st)
         if code in _RETRYABLE_CODES:
             if not spread:
@@ -736,8 +744,10 @@ class RheaKVStore:
         Best-effort: a down PD must not fail ops the cached routes or the
         stores themselves can still serve."""
         regions: list[Region] = []
+        pd_ids: Optional[set[int]] = None
         try:
             regions = await self.pd.list_regions()
+            pd_ids = {r.id for r in regions}
         except Exception:  # noqa: BLE001 — PD unreachable / electing
             LOG.debug("pd route refresh failed; falling back to stores",
                       exc_info=True)
@@ -762,7 +772,6 @@ class RheaKVStore:
         # fold: keep the freshest epoch per region id — seeded with the
         # table we already hold, so a refresh answered only by lagging
         # replicas (leader down, PD stale) can never regress the view
-        # (regions only ever split; they never merge back)
         regions.extend(self.route_table.list_regions())
         best: dict[int, Region] = {}
         for r in regions:
@@ -770,6 +779,24 @@ class RheaKVStore:
             if cur is None or (r.epoch.version, r.epoch.conf_ver) > \
                     (cur.epoch.version, cur.epoch.conf_ver):
                 best[r.id] = r
+        # merged-away eviction (region lifecycle): a region the stores
+        # bounce with ERR_NO_REGION and a PD answer no longer lists was
+        # absorbed into a neighbor — drop it from the fold so the
+        # absorbing region's extended range (same start key, and NOT
+        # necessarily a higher version — the absorbed side may have
+        # split more) can take over the route.  A suspect the PD still
+        # lists is alive (a lagging split child); PD-down refreshes
+        # adjudicate nothing (conservative — both cases look the same
+        # from the stores alone).
+        if pd_ids is not None and self._merge_suspects:
+            for rid in list(self._merge_suspects):
+                self._merge_suspects.discard(rid)
+                if rid not in pd_ids and rid in best:
+                    best.pop(rid)
+                    self._leaders.pop(rid, None)
+                    self.route_table.remove_region(rid)
+                    self.merged_evictions += 1
+                    LOG.debug("evicted merged-away region %d", rid)
         if best:  # never wipe a usable cache with an empty refresh
             self.route_table.reset(list(best.values()))
 
@@ -891,6 +918,7 @@ class RheaKVStore:
                 raise _Retry(refresh=True)
             if resp.code == ERR_NO_REGION:
                 self._leaders.pop(region.id, None)
+                self._merge_suspects.add(region.id)
                 raise _Retry(refresh=True)
             if resp.code in _RETRYABLE_CODES:
                 # not leader / electing / readIndex round timed out under

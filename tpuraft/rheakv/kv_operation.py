@@ -36,6 +36,17 @@ class KVOp(enum.IntEnum):
     # quorum round; the FSM applies sub-ops in order with per-op
     # results).  Never sent by clients directly.
     MULTI = 15
+    # region-merge choreography (the lifecycle plane): SEAL is the
+    # merge barrier replicated through the SOURCE group (writes behind
+    # it in the log still apply; writes after it are deterministically
+    # rejected on every replica), ABSORB carries the sealed keyspace
+    # into the TARGET group's log (range extension + epoch bump apply
+    # deterministically on every target replica), COMMIT retires the
+    # source group after the target acked the absorb.  Never sent by
+    # clients — proposed leader-side by the store engine.
+    MERGE_SEAL = 16
+    MERGE_ABSORB = 17
+    MERGE_COMMIT = 18
     # read ops (only replicated when linearizable-via-log is requested;
     # normally served via readIndex + local read)
     GET = 20
@@ -104,6 +115,43 @@ class KVOperation:
     def range_split(new_region_id: int, split_key: bytes) -> "KVOperation":
         return KVOperation(KVOp.RANGE_SPLIT, split_key,
                            aux=struct.pack("<q", new_region_id))
+
+    @staticmethod
+    def merge_seal(target_region_id: int) -> "KVOperation":
+        """Merge barrier for the SOURCE group: aux names the absorbing
+        region so every replica records where its keyspace went."""
+        return KVOperation(KVOp.MERGE_SEAL,
+                           aux=struct.pack("<q", target_region_id))
+
+    @staticmethod
+    def merge_absorb(source_region_id: int, source_start: bytes,
+                     source_end: bytes, data_blob: bytes) -> "KVOperation":
+        """Keyspace handoff for the TARGET group: value carries the
+        source's serialized range, aux its id + boundaries so the range
+        extension applies deterministically on every replica."""
+        aux = (struct.pack("<q", source_region_id)
+               + struct.pack("<I", len(source_start)) + source_start
+               + struct.pack("<I", len(source_end)) + source_end)
+        return KVOperation(KVOp.MERGE_ABSORB, value=data_blob, aux=aux)
+
+    @staticmethod
+    def unpack_merge_absorb(aux: bytes) -> tuple[int, bytes, bytes]:
+        (src_id,) = struct.unpack_from("<q", aux, 0)
+        off = 8
+        (sl,) = struct.unpack_from("<I", aux, off)
+        off += 4
+        start = aux[off:off + sl]
+        off += sl
+        (el,) = struct.unpack_from("<I", aux, off)
+        off += 4
+        return src_id, start, aux[off:off + el]
+
+    @staticmethod
+    def merge_commit(target_region_id: int) -> "KVOperation":
+        """Retirement entry for the SOURCE group, proposed once the
+        target acked the absorb."""
+        return KVOperation(KVOp.MERGE_COMMIT,
+                           aux=struct.pack("<q", target_region_id))
 
     @staticmethod
     def put_list(kvs: list[tuple[bytes, bytes]]) -> "KVOperation":

@@ -87,12 +87,33 @@ class KVCommandBatchResponse:
     items: list[bytes] = field(default_factory=list)
 
 
+@dataclass
+class MergeAbsorbRequest:
+    """Keyspace handoff (lifecycle plane): the SOURCE region's leader
+    store hands the sealed range to the TARGET region's leader, which
+    replicates it through the target group as a MERGE_ABSORB entry."""
+
+    target_region_id: int = 0
+    source_region_id: int = 0
+    source_start: bytes = b""
+    source_end: bytes = b""
+    data_blob: bytes = b""    # serialized source range (RawKVStore codec)
+
+
+@dataclass
+class MergeAbsorbResponse:
+    code: int = 0
+    msg: str = ""
+
+
 register_message(128, KVCommandRequest)
 register_message(129, KVCommandResponse)
 register_message(130, ListRegionsOnStoreRequest)
 register_message(131, ListRegionsOnStoreResponse)
 register_message(132, KVCommandBatchRequest)
 register_message(133, KVCommandBatchResponse)
+register_message(134, MergeAbsorbRequest)
+register_message(135, MergeAbsorbResponse)
 
 
 # ---- batch item / reply codecs ---------------------------------------------
@@ -235,6 +256,8 @@ class KVCommandProcessor:
                                          self.handle_batch)
         store_engine.rpc_server.register("kv_list_regions",
                                          self.handle_list_regions)
+        store_engine.rpc_server.register("kv_merge_absorb",
+                                         self.handle_merge_absorb)
         # observability (bench counters / wire-compat tests)
         self.batch_rpcs = 0      # kv_command_batch RPCs served
         self.batch_items = 0     # items carried inside them
@@ -257,6 +280,31 @@ class KVCommandProcessor:
         return ListRegionsOnStoreResponse(
             regions=[r.encode() for r in self._se.list_regions()])
 
+    async def handle_merge_absorb(self, req: MergeAbsorbRequest
+                                  ) -> MergeAbsorbResponse:
+        """Target-side half of a region merge: replicate the handed-over
+        keyspace through the target group (store-to-store RPC — the
+        source leader calls this after its seal barrier applied)."""
+        engine = self._se.get_region_engine(req.target_region_id)
+        if engine is None:
+            return MergeAbsorbResponse(
+                code=ERR_NO_REGION,
+                msg=f"target region {req.target_region_id} not on "
+                    f"store {self._se.server_id}")
+        try:
+            await engine.raft_store.merge_absorb(
+                req.source_region_id, req.source_start, req.source_end,
+                req.data_blob)
+        except KVStoreError as e:
+            # EPERM (not leader) / ESTATEMACHINE etc. bounce to the
+            # source store, which retries against the fresh leader
+            return MergeAbsorbResponse(code=e.status.code,
+                                       msg=e.status.error_msg)
+        except Exception as e:  # noqa: BLE001
+            return MergeAbsorbResponse(code=int(RaftError.EINTERNAL),
+                                       msg=str(e))
+        return MergeAbsorbResponse()
+
     def _validate(self, region_id: int, conf_ver: int, version: int,
                   op_blob: bytes):
         """Shared per-item admission: returns either ``(None, engine, op)``
@@ -275,6 +323,18 @@ class KVCommandProcessor:
                       f"client sent {conf_ver}.{version}"),
                      region.encode()), None, None)
         op = KVOperation.decode(op_blob)
+        if op.op in _WRITE_OPS \
+                and (engine.sealing
+                     or getattr(engine.fsm, "sealed_into", -1) >= 0):
+            # merge barrier: new writes bounce RETRYABLY the moment the
+            # seal is decided (leader-local `sealing` covers the window
+            # before the entry applies); reads keep serving off the
+            # immutable sealed range until retirement.  The client
+            # retries, lands ERR_NO_REGION after retirement, refreshes
+            # and reroutes into the absorbing region.
+            return ((ERR_STORE_BUSY,
+                     f"region {region_id} sealed for merge "
+                     f"(retry-after-ms=100)", b""), None, None)
         if not _keys_in_region(op, region):
             # epoch matched but a key escapes the range: the client grouped
             # a batch against a route view that split under it — make it

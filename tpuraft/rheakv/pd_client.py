@@ -30,6 +30,12 @@ class PlacementDriverClient:
     async def report_split(self, parent: Region, child: Region) -> None:
         pass
 
+    async def report_merge(self, source_region_id: int,
+                           target_region_id: int) -> None:
+        """Lifecycle plane: a completed merge (source sealed, absorbed,
+        retired) — no-op for PD-less clients; the static view has no
+        merge policy that could have ordered one."""
+
     async def store_heartbeat(self, meta: StoreMeta,
                               health: str = "") -> None:
         pass
@@ -182,6 +188,20 @@ class RemotePlacementDriverClient(PlacementDriverClient):
 
         await self._call("pd_report_split", ReportSplitRequest(
             parent=parent.encode(), child=child.encode()))
+
+    async def report_merge(self, source_region_id: int,
+                           target_region_id: int) -> None:
+        from tpuraft.rheakv.pd_messages import ReportMergeRequest
+        from tpuraft.rpc.transport import RpcError, is_no_method
+
+        try:
+            await self._call("pd_report_merge", ReportMergeRequest(
+                source_region_id=source_region_id,
+                target_region_id=target_region_id))
+        except RpcError as e:
+            if is_no_method(e):
+                return  # pre-lifecycle PD (it never orders merges either)
+            raise
 
     async def store_heartbeat(self, meta: StoreMeta,
                               health: str = "") -> None:

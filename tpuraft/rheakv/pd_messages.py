@@ -192,6 +192,27 @@ def decode_region_delta(blob: bytes) -> tuple[bytes, str, int]:
     return bytes(blob[10 + n:]), leader, keys
 
 
+@_pd(156)
+class ReportMergeRequest:
+    """Lifecycle plane: the SOURCE region's leader store reports a
+    completed merge (seal + absorb + commit all applied) so the PD
+    finalizes its replicated metadata — extend the target's range over
+    the source's, drop the source region, clear the pending-merge
+    entry.  Belt-and-braces: the PD also finalizes from the target's
+    own delta heartbeat (its extended range covers the source), so a
+    lost report only delays the bookkeeping."""
+
+    source_region_id: int = 0
+    target_region_id: int = 0
+
+
+@_pd(157)
+class ReportMergeResponse:
+    success: bool = True
+    redirect: str = ""
+    msg: str = ""
+
+
 @dataclass
 class Instruction:
     """A PD order to a store (reference: ``rhea:metadata/Instruction`` —
@@ -199,23 +220,44 @@ class Instruction:
 
     KIND_SPLIT = 1
     KIND_TRANSFER_LEADER = 2
+    # lifecycle plane: merge region_id INTO new_region_id, whose leader
+    # (the absorb RPC destination) rides target_peer
+    KIND_MERGE = 3
+    # lifecycle plane: move region_id's replica src_peer -> target_peer
+    # (add-learner, catch up, promote + remove on joint consensus)
+    KIND_MOVE = 4
 
     kind: int = 0
     region_id: int = 0
     new_region_id: int = 0
     target_peer: str = ""
+    # trailing extension (KIND_MOVE): the replica being replaced.  Old
+    # decoders never see MOVE instructions (a PD only issues them to
+    # stores that report moves working), and trailing bytes are safe —
+    # each instruction travels as its own length-delimited blob.
+    src_peer: str = ""
 
     def encode(self) -> bytes:
         tp = self.target_peer.encode()
-        return struct.pack("<Bqq", self.kind, self.region_id,
-                           self.new_region_id) \
+        out = struct.pack("<Bqq", self.kind, self.region_id,
+                          self.new_region_id) \
             + struct.pack("<H", len(tp)) + tp
+        if self.src_peer:
+            sp = self.src_peer.encode()
+            out += struct.pack("<H", len(sp)) + sp
+        return out
 
     @staticmethod
     def decode(blob: bytes) -> "Instruction":
         kind, rid, nrid = struct.unpack_from("<Bqq", blob, 0)
         (n,) = struct.unpack_from("<H", blob, 17)
-        return Instruction(kind, rid, nrid, blob[19:19 + n].decode())
+        target = blob[19:19 + n].decode()
+        off = 19 + n
+        src = ""
+        if off + 2 <= len(blob):
+            (sn,) = struct.unpack_from("<H", blob, off)
+            src = bytes(blob[off + 2:off + 2 + sn]).decode()
+        return Instruction(kind, rid, nrid, target, src)
 
 
 def encode_store_meta(store_id: int, endpoint: str, zone: str = "") -> bytes:

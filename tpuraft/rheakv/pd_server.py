@@ -58,6 +58,8 @@ _CMD_REGION_UPSERT = 2
 _CMD_SPLIT = 3
 _CMD_ALLOC_ID = 4
 _CMD_SPLIT_ISSUED = 5   # alloc child id + record the pending decision
+_CMD_MERGE_ISSUED = 6   # record a pending (source -> target) merge
+_CMD_MERGE = 7          # merge completed: fold source into target
 
 
 def _cmd(kind: int, payload: bytes = b"") -> bytes:
@@ -74,6 +76,18 @@ class _StoreRecord:
 def _peer_endpoint(peer_str: str) -> str:
     """Peer string ('ip:port[:idx[:prio]][/learner|/witness]') -> endpoint."""
     return ":".join(peer_str.split("/", 1)[0].split(":")[:2])
+
+
+def _range_covers(region: Region, src: Region) -> bool:
+    """True when ``region``'s range already covers ``src``'s — the
+    same containment test extend_region_over's idempotency guard runs
+    (b"" bounds are -inf/+inf sentinels)."""
+    lo_ok = (region.start_key == b"" if src.start_key == b""
+             else region.start_key == b""
+             or region.start_key <= src.start_key)
+    hi_ok = (region.end_key == b"" if src.end_key == b""
+             else region.end_key == b"" or src.end_key <= region.end_key)
+    return lo_ok and hi_ok
 
 
 def zone_leader_histogram(region_leaders: dict[int, str],
@@ -101,6 +115,19 @@ class PDMetadataFSM(StateMachine):
         # child id (idempotent at the store) instead of allocating a
         # duplicate.  Cleared when the split is reported done.
         self.pending_splits: dict[int, int] = {}
+        # REPLICATED merge decisions (lifecycle plane, same failover
+        # argument): source region -> target region.  The new PD leader
+        # re-issues the SAME pair until the merge completes — a merge
+        # is a multi-step store-side protocol and must never be
+        # half-forgotten or re-decided against a different neighbor.
+        self.pending_merges: dict[int, int] = {}
+        # REPLICATED merge tombstones: retired source region -> the
+        # target that absorbed it.  A full resync from the (now
+        # retiring) source leader can still carry the dead region's
+        # row; without the tombstone that upsert would resurrect it in
+        # the PD view and double-cover the keyspace.  Bounded by the
+        # merge count (region ids are never reused).
+        self.retired_regions: dict[int, int] = {}
 
     async def on_apply(self, it: Iterator) -> None:
         while it.valid():
@@ -130,6 +157,8 @@ class PDMetadataFSM(StateMachine):
             (ln,) = struct.unpack_from("<H", payload, 0)
             leader = payload[2:2 + ln].decode()
             region = Region.decode(payload[2 + ln:])
+            if region.id in self.retired_regions:
+                return True  # merged away: never resurrect
             cur = self.regions.get(region.id)
             if cur is None or (region.epoch.version, region.epoch.conf_ver) \
                     >= (cur.epoch.version, cur.epoch.conf_ver):
@@ -156,8 +185,17 @@ class PDMetadataFSM(StateMachine):
                 self.pending_splits.pop(parent.id, None)
             # epoch-guarded like _CMD_REGION_UPSERT: a replayed
             # report_split (client retry after a lost response) must not
-            # stomp fresher metadata from heartbeats or a later split
+            # stomp fresher metadata from heartbeats or a later split —
+            # and, like the heartbeat path, must never RESURRECT a
+            # region that has since merged away (a re-issued split
+            # instruction makes the store re-report an old split long
+            # after both halves may have gone cold and been absorbed;
+            # cur is None after the tombstone pop, so without this
+            # check the stale mint-era record would land unguarded and
+            # overlap the absorber's extended range)
             for region in (parent, child):
+                if region.id in self.retired_regions:
+                    continue
                 cur = self.regions.get(region.id)
                 if cur is None or (region.epoch.version,
                                    region.epoch.conf_ver) >= \
@@ -165,6 +203,34 @@ class PDMetadataFSM(StateMachine):
                     self.regions[region.id] = region
             self.next_region_id = max(self.next_region_id, child.id + 1)
             return True
+        if kind == _CMD_MERGE_ISSUED:
+            src_id, tgt_id = struct.unpack_from("<qq", payload, 0)
+            already = self.pending_merges.get(src_id)
+            if already is not None:
+                return already  # idempotent: same target re-issued
+            self.pending_merges[src_id] = tgt_id
+            return tgt_id
+        if kind == _CMD_MERGE:
+            from tpuraft.rheakv.state_machine import extend_region_over
+
+            src_id, tgt_id = struct.unpack_from("<qq", payload, 0)
+            src = self.regions.pop(src_id, None)
+            self.region_leaders.pop(src_id, None)
+            tgt = self.regions.get(tgt_id)
+            if src is not None and tgt is not None:
+                # same deterministic extension the target replicas ran
+                # (idempotent: a heartbeat may have upserted the
+                # already-extended target first)
+                extend_region_over(tgt, src.start_key, src.end_key)
+            if self.pending_merges.get(src_id) == tgt_id:
+                self.pending_merges.pop(src_id, None)
+            # True only for the FIRST finalization of this source: the
+            # report-RPC path and the heartbeat finalization arm can
+            # race the same merge through here, and both count from
+            # this return value (replicated state is the tiebreak)
+            fresh = src_id not in self.retired_regions
+            self.retired_regions[src_id] = tgt_id
+            return fresh
         if kind == _CMD_ALLOC_ID:
             rid = self.next_region_id
             self.next_region_id += 1
@@ -197,6 +263,13 @@ class PDMetadataFSM(StateMachine):
             epb, zb = ep.encode(), zone.encode()
             out += struct.pack("<H", len(epb)) + epb
             out += struct.pack("<H", len(zb)) + zb
+        # trailing (lifecycle plane) — absent in pre-merge snapshots
+        out += struct.pack("<I", len(self.pending_merges))
+        for src_id, tgt_id in self.pending_merges.items():
+            out += struct.pack("<qq", src_id, tgt_id)
+        out += struct.pack("<I", len(self.retired_regions))
+        for src_id, tgt_id in self.retired_regions.items():
+            out += struct.pack("<qq", src_id, tgt_id)
         writer.write_file("pd_meta", bytes(out))
         done(Status.OK())
 
@@ -256,6 +329,22 @@ class PDMetadataFSM(StateMachine):
                 off += zn
                 if ep in self.stores:
                     self.stores[ep].zone = zone
+        self.pending_merges = {}
+        if off + 4 <= len(buf):  # absent in pre-merge snapshots
+            (nm,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            for _ in range(nm):
+                src_id, tgt_id = struct.unpack_from("<qq", buf, off)
+                off += 16
+                self.pending_merges[src_id] = tgt_id
+        self.retired_regions = {}
+        if off + 4 <= len(buf):  # absent in pre-merge snapshots
+            (nt,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            for _ in range(nt):
+                src_id, tgt_id = struct.unpack_from("<qq", buf, off)
+                off += 16
+                self.retired_regions[src_id] = tgt_id
         return True
 
 
@@ -463,6 +552,15 @@ class ClusterStatsManager:
                          math.ceil(p / 100.0 * len(scores)) - 1))
         return scores[idx]
 
+    def drop(self, region_id: int) -> None:
+        """Region left the fleet (merged away): forget its stats so the
+        cold ranking and hot set stop listing a dead id."""
+        self._stats.pop(region_id, None)
+        self._inflight_splits.pop(region_id, None)
+        self._transfer_cooldown.pop(region_id, None)
+        self._pending_moves.pop(region_id, None)
+        self._hot.discard(region_id)
+
     def hot_regions(self) -> set[int]:
         return set(self._hot)
 
@@ -492,14 +590,20 @@ class ClusterStatsManager:
         ent = self._stats.get(region_id)
         return ent.keys if ent is not None else 0
 
-    def should_split(self, region_id: int) -> bool:
-        if self.split_threshold_keys <= 0:
-            return False
+    def split_pacing_ok(self, region_id: int) -> bool:
+        """Split pacing gate shared by the key-count path and the
+        lifecycle plane's heat-driven path: False while a split of this
+        region is in flight / cooling down."""
         # graftcheck: allow(raw-clock) — PD-side split cooldown window (real time)
         now = time.monotonic()
         self._inflight_splits = {r: d for r, d in
                                  self._inflight_splits.items() if d > now}
-        if region_id in self._inflight_splits:
+        return region_id not in self._inflight_splits
+
+    def should_split(self, region_id: int) -> bool:
+        if self.split_threshold_keys <= 0:
+            return False
+        if not self.split_pacing_ok(region_id):
             return False
         return self.last_keys(region_id) >= self.split_threshold_keys
 
@@ -631,6 +735,21 @@ class PlacementDriverOptions:
     # same render answers the ``pd_describe_metrics`` RPC regardless.
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
+    # -- region lifecycle engine (ISSUE 20) ----------------------------------
+    # master switch: run the placement policy (heat-driven splits, cold
+    # merges, cross-store moves) over the heartbeat stream.  The policy
+    # itself lives in tpuraft/rheakv/placement.py; the knobs below
+    # mirror LifecycleOptions.
+    lifecycle: bool = False
+    lifecycle_heat_split_min_keys: int = 32
+    lifecycle_merge_max_score: float = 0.05
+    lifecycle_merge_max_keys: int = 4096
+    lifecycle_merge_cooldown_s: float = 10.0
+    lifecycle_max_inflight_merges: int = 2
+    lifecycle_min_regions: int = 4
+    lifecycle_move_imbalance: int = 2
+    lifecycle_move_cooldown_s: float = 10.0
+    lifecycle_max_inflight_moves: int = 2
 
 
 class PlacementDriverServer:
@@ -645,6 +764,23 @@ class PlacementDriverServer:
         self.node_manager = NodeManager(rpc_server)
         self.fsm = PDMetadataFSM()
         self.stats = ClusterStatsManager(opts.split_threshold_keys)
+        # region lifecycle engine (ISSUE 20): the policy half lives in
+        # placement.py; None = lifecycle off (legacy PD behavior)
+        self.placement = None
+        if opts.lifecycle:
+            from tpuraft.rheakv.placement import (LifecycleOptions,
+                                                  PlacementEngine)
+
+            self.placement = PlacementEngine(LifecycleOptions(
+                heat_split_min_keys=opts.lifecycle_heat_split_min_keys,
+                merge_max_score=opts.lifecycle_merge_max_score,
+                merge_max_keys=opts.lifecycle_merge_max_keys,
+                merge_cooldown_s=opts.lifecycle_merge_cooldown_s,
+                max_inflight_merges=opts.lifecycle_max_inflight_merges,
+                min_regions=opts.lifecycle_min_regions,
+                move_imbalance=opts.lifecycle_move_imbalance,
+                move_cooldown_s=opts.lifecycle_move_cooldown_s,
+                max_inflight_moves=opts.lifecycle_max_inflight_moves))
         self._group: Optional[RaftGroupService] = None
         for method, handler in [
             ("pd_list_regions", self._list_regions),
@@ -653,6 +789,7 @@ class PlacementDriverServer:
             ("pd_region_heartbeat", self._region_heartbeat),
             ("pd_store_heartbeat_batch", self._store_heartbeat_batch),
             ("pd_report_split", self._report_split),
+            ("pd_report_merge", self._report_merge),
             ("pd_create_region_id", self._create_region_id),
             ("pd_cluster_describe", self._cluster_describe),
             ("pd_describe_metrics", self._describe_metrics),
@@ -681,6 +818,12 @@ class PlacementDriverServer:
         self.splits_ordered = 0
         self.transfers_ordered = 0
         self.cluster_describes = 0
+        # lifecycle counters (the soak exit gate + admin plane read
+        # these; heat_splits_ordered also counts into splits_ordered)
+        self.heat_splits_ordered = 0
+        self.merges_ordered = 0       # KIND_MERGE instructions issued
+        self.merges_completed = 0     # _CMD_MERGE finalized
+        self.moves_ordered = 0        # KIND_MOVE instructions issued
         self._metrics_httpd = None
         self.metrics_http_port: Optional[int] = None
 
@@ -908,6 +1051,13 @@ class PlacementDriverServer:
             instructions.extend(await self._region_hb_core(
                 region, leader, self.stats.last_keys(rid),
                 zones, zone_counts))
+        # lifecycle decisions (ISSUE 20): one merge + one move pick per
+        # batch, scoped to regions THIS store leads (instructions ride
+        # its heartbeat response).  Decisions replicate before the
+        # instruction leaves, so a PD failover re-issues the same pair.
+        if self.placement is not None:
+            instructions.extend(await self._lifecycle_pass(
+                req.endpoint, zones))
         term = node.current_term
         if req.full:
             self._batch_synced[req.endpoint] = term
@@ -947,23 +1097,71 @@ class PlacementDriverServer:
             await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
         self.stats.record(region.id, approximate_keys)
         instructions: list[Instruction] = []
+        # -- lifecycle: merge finalization (belt-and-braces) ----------------
+        # the TARGET's own report shows its extended range covering a
+        # pending source: the absorb committed even if the source
+        # leader's pd_report_merge was lost — finalize from here
+        for src_id, tgt_id in list(self.fsm.pending_merges.items()):
+            if tgt_id != region.id:
+                continue
+            src = self.fsm.regions.get(src_id)
+            if src is not None and _range_covers(region, src):
+                if await self._apply(_cmd(
+                        _CMD_MERGE, struct.pack("<qq", src_id, tgt_id))):
+                    self.merges_completed += 1
+                self.stats.drop(src_id)
+        # -- lifecycle: pending-merge re-issue ------------------------------
+        pending_merge_tgt = self.fsm.pending_merges.get(region.id)
+        if pending_merge_tgt is not None:
+            # merging away: re-issue the replicated decision (paced —
+            # the store defers mid-conf-change, the absorb can bounce
+            # on a stale target leader) and run NO other policy on it
+            if self.placement is not None \
+                    and self.placement.merge_reissue_due(region.id):
+                self.merges_ordered += 1
+                instructions.append(Instruction(
+                    kind=Instruction.KIND_MERGE, region_id=region.id,
+                    new_region_id=pending_merge_tgt,
+                    target_peer=self.fsm.region_leaders.get(
+                        pending_merge_tgt, "")))
+            return instructions
+        # an absorb TARGET must not split mid-merge (the extension and
+        # the split would race over the same metadata)
+        merge_target = region.id in set(self.fsm.pending_merges.values())
+        keys_fire = not merge_target and self.stats.should_split(region.id)
+        heat_fire = (self.placement is not None and not merge_target
+                     and self.placement.should_heat_split(
+                         region.id, self.stats)
+                     and self.stats.split_pacing_ok(region.id))
         pending_child = self.fsm.pending_splits.get(region.id)
         if pending_child is not None:
             # a split was already ORDERED (possibly by a previous PD
             # leader — the decision is replicated): re-issue the SAME
-            # child id while the region still reports oversize, paced by
-            # the leader-local cooldown.  Never allocate a duplicate.
-            if self.stats.should_split(region.id):
+            # child id while the region still reports oversize (or the
+            # heat detector still flags it), paced by the leader-local
+            # cooldown.  Never allocate a duplicate.
+            if keys_fire or heat_fire:
                 self.stats.mark_split_issued(region.id)
                 self.splits_ordered += 1
                 instructions.append(Instruction(
                     kind=Instruction.KIND_SPLIT, region_id=region.id,
                     new_region_id=pending_child))
-        elif self.stats.should_split(region.id):
+        elif keys_fire or heat_fire:
             new_id = await self._apply(_cmd(
                 _CMD_SPLIT_ISSUED, struct.pack("<q", region.id)))
             self.stats.mark_split_issued(region.id)
             self.splits_ordered += 1
+            if heat_fire and not keys_fire:
+                from tpuraft.util.trace import RECORDER
+
+                # heat-DRIVEN split: the detector fired below the
+                # key-count threshold — the lifecycle plane's signal
+                self.heat_splits_ordered += 1
+                if self.placement is not None:
+                    self.placement.note_decision(
+                        "heat_split", region=region.id, child=new_id)
+                RECORDER.record_coalesced("heat_split", str(region.id),
+                                          child=new_id)
             instructions.append(Instruction(
                 kind=Instruction.KIND_SPLIT, region_id=region.id,
                 new_region_id=new_id))
@@ -989,6 +1187,57 @@ class PlacementDriverServer:
                     kind=Instruction.KIND_TRANSFER_LEADER,
                     region_id=region.id, target_peer=target))
         return instructions
+
+    async def _lifecycle_pass(self, store_ep: str,
+                              zones: Optional[dict] = None
+                              ) -> list[Instruction]:
+        """Batch-scoped lifecycle decisions: at most one cold-merge pick
+        and one cross-store move pick per heartbeat batch, both limited
+        to regions led from ``store_ep`` (the instruction rides this
+        store's response).  A merge decision replicates as a pending
+        (source -> target) pair BEFORE the instruction leaves the PD —
+        a failover re-issues the same pair; a move needs no replication
+        (apply_move is retry-safe and re-picked from live imbalance)."""
+        from tpuraft.util.trace import RECORDER
+
+        placement = self.placement
+        node = self.node
+        placement.note_term(node.current_term,
+                            max(placement.opts.merge_cooldown_s,
+                                placement.opts.move_cooldown_s))
+        out: list[Instruction] = []
+        self.stats.maybe_sweep()
+        pick = placement.pick_merge(
+            self.fsm.regions, self.fsm.region_leaders, store_ep,
+            self.stats, self.fsm.pending_merges, self.fsm.pending_splits)
+        if pick is not None:
+            src, tgt = pick
+            tgt = await self._apply(_cmd(
+                _CMD_MERGE_ISSUED, struct.pack("<qq", src, tgt)))
+            self.merges_ordered += 1
+            placement.note_decision("merge", region=src, into=tgt)
+            RECORDER.record("region_merge_ordered", str(src), into=tgt)
+            out.append(Instruction(
+                kind=Instruction.KIND_MERGE, region_id=src,
+                new_region_id=tgt,
+                target_peer=self.fsm.region_leaders.get(tgt, "")))
+        mv = placement.pick_move(
+            self.fsm.regions, self.fsm.region_leaders, store_ep,
+            list(self.fsm.stores.keys()),
+            zones if zones is not None else self._store_zones(),
+            self._store_health, self.fsm.pending_merges,
+            self.fsm.pending_splits)
+        if mv is not None:
+            rid, src_p, dst_ep = mv
+            self.moves_ordered += 1
+            placement.note_decision("move", region=rid, src=src_p,
+                                    dst=dst_ep)
+            RECORDER.record("region_move_ordered", str(rid),
+                            src=src_p, dst=dst_ep)
+            out.append(Instruction(
+                kind=Instruction.KIND_MOVE, region_id=rid,
+                target_peer=dst_ep, src_peer=src_p))
+        return out
 
     # -- fleet observability: cluster view + metrics exposition --------------
 
@@ -1044,6 +1293,18 @@ class PlacementDriverServer:
 
         replicas = sum(o[0] for o in self._store_occupancy.values())
         quiescent = sum(o[1] for o in self._store_occupancy.values())
+        lifecycle = None
+        if self.placement is not None:
+            lifecycle = {
+                "pending_merges": {str(s): t for s, t
+                                   in self.fsm.pending_merges.items()},
+                "retired_regions": len(self.fsm.retired_regions),
+                "recent": self.placement.recent_decisions(),
+                "heat_splits_ordered": self.heat_splits_ordered,
+                "merges_ordered": self.merges_ordered,
+                "merges_completed": self.merges_completed,
+                "moves_ordered": self.moves_ordered,
+            }
         return {
             "term": self.node.current_term if self.node else 0,
             "stores": stores,
@@ -1063,6 +1324,8 @@ class PlacementDriverServer:
                 "fraction": round(quiescent / replicas, 4)
                 if replicas else 0.0,
             },
+            # lifecycle plane (None = policy off — legacy PD behavior)
+            "lifecycle": lifecycle,
         }
 
     async def _cluster_describe(self, req) -> "object":
@@ -1095,6 +1358,10 @@ class PlacementDriverServer:
             "pd_transfers_ordered": self.transfers_ordered,
             "pd_cluster_describes": self.cluster_describes,
             "pd_hot_region_events": self.stats.hot_events,
+            "pd_heat_splits_ordered": self.heat_splits_ordered,
+            "pd_merges_ordered": self.merges_ordered,
+            "pd_merges_completed": self.merges_completed,
+            "pd_moves_ordered": self.moves_ordered,
         }
         # C-atomic list() snapshots: this render runs on the metrics
         # HTTP daemon thread while heartbeats mutate these dicts on the
@@ -1112,6 +1379,7 @@ class PlacementDriverServer:
             "pd_regions": len(self.fsm.regions),
             "pd_sick_stores": sum(1 for lvl in health if lvl == "sick"),
             "pd_hot_regions": self.stats.hot_count(),
+            "pd_pending_merges": len(self.fsm.pending_merges),
             "pd_replicas": replicas,
             "pd_replicas_quiescent": quiescent,
             "pd_hibernation_fraction":
@@ -1134,6 +1402,24 @@ class PlacementDriverServer:
         payload = struct.pack("<I", len(parent)) + parent + req.child
         await self._apply(_cmd(_CMD_SPLIT, payload))
         return ReportSplitResponse()
+
+    async def _report_merge(self, req) -> "object":
+        """Lifecycle plane: the source store reports a COMPLETED merge
+        (seal + absorb + commit all applied) — finalize the replicated
+        metadata.  Idempotent: a client retry (or the heartbeat-driven
+        finalization racing this report) finds the source already
+        popped and applies a no-op."""
+        from tpuraft.rheakv.pd_messages import ReportMergeResponse
+
+        node = self.node
+        if node is None or not node.is_leader():
+            return self._not_leader(ReportMergeResponse)
+        fresh = await self._apply(_cmd(_CMD_MERGE, struct.pack(
+            "<qq", req.source_region_id, req.target_region_id)))
+        if fresh:
+            self.merges_completed += 1
+            self.stats.drop(req.source_region_id)
+        return ReportMergeResponse()
 
     async def _create_region_id(self, req: CreateRegionIdRequest
                                 ) -> CreateRegionIdResponse:

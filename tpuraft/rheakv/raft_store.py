@@ -305,6 +305,23 @@ class RaftRawKVStore:
         return await self._apply(
             KVOperation.range_split(new_region_id, split_key))
 
+    # -- region-merge choreography (lifecycle plane) -------------------------
+    # none of these are blind: the seal barrier's position in the log
+    # IS the merge's linearization point, so the proposer must observe
+    # its actual apply (and any deterministic rejection), never an
+    # eager commit-time ack
+
+    async def merge_seal(self, target_region_id: int) -> bool:
+        return await self._apply(KVOperation.merge_seal(target_region_id))
+
+    async def merge_absorb(self, source_region_id: int, source_start: bytes,
+                           source_end: bytes, data_blob: bytes) -> bool:
+        return await self._apply(KVOperation.merge_absorb(
+            source_region_id, source_start, source_end, data_blob))
+
+    async def merge_commit(self, target_region_id: int) -> bool:
+        return await self._apply(KVOperation.merge_commit(target_region_id))
+
     # -- read path (readIndex barrier + local read) --------------------------
 
     async def _read(self, fn, *args):

@@ -30,6 +30,11 @@ class RegionEngine:
         self.fsm: Optional[KVStoreStateMachine] = None
         self.raft_store: Optional[RaftRawKVStore] = None
         self._group_service: Optional[RaftGroupService] = None
+        # merge barrier, leader-local half (lifecycle plane): set BEFORE
+        # the seal entry is proposed so no new write is admitted after
+        # the seal's position in the log is decided — the FSM's
+        # replicated `sealed_into` takes over once the entry applies
+        self.sealing = False
 
     @property
     def group_id(self) -> str:

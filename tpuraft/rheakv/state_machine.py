@@ -22,6 +22,38 @@ from tpuraft.rheakv.raw_store import RawKVStore
 LOG = logging.getLogger(__name__)
 
 
+def extend_region_over(region: Region, src_start: bytes,
+                       src_end: bytes) -> None:
+    """Extend ``region``'s keyspace over an ADJACENT absorbed range and
+    bump its epoch version — the deterministic metadata half of a
+    MERGE_ABSORB apply (every target replica runs this with identical
+    inputs).  Raises on a non-adjacent range: a PD that proposed one
+    has a policy bug, and silently absorbing would tear the keyspace
+    tiling invariant.
+
+    Idempotent: a range the region ALREADY covers (a resumed merge
+    re-absorbing after a source-leader retry, or log replay over a
+    snapshot that post-dates the absorb) is a no-op — regions tile the
+    keyspace disjointly, so containment can only mean "absorbed
+    before"."""
+    lo_ok = (region.start_key == b"" if src_start == b""
+             else region.start_key == b"" or region.start_key <= src_start)
+    hi_ok = (region.end_key == b"" if src_end == b""
+             else region.end_key == b"" or src_end <= region.end_key)
+    if lo_ok and hi_ok:
+        return
+    if src_end != b"" and src_end == region.start_key:
+        region.start_key = src_start          # source sat to our LEFT
+    elif region.end_key != b"" and region.end_key == src_start:
+        region.end_key = src_end              # source sat to our RIGHT
+    else:
+        raise RuntimeError(
+            f"absorb range [{src_start!r}, {src_end!r}) is not adjacent "
+            f"to region {region.id} [{region.start_key!r}, "
+            f"{region.end_key!r})")
+    region.epoch.version += 1
+
+
 class KVClosure:
     """Proposal completion carrying an op result back to the proposer
     (reference: ``rhea:storage/KVStoreClosure#setData``).
@@ -63,6 +95,11 @@ class KVStoreStateMachine(StateMachine):
     # (all return True and only touch the data namespace)
     _RUN_OPS = frozenset(
         (KVOp.PUT, KVOp.DELETE, KVOp.PUT_LIST, KVOp.DELETE_LIST))
+    # ops a SEALED region still applies: the merge choreography itself
+    # plus log-replicated reads (the data keeps serving until the
+    # target's absorb commits and this group retires)
+    _SEALED_OK = frozenset((KVOp.MERGE_SEAL, KVOp.MERGE_COMMIT,
+                            KVOp.GET, KVOp.MULTI_GET, KVOp.CONTAINS_KEY))
 
     def __init__(self, region: Region, store: RawKVStore,
                  store_engine=None, coalesce_applies: bool = True) -> None:
@@ -81,6 +118,13 @@ class KVStoreStateMachine(StateMachine):
         self.coalesce_applies = coalesce_applies
         self.coalesced_flushes = 0   # flushes that merged more than one row
         self.coalesced_ops = 0       # rows that rode a merged flush
+        # merge barrier (lifecycle plane): >= 0 once a MERGE_SEAL entry
+        # applied, naming the absorbing region.  Derived ONLY from the
+        # applied log (+ snapshot), so every replica agrees; writes
+        # sequenced after the seal are deterministically rejected
+        # (ESTATEMACHINE) — the barrier IS the merge's linearization
+        # point in the source group's log
+        self.sealed_into = -1
 
     # -- apply ---------------------------------------------------------------
 
@@ -143,7 +187,8 @@ class KVStoreStateMachine(StateMachine):
             op = KVOperation.decode(it.data())
             done = it.done()
             closure = done if isinstance(done, KVClosure) else None
-            if self.coalesce_applies and op.op in self._RUN_OPS:
+            if self.coalesce_applies and op.op in self._RUN_OPS \
+                    and self.sealed_into < 0:
                 run_rows.extend(self._run_rows(op))
                 run_dones.append((done, closure))
                 it.next()
@@ -169,6 +214,13 @@ class KVStoreStateMachine(StateMachine):
     def _dispatch(self, op: KVOperation):
         s = self.store
         code = op.op
+        if self.sealed_into >= 0 and code not in self._SEALED_OK:
+            # deterministic on every replica: the seal entry precedes
+            # this op in the SAME log, so all replicas reject it — a
+            # write that raced the seal and lost reroutes (via the
+            # client's bounce path) into the absorbing region
+            raise RuntimeError(
+                f"region sealed into {self.sealed_into} (merging)")
         if code == KVOp.PUT:
             s.put(op.key, op.value)
             return True
@@ -226,6 +278,37 @@ class KVStoreStateMachine(StateMachine):
                 return True
             self.store_engine.do_split(self.region.id, new_region_id, op.key)
             return True
+        if code == KVOp.MERGE_SEAL:
+            (target_id,) = struct.unpack("<q", op.aux)
+            # idempotent: a re-proposed seal (leader retry) re-applies
+            # to the same state
+            self.sealed_into = target_id
+            return True
+        if code == KVOp.MERGE_ABSORB:
+            src_id, src_start, src_end = \
+                KVOperation.unpack_merge_absorb(op.aux)
+            # data first, in the store-owning context (idempotent
+            # overwrite: on a shared per-store raw store the source's
+            # rows are already physically present)
+            if op.value:
+                s.load_serialized(op.value)
+            self._absorb_meta(src_id, src_start, src_end)
+            return True
+        if code == KVOp.MERGE_COMMIT:
+            (target_id,) = struct.unpack("<q", op.aux)
+            if self.store_engine is not None:
+                try:
+                    asyncio.get_running_loop()
+                except RuntimeError:
+                    # lane apply: retirement mutates loop-confined
+                    # StoreEngine state (region table, heat rows, the
+                    # engine shutdown task) — hop to the engine's loop
+                    self.store_engine.loop_call_threadsafe(
+                        self.store_engine.do_retire,
+                        self.region.id, target_id)
+                    return True
+                self.store_engine.do_retire(self.region.id, target_id)
+            return True
         if code == KVOp.GET:  # linearizable-via-log read
             return s.get(op.key)
         if code == KVOp.MULTI_GET:
@@ -246,7 +329,8 @@ class KVStoreStateMachine(StateMachine):
         outs: list = [None] * len(ops)
         i, n = 0, len(ops)
         while i < n:
-            if self.coalesce_applies and ops[i].op in self._RUN_OPS:
+            if self.coalesce_applies and ops[i].op in self._RUN_OPS \
+                    and self.sealed_into < 0:
                 j = i
                 rows: list = []
                 while j < n and ops[j].op in self._RUN_OPS:
@@ -275,6 +359,25 @@ class KVStoreStateMachine(StateMachine):
             i += 1
         return outs
 
+    def _absorb_meta(self, src_id: int, src_start: bytes,
+                     src_end: bytes) -> None:
+        """Metadata half of a MERGE_ABSORB apply: range extension +
+        epoch bump (+ store-engine bookkeeping), hopped to the engine's
+        loop when applying on the store's worker lane — same contract
+        as the RANGE_SPLIT arm."""
+        if self.store_engine is None:
+            extend_region_over(self.region, src_start, src_end)
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self.store_engine.loop_call_threadsafe(
+                self.store_engine.do_absorb,
+                self.region.id, src_id, src_start, src_end)
+            return
+        self.store_engine.do_absorb(self.region.id, src_id,
+                                    src_start, src_end)
+
     # -- leadership ----------------------------------------------------------
 
     async def on_leader_start(self, term: int) -> None:
@@ -286,6 +389,26 @@ class KVStoreStateMachine(StateMachine):
         self.leader_term = -1
         if self.store_engine is not None:
             self.store_engine.on_region_leader_stop(self.region.id)
+
+    async def on_configuration_committed(self, conf) -> None:
+        """Committed conf entries update the region's replica roster and
+        bump conf_ver — every replica applies the same entries, so the
+        roster/epoch stay deterministic fleet-wide.  Before the
+        lifecycle plane region.peers never tracked joint-consensus
+        changes, so a MOVEd region kept advertising its old store
+        forever.  No-op re-commits (a new leader re-committing the
+        stable conf) are skipped so restart replay can't drift conf_ver
+        across replicas."""
+        w = set(conf.witnesses)
+        toks = [f"{p}/witness" if p in w else str(p)
+                for p in sorted(conf.peers)]
+        toks += [f"{p}/learner" for p in sorted(conf.learners)]
+        if not toks or set(toks) == set(self.region.peers):
+            return
+        self.region.peers = toks
+        self.region.epoch.conf_ver += 1
+        if self.store_engine is not None:
+            self.store_engine.on_region_conf_changed(self.region.id)
 
     # -- snapshot ------------------------------------------------------------
 
@@ -303,6 +426,12 @@ class KVStoreStateMachine(StateMachine):
                                                   self.region.end_key)
             writer.write_file("kv_data", blob)
             writer.write_file("region_meta", self.region.encode())
+            if self.sealed_into >= 0:
+                # a replica installing this snapshot must come up SEALED
+                # (the seal entry may sit below the snapshot index) —
+                # trailing file, absent on pre-lifecycle snapshots
+                writer.write_file("merge_state",
+                                  struct.pack("<q", self.sealed_into))
             done(Status.OK())
         except Exception as e:  # noqa: BLE001
             done(Status.error(RaftError.EIO, f"kv snapshot save: {e}"))
@@ -319,6 +448,9 @@ class KVStoreStateMachine(StateMachine):
             self.region.start_key = saved.start_key
             self.region.end_key = saved.end_key
             self.region.epoch = saved.epoch
+        sealed = reader.read_file("merge_state")
+        self.sealed_into = struct.unpack("<q", sealed)[0] \
+            if sealed is not None else -1
         # exact state reset of our slice (data + sequences + locks), then
         # load — merging would leave post-snapshot keys behind and make
         # log replay after restart non-deterministic across replicas

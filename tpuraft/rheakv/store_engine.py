@@ -732,6 +732,12 @@ class StoreEngine:
         self._leader_regions: set[int] = set()
         self._started = False
         self._pending_splits: set[int] = set()
+        # region lifecycle plane (merge/move) counters — the soak exit
+        # gate and admin `regions` view read these
+        self.merges_led = 0        # source-side merges this store drove
+        self.regions_retired = 0   # source replicas retired (merged away)
+        self.regions_absorbed = 0  # absorb applies folded into a target
+        self.moves_applied = 0     # PD-ordered replica moves executed
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._meta_journal = None  # store-lifetime ref (multilog scheme)
         # delta-batched PD reporting state: region -> (fingerprint,
@@ -1164,6 +1170,10 @@ class StoreEngine:
             "evacuation_rounds": self.evacuation_rounds,
             "disk_reclaims": self.disk_reclaims,
             "disk_reclaim_rounds": self.disk_reclaim_rounds,
+            "merges_led": self.merges_led,
+            "regions_retired": self.regions_retired,
+            "regions_absorbed": self.regions_absorbed,
+            "moves_applied": self.moves_applied,
             "kv_disk_shed_items": self.disk_shed_items,
             "metrics_renders": self.metrics_renders,
             "metrics_cache_hits": self.metrics_cache_hits,
@@ -1444,6 +1454,25 @@ class StoreEngine:
                     and ins.target_peer:
                 await engine.transfer_leadership_to(
                     PeerId.parse(ins.target_peer))
+            elif ins.kind == Instruction.KIND_MERGE:
+                st = await self.apply_merge(ins.region_id,
+                                            ins.new_region_id,
+                                            ins.target_peer)
+                if not st.is_ok():
+                    # deferred (mid-conf-change) or bounced (target
+                    # leader moved): a fresh report makes the PD
+                    # re-issue from its replicated pending_merges map
+                    LOG.info("pd-ordered merge of region %d into %d "
+                             "deferred: %s", ins.region_id,
+                             ins.new_region_id, st)
+                    self._pd_dirty.add(ins.region_id)
+            elif ins.kind == Instruction.KIND_MOVE and ins.target_peer:
+                st = await self.apply_move(ins.region_id, ins.target_peer,
+                                           ins.src_peer)
+                if not st.is_ok():
+                    LOG.info("pd-ordered move of region %d -> %s failed: "
+                             "%s", ins.region_id, ins.target_peer, st)
+                    self._pd_dirty.add(ins.region_id)
 
     def _heat_report(self, full: bool) -> list[tuple[tuple, float]]:
         """Fold the heat window and pick the led regions whose heat
@@ -1714,3 +1743,253 @@ class StoreEngine:
                 self._pending_splits.discard(new_region_id)
 
         asyncio.ensure_future(boot())
+
+    # -- merge / move (the region lifecycle plane) ---------------------------
+
+    async def apply_merge(self, region_id: int, target_region_id: int,
+                          target_peer: str) -> Status:
+        """Leader-side entry for a PD-ordered cold merge: replicate the
+        seal barrier through the SOURCE group, hand the sealed keyspace
+        to the TARGET group's leader (kv_merge_absorb), then retire the
+        source group with a MERGE_COMMIT entry.
+
+        Every step is retry-safe: the PD's replicated pending-merge map
+        re-issues the instruction until the merge completes, and a
+        resumed attempt skips the already-applied seal (``sealed_into``
+        names the target) while absorb/extend apply idempotently."""
+        engine = self._regions.get(region_id)
+        if engine is None:
+            return Status.error(RaftError.ENOENT, f"region {region_id} absent")
+        node = engine.node
+        if node is None or not engine.is_leader():
+            return Status.error(RaftError.EPERM,
+                                f"not leader of region {region_id}")
+        already = getattr(engine.fsm, "sealed_into", -1)
+        if already >= 0 and already != target_region_id:
+            return Status.error(
+                RaftError.EINVAL,
+                f"region {region_id} already sealed into {already}")
+        if already < 0 and (node._conf_ctx is not None
+                            or not node.conf_entry.old_conf.is_empty()):
+            # DEFER, don't wedge: a seal proposed while a joint conf
+            # change is in flight would interleave two multi-step
+            # protocols on one log — the PD re-issues after the change
+            # completes (satellite 3's merge-vs-conf-change test)
+            return Status.error(
+                RaftError.EBUSY,
+                f"region {region_id} mid-conf-change (merge deferred)")
+        region = engine.region
+        # leader-local barrier half: no NEW write is admitted once the
+        # seal's log position is decided; the FSM's replicated
+        # sealed_into takes over when the entry applies
+        engine.sealing = True
+        try:
+            if already < 0:
+                await engine.raft_store.merge_seal(target_region_id)
+            # capture the range AFTER the seal applies: a split racing
+            # the merge may have shrunk this region up to the seal's
+            # log position (later splits bounce off the sealed guard) —
+            # serializing the pre-split range would hand the target
+            # keys a sibling region now owns
+            src_start, src_end = region.start_key, region.end_key
+            # the blob ALWAYS carries the data: target replicas on
+            # stores that never hosted the source need it (replicas
+            # sharing this raw store re-apply it as an idempotent
+            # overwrite)
+            if self.apply_lane is not None:
+                blob = await self.apply_lane.submit(
+                    self.raw_store.serialize_range, src_start, src_end)
+            else:
+                blob = self.raw_store.serialize_range(src_start, src_end)
+            st = await self._absorb_into_target(
+                target_region_id, target_peer, region_id,
+                src_start, src_end, blob)
+            if not st.is_ok():
+                return st
+            await engine.raft_store.merge_commit(target_region_id)
+        except Exception as e:  # noqa: BLE001
+            return Status.error(RaftError.EINTERNAL, f"merge failed: {e}")
+        self.merges_led += 1
+        RECORDER.record("region_merge", engine.group_id,
+                        node=str(self.server_id), into=target_region_id)
+        LOG.info("region %d merged into %d (store %s)", region_id,
+                 target_region_id, self.server_id)
+        if self.pd_client is not None:
+            try:
+                await self.pd_client.report_merge(region_id,
+                                                  target_region_id)
+            except Exception:  # noqa: BLE001 — the PD also finalizes
+                # from the target's own delta heartbeat (extended range)
+                LOG.warning("report_merge(%d -> %d) failed; the PD will "
+                            "finalize from heartbeats", region_id,
+                            target_region_id, exc_info=True)
+        return Status.OK()
+
+    async def _absorb_into_target(self, target_region_id: int,
+                                  target_peer: str, src_id: int,
+                                  src_start: bytes, src_end: bytes,
+                                  blob: bytes) -> Status:
+        """Hand the sealed source range to the target group's leader —
+        directly when this store leads the target, over the store-to-
+        store ``kv_merge_absorb`` RPC otherwise."""
+        from tpuraft.rheakv.kv_service import MergeAbsorbRequest
+
+        target_engine = self._regions.get(target_region_id)
+        if target_engine is not None and target_engine.is_leader():
+            try:
+                await target_engine.raft_store.merge_absorb(
+                    src_id, src_start, src_end, blob)
+                return Status.OK()
+            except Exception as e:  # noqa: BLE001
+                return Status.error(RaftError.EINTERNAL,
+                                    f"local absorb: {e}")
+        if not target_peer:
+            return Status.error(RaftError.EINVAL,
+                                "no target peer for absorb")
+        try:
+            resp = await self.transport.call(
+                PeerId.parse(target_peer).endpoint, "kv_merge_absorb",
+                MergeAbsorbRequest(
+                    target_region_id=target_region_id,
+                    source_region_id=src_id,
+                    source_start=src_start, source_end=src_end,
+                    data_blob=blob),
+                timeout_ms=max(5000, self.opts.election_timeout_ms * 3))
+        except Exception as e:  # noqa: BLE001
+            return Status.error(RaftError.EINTERNAL, f"absorb rpc: {e}")
+        if resp.code != 0:
+            # EPERM = stale target leader hint; the PD's next issue
+            # carries the fresh leader from its cluster view
+            return Status.error(RaftError.EBUSY,
+                                f"target absorb bounced: {resp.code} "
+                                f"{resp.msg}")
+        return Status.OK()
+
+    async def apply_move(self, region_id: int, target_peer: str,
+                         src_peer: str) -> Status:
+        """PD-ordered replica move: add the destination as a LEARNER
+        (it catches up without voting), then one joint-consensus change
+        promotes it and drops the source replica.  A move whose source
+        is this leader itself hands leadership off first and defers —
+        the joint change needs a leader that stays in the conf."""
+        engine = self._regions.get(region_id)
+        if engine is None:
+            return Status.error(RaftError.ENOENT, f"region {region_id} absent")
+        node = engine.node
+        if node is None or not engine.is_leader():
+            return Status.error(RaftError.EPERM,
+                                f"not leader of region {region_id}")
+        if not src_peer:
+            return Status.error(RaftError.EINVAL, "move needs a source peer")
+        dst = PeerId.parse(target_peer)
+        src = PeerId.parse(src_peer)
+        conf = node.conf_entry.conf
+        if not conf.contains(src):
+            # retried move whose removal already committed
+            return Status.OK() if conf.contains(dst) else Status.error(
+                RaftError.EINVAL, f"{src_peer} not in region {region_id}")
+        if src == node.server_id:
+            for p in conf.peers:
+                if p != src and not conf.is_witness(p):
+                    await engine.transfer_leadership_to(p)
+                    break
+            return Status.error(
+                RaftError.EBUSY,
+                f"region {region_id} leader is the move source; "
+                f"transferring leadership first")
+        if not conf.contains(dst) and dst not in conf.learners:
+            st = await node.add_learners([dst])
+            if not st.is_ok():
+                return st
+            conf = node.conf_entry.conf
+        new_conf = conf.copy()
+        if dst not in new_conf.peers:
+            new_conf.peers.append(dst)
+        new_conf.peers = [p for p in new_conf.peers if p != src]
+        new_conf.learners = [l for l in new_conf.learners if l != dst]
+        st = await node.change_peers(new_conf)
+        if st.is_ok():
+            self.moves_applied += 1
+            self._pd_dirty.add(region_id)
+            RECORDER.record("region_move", engine.group_id,
+                            node=str(self.server_id), src=src_peer,
+                            dst=target_peer)
+            LOG.info("region %d replica moved %s -> %s", region_id,
+                     src_peer, target_peer)
+        return st
+
+    def do_absorb(self, region_id: int, src_id: int, src_start: bytes,
+                  src_end: bytes) -> None:
+        """Loop-side metadata half of a MERGE_ABSORB apply (invoked on
+        EVERY replica of the target group): extend the region over the
+        absorbed range, fold lifecycle bookkeeping.  The absorbed data
+        itself already landed via ``load_serialized`` in the store-
+        owning context."""
+        from tpuraft.rheakv.state_machine import extend_region_over
+
+        engine = self._regions.get(region_id)
+        if engine is None:
+            LOG.warning("absorb for unknown region %d (src %d) dropped",
+                        region_id, src_id)
+            return
+        try:
+            extend_region_over(engine.region, src_start, src_end)
+        except RuntimeError:
+            LOG.exception("region %d cannot absorb [%r, %r)", region_id,
+                          src_start, src_end)
+            return
+        self.regions_absorbed += 1
+        if self.heat is not None:
+            # the source's standing rates now land on this region —
+            # let them re-accumulate under the merged id
+            self.heat.drop(src_id)
+        self._pd_dirty.add(region_id)
+
+    def do_retire(self, region_id: int, target_id: int) -> None:
+        """Loop-side MERGE_COMMIT apply (every source replica): drop the
+        merged-away region from the serving table and shut its raft
+        group down asynchronously.  The absorbed keyspace is NEVER
+        wiped — on a shared per-store raw store the target region (or
+        its replica on another store) serves those rows now."""
+        engine = self._regions.pop(region_id, None)
+        if engine is None:
+            return  # idempotent: replayed commit entry after a restart
+        self._leader_regions.discard(region_id)
+        self._pd_reported.pop(region_id, None)
+        self._pd_dirty.discard(region_id)
+        self._pd_heat_reported.pop(region_id, None)
+        self._evac_cooldown.pop(region_id, None)
+        self._reclaim_cooldown.pop(region_id, None)
+        if self.heat is not None:
+            self.heat.drop(region_id)
+        self.regions_retired += 1
+        RECORDER.record("region_retired", engine.group_id,
+                        node=str(self.server_id), into=target_id)
+        LOG.info("region %d retired into %d (store %s)", region_id,
+                 target_id, self.server_id)
+
+        async def _stop():
+            # propagation grace: the replica that applied MERGE_COMMIT
+            # first is usually the LEADER — shutting its node down at
+            # its own apply would strand followers before the advanced
+            # commit index reaches them (each successor leader then
+            # retires itself the same way until the last replica is
+            # alone without a quorum, wedged un-retired forever).  Keep
+            # the node voting/appending for a few election timeouts so
+            # every replica hears the commit; the region is already out
+            # of the serving table either way.
+            try:
+                await asyncio.sleep(
+                    self.opts.election_timeout_ms * 3 / 1000.0)
+                await engine.shutdown()
+            except Exception:  # noqa: BLE001
+                LOG.exception("retiring region %d shutdown failed",
+                              region_id)
+
+        asyncio.ensure_future(_stop())
+
+    def on_region_conf_changed(self, region_id: int) -> None:
+        """FSM hook: a committed conf entry changed the replica roster
+        (move promotion/removal) — force a fresh PD report so the route
+        plane and the placement policy see the new peers/conf_ver."""
+        self._pd_dirty.add(region_id)
