@@ -527,3 +527,175 @@ def test_replayed_split_report_cannot_resurrect_merged_region():
     # finalizing the same merge again is not "fresh" (no double count)
     assert fsm._dispatch(
         _cmd(_CMD_MERGE, struct.pack("<qq", 1024, 2))) is False
+
+
+# ---- regression: merge finalization safety (review findings) ---------------
+
+
+async def test_target_coverage_alone_never_finalizes_pending_merge(tmp_path):
+    """Regression: the TARGET's extended range proves the absorb
+    committed — NOT that the source's MERGE_COMMIT is durable.  If the
+    PD tombstoned the pending pair on coverage alone, a source leader
+    crash between the absorb and the commit would stop the KIND_MERGE
+    re-issue (the only path that proposes MERGE_COMMIT) and leave the
+    sealed source group alive forever, serving stale sealed GETs for
+    keyspace the target now owns.  The pending pair must survive the
+    coverage report, keep re-issuing, and finalize only on an explicit
+    pd_report_merge from the source group."""
+    import struct
+
+    from tpuraft.rheakv.pd_messages import (
+        Instruction, ReportMergeRequest, StoreHeartbeatBatchRequest,
+        encode_region_delta)
+    from tpuraft.rheakv.pd_server import _CMD_MERGE_ISSUED, _cmd
+
+    c = PDTestCluster(
+        n_stores=0, n_pd=1, tmp_path=tmp_path,
+        pd_opts={"lifecycle": True,
+                 # the policy must not order merges of its own: this
+                 # test injects the pending pair by hand
+                 "lifecycle_min_regions": 99,
+                 "lifecycle_merge_cooldown_s": 0.01})
+    for ep in c.pd_endpoints:
+        await c.start_pd(ep)
+    try:
+        pd = await c.wait_pd_leader()
+        pd_client = c.pd_client()
+        store_ep = "127.0.0.1:9001"
+
+        def hb(regions):
+            return pd_client._call(
+                "pd_store_heartbeat_batch",
+                StoreHeartbeatBatchRequest(
+                    store_id=1, endpoint=store_ep,
+                    deltas=[encode_region_delta(r.encode(), store_ep, 5)
+                            for r in regions],
+                    full=True))
+
+        src = Region(id=1, start_key=b"", end_key=b"m", peers=[store_ep])
+        tgt = Region(id=2, start_key=b"m", end_key=b"", peers=[store_ep])
+        resp = await hb([src, tgt])
+        assert resp.success
+        # replicate the pending (1 -> 2) pair, as _lifecycle_pass would
+        assert await pd._apply(
+            _cmd(_CMD_MERGE_ISSUED, struct.pack("<qq", 1, 2))) == 2
+        # the absorb commits at the target: it reports its EXTENDED
+        # range (covering the source) under a bumped epoch — the exact
+        # window where the source's MERGE_COMMIT may not be durable yet
+        grown = Region(id=2, start_key=b"", end_key=b"",
+                       peers=[store_ep])
+        grown.epoch.version = 2
+        await asyncio.sleep(0.05)   # clear the merge_reissue pacing
+        resp = await hb([src, grown])
+        assert resp.success
+        # coverage must NOT finalize: pending survives, no tombstone
+        assert pd.fsm.pending_merges == {1: 2}
+        assert 1 in pd.fsm.regions
+        assert 1 not in pd.fsm.retired_regions
+        assert pd.merges_completed == 0
+        # ...and the KIND_MERGE keeps re-issuing toward the source
+        ins = [Instruction.decode(b) for b in resp.instructions]
+        merges = [i for i in ins if i.kind == Instruction.KIND_MERGE]
+        assert merges, "pending merge stopped re-issuing"
+        assert merges[0].region_id == 1
+        assert merges[0].new_region_id == 2
+        # only the source group's explicit completion report finalizes
+        await pd_client._call("pd_report_merge", ReportMergeRequest(
+            source_region_id=1, target_region_id=2))
+        assert pd.fsm.pending_merges == {}
+        assert 1 not in pd.fsm.regions
+        assert pd.fsm.retired_regions[1] == 2
+        assert pd.merges_completed == 1
+        assert coverage_errors(pd.fsm.regions.values()) == []
+    finally:
+        await c.stop_all()
+
+
+def test_duplicate_absorb_does_not_roll_back_target_writes():
+    """Regression: a re-issued MERGE_ABSORB (the PD retrying after a
+    lost ack) carries the sealed source's ORIGINAL blob; reloading it
+    after the first absorb landed would resurrect stale source values
+    over writes the target accepted in its extended range since (lost
+    updates).  Containment-first makes the duplicate a pure no-op —
+    no data load, no epoch bump."""
+    from tpuraft.rheakv.kv_operation import KVOperation
+    from tpuraft.rheakv.raw_store import MemoryRawKVStore
+    from tpuraft.rheakv.state_machine import KVStoreStateMachine
+
+    src_store = MemoryRawKVStore()
+    src_store.put(b"a", b"stale")
+    blob = src_store.serialize_range(b"", b"m")
+
+    tgt_store = MemoryRawKVStore()
+    region = Region(id=2, start_key=b"m", end_key=b"")
+    fsm = KVStoreStateMachine(region, tgt_store)
+    absorb = KVOperation.merge_absorb(1, b"", b"m", blob)
+    assert fsm._dispatch(absorb) is True
+    assert (region.start_key, region.end_key) == (b"", b"")
+    assert tgt_store.get(b"a") == b"stale"
+    ver = region.epoch.version
+    # the target accepts a write in its extended range...
+    tgt_store.put(b"a", b"fresh")
+    # ...then the duplicate absorb arrives: no rollback, no epoch bump
+    assert fsm._dispatch(absorb) is True
+    assert tgt_store.get(b"a") == b"fresh"
+    assert region.epoch.version == ver
+
+
+def test_pd_merge_finalize_non_adjacent_degrades_gracefully():
+    """Regression: _CMD_MERGE runs inside the replicated PD FSM apply;
+    a non-adjacent pair (policy bug / metadata skew) must degrade to a
+    logged violation, never throw out of on_apply on every replica."""
+    import struct
+
+    from tpuraft.rheakv.pd_server import (
+        _CMD_MERGE, _CMD_REGION_UPSERT, PDMetadataFSM, _cmd)
+
+    fsm = PDMetadataFSM()
+    lb = EP[0].encode()
+    for region in (_r(1, b"", b"g"), _r(2, b"t", b"")):
+        fsm._dispatch(_cmd(
+            _CMD_REGION_UPSERT,
+            struct.pack("<H", len(lb)) + lb + region.encode()))
+    # regions 1 and 2 are NOT adjacent: the apply must not raise
+    assert fsm._dispatch(
+        _cmd(_CMD_MERGE, struct.pack("<qq", 1, 2))) is True
+    assert fsm.retired_regions[1] == 2
+    # the target's range is left for heartbeat repair, not torn
+    assert fsm.regions[2].start_key == b"t"
+    assert fsm.regions[2].end_key == b""
+
+
+async def test_failed_seal_propose_clears_leader_local_sealing():
+    """Regression: engine.sealing is set at propose time; if the seal
+    never applies (propose failed / leadership lost mid-attempt) the
+    flag must clear, or a regained leadership would bounce every write
+    ERR_STORE_BUSY on a region that was never sealed."""
+    async with kv_cluster(regions=_two_region_template()) as c:
+        l1 = await c.wait_region_leader(1)
+        l2 = await c.wait_region_leader(2)
+        tp = str(l2.node.server_id)
+
+        async def boom(_target_id):
+            raise RuntimeError("propose lost with leadership")
+
+        l1.raft_store.merge_seal = boom
+        st = await l1.store_engine.apply_merge(1, 2, tp)
+        assert st.code == RaftError.EINTERNAL, str(st)
+        assert getattr(l1.fsm, "sealed_into", -1) == -1
+        assert l1.sealing is False, \
+            "leader-local seal flag leaked after a failed attempt"
+        # the region still serves writes and a retried merge completes
+        assert await l1.raft_store.put(b"pre", b"merge")
+        del l1.raft_store.merge_seal    # restore the real propose path
+        st = await l1.store_engine.apply_merge(1, 2, tp)
+        assert st.is_ok(), str(st)
+        await _wait(lambda: all(s.get_region_engine(1) is None
+                                for s in c.stores.values()),
+                    what="retried merge completion")
+        # every store remembers the retirement, so a re-issued
+        # KIND_MERGE after a lost report is answered with a fresh one
+        for s in c.stores.values():
+            assert s._retired_into.get(1) == 2
+        l2 = await c.wait_region_leader(2)
+        assert await l2.raft_store.get(b"pre") == b"merge"

@@ -194,13 +194,16 @@ def decode_region_delta(blob: bytes) -> tuple[bytes, str, int]:
 
 @_pd(156)
 class ReportMergeRequest:
-    """Lifecycle plane: the SOURCE region's leader store reports a
-    completed merge (seal + absorb + commit all applied) so the PD
-    finalizes its replicated metadata — extend the target's range over
-    the source's, drop the source region, clear the pending-merge
-    entry.  Belt-and-braces: the PD also finalizes from the target's
-    own delta heartbeat (its extended range covers the source), so a
-    lost report only delays the bookkeeping."""
+    """Lifecycle plane: a SOURCE region's store reports a completed
+    merge (seal + absorb + commit all applied) so the PD finalizes its
+    replicated metadata — extend the target's range over the source's,
+    drop the source region, clear the pending-merge entry.  This report
+    is the ONLY finalization trigger (the target's extended range
+    proves the absorb, not that the source's MERGE_COMMIT is durable),
+    so it is sent redundantly: by the source leader after commit, by
+    every replica at its MERGE_COMMIT apply, and by any store answering
+    a re-issued KIND_MERGE for a region it already retired.  Idempotent
+    at the PD (the retirement tombstone counts once)."""
 
     source_region_id: int = 0
     target_region_id: int = 0

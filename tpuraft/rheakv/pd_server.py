@@ -78,18 +78,6 @@ def _peer_endpoint(peer_str: str) -> str:
     return ":".join(peer_str.split("/", 1)[0].split(":")[:2])
 
 
-def _range_covers(region: Region, src: Region) -> bool:
-    """True when ``region``'s range already covers ``src``'s — the
-    same containment test extend_region_over's idempotency guard runs
-    (b"" bounds are -inf/+inf sentinels)."""
-    lo_ok = (region.start_key == b"" if src.start_key == b""
-             else region.start_key == b""
-             or region.start_key <= src.start_key)
-    hi_ok = (region.end_key == b"" if src.end_key == b""
-             else region.end_key == b"" or src.end_key <= region.end_key)
-    return lo_ok and hi_ok
-
-
 def zone_leader_histogram(region_leaders: dict[int, str],
                           zones: dict[str, str]) -> dict[str, int]:
     """Leaders per zone — computed ONCE per heartbeat batch and shared
@@ -220,8 +208,21 @@ class PDMetadataFSM(StateMachine):
             if src is not None and tgt is not None:
                 # same deterministic extension the target replicas ran
                 # (idempotent: a heartbeat may have upserted the
-                # already-extended target first)
-                extend_region_over(tgt, src.start_key, src.end_key)
+                # already-extended target first).  NEVER throw out of
+                # on_apply: a non-adjacent pair (a policy bug, or
+                # metadata skew from a stale report) must degrade to a
+                # logged violation, not crash the apply loop on every
+                # PD replica — the next target heartbeat re-upserts the
+                # true range either way.
+                try:
+                    extend_region_over(tgt, src.start_key, src.end_key)
+                except RuntimeError:
+                    LOG.error(
+                        "merge finalize %d -> %d: source range "
+                        "[%r, %r) not adjacent to target [%r, %r); "
+                        "keyspace left to heartbeat repair", src_id,
+                        tgt_id, src.start_key, src.end_key,
+                        tgt.start_key, tgt.end_key)
             if self.pending_merges.get(src_id) == tgt_id:
                 self.pending_merges.pop(src_id, None)
             # True only for the FIRST finalization of this source: the
@@ -1097,19 +1098,19 @@ class PlacementDriverServer:
             await self._apply(_cmd(_CMD_REGION_UPSERT, payload))
         self.stats.record(region.id, approximate_keys)
         instructions: list[Instruction] = []
-        # -- lifecycle: merge finalization (belt-and-braces) ----------------
-        # the TARGET's own report shows its extended range covering a
-        # pending source: the absorb committed even if the source
-        # leader's pd_report_merge was lost — finalize from here
-        for src_id, tgt_id in list(self.fsm.pending_merges.items()):
-            if tgt_id != region.id:
-                continue
-            src = self.fsm.regions.get(src_id)
-            if src is not None and _range_covers(region, src):
-                if await self._apply(_cmd(
-                        _CMD_MERGE, struct.pack("<qq", src_id, tgt_id))):
-                    self.merges_completed += 1
-                self.stats.drop(src_id)
+        # NOTE: the PD never finalizes a pending merge from the
+        # TARGET's coverage alone.  The target's extended range proves
+        # the absorb committed, but NOT that the source's MERGE_COMMIT
+        # is durable — if the source leader crashed in that window,
+        # tombstoning here would stop the KIND_MERGE re-issue (the only
+        # path that proposes MERGE_COMMIT) and leave the sealed source
+        # group alive forever, serving stale linearizable GETs for
+        # keyspace the target now owns.  Finalization waits for a
+        # pd_report_merge from the source group (its leader after
+        # commit, every replica at MERGE_COMMIT apply, and any store
+        # answering a re-issued instruction for a region it already
+        # retired); until one lands, the re-issue arm below keeps
+        # driving the source to completion.
         # -- lifecycle: pending-merge re-issue ------------------------------
         pending_merge_tgt = self.fsm.pending_merges.get(region.id)
         if pending_merge_tgt is not None:

@@ -22,6 +22,20 @@ from tpuraft.rheakv.raw_store import RawKVStore
 LOG = logging.getLogger(__name__)
 
 
+def range_covers(region: Region, src_start: bytes,
+                 src_end: bytes) -> bool:
+    """True when ``region``'s range already contains ``[src_start,
+    src_end)`` (b"" bounds are -inf/+inf sentinels).  Regions tile the
+    keyspace disjointly, so containment of another region's range can
+    only mean "absorbed before" — this is the idempotency test both
+    the absorb apply and the PD's merge bookkeeping rely on."""
+    lo_ok = (region.start_key == b"" if src_start == b""
+             else region.start_key == b"" or region.start_key <= src_start)
+    hi_ok = (region.end_key == b"" if src_end == b""
+             else region.end_key == b"" or src_end <= region.end_key)
+    return lo_ok and hi_ok
+
+
 def extend_region_over(region: Region, src_start: bytes,
                        src_end: bytes) -> None:
     """Extend ``region``'s keyspace over an ADJACENT absorbed range and
@@ -33,14 +47,8 @@ def extend_region_over(region: Region, src_start: bytes,
 
     Idempotent: a range the region ALREADY covers (a resumed merge
     re-absorbing after a source-leader retry, or log replay over a
-    snapshot that post-dates the absorb) is a no-op — regions tile the
-    keyspace disjointly, so containment can only mean "absorbed
-    before"."""
-    lo_ok = (region.start_key == b"" if src_start == b""
-             else region.start_key == b"" or region.start_key <= src_start)
-    hi_ok = (region.end_key == b"" if src_end == b""
-             else region.end_key == b"" or src_end <= region.end_key)
-    if lo_ok and hi_ok:
+    snapshot that post-dates the absorb) is a no-op (``range_covers``)."""
+    if range_covers(region, src_start, src_end):
         return
     if src_end != b"" and src_end == region.start_key:
         region.start_key = src_start          # source sat to our LEFT
@@ -287,6 +295,15 @@ class KVStoreStateMachine(StateMachine):
         if code == KVOp.MERGE_ABSORB:
             src_id, src_start, src_end = \
                 KVOperation.unpack_merge_absorb(op.aux)
+            # containment FIRST: a duplicate absorb (the PD re-issuing
+            # the pending pair after a lost ack, racing the first
+            # absorb's completion) carries the sealed source's blob —
+            # loading it again would roll back writes this region
+            # accepted in its extended range since the first absorb
+            # (lost updates).  Covered range == absorbed before; skip
+            # the data load AND the (no-op) extension.
+            if range_covers(self.region, src_start, src_end):
+                return True
             # data first, in the store-owning context (idempotent
             # overwrite: on a shared per-store raw store the source's
             # rows are already physically present)

@@ -738,6 +738,13 @@ class StoreEngine:
         self.regions_retired = 0   # source replicas retired (merged away)
         self.regions_absorbed = 0  # absorb applies folded into a target
         self.moves_applied = 0     # PD-ordered replica moves executed
+        # regions this store retired (merged away) -> absorbing target.
+        # The PD only finalizes a pending merge on an explicit report,
+        # so a re-issued KIND_MERGE that arrives after local retirement
+        # is answered from this map with a fresh report (the original
+        # may have been lost with a crashed leader).  Repopulated by
+        # MERGE_COMMIT replay after a restart.
+        self._retired_into: dict[int, int] = {}
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._meta_journal = None  # store-lifetime ref (multilog scheme)
         # delta-batched PD reporting state: region -> (fingerprint,
@@ -1441,6 +1448,22 @@ class StoreEngine:
         for ins in instructions:
             engine = self._regions.get(ins.region_id)
             if engine is None or not engine.is_leader():
+                if ins.kind == Instruction.KIND_MERGE and \
+                        self._retired_into.get(ins.region_id) == \
+                        ins.new_region_id:
+                    # re-issued merge for a region this store already
+                    # retired: the completion reports were all lost
+                    # (PD down/partitioned across the merge) — answer
+                    # with a fresh one so the PD finalizes the pending
+                    # pair instead of re-issuing forever
+                    try:
+                        await self.pd_client.report_merge(
+                            ins.region_id, ins.new_region_id)
+                    except Exception:  # noqa: BLE001 — next round
+                        LOG.debug("retired-merge report %d -> %d "
+                                  "failed; will answer the next "
+                                  "re-issue", ins.region_id,
+                                  ins.new_region_id, exc_info=True)
                 continue
             if ins.kind == Instruction.KIND_SPLIT:
                 st = await self.apply_split(ins.region_id,
@@ -1781,7 +1804,11 @@ class StoreEngine:
         region = engine.region
         # leader-local barrier half: no NEW write is admitted once the
         # seal's log position is decided; the FSM's replicated
-        # sealed_into takes over when the entry applies
+        # sealed_into takes over when the entry applies.  If the seal
+        # never applies (propose failed, leadership lost mid-attempt)
+        # the flag is cleared in the finally below — otherwise a
+        # regained leadership would bounce every write ERR_STORE_BUSY
+        # on a region that was never actually sealed.
         engine.sealing = True
         try:
             if already < 0:
@@ -1809,6 +1836,9 @@ class StoreEngine:
             await engine.raft_store.merge_commit(target_region_id)
         except Exception as e:  # noqa: BLE001
             return Status.error(RaftError.EINTERNAL, f"merge failed: {e}")
+        finally:
+            if getattr(engine.fsm, "sealed_into", -1) < 0:
+                engine.sealing = False
         self.merges_led += 1
         RECORDER.record("region_merge", engine.group_id,
                         node=str(self.server_id), into=target_region_id)
@@ -1818,11 +1848,14 @@ class StoreEngine:
             try:
                 await self.pd_client.report_merge(region_id,
                                                   target_region_id)
-            except Exception:  # noqa: BLE001 — the PD also finalizes
-                # from the target's own delta heartbeat (extended range)
-                LOG.warning("report_merge(%d -> %d) failed; the PD will "
-                            "finalize from heartbeats", region_id,
-                            target_region_id, exc_info=True)
+            except Exception:  # noqa: BLE001 — every replica's
+                # MERGE_COMMIT apply (do_retire) also reports, and a
+                # re-issued KIND_MERGE for the retired region is
+                # answered with a fresh report — the PD hears about
+                # the completion through one of those
+                LOG.warning("report_merge(%d -> %d) failed; replica "
+                            "retirement reports will finalize",
+                            region_id, target_region_id, exc_info=True)
         return Status.OK()
 
     async def _absorb_into_target(self, target_region_id: int,
@@ -1951,6 +1984,7 @@ class StoreEngine:
         group down asynchronously.  The absorbed keyspace is NEVER
         wiped — on a shared per-store raw store the target region (or
         its replica on another store) serves those rows now."""
+        self._retired_into[region_id] = target_id
         engine = self._regions.pop(region_id, None)
         if engine is None:
             return  # idempotent: replayed commit entry after a restart
@@ -1967,6 +2001,30 @@ class StoreEngine:
                         node=str(self.server_id), into=target_id)
         LOG.info("region %d retired into %d (store %s)", region_id,
                  target_id, self.server_id)
+        if self.pd_client is not None:
+            # replica-side completion report: the source LEADER's
+            # apply_merge report is lost if it crashes between the
+            # MERGE_COMMIT committing and the RPC landing — and a fully
+            # retired group stops heartbeating, so without this the
+            # PD's pending pair would re-issue into the void forever.
+            # Every replica reports at its own commit apply (the PD's
+            # _CMD_MERGE is idempotent and counts once), with a few
+            # paced retries to ride out a PD failover.
+            async def _report():
+                for delay in (0.0, 0.5, 2.0, 8.0):
+                    try:
+                        await asyncio.sleep(delay)
+                        await self.pd_client.report_merge(region_id,
+                                                          target_id)
+                        return
+                    except Exception:  # noqa: BLE001
+                        continue
+                LOG.warning(
+                    "retirement report %d -> %d never landed; the PD "
+                    "will hear it when a re-issued merge instruction "
+                    "reaches this store", region_id, target_id)
+
+            asyncio.ensure_future(_report())
 
         async def _stop():
             # propagation grace: the replica that applied MERGE_COMMIT
